@@ -1,0 +1,105 @@
+"""Golden fixtures mirroring the reference's correctness oracle.
+
+The two typical-pod distributions below are the data fixtures of
+pkg/utils/frag_test.go:13-87 (37-spec and 31-spec target workloads); the
+expected values in test_frag.py are the asserted golden numbers from that
+file. GPU type strings are encoded as model bitmasks.
+"""
+
+from tpusim.constants import gpu_spec_to_mask
+from tpusim.types import make_typical_pods
+
+# (cpu_milli, gpu_milli, gpu_num, gpu_spec, percentage)
+_TYPICAL_GPU = [
+    (6000, 465, 1, "", 9.33),
+    (8000, 440, 1, "2080", 9.15),
+    (8000, 475, 1, "T4", 8.76),
+    (8000, 440, 1, "P100", 8.72),
+    (2000, 465, 1, "", 8.68),
+    (12000, 900, 1, "", 8.65),
+    (4000, 900, 1, "", 8.43),
+    (16000, 678, 1, "T4", 8.36),
+    (8000, 500, 1, "", 8.29),
+    (6000, 511, 1, "", 8.11),
+    (14000, 1000, 2, "2080", 0.54),
+    (4000, 1000, 1, "2080", 0.43),
+    (32000, 1000, 2, "T4", 0.43),
+    (16000, 1000, 1, "V100M16", 0.40),
+    (64000, 1000, 2, "", 0.40),
+    (10000, 1000, 2, "", 0.40),
+    (11400, 1000, 1, "T4", 0.36),
+    (16000, 1000, 1, "T4", 0.36),
+    (4000, 1000, 2, "", 0.36),
+    (14000, 1000, 2, "V100M16", 0.36),
+    (8000, 1000, 4, "", 0.36),
+    (16000, 1000, 2, "", 0.32),
+    (2000, 1000, 1, "T4", 0.32),
+    (6000, 1000, 1, "", 0.32),
+    (4000, 1000, 1, "", 0.32),
+    (5000, 1000, 1, "", 0.32),
+    (32000, 1000, 4, "V100M16", 0.32),
+    (32000, 1000, 2, "", 0.32),
+    (24000, 1000, 8, "2080", 0.32),
+    (40000, 1000, 4, "", 0.29),
+    (32000, 1000, 8, "", 0.29),
+    (32000, 1000, 1, "T4", 0.29),
+    (16000, 1000, 1, "", 0.25),
+    (7000, 1000, 1, "V100M16", 0.25),
+    (24000, 1000, 1, "T4", 0.25),
+]
+
+_TYPICAL_WITH_NONGPU = [
+    (15700, 1000, 1, "", 28.69),
+    (11900, 1000, 1, "", 18.93),
+    (11400, 1000, 1, "", 12.27),
+    (1000, 0, 0, "", 7.36),
+    (18710, 1000, 1, "", 4.85),
+    (8200, 1000, 1, "", 3.79),
+    (16400, 1000, 1, "", 3.31),
+    (9810, 1000, 1, "", 1.97),
+    (15200, 1000, 1, "", 1.87),
+    (11200, 1000, 1, "", 1.81),
+    (14200, 1000, 1, "", 1.76),
+    (12000, 0, 0, "", 1.65),
+    (14900, 1000, 1, "", 1.39),
+    (60200, 1000, 4, "", 1.23),
+    (64200, 1000, 8, "", 1.07),
+    (32200, 1000, 4, "", 1.01),
+    (17400, 1000, 2, "", 0.91),
+    (30200, 1000, 2, "", 0.69),
+    (16000, 1000, 1, "", 0.64),
+    (15000, 1000, 1, "", 0.59),
+    (64000, 1000, 8, "", 0.53),
+    (15000, 0, 0, "", 0.53),
+    (11910, 1000, 1, "", 0.53),
+    (120200, 1000, 8, "", 0.48),
+    (11300, 1000, 1, "", 0.37),
+    (30000, 1000, 2, "", 0.32),
+    (9800, 1000, 1, "", 0.32),
+    (8000, 1000, 1, "", 0.32),
+    (2000, 1000, 1, "", 0.27),
+    (2000, 80, 1, "", 0.27),
+    (1000, 1000, 1, "", 0.27),
+]
+
+
+def _rows(table):
+    return [
+        (cpu, milli, num, gpu_spec_to_mask(spec), pct / 100.0)
+        for cpu, milli, num, spec, pct in table
+    ]
+
+
+def typical_pods_gpu():
+    """frag_test.go:13-51 TestingGenerateGetTypicalPods (35 specs)."""
+    return make_typical_pods(_rows(_TYPICAL_GPU))
+
+
+def typical_pods_with_nongpu():
+    """frag_test.go:53-87 TestingGenerateGetTypicalPodsWithNonGpu (31 specs)."""
+    return make_typical_pods(_rows(_TYPICAL_WITH_NONGPU))
+
+
+def typical_rows_gpu_host():
+    """Same distribution as host-side tuples for the Bellman reference."""
+    return _rows(_TYPICAL_GPU)

@@ -1,0 +1,129 @@
+"""Golden-value tests of the fragmentation math.
+
+Expected numbers are the asserted values of pkg/utils/frag_test.go (the
+reference's correctness oracle): TestNodeGpuShareFragAmount[Score],
+TestNodeGpuShareFragAmountWithNonGpu, TestGetGpuFragMilliByNodeResAndPodRes,
+TestNodeGpuFragAmountBellman_EightGpu.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.fixtures import (
+    typical_pods_gpu,
+    typical_pods_with_nongpu,
+    typical_rows_gpu_host,
+)
+from tpusim.constants import GPU_MODEL_IDS, Q4_LACK_CPU
+from tpusim.ops import frag
+from tpusim.ops.resource import gpu_frag_milli
+from tpusim.types import make_typical_pods
+
+
+def node(cpu_left, gpus, gpu_type):
+    g = np.zeros(8, np.int32)
+    g[: len(gpus)] = gpus
+    return jnp.int32(cpu_left), jnp.asarray(g), jnp.int32(GPU_MODEL_IDS[gpu_type])
+
+
+def score(cpu_left, gpus, gpu_type, tp):
+    c, g, t = node(cpu_left, gpus, gpu_type)
+    return float(frag.node_frag_score(c, g, t, tp))
+
+
+class TestNodeGpuShareFragAmountScore:
+    # frag_test.go:100-121 / 142-163
+    def test_4x1080_used(self):
+        tp = typical_pods_gpu()
+        assert score(1000, [200, 1000, 1000, 500], "1080", tp) == pytest.approx(
+            2566.62, abs=0.05
+        )
+
+    def test_4x1080_full(self):
+        tp = typical_pods_gpu()
+        assert score(1000, [1000, 1000, 1000, 1000], "1080", tp) == pytest.approx(
+            3802.40, abs=0.05
+        )
+
+    def test_8x1080_full(self):
+        tp = typical_pods_gpu()
+        assert score(1000, [1000] * 8, "1080", tp) == pytest.approx(7604.80, abs=0.05)
+
+    def test_single_spec_lack_cpu(self):
+        tp = make_typical_pods([(6000, 465, 1, 0, 9.33 / 100)])
+        c, g, t = node(1000, [200, 1000, 1000, 500], "1080")
+        assert int(frag.frag_class(c, g, t, tp)[0]) == Q4_LACK_CPU
+        assert int(g.sum()) == 2700
+        assert score(1000, [200, 1000, 1000, 500], "1080", tp) == pytest.approx(
+            251.91, abs=0.01
+        )
+
+
+class TestNodeGpuShareFragAmountWithNonGpu:
+    # frag_test.go:123-140
+    def test_8xP100_empty(self):
+        tp = typical_pods_with_nongpu()
+        assert score(64000, [1000] * 8, "P100", tp) == pytest.approx(887.20, abs=0.05)
+
+    def test_8xP100_halved(self):
+        tp = typical_pods_with_nongpu()
+        assert score(32000, [1000] * 4 + [0] * 4, "P100", tp) == pytest.approx(
+            554.4, abs=0.05
+        )
+
+    def test_8xP100_nocpu(self):
+        tp = typical_pods_with_nongpu()
+        assert score(0, [1000] * 4 + [0] * 4, "P100", tp) == pytest.approx(
+            4000, abs=0.05
+        )
+
+
+class TestGetGpuFragMilli:
+    # frag_test.go:165-185
+    def test_cases(self):
+        g1 = jnp.asarray(np.array([200, 1000, 1000, 500, 0, 0, 0, 0], np.int32))
+        assert int(gpu_frag_milli(g1, jnp.int32(1000))) == 700
+        full4 = jnp.asarray(
+            np.array([1000, 1000, 1000, 1000, 0, 0, 0, 0], np.int32)
+        )
+        assert int(gpu_frag_milli(full4, jnp.int32(1000))) == 0
+        full8 = jnp.asarray(np.full(8, 1000, np.int32))
+        assert int(gpu_frag_milli(full8, jnp.int32(1000))) == 0
+        assert int(gpu_frag_milli(g1, jnp.int32(200))) == 0
+
+
+def test_bellman_eight_gpu():
+    # frag_test.go:89-98: node with 78000 mCPU, 8 GPUs [6x1000, 535, 70],
+    # V100M32, 35-spec distribution → 160.73
+    rows = typical_rows_gpu_host()
+    val = frag.node_frag_bellman(
+        (78000, [1000] * 6 + [535, 70], GPU_MODEL_IDS["V100M32"]), rows
+    )
+    assert val == pytest.approx(160.73, abs=0.05)
+
+
+def test_cluster_report_shapes():
+    from tpusim.types import make_node_state
+
+    tp = typical_pods_gpu()
+    state = make_node_state(
+        cpu_cap=[64000, 32000],
+        mem_cap=[262144, 131072],
+        gpu_cnt=[4, 0],
+        gpu_type=[GPU_MODEL_IDS["1080"], -1],
+    )
+    amounts, frag_milli, frag_ratio, q124 = frag.cluster_frag_report(state, tp)
+    assert amounts.shape == (7,)
+    # all-idle 4x1080 node: frag == the 3802.40 golden value; CPU node adds 0
+    assert float(frag_milli) == pytest.approx(
+        frag.frag_sum_except_q3(
+            frag.node_frag_amounts(
+                jnp.int32(64000),
+                jnp.asarray(np.array([1000] * 4 + [0] * 4, np.int32)),
+                jnp.int32(GPU_MODEL_IDS["1080"]),
+                tp,
+            )
+        ),
+        rel=1e-5,
+    )

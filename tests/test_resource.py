@@ -1,0 +1,110 @@
+"""Resource-algebra tests (ref: pkg/type/resource_test.go semantics:
+Flatten sort+pad, Sub packs least-free fitting GPUs first, Add returns
+resources to given devices)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusim.ops import resource as res
+from tpusim.types import make_pod
+
+
+def g(*vals):
+    a = np.zeros(8, np.int32)
+    a[: len(vals)] = vals
+    return jnp.asarray(a)
+
+
+def test_flatten_sorts_desc_and_pads():
+    assert res.flatten_gpu_left(g(200, 1000, 1000, 500)).tolist() == [
+        1000,
+        1000,
+        500,
+        200,
+        0,
+        0,
+        0,
+        0,
+    ]
+
+
+def test_sub_packs_least_free_first():
+    # share pod 300m goes to the tightest fitting device (500, idx 3)
+    pod = make_pod(cpu=1000, gpu_milli=300, gpu_num=1)
+    cpu, mem, gpu, mask, ok = res.sub_pod(
+        jnp.int32(4000), jnp.int32(0), g(200, 1000, 1000, 500), pod
+    )
+    assert bool(ok)
+    assert int(cpu) == 3000
+    assert gpu.tolist()[:4] == [200, 1000, 1000, 200]
+    assert mask.tolist()[:4] == [False, False, False, True]
+
+
+def test_sub_whole_gpus_tie_by_index():
+    pod = make_pod(cpu=0, gpu_milli=1000, gpu_num=2)
+    _, _, gpu, mask, ok = res.sub_pod(
+        jnp.int32(1000), jnp.int32(0), g(1000, 1000, 1000, 1000), pod
+    )
+    assert bool(ok)
+    assert mask.tolist()[:4] == [True, True, False, False]
+    assert gpu.tolist()[:4] == [0, 0, 1000, 1000]
+
+
+def test_sub_infeasible():
+    pod = make_pod(cpu=0, gpu_milli=1000, gpu_num=3)
+    *_, ok = res.sub_pod(jnp.int32(1000), jnp.int32(0), g(1000, 500, 1000), pod)
+    assert not bool(ok)
+    pod = make_pod(cpu=9999, gpu_milli=0, gpu_num=0)
+    *_, ok = res.sub_pod(jnp.int32(1000), jnp.int32(0), g(1000), pod)
+    assert not bool(ok)
+
+
+def test_add_inverts_sub():
+    pod = make_pod(cpu=2000, mem=100, gpu_milli=450, gpu_num=1)
+    cpu0, mem0, gpu0 = jnp.int32(8000), jnp.int32(500), g(700, 1000, 250, 0)
+    cpu1, mem1, gpu1, mask, ok = res.sub_pod(cpu0, mem0, gpu0, pod)
+    assert bool(ok)
+    cpu2, mem2, gpu2 = res.add_pod(cpu1, mem1, gpu1, pod, mask)
+    assert int(cpu2) == 8000 and int(mem2) == 500
+    assert gpu2.tolist() == gpu0.tolist()
+
+
+def test_can_host_and_allocate():
+    gl = g(200, 1000, 1000, 500)
+    assert bool(res.can_host_on_gpu(gl, jnp.int32(500), jnp.int32(3)))
+    assert not bool(res.can_host_on_gpu(gl, jnp.int32(500), jnp.int32(4)))
+    # two-pointer packs multiple sub-GPU units on one device:
+    # floor-units = [0, 2, 2, 1] at 500m → 5 units
+    assert bool(res.can_allocate(gl, jnp.int32(500), jnp.int32(5)))
+    assert not bool(res.can_allocate(gl, jnp.int32(500), jnp.int32(6)))
+
+
+def test_allocate_two_pointer_counts():
+    take, ok = res.allocate_two_pointer(g(200, 1000, 1000, 500), jnp.int32(500), jnp.int32(3))
+    assert bool(ok)
+    assert take.tolist()[:4] == [0, 2, 1, 0]
+
+
+def test_allocate_exclusive_first_free():
+    mask = res.allocate_exclusive(g(500, 1000, 200, 1000, 1000), jnp.int32(2000))
+    assert mask.tolist()[:5] == [False, True, False, True, False]
+    none = res.allocate_exclusive(g(500, 1000), jnp.int32(2000))
+    assert not bool(none.any())
+
+
+def test_share_best_worst_random():
+    gl = g(200, 1000, 1000, 500)
+    assert int(res.allocate_share_best(gl, jnp.int32(300))) == 3
+    assert int(res.allocate_share_worst(gl, jnp.int32(300))) == 1
+    assert int(res.allocate_share_best(gl, jnp.int32(2000))) == -1
+    dev = res.allocate_share_random(gl, jnp.int32(300), jax.random.PRNGKey(0))
+    assert int(dev) in (1, 2, 3)
+
+
+def test_accessibility():
+    assert bool(res.is_accessible(jnp.int32(5), jnp.int32(0)))  # no constraint
+    assert bool(res.is_accessible(jnp.int32(5), jnp.int32(1 << 5)))
+    assert not bool(res.is_accessible(jnp.int32(4), jnp.int32(1 << 5)))
+    assert not bool(res.is_accessible(jnp.int32(-1), jnp.int32(1 << 5)))
+    assert bool(res.is_accessible(jnp.int32(-1), jnp.int32(0)))

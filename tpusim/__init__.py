@@ -1,0 +1,23 @@
+"""tpusim — a TPU-native cluster-scheduling simulator.
+
+Re-implements the capabilities of hkust-adsl/kubernetes-scheduler-simulator
+(USENIX ATC'23 "Beware of Fragmentation", FGD) as a JAX/XLA program: cluster
+state is a struct-of-arrays over nodes, every scoring policy is a vmapped
+kernel, and the trace replay loop is a `lax.scan` (oracle-parity mode) or a
+batched wave dispatcher (throughput mode).
+
+Layer map (mirrors SURVEY.md §1 of this repo):
+  tpusim.ops       — resource algebra + fragmentation math   (ref: pkg/type, pkg/utils)
+  tpusim.policies  — node-scoring policy kernels             (ref: pkg/simulator/plugin)
+  tpusim.sim       — scheduler step, event loop, analysis    (ref: pkg/simulator, vendor scheduler)
+  tpusim.io        — trace/config ingestion, export          (ref: data/, pkg/api, scripts)
+  tpusim.parallel  — mesh-sharded scoring for large clusters (ref: §2.9 — replaces goroutine fan-out)
+  tpusim.utils     — vector math, misc helpers               (ref: pkg/utils/utils.go)
+"""
+
+from tpusim import constants
+from tpusim.types import NodeState, PodSpec, TypicalPods
+
+__version__ = "0.1.0"
+
+__all__ = ["constants", "NodeState", "PodSpec", "TypicalPods", "__version__"]

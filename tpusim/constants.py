@@ -1,0 +1,159 @@
+"""Static lookup tables and constants.
+
+Mirrors the reference's pkg/type/const.go and
+pkg/type/open-gpu-share/utils/const.go:4-121: GPU model registry (14 models),
+GPU memory sizes, CPU/GPU energy tables, and the milli-resource conventions.
+Strings are interned into integer ids at trace-ingest time so that all device
+arrays are integer-typed; gpu_type `-1` means "no GPU", while unknown CPU
+models map to id 0 (the reference's fallback energy profile, const.go:49).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MILLI = 1000  # 1 GPU == 1000 milli-GPU (ref: utils/const.go:14)
+MAX_GPUS_PER_NODE = 8  # ref: pkg/type/const.go MaxNumGpuPerNode
+MAX_SPEC_CPU = 128_000  # milli vCPU (ref: utils/const.go:16)
+MAX_SPEC_MEM = 1_048_576  # MiB (ref: utils/const.go:17)
+MAX_SPEC_GPU = 8_000  # milli GPU (ref: utils/const.go:18)
+
+MAX_NODE_SCORE = 100  # k8s framework.MaxNodeScore
+MIN_NODE_SCORE = 0
+
+# Fragmentation classes (ref: pkg/utils/frag.go:17-35). Order == array index.
+Q1_LACK_BOTH = 0
+Q2_LACK_GPU = 1
+Q3_SATISFIED = 2
+Q4_LACK_CPU = 3
+XL_SATISFIED = 4
+XR_LACK_CPU = 5
+NO_ACCESS = 6
+NUM_FRAG_CLASSES = 7
+FRAG_CLASS_NAMES = (
+    "q1_lack_both",
+    "q2_lack_gpu",
+    "q3_satisfied",
+    "q4_lack_cpu",
+    "xl_satisfied",
+    "xr_lack_cpu",
+    "no_access",
+)
+
+# GPU model registry. Index == integer id used in device arrays; a pod's
+# gpu_spec "A|B" OR-list becomes a bitmask over these ids
+# (ref: utils/const.go:23-38 MapGpuTypeMemoryMiB; data/README.md gpu_spec).
+GPU_MODELS = (
+    "P4",
+    "2080",
+    "1080",
+    "M40",
+    "T4",
+    "V100M16",
+    "P100",
+    "A10",
+    "3090",
+    "V100M32",
+    "A100",
+    "G1",
+    "G2",
+    "G3",
+)
+GPU_MODEL_IDS = {name: i for i, name in enumerate(GPU_MODELS)}
+NO_GPU = -1  # gpu_type id of CPU-only nodes
+
+GPU_MEMORY_MIB = {
+    "P4": 7980711936 // 1024 // 1024,
+    "2080": 11554258944 // 1024 // 1024,
+    "1080": 11720982528 // 1024 // 1024,
+    "M40": 12004098048 // 1024 // 1024,
+    "T4": 15842934784 // 1024 // 1024,
+    "V100M16": 16944988160 // 1024 // 1024,
+    "P100": 17070817280 // 1024 // 1024,
+    "A10": 23835181056 // 1024 // 1024,
+    "3090": 25446842368 // 1024 // 1024,
+    "V100M32": 34089205760 // 1024 // 1024,
+    "A100": 85198045184 // 1024 // 1024,
+    "G1": 1048576000 // 1024 // 1024,
+    "G2": 20971520000 // 1024 // 1024,
+    "G3": 31457280000 // 1024 // 1024,
+}
+
+# CPU model registry (ref: utils/const.go:48-55 MapCpuTypeEnergyConsumption).
+# Index 0 is the "unknown model" fallback profile (2682's numbers).
+CPU_MODELS = (
+    "",
+    "Intel-Xeon-8269CY",
+    "Intel-Xeon-8163",
+    "Intel-Xeon-ES-2682-V4",
+    "Intel-Xeon-6326",
+    "Intel-Xeon-8369B",
+)
+CPU_MODEL_IDS = {name: i for i, name in enumerate(CPU_MODELS)}
+
+_CPU_ENERGY = {
+    "": (15.0, 120.0, 16.0),
+    "Intel-Xeon-8269CY": (20.0, 205.0, 26.0),
+    "Intel-Xeon-8163": (20.0, 165.0, 24.0),
+    "Intel-Xeon-ES-2682-V4": (15.0, 120.0, 16.0),
+    "Intel-Xeon-6326": (20.0, 185.0, 16.0),
+    "Intel-Xeon-8369B": (20.0, 270.0, 32.0),
+}
+# Dense (idle, full, ncores) tables indexed by cpu_type id.
+CPU_IDLE_W = np.array([_CPU_ENERGY[m][0] for m in CPU_MODELS], np.float32)
+CPU_FULL_W = np.array([_CPU_ENERGY[m][1] for m in CPU_MODELS], np.float32)
+CPU_NCORES = np.array([_CPU_ENERGY[m][2] for m in CPU_MODELS], np.float32)
+
+# GPU energy (idle W, full W) per model id; models absent from the reference's
+# MapGpuTypeModelEnergy (P4/2080/1080/M40/3090/G1 — calling them would panic in
+# the Go code) get zeros (ref: utils/const.go:62-121; G2≈A10, G3≈A100).
+_GPU_ENERGY = {
+    "T4": (10.0, 70.0),
+    "A10": (30.0, 150.0),
+    "P100": (25.0, 250.0),
+    "V100M16": (30.0, 300.0),
+    "V100M32": (30.0, 300.0),
+    "A100": (50.0, 400.0),
+    "G2": (30.0, 150.0),
+    "G3": (50.0, 400.0),
+}
+GPU_IDLE_W = np.array(
+    [_GPU_ENERGY.get(m, (0.0, 0.0))[0] for m in GPU_MODELS], np.float32
+)
+GPU_FULL_W = np.array(
+    [_GPU_ENERGY.get(m, (0.0, 0.0))[1] for m in GPU_MODELS], np.float32
+)
+
+# Pod "GPU affinity" classes used by the GpuClustering policy
+# (ref: open-gpu-share/utils/pod.go:111-123): share-gpu plus "N-gpu" for
+# N in 1..8. no-gpu pods are tracked separately (they never enter the map).
+AFF_SHARE = 0  # gpu_count == 1 and milli < 1000
+NUM_AFF_CLASSES = 1 + MAX_GPUS_PER_NODE  # share + 1..8 whole-GPU
+
+
+def gpu_affinity_class(gpu_num: int, gpu_milli: int) -> int:
+    """Affinity class id, or -1 for no-gpu pods."""
+    if gpu_num == 0:
+        return -1
+    if gpu_num == 1 and gpu_milli < MILLI:
+        return AFF_SHARE
+    return gpu_num  # "N-gpu" → class N (1..8)
+
+
+def gpu_spec_to_mask(spec: str) -> int:
+    """Encode a 'V100M16|V100M32' OR-list as a bitmask over GPU_MODELS.
+
+    Empty spec (no constraint) → 0 (ref: pkg/utils/utils.go:957-1005
+    IsNodeAccessibleToPodByType: empty pod type is accessible everywhere).
+    """
+    mask = 0
+    for part in str(spec).split("|"):
+        part = part.strip()
+        if not part or part == "nan":
+            continue
+        mask |= 1 << GPU_MODEL_IDS[part]
+    return mask
+
+
+DEFAULT_TYPICAL_POD_POPULARITY = 60  # ref: pkg/type/resource.go:46-49
+DEFAULT_TYPICAL_POD_INCREASE_STEP = 10

@@ -1,0 +1,3 @@
+from tpusim.ops import frag, resource, energy, vectormath
+
+__all__ = ["frag", "resource", "energy", "vectormath"]

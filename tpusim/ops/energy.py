@@ -1,0 +1,47 @@
+"""Node power model (ref: pkg/type/resource.go:533-563 GetEnergyConsumptionNode
+and open-gpu-share/utils/const.go:48-121 energy tables).
+
+GPU power: fully-idle devices draw idle watts, every other device draws full
+watts (even minimally-used ones). CPU power: 2 vCPUs per physical core;
+whole CPU packages flip from idle to full wattage as cores become busy.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpusim.constants import (
+    CPU_FULL_W,
+    CPU_IDLE_W,
+    CPU_NCORES,
+    GPU_FULL_W,
+    GPU_IDLE_W,
+    MILLI,
+)
+
+
+def node_power(cpu_left, cpu_cap, gpu_left, gpu_cnt, gpu_type, cpu_type):
+    """Returns (cpu_watts, gpu_watts) for one node; vmap over nodes."""
+    gpu_idle_w = jnp.asarray(GPU_IDLE_W)
+    gpu_full_w = jnp.asarray(GPU_FULL_W)
+    cpu_idle_w = jnp.asarray(CPU_IDLE_W)
+    cpu_full_w = jnp.asarray(CPU_FULL_W)
+    cpu_ncores = jnp.asarray(CPU_NCORES)
+
+    # --- GPU side (ref: resource.go:537-545) ---
+    num_idle_gpus = (gpu_left == MILLI).sum().astype(jnp.float32)
+    num_working = gpu_cnt.astype(jnp.float32) - num_idle_gpus
+    idle_w = jnp.where(gpu_type >= 0, gpu_idle_w[jnp.maximum(gpu_type, 0)], 0.0)
+    full_w = jnp.where(gpu_type >= 0, gpu_full_w[jnp.maximum(gpu_type, 0)], 0.0)
+    gpu_watts = idle_w * num_idle_gpus + full_w * num_working
+
+    # --- CPU side (ref: resource.go:547-559) ---
+    real_cores = jnp.ceil(cpu_cap.astype(jnp.float32) / MILLI / 2)
+    idle_cores = jnp.floor(cpu_left.astype(jnp.float32) / MILLI / 2)
+    working_cores = real_cores - idle_cores
+    ncores = cpu_ncores[cpu_type]
+    num_cpus = jnp.ceil(real_cores / ncores)
+    active_cpus = jnp.ceil(working_cores / ncores)
+    idle_cpus = num_cpus - active_cpus
+    cpu_watts = cpu_idle_w[cpu_type] * idle_cpus + cpu_full_w[cpu_type] * active_cpus
+    return cpu_watts, gpu_watts

@@ -1,0 +1,207 @@
+"""Fragmentation math — the FGD core (ref: pkg/utils/frag.go).
+
+Everything is expressed for a single node against the [T]-vector typical-pod
+distribution and vmapped over nodes. The per-(node, typical-pod) classifier
+and the frag-amount accumulation are exact re-derivations of
+frag.go:460-493 (GetNodePodFrag) and frag.go:148-203
+(NodeGpuShareFragAmount / ...Score); golden values from
+pkg/utils/frag_test.go pin the semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tpusim.constants import (
+    NO_ACCESS,
+    NUM_FRAG_CLASSES,
+    Q1_LACK_BOTH,
+    Q2_LACK_GPU,
+    Q3_SATISFIED,
+    Q4_LACK_CPU,
+    XL_SATISFIED,
+    XR_LACK_CPU,
+)
+from tpusim.ops.resource import can_host_on_gpu, gpu_frag_milli, is_accessible
+from tpusim.types import NodeState, TypicalPods
+
+# Single-pod kernels from the resource algebra, lifted over the typical-pod
+# axis — one definition shared with the placement path.
+_can_host_t = jax.vmap(can_host_on_gpu, in_axes=(None, 0, 0))
+_frag_milli_t = jax.vmap(gpu_frag_milli, in_axes=(None, 0))
+
+
+def frag_class(cpu_left, gpu_left, gpu_type, tp: TypicalPods):
+    """Classify how each typical pod 'sees' this node → i32[T] class ids
+    (ref: frag.go:460-493 GetNodePodFrag).
+
+    Decision order matters and is preserved: no-GPU pod → XL/XR; no model
+    access → NA; GPU hostable → Q3/Q4 by CPU; else Q2/Q1 by CPU.
+    """
+    cpu_ok = cpu_left >= tp.cpu  # [T]
+    acc = is_accessible(gpu_type, tp.gpu_mask)  # [T]
+    can_host = _can_host_t(gpu_left, tp.gpu_milli, tp.gpu_num)  # [T]
+    return jnp.where(
+        tp.gpu_milli == 0,
+        jnp.where(cpu_ok, XL_SATISFIED, XR_LACK_CPU),
+        jnp.where(
+            ~acc,
+            NO_ACCESS,
+            jnp.where(
+                can_host,
+                jnp.where(cpu_ok, Q3_SATISFIED, Q4_LACK_CPU),
+                jnp.where(cpu_ok, Q2_LACK_GPU, Q1_LACK_BOTH),
+            ),
+        ),
+    ).astype(jnp.int32)
+
+
+def node_frag_amounts(cpu_left, gpu_left, gpu_type, tp: TypicalPods):
+    """Per-class frag amounts f32[7] for one node
+    (ref: frag.go:148-188 NodeGpuShareFragAmount).
+
+    Q3 pods split the node's idle GPU milli: devices individually too small
+    count toward Q2 (freq × gpuFragMilli), the rest stays in Q3. Every other
+    class contributes freq × total idle milli to its own bucket.
+    """
+    cls = frag_class(cpu_left, gpu_left, gpu_type, tp)  # [T]
+    total_left = gpu_left.sum().astype(jnp.float32)
+    frag_small = _frag_milli_t(gpu_left, tp.gpu_milli).astype(jnp.float32)  # [T]
+    is_q3 = cls == Q3_SATISFIED
+    onehot = jax.nn.one_hot(cls, NUM_FRAG_CLASSES, dtype=jnp.float32)  # [T,7]
+    base = onehot * (tp.freq * total_left)[:, None]  # non-Q3 rows correct
+    q3_contrib = jnp.zeros((tp.size, NUM_FRAG_CLASSES), jnp.float32)
+    q3_contrib = q3_contrib.at[:, Q2_LACK_GPU].set(tp.freq * frag_small)
+    q3_contrib = q3_contrib.at[:, Q3_SATISFIED].set(tp.freq * (total_left - frag_small))
+    contrib = jnp.where(is_q3[:, None], q3_contrib, base)
+    return contrib.sum(0)
+
+
+def frag_sum_except_q3(amounts):
+    """ref: frag.go:411-418 FragAmountSumExceptQ3."""
+    return amounts.sum(-1) - amounts[..., Q3_SATISFIED]
+
+
+def frag_sum_q1q2q4(amounts):
+    """ref: frag.go:420-425 FragAmountSumQ1Q2Q4."""
+    return (
+        amounts[..., Q1_LACK_BOTH]
+        + amounts[..., Q2_LACK_GPU]
+        + amounts[..., Q4_LACK_CPU]
+    )
+
+
+def node_frag_score(cpu_left, gpu_left, gpu_type, tp: TypicalPods):
+    """Scalar frag score = sum of all classes except Q3
+    (ref: frag.go:200-203 NodeGpuShareFragAmountScore)."""
+    return frag_sum_except_q3(node_frag_amounts(cpu_left, gpu_left, gpu_type, tp))
+
+
+# Vmapped over the node axis: NodeState arrays → f32[N, 7] / f32[N].
+cluster_frag_amounts = jax.vmap(
+    lambda s, tp: node_frag_amounts(s.cpu_left, s.gpu_left, s.gpu_type, tp),
+    in_axes=(NodeState(0, 0, 0, 0, 0, 0, 0, 0, 0), None),
+)
+cluster_frag_scores = jax.vmap(
+    lambda s, tp: node_frag_score(s.cpu_left, s.gpu_left, s.gpu_type, tp),
+    in_axes=(NodeState(0, 0, 0, 0, 0, 0, 0, 0, 0), None),
+)
+
+
+@partial(jax.jit, static_argnames=())
+def cluster_frag_report(state: NodeState, tp: TypicalPods):
+    """Cluster-level frag aggregate (ref: analysis.go:59-121
+    ClusterGpuFragReport, origin variant): returns
+    (cluster_amounts f32[7], frag_gpu_milli, frag_ratio_pct, q124_ratio_pct).
+    """
+    amounts = cluster_frag_amounts(state, tp).sum(0)
+    idle = amounts.sum()
+    frag = frag_sum_except_q3(amounts)
+    q124 = frag_sum_q1q2q4(amounts)
+    return amounts, frag, 100.0 * frag / idle, 100.0 * q124 / idle
+
+
+def node_frag_bellman(node, typical, max_depth: int = 64):
+    """Host-side Bellman expected-frag value function
+    (ref: frag.go:231-283 NodeGpuFragBellman).
+
+    Unbounded memoized recursion is hostile to XLA (SURVEY.md §7.3), so this
+    stays a pure-Python reference implementation used for reporting/tests.
+    `node` is (cpu_left:int, gpu_left:tuple[int,...], gpu_type:int); `typical`
+    is a list of (cpu, gpu_milli, gpu_num, gpu_mask, freq) tuples.
+    """
+    import numpy as np
+
+    memo = {}
+    t_arr = list(typical)
+
+    def classify(cpu_left, gpu_left, gpu_type, t):
+        cpu, milli, num, mask, _ = t
+        if milli == 0:
+            return XL_SATISFIED if cpu_left >= cpu else XR_LACK_CPU
+        node_bit = (1 << gpu_type) if gpu_type >= 0 else 0
+        if mask != 0 and not (mask & node_bit):
+            return NO_ACCESS
+        fit = sum(1 for g in gpu_left if g >= milli)
+        if fit >= num:
+            return Q3_SATISFIED if cpu_left >= cpu else Q4_LACK_CPU
+        return Q2_LACK_GPU if cpu_left >= cpu else Q1_LACK_BOTH
+
+    def sub(cpu_left, gpu_left, t):
+        cpu, milli, num, _, _ = t
+        if cpu_left < cpu or len(gpu_left) < num:
+            return None
+        g = list(gpu_left)
+        if num == 0:
+            return cpu_left - cpu, tuple(g)
+        order = sorted(range(len(g)), key=lambda i: (g[i], i))
+        need = num
+        for i in order:
+            if milli <= g[i]:
+                g[i] -= milli
+                need -= 1
+                if need == 0:
+                    return cpu_left - cpu, tuple(g)
+        return None
+
+    def rec(cpu_left, gpu_left, gpu_type, cum_prob, depth):
+        # Memo hit takes precedence over the cum_prob cutoff (frag.go:233-239:
+        # the dp load happens before the gpuMilliLeftTotal checks).
+        key = (cpu_left, tuple(sorted(gpu_left, reverse=True)), gpu_type)
+        if key in memo:
+            return memo[key]
+        total = sum(gpu_left)
+        if total == 0:
+            return 0.0
+        if total * cum_prob < 1:
+            return 0.0
+        ratio_except_q3 = sum(
+            t[4]
+            for t in t_arr
+            if classify(cpu_left, gpu_left, gpu_type, t) != Q3_SATISFIED
+        )
+        if depth >= max_depth:
+            # Defensive truncation (the Go code has no depth limit; its
+            # cum_prob cutoff bounds recursion in practice). Do NOT memoize:
+            # the truncated value would poison shallow-depth revisits.
+            return float(total)
+        if ratio_except_q3 < 0.999:
+            pv = 0.0
+            for t in t_arr:
+                p = t[4]
+                nxt = sub(cpu_left, gpu_left, t)
+                if nxt is None:
+                    pv += total * p
+                else:
+                    pv += p * rec(nxt[0], nxt[1], gpu_type, cum_prob * p, depth + 1)
+            frag = pv
+        else:
+            frag = float(total)
+        memo[key] = frag
+        return frag
+
+    cpu_left, gpu_left, gpu_type = node
+    return rec(int(cpu_left), tuple(int(g) for g in gpu_left), int(gpu_type), 1.0, 0)

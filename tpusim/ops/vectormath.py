@@ -1,0 +1,41 @@
+"""Vector helpers (ref: pkg/utils/utils.go:1181-1272).
+
+Used by the DotProduct (Tetris) policy and the cosine-similarity descheduler.
+The Go versions return -1 on malformed input; shapes are static here so only
+the zero-magnitude guard survives.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cosine_similarity(a, b):
+    """ref: utils.go:1196-1219 CalculateVectorCosineSimilarity; -1 when either
+    vector has zero magnitude."""
+    ma = jnp.sqrt((a * a).sum(-1))
+    mb = jnp.sqrt((b * b).sum(-1))
+    ok = (ma > 0) & (mb > 0)
+    return jnp.where(ok, (a * b).sum(-1) / jnp.where(ok, ma * mb, 1.0), -1.0)
+
+
+def dot_product(a, b):
+    """ref: utils.go:1246-1256."""
+    return (a * b).sum(-1)
+
+
+def l2_norm_diff(a, b):
+    """ref: utils.go:1258-1267 (squared L2 distance)."""
+    d = a - b
+    return (d * d).sum(-1)
+
+
+def normalize_by(vec, norm):
+    """Element-wise vec/norm with zero where norm <= 0
+    (ref: utils.go:1221-1244 NormalizeVector)."""
+    return jnp.where(norm > 0, vec / jnp.where(norm > 0, norm, 1.0), 0.0)
+
+
+def sigmoid(x):
+    """ref: plugin_utils.go:76-78."""
+    return 1.0 / (1.0 + jnp.exp(-x))
