@@ -1,11 +1,27 @@
-"""Test harness: force an 8-device virtual CPU mesh so sharding tests run
-anywhere (the driver separately dry-runs the multi-chip path)."""
+"""Test harness: force an 8-device virtual CPU mesh so unit tests run
+anywhere without touching TPU hardware (the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip).
+
+This environment pre-registers an 'axon' TPU-tunnel PJRT plugin via
+sitecustomize *before* conftest runs, and plain JAX_PLATFORMS env tweaks do
+not stop its (potentially hanging) backend init. So: update the live jax
+config and drop the factory registration directly — both happen before the
+first backend initialization, which is what matters.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)

@@ -1,0 +1,2 @@
+"""Trace/config ingestion and snapshot export (ref: data/, scripts/,
+pkg/api/v1alpha1, pkg/simulator/export.go)."""

@@ -1,0 +1,202 @@
+"""openb trace ingestion: CSV → device arrays.
+
+Replaces the reference's CSV → YAML → k8s-object pipeline
+(data/pod_csv_to_yaml.py + pkg/simulator/utils.go GetObjectFromYamlContent):
+the trace loads straight into NodeState / PodSpec struct-of-arrays.
+
+Node CSV schema (data/README.md): sn, cpu_milli, memory_mib, gpu, model.
+Pod CSV schema: name, cpu_milli, memory_mib, num_gpu, gpu_milli, gpu_spec,
+qos, pod_phase, creation_time, deletion_time, scheduled_time.
+
+gpu_milli sanitization follows pod_csv_to_yaml.py: clamp to (0, 1000];
+values > 1000 → 1000; only meaningful when num_gpu > 0.
+"""
+
+from __future__ import annotations
+
+import csv
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpusim.constants import (
+    CPU_MODEL_IDS,
+    GPU_MODEL_IDS,
+    MAX_GPUS_PER_NODE,
+    NO_GPU,
+    gpu_spec_to_mask,
+)
+from tpusim.types import NodeState, PodSpec, make_node_state
+
+
+@dataclass
+class PodRow:
+    """One trace pod, host-side (ref: PodResource + trace annotations)."""
+
+    name: str
+    cpu_milli: int
+    memory_mib: int
+    num_gpu: int
+    gpu_milli: int
+    gpu_spec: str = ""
+    qos: str = ""
+    pod_phase: str = ""
+    creation_time: int = 0
+    deletion_time: int = 0
+    scheduled_time: int = 0
+
+    @property
+    def total_gpu_milli(self) -> int:
+        return self.gpu_milli * self.num_gpu
+
+    def spec_key(self) -> tuple:
+        """Identity for typical-pod histogramming (GetPodResource fields that
+        enter the PodResource map key, frag.go:292-310)."""
+        return (self.cpu_milli, self.gpu_milli, self.num_gpu, self.gpu_spec)
+
+
+@dataclass
+class NodeRow:
+    name: str
+    cpu_milli: int
+    memory_mib: int
+    gpu: int
+    model: str = ""
+    cpu_model: str = ""
+
+
+def _sanitize_gpu_milli(num_gpu: int, gpu_milli) -> int:
+    if num_gpu == 0:
+        return 0
+    try:
+        m = int(float(gpu_milli))
+    except (TypeError, ValueError):
+        m = 1000
+    if m > 1000:
+        return 1000
+    if m <= 0:
+        return 0
+    return m
+
+
+def load_node_csv(path: str) -> List[NodeRow]:
+    rows = []
+    with open(path, newline="") as f:
+        for r in csv.DictReader(f):
+            model = (r.get("model") or "").strip()
+            if model.lower() == "nan":
+                model = ""
+            rows.append(
+                NodeRow(
+                    name=r["sn"],
+                    cpu_milli=int(float(r["cpu_milli"])),
+                    memory_mib=int(float(r["memory_mib"])),
+                    gpu=int(float(r["gpu"])),
+                    model=model,
+                    cpu_model=(r.get("cpu_model") or "").strip(),
+                )
+            )
+    return rows
+
+
+def load_pod_csv(path: str) -> List[PodRow]:
+    rows = []
+    with open(path, newline="") as f:
+        for r in csv.DictReader(f):
+            num_gpu = int(float(r["num_gpu"]))
+            spec = (r.get("gpu_spec") or "").strip()
+            if spec.lower() == "nan":
+                spec = ""
+            rows.append(
+                PodRow(
+                    name=r["name"],
+                    cpu_milli=int(float(r["cpu_milli"])),
+                    memory_mib=int(float(r.get("memory_mib") or 0)),
+                    num_gpu=num_gpu,
+                    gpu_milli=_sanitize_gpu_milli(num_gpu, r.get("gpu_milli")),
+                    gpu_spec=spec if num_gpu > 0 else "",
+                    qos=r.get("qos", ""),
+                    pod_phase=r.get("pod_phase", ""),
+                    creation_time=int(float(r.get("creation_time") or 0)),
+                    deletion_time=int(float(r.get("deletion_time") or 0)),
+                    scheduled_time=int(float(r.get("scheduled_time") or 0)),
+                )
+            )
+    return rows
+
+
+def nodes_to_state(nodes: Sequence[NodeRow]) -> NodeState:
+    """NodeRow list → all-idle NodeState (ref: node YAML → corev1.Node →
+    NodeResource)."""
+    gpu_type = np.array(
+        [GPU_MODEL_IDS[n.model] if n.model else NO_GPU for n in nodes], np.int32
+    )
+    cpu_type = np.array(
+        [CPU_MODEL_IDS.get(n.cpu_model, 0) for n in nodes], np.int32
+    )
+    for n in nodes:
+        if n.gpu > MAX_GPUS_PER_NODE:
+            raise ValueError(f"node {n.name}: {n.gpu} GPUs > {MAX_GPUS_PER_NODE}")
+    return make_node_state(
+        cpu_cap=[n.cpu_milli for n in nodes],
+        mem_cap=[n.memory_mib for n in nodes],
+        gpu_cnt=[n.gpu for n in nodes],
+        gpu_type=gpu_type,
+        cpu_type=cpu_type,
+    )
+
+
+def pods_to_specs(pods: Sequence[PodRow]) -> PodSpec:
+    """PodRow list → batched PodSpec arrays."""
+    import jax.numpy as jnp
+
+    return PodSpec(
+        cpu=jnp.asarray(np.array([p.cpu_milli for p in pods], np.int32)),
+        mem=jnp.asarray(np.array([p.memory_mib for p in pods], np.int32)),
+        gpu_milli=jnp.asarray(np.array([p.gpu_milli for p in pods], np.int32)),
+        gpu_num=jnp.asarray(np.array([p.num_gpu for p in pods], np.int32)),
+        gpu_mask=jnp.asarray(
+            np.array([gpu_spec_to_mask(p.gpu_spec) for p in pods], np.int32)
+        ),
+    )
+
+
+def build_events(
+    pods: Sequence[PodRow], use_timestamps: bool = False
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pod list → (ev_kind i32[E], ev_pod i32[E]).
+
+    use_timestamps=False mirrors the experiment pipeline (creation/deletion
+    annotations commented out in pod_csv_to_yaml.py:119-120): one creation
+    event per pod in list order, no deletions. use_timestamps=True mirrors
+    the annotation-driven path (simulator.go:672-717): creation + deletion
+    events stable-sorted by timestamp.
+    """
+    from tpusim.sim.engine import EV_CREATE, EV_DELETE
+
+    if not use_timestamps:
+        kind = np.zeros(len(pods), np.int32) + EV_CREATE
+        idx = np.arange(len(pods), dtype=np.int32)
+        return kind, idx
+    events = []
+    for i, p in enumerate(pods):
+        events.append((p.creation_time, EV_CREATE, i))
+        if p.deletion_time:
+            events.append((p.deletion_time, EV_DELETE, i))
+    events.sort(key=lambda e: e[0])  # python sort is stable
+    kind = np.array([e[1] for e in events], np.int32)
+    idx = np.array([e[2] for e in events], np.int32)
+    return kind, idx
+
+
+def tiebreak_rank(num_nodes: int, seed: int = 42) -> np.ndarray:
+    """Random permutation standing in for the reference's 4-digit random
+    node-name prefixes + lexicographic selectHost tie-break
+    (simulator.go:584-588; generic_scheduler.go:199-203): rank[i] = position
+    of node i in the prefixed lexicographic order."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(num_nodes)
+    rank = np.empty(num_nodes, np.int32)
+    rank[perm] = np.arange(num_nodes, dtype=np.int32)
+    return rank

@@ -1,0 +1,82 @@
+"""Scoring-policy kernel registry (ref: pkg/simulator/plugin/*, registered via
+the out-of-tree registry in pkg/simulator/simulator.go:153-181; plugin names
+from pkg/type/const.go:4-13).
+
+make_policy(name, **cfg) resolves a scheduler-config plugin name to a policy
+kernel `(NodeState, PodSpec, ScoreContext) -> PolicyResult`.
+"""
+
+from __future__ import annotations
+
+from tpusim.policies.base import (
+    PolicyFn,
+    PolicyResult,
+    ScoreContext,
+    minmax_normalize_i32,
+    pwr_normalize_i32,
+)
+from tpusim.policies.bestfit import bestfit_score
+from tpusim.policies.clustering import clustering_score
+from tpusim.policies.dotprod import make_dotprod
+from tpusim.policies.fgd import fgd_score
+from tpusim.policies.packing import packing_score
+from tpusim.policies.pwr import pwr_score
+from tpusim.policies.random_policy import random_score
+from tpusim.policies.simon import simon_score
+
+
+_JIT_CACHE = {}
+
+
+def jit_policy(fn):
+    """Jitted view of a policy kernel (eager per-primitive dispatch is far
+    too slow for direct calls; inside the replay scan policies are already
+    traced). Preserves the policy's metadata attributes."""
+    import jax
+
+    if fn not in _JIT_CACHE:
+        j = jax.jit(fn)
+        j.normalize = fn.normalize
+        j.policy_name = fn.policy_name
+        _JIT_CACHE[fn] = j
+    return _JIT_CACHE[fn]
+
+
+def make_policy(name: str, dim_ext_method: str = "share", norm_method: str = "max"):
+    """Plugin-name → kernel (names as in scheduler-config YAML)."""
+    table = {
+        "FGDScore": lambda: fgd_score,
+        "PWRScore": lambda: pwr_score,
+        "BestFitScore": lambda: bestfit_score,
+        "GpuPackingScore": lambda: packing_score,
+        "GpuClusteringScore": lambda: clustering_score,
+        "RandomScore": lambda: random_score,
+        "Simon": lambda: simon_score,
+        "DotProductScore": lambda: make_dotprod(dim_ext_method, norm_method),
+    }
+    if name not in table:
+        raise KeyError(f"unknown score plugin: {name!r}")
+    return table[name]()
+
+
+POLICY_NAMES = (
+    "FGDScore",
+    "PWRScore",
+    "BestFitScore",
+    "GpuPackingScore",
+    "GpuClusteringScore",
+    "RandomScore",
+    "Simon",
+    "DotProductScore",
+)
+
+__all__ = [
+    "PolicyFn",
+    "PolicyResult",
+    "ScoreContext",
+    "make_policy",
+    "make_dotprod",
+    "minmax_normalize_i32",
+    "pwr_normalize_i32",
+    "POLICY_NAMES",
+]

@@ -1,0 +1,79 @@
+"""Policy-kernel plumbing shared by all scoring policies.
+
+The reference runs each enabled ScorePlugin over the feasible node list,
+optionally min-max normalizes (plugin_utils.go:48-74 NormalizeScore), applies
+the config weight, sums, and picks the max-score node with
+smallest-node-name tie-breaking (vendored generic_scheduler.go:185-210
+selectHost). Here each policy is a function over the whole NodeState
+struct-of-arrays producing
+
+    raw_scores: i32[N]  — the plugin's Score() output per node
+    share_dev:  i32[N]  — per node, the device the policy would hand a
+                          share-GPU pod at Reserve time (-1 = none); whole-GPU
+                          pods always use allocate_exclusive at bind
+                          (open_gpu_share.go:285-343 + AllocateExclusiveGpuId)
+
+and the framework semantics (normalize over feasible nodes only, integer
+division, weighting, argmax with a fixed random tie-break permutation
+standing in for the reference's random node-name prefixes,
+simulator.go:584-588) live in tpusim.sim.step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax.numpy as jnp
+
+from tpusim.constants import MAX_NODE_SCORE
+from tpusim.types import NodeState, PodSpec, TypicalPods
+
+
+class ScoreContext(NamedTuple):
+    """Dynamic inputs every policy may consume.
+
+    feasible: bool[N] Filter-phase mask — normalization reductions and the
+    Random policy's node draw only look at feasible nodes, like the vendored
+    framework which scores feasible nodes only.
+    """
+
+    tp: TypicalPods
+    feasible: jnp.ndarray  # bool[N]
+    rng: jnp.ndarray  # jax PRNG key (Random policy, random gpu-sel)
+
+
+class PolicyResult(NamedTuple):
+    raw_scores: jnp.ndarray  # i32[N]
+    share_dev: jnp.ndarray  # i32[N], -1 = no share-GPU choice
+
+
+# A policy is (state, pod, ctx) -> PolicyResult, plus a `normalize` mode
+# consumed by the step: "none" | "minmax" | "pwr".
+PolicyFn = Callable[[NodeState, PodSpec, ScoreContext], PolicyResult]
+
+
+def minmax_normalize_i32(scores, feasible):
+    """Integer min-max rescale to [0, 100] over feasible nodes
+    (ref: plugin_utils.go:48-74). oldRange == 0 → all MinNodeScore(0).
+    Infeasible rows are passed through untouched (the reference never sees
+    them); callers mask them out before use.
+    """
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
+    lo = jnp.min(jnp.where(feasible, scores, big))
+    hi = jnp.max(jnp.where(feasible, scores, -big))
+    rng = hi - lo
+    scaled = jnp.where(rng == 0, 0, (scores - lo) * MAX_NODE_SCORE // jnp.maximum(rng, 1))
+    return jnp.where(feasible, scaled, scores)
+
+
+def pwr_normalize_i32(scores, feasible):
+    """PWR's own NormalizeScore (pwr_score.go:104-139): min-max to [0,100]
+    but the degenerate all-equal case maps to 100, not 0."""
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
+    lo = jnp.min(jnp.where(feasible, scores, big))
+    hi = jnp.max(jnp.where(feasible, scores, -big))
+    rng = hi - lo
+    scaled = jnp.where(
+        rng == 0, MAX_NODE_SCORE, (scores - lo) * MAX_NODE_SCORE // jnp.maximum(rng, 1)
+    )
+    return jnp.where(feasible, scaled, scores)

@@ -1,0 +1,67 @@
+"""FGD — Fragmentation Gradient Descent (ref: plugin/fgd_score.go).
+
+score(node) = trunc(sigmoid((frag(node) − frag(node ⊖ pod)) / 1000) × 100)
+
+For a share-GPU pod the hypothetical placement is tried on every fitting
+device and the best per-device score wins (fgd_score.go:111-134, first device
+on ties); for whole-GPU / CPU-only pods the placement is NodeResource.Sub
+(fgd_score.go:137-148). Reserve re-runs the same computation to pick the
+device (allocateGpuIdBasedOnFGDScore, fgd_score.go:153-156).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
+from tpusim.ops.frag import node_frag_score
+from tpusim.ops.resource import sub_pod
+from tpusim.policies.base import PolicyResult, ScoreContext
+from tpusim.types import NodeState, PodSpec
+
+
+def _sigmoid_score(cur, new):
+    """trunc(sigmoid((cur-new)/1000) * MaxNodeScore) — fgd_score.go:124."""
+    s = jax.nn.sigmoid((cur - new) / 1000.0)
+    return jnp.floor(s * MAX_NODE_SCORE).astype(jnp.int32)
+
+
+def _fgd_node(cpu_left, mem_left, gpu_left, gpu_type, pod: PodSpec, tp):
+    cur = node_frag_score(cpu_left, gpu_left, gpu_type, tp)
+
+    # --- share-GPU branch: hypothetical per device (fgd_score.go:111-134) ---
+    def per_dev(d):
+        hyp = gpu_left.at[d].add(-pod.gpu_milli)
+        return node_frag_score(cpu_left - pod.cpu, hyp, gpu_type, tp)
+
+    new_per_dev = jax.vmap(per_dev)(jnp.arange(MAX_GPUS_PER_NODE))  # f32[8]
+    fits = gpu_left >= pod.gpu_milli
+    dev_scores = jnp.where(fits, _sigmoid_score(cur, new_per_dev), jnp.int32(-1))
+    best_dev = jnp.argmax(dev_scores).astype(jnp.int32)  # first max on ties
+    share_score = jnp.where(fits.any(), dev_scores[best_dev], 0)
+    share_dev = jnp.where(fits.any(), best_dev, -1).astype(jnp.int32)
+
+    # --- whole-GPU / CPU-only branch: Sub hypothetical (fgd_score.go:137-148) ---
+    c2, _, g2, _, _ = sub_pod(cpu_left, mem_left, gpu_left, pod)
+    whole_score = _sigmoid_score(cur, node_frag_score(c2, g2, gpu_type, tp))
+
+    is_share = pod.is_gpu_share()
+    return (
+        jnp.where(is_share, share_score, whole_score),
+        jnp.where(is_share, share_dev, -1).astype(jnp.int32),
+    )
+
+
+_fgd_nodes = jax.vmap(_fgd_node, in_axes=(0, 0, 0, 0, None, None))
+
+
+def fgd_score(state: NodeState, pod: PodSpec, ctx: ScoreContext) -> PolicyResult:
+    scores, share_dev = _fgd_nodes(
+        state.cpu_left, state.mem_left, state.gpu_left, state.gpu_type, pod, ctx.tp
+    )
+    return PolicyResult(scores, share_dev)
+
+
+fgd_score.normalize = "none"
+fgd_score.policy_name = "FGDScore"

@@ -1,0 +1,69 @@
+"""PWR — power-aware scoring (ref: plugin/pwr_score.go).
+
+score(node) = trunc(oldPower − newPower) after hypothetically placing the pod
+(per fitting device for share-GPU pods, pwr_score.go:150-200; Sub for
+whole-GPU / CPU-only, pwr_score.go:204-218). Raw scores are ≤ 0 watts-deltas;
+the plugin's own NormalizeScore maps them to [0, 100] with the all-equal case
+pinned to 100 (pwr_score.go:104-139).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpusim.constants import MAX_GPUS_PER_NODE
+from tpusim.ops.energy import node_power
+from tpusim.ops.resource import sub_pod
+from tpusim.policies.base import PolicyResult, ScoreContext
+from tpusim.types import NodeState, PodSpec
+
+_NEG_INF = jnp.int32(-(2**31) + 1)  # stands in for Go's math.MinInt64 init
+
+
+def _power(cpu_left, cpu_cap, gpu_left, gpu_cnt, gpu_type, cpu_type):
+    c, g = node_power(cpu_left, cpu_cap, gpu_left, gpu_cnt, gpu_type, cpu_type)
+    return c + g
+
+
+def _pwr_node(row: NodeState, pod: PodSpec):
+    old = _power(
+        row.cpu_left, row.cpu_cap, row.gpu_left, row.gpu_cnt, row.gpu_type, row.cpu_type
+    )
+
+    def per_dev(d):
+        hyp = row.gpu_left.at[d].add(-pod.gpu_milli)
+        return _power(
+            row.cpu_left - pod.cpu, row.cpu_cap, hyp, row.gpu_cnt, row.gpu_type,
+            row.cpu_type,
+        )
+
+    new_per_dev = jax.vmap(per_dev)(jnp.arange(MAX_GPUS_PER_NODE))
+    fits = row.gpu_left >= pod.gpu_milli
+    dev_scores = jnp.where(fits, (old - new_per_dev).astype(jnp.int32), _NEG_INF)
+    best_dev = jnp.argmax(dev_scores).astype(jnp.int32)
+    share_score = jnp.where(fits.any(), dev_scores[best_dev], _NEG_INF)
+    share_dev = jnp.where(fits.any(), best_dev, -1).astype(jnp.int32)
+
+    c2, _, g2, _, _ = sub_pod(row.cpu_left, row.mem_left, row.gpu_left, pod)
+    whole_score = (
+        old - _power(c2, row.cpu_cap, g2, row.gpu_cnt, row.gpu_type, row.cpu_type)
+    ).astype(jnp.int32)
+
+    is_share = pod.is_gpu_share()
+    return (
+        jnp.where(is_share, share_score, whole_score),
+        jnp.where(is_share, share_dev, -1).astype(jnp.int32),
+    )
+
+
+_pwr_nodes = jax.vmap(_pwr_node, in_axes=(NodeState(0, 0, 0, 0, 0, 0, 0, 0, 0), None))
+
+
+def pwr_score(state: NodeState, pod: PodSpec, ctx: ScoreContext) -> PolicyResult:
+    scores, share_dev = _pwr_nodes(state, pod)
+    return PolicyResult(scores, share_dev)
+
+
+pwr_score.normalize = "pwr"
+pwr_score.policy_name = "PWRScore"

@@ -1,0 +1,2 @@
+"""Simulator core: filter/score/bind step, trace replay engine, analysis
+(ref: pkg/simulator/ + the vendored kube-scheduler event loop it drives)."""

@@ -1,0 +1,255 @@
+"""Host-side experiment driver — the Simulate() orchestration
+(ref: pkg/simulator/core.go:86-268 + the Simulator struct's Interface
+surface, core.go:43-74).
+
+The driver owns everything that happens once per experiment (trace prep,
+typical pods, tuning, config); the per-event hot loop runs entirely on
+device via tpusim.sim.engine.make_replay.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusim.constants import MILLI
+from tpusim.io.trace import (
+    NodeRow,
+    PodRow,
+    build_events,
+    nodes_to_state,
+    pods_to_specs,
+    tiebreak_rank,
+)
+from tpusim.policies import make_policy
+from tpusim.sim.engine import make_replay
+from tpusim.sim.reports import (
+    LogSink,
+    cluster_analysis_block,
+    report_alloc_lines,
+    report_frag_line,
+    report_power_line,
+)
+from tpusim.sim.typical import TypicalPodsConfig, get_skyline_pods, get_typical_pods
+from tpusim.sim.workload import sort_cluster_pods, tune_pods
+from tpusim.types import NodeState, TypicalPods
+
+
+@dataclass
+class SimulatorConfig:
+    """Experiment knobs (ref: CustomConfig, pkg/api/v1alpha1/types.go:57-109,
+    + scheduler-config plugin selection, §5.6)."""
+
+    policies: Sequence[Tuple[str, int]] = (("FGDScore", 1000),)
+    gpu_sel_method: str = "best"  # best | worst | random | <policy name>
+    dim_ext_method: str = "share"
+    norm_method: str = "max"
+    shuffle_pod: bool = False
+    tuning_ratio: float = 0.0
+    tuning_seed: int = 233
+    inflation_ratio: float = 1.0
+    inflation_seed: int = 233
+    typical_pods: TypicalPodsConfig = field(default_factory=TypicalPodsConfig)
+    deschedule_ratio: float = 0.0
+    deschedule_policy: str = ""
+    seed: int = 42  # node tie-break permutation + jax PRNG
+    report_per_event: bool = True
+    use_timestamps: bool = False
+
+
+@dataclass
+class UnscheduledPod:
+    """ref: pkg/type/simulate_result.go:10-13."""
+
+    pod: PodRow
+    reason: str = "unschedulable"
+
+
+@dataclass
+class SimulateResult:
+    """ref: pkg/type/simulate_result.go:5-18 + replay telemetry."""
+
+    unscheduled_pods: List[UnscheduledPod]
+    placed_node: np.ndarray  # i32[P] final node per pod (-1 = none)
+    dev_mask: np.ndarray  # bool[P, 8]
+    state: NodeState
+    pods: List[PodRow]
+    node_names: List[str]
+    wall_seconds: float
+    events: int
+
+
+class Simulator:
+    """Drives one cluster + workload through the compiled replay.
+
+    Method surface mirrors simulator.Interface (core.go:43-74); the fake
+    API server / informer machinery has no equivalent — cluster state is
+    the NodeState array itself.
+    """
+
+    def __init__(self, nodes: Sequence[NodeRow], cfg: SimulatorConfig = None):
+        self.cfg = cfg or SimulatorConfig()
+        self.nodes = list(nodes)
+        self.node_names = [n.name for n in self.nodes]
+        self.init_state = nodes_to_state(self.nodes)
+        self.rank = jnp.asarray(tiebreak_rank(len(self.nodes), self.cfg.seed))
+        self.log = LogSink(stream=None)
+        self.workload_pods: List[PodRow] = []
+        self.typical: Optional[TypicalPods] = None
+        self.node_total_milli_cpu = int(sum(n.cpu_milli for n in self.nodes))
+        self.node_total_milli_gpu = int(sum(n.gpu * MILLI for n in self.nodes))
+        self._policy_fns = [
+            (
+                make_policy(
+                    name,
+                    dim_ext_method=self.cfg.dim_ext_method,
+                    norm_method=self.cfg.norm_method,
+                ),
+                weight,
+            )
+            for name, weight in self.cfg.policies
+        ]
+        self._replay = make_replay(
+            self._policy_fns,
+            gpu_sel=self.cfg.gpu_sel_method,
+            report=self.cfg.report_per_event,
+        )
+
+    # ---- workload prep (core.go:103-142) ----
+
+    def set_workload_pods(self, pods: Sequence[PodRow]):
+        self.workload_pods = list(pods)
+
+    def set_typical_pods(self):
+        self.typical, self._typical_info = get_typical_pods(
+            self.workload_pods, self.cfg.typical_pods
+        )
+        self.log.info(f"Num of Total Pods: {len(self.workload_pods)}")
+        self.log.info(f"Num of Total Pod Sepc: {len(self._typical_info)}")
+
+    def set_skyline_pods(self):
+        self.skyline = get_skyline_pods(self.workload_pods)
+
+    def prepare_pods(self) -> List[PodRow]:
+        """SortClusterPods + tuning (core.go:131-142)."""
+        rng = np.random.default_rng(self.cfg.tuning_seed)
+        pods = sort_cluster_pods(
+            list(self.workload_pods), self.cfg.shuffle_pod, rng
+        )
+        if self.cfg.tuning_ratio > 0:
+            pods = tune_pods(
+                pods, self.node_total_milli_gpu, self.cfg.tuning_ratio, rng
+            )
+        return pods
+
+    # ---- the run (core.go:148 RunCluster → SchedulePods) ----
+
+    def schedule_pods(self, pods: Sequence[PodRow]) -> SimulateResult:
+        if self.typical is None:
+            self.set_typical_pods()
+        specs = pods_to_specs(pods)
+        ev_kind, ev_pod = build_events(pods, self.cfg.use_timestamps)
+        key = jax.random.PRNGKey(self.cfg.seed)
+        t0 = time.perf_counter()
+        result = self._replay(
+            self.init_state,
+            specs,
+            jnp.asarray(ev_kind),
+            jnp.asarray(ev_pod),
+            self.typical,
+            key,
+            self.rank,
+        )
+        placed = np.asarray(result.placed_node)
+        failed = np.asarray(result.ever_failed)
+        wall = time.perf_counter() - t0
+
+        if self.cfg.report_per_event and result.metrics is not None:
+            self._emit_event_reports(result.metrics)
+
+        unscheduled = [
+            UnscheduledPod(pods[i]) for i in np.flatnonzero(failed)
+        ]
+        self.last_result = SimulateResult(
+            unscheduled_pods=unscheduled,
+            placed_node=placed,
+            dev_mask=np.asarray(result.dev_mask),
+            state=jax.tree.map(np.asarray, result.state),
+            pods=list(pods),
+            node_names=self.node_names,
+            wall_seconds=wall,
+            events=len(ev_kind),
+        )
+        self.log.info(f"there are {len(unscheduled)} unscheduled pods")
+        return self.last_result
+
+    def run(self) -> SimulateResult:
+        """Full experiment (core.go:86-268 minus deschedule/inflation, which
+        the CLI layers on)."""
+        self.set_typical_pods()
+        self.set_skyline_pods()
+        pods = self.prepare_pods()
+        self.log.info(f"Number of original workload pods: {len(self.workload_pods)}")
+        res = self.schedule_pods(pods)
+        self.cluster_analysis("InitSchedule")
+        return res
+
+    # ---- reporting (analysis.go) ----
+
+    def _emit_event_reports(self, m):
+        amounts = np.asarray(m.frag_amounts)
+        un = np.asarray(m.used_nodes)
+        ug = np.asarray(m.used_gpus)
+        um = np.asarray(m.used_gpu_milli)
+        uc = np.asarray(m.used_cpu_milli)
+        ag = np.asarray(m.arrived_gpu_milli)
+        ac = np.asarray(m.arrived_cpu_milli)
+        pc = np.asarray(m.power_cpu)
+        pg = np.asarray(m.power_gpu)
+        total_gpus = int(np.asarray(self.init_state.gpu_cnt).sum())
+        for e in range(amounts.shape[0]):
+            report_frag_line(self.log, amounts[e])
+            report_alloc_lines(
+                self.log, int(un[e]), int(ug[e]), int(um[e]), total_gpus,
+                int(ag[e]), int(uc[e]), int(ac[e]),
+            )
+            report_power_line(self.log, float(pc[e]), float(pg[e]))
+
+    def alloc_maps(self, state: NodeState):
+        """Cluster requested/allocatable per resource (ref: alloc.go:90-127
+        GetNodeAllocMap aggregated)."""
+        s = jax.tree.map(np.asarray, state)
+        slot = np.arange(s.gpu_left.shape[1])[None, :] < s.gpu_cnt[:, None]
+        used_dev = slot & (s.gpu_left < MILLI)
+        requested = {
+            "MilliCpu": int((s.cpu_cap - s.cpu_left).sum()),
+            "Memory": int(np.int64(s.mem_cap - s.mem_left).sum() * 1024 * 1024),
+            "Gpu": int(used_dev.sum()),
+            "MilliGpu": int((np.where(slot, MILLI - s.gpu_left, 0)).sum()),
+        }
+        allocatable = {
+            "MilliCpu": int(np.int64(s.cpu_cap).sum()),
+            "Memory": int(np.int64(s.mem_cap).sum() * 1024 * 1024),
+            "Gpu": int(s.gpu_cnt.sum()),
+            "MilliGpu": int(s.gpu_cnt.sum()) * MILLI,
+        }
+        return requested, allocatable
+
+    def cluster_analysis(self, tag: str = "InitSchedule"):
+        """The end-of-stage 16-line analysis block (analysis.go:145-199)."""
+        from tpusim.ops.frag import cluster_frag_report
+
+        state = (
+            self.last_result.state if hasattr(self, "last_result") else self.init_state
+        )
+        state_j = jax.tree.map(jnp.asarray, state)
+        amounts = np.asarray(cluster_frag_report(state_j, self.typical)[0])
+        requested, allocatable = self.alloc_maps(state)
+        cluster_analysis_block(self.log, tag, amounts, requested, allocatable)
+        return amounts, requested, allocatable

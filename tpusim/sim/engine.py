@@ -1,0 +1,180 @@
+"""Trace-replay engine: the event hot loop as one compiled lax.scan.
+
+Replaces the reference's driver↔scheduler goroutine pair with its fake API
+server and 2 ms spin-waits (simulator.go:377-433 SchedulePods,
+:490-568 sync*): each creation event runs the full scheduling cycle
+synchronously on device; each deletion event returns the pod's recorded
+resources. The per-event ClusterGpuFragReport/ClusterPowerConsumptionReport
+(simulator.go:426-427, analysis.go:24-126) — the reference's dominant cost —
+becomes a vmapped array reduction emitted as scan outputs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tpusim.constants import MILLI
+from tpusim.ops.energy import node_power
+from tpusim.ops.frag import cluster_frag_amounts, frag_sum_except_q3, frag_sum_q1q2q4
+from tpusim.sim.step import Placement, schedule_one, unschedule
+from tpusim.types import NodeState, PodSpec
+
+EV_CREATE = 0
+EV_DELETE = 1
+EV_SKIP = 2  # padding / `simon/pod-unscheduled`-annotated pods (simulator.go:391-399)
+
+_power_nodes = jax.vmap(node_power)
+
+
+class EventMetrics(NamedTuple):
+    """Per-event report rows (ref: analysis.go:59-126 [Report]/[Alloc] lines)."""
+
+    frag_amounts: jnp.ndarray  # f32[E, 7]
+    used_nodes: jnp.ndarray  # i32[E]
+    used_gpus: jnp.ndarray  # i32[E]
+    used_gpu_milli: jnp.ndarray  # i32[E]
+    used_cpu_milli: jnp.ndarray  # i32[E]
+    arrived_gpu_milli: jnp.ndarray  # i32[E]
+    arrived_cpu_milli: jnp.ndarray  # i32[E]
+    power_cpu: jnp.ndarray  # f32[E]
+    power_gpu: jnp.ndarray  # f32[E]
+
+    def frag_gpu_milli(self):
+        return frag_sum_except_q3(self.frag_amounts)
+
+    def idle_gpu_milli(self):
+        return self.frag_amounts.sum(-1)
+
+    def frag_ratio_pct(self):
+        return 100.0 * self.frag_gpu_milli() / self.idle_gpu_milli()
+
+    def q124_ratio_pct(self):
+        return 100.0 * frag_sum_q1q2q4(self.frag_amounts) / self.idle_gpu_milli()
+
+
+class ReplayResult(NamedTuple):
+    state: NodeState
+    placed_node: jnp.ndarray  # i32[P], -1 = unscheduled/not-arrived/deleted
+    dev_mask: jnp.ndarray  # bool[P, 8]
+    ever_failed: jnp.ndarray  # bool[P] creation attempted and rejected
+    metrics: EventMetrics
+    event_node: jnp.ndarray  # i32[E] node chosen at each event (-1 otherwise)
+
+
+def cluster_usage(state: NodeState):
+    """[Alloc]/[AllocCPU] aggregates (ref: analysis.go:91-99): a node is
+    'used' if any GPU is non-idle or any CPU is taken; used GPUs count every
+    device on a used node."""
+    used = (state.fully_free_gpus() < state.gpu_cnt) | (
+        state.cpu_left < state.cpu_cap
+    )
+    used_nodes = used.sum().astype(jnp.int32)
+    used_gpus = jnp.where(used, state.gpu_cnt, 0).sum().astype(jnp.int32)
+    used_gpu_milli = (
+        jnp.where(used, state.gpu_cnt * MILLI - state.total_gpu_left(), 0)
+        .sum()
+        .astype(jnp.int32)
+    )
+    used_cpu_milli = (
+        jnp.where(used, state.cpu_cap - state.cpu_left, 0).sum().astype(jnp.int32)
+    )
+    return used_nodes, used_gpus, used_gpu_milli, used_cpu_milli
+
+
+def _metrics_row(state, tp, arr_cpu, arr_gpu):
+    amounts = cluster_frag_amounts(state, tp).sum(0)
+    used_nodes, used_gpus, used_gpu_milli, used_cpu_milli = cluster_usage(state)
+    pc, pg = _power_nodes(
+        state.cpu_left, state.cpu_cap, state.gpu_left, state.gpu_cnt,
+        state.gpu_type, state.cpu_type,
+    )
+    return (
+        amounts, used_nodes, used_gpus, used_gpu_milli, used_cpu_milli,
+        arr_gpu, arr_cpu, pc.sum(), pg.sum(),
+    )
+
+
+def make_replay(policies, gpu_sel: str = "best", report: bool = True):
+    """Build a jitted trace replayer for a static policy configuration.
+
+    policies: [(policy_fn, weight)]; gpu_sel: Reserve-phase gpuSelMethod.
+    report=False skips per-event metric rows (pure-throughput mode).
+    """
+
+    @jax.jit
+    def replay(
+        state: NodeState,
+        pods: PodSpec,  # [P] arrays
+        ev_kind: jnp.ndarray,  # i32[E]
+        ev_pod: jnp.ndarray,  # i32[E]
+        tp,
+        key,
+        tiebreak_rank=None,
+    ) -> ReplayResult:
+        num_pods = pods.cpu.shape[0]
+        placed = jnp.full(num_pods, -1, jnp.int32)
+        masks = jnp.zeros((num_pods, state.gpu_left.shape[1]), jnp.bool_)
+        failed = jnp.zeros(num_pods, jnp.bool_)
+
+        def body(carry, ev):
+            state, placed, masks, failed, arr_cpu, arr_gpu, key = carry
+            kind, idx = ev
+            pod = jax.tree.map(lambda a: a[idx], pods)
+            key, sub = jax.random.split(key)
+
+            def do_create(_):
+                # arrived counters accumulate per creation event regardless
+                # of outcome (simulator.go:406-408).
+                new_state, pl = schedule_one(
+                    state, pod, sub, policies, gpu_sel, tp, tiebreak_rank
+                )
+                return (
+                    new_state,
+                    placed.at[idx].set(pl.node),
+                    masks.at[idx].set(pl.dev_mask),
+                    failed.at[idx].set(pl.node < 0),
+                    arr_cpu + pod.cpu,
+                    arr_gpu + pod.total_gpu_milli(),
+                    pl.node,
+                )
+
+            def do_delete(_):
+                pl = Placement(placed[idx], masks[idx])
+                new_state = unschedule(state, pod, pl)
+                return (
+                    new_state,
+                    placed.at[idx].set(-1),
+                    masks.at[idx].set(False),
+                    failed,
+                    arr_cpu,
+                    arr_gpu,
+                    jnp.int32(-1),
+                )
+
+            def do_skip(_):
+                return (state, placed, masks, failed, arr_cpu, arr_gpu, jnp.int32(-1))
+
+            state2, placed2, masks2, failed2, arr_cpu2, arr_gpu2, node = jax.lax.switch(
+                jnp.clip(kind, 0, 2), [do_create, do_delete, do_skip], None
+            )
+            if report:
+                row = _metrics_row(state2, tp, arr_cpu2, arr_gpu2)
+            else:
+                row = ()
+            return (state2, placed2, masks2, failed2, arr_cpu2, arr_gpu2, key), (
+                row,
+                node,
+            )
+
+        init = (state, placed, masks, failed, jnp.int32(0), jnp.int32(0), key)
+        (state, placed, masks, failed, _, _, _), (rows, nodes) = jax.lax.scan(
+            body, init, (ev_kind, ev_pod)
+        )
+        metrics = EventMetrics(*rows) if report else None
+        return ReplayResult(state, placed, masks, failed, metrics, nodes)
+
+    return replay

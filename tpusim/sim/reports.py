@@ -1,0 +1,123 @@
+"""analysis.py-compatible log emission (ref: §5.5 of SURVEY.md — the metric
+contract is logrus Info lines parsed by scripts/analysis.py:120-260).
+
+Formats reproduce pkg/simulator/analysis.go + pkg/utils/alloc.go exactly
+(alloc keys use the parser-side names 'MilliCpu' etc. from
+scripts/analysis.py ALLO_KEYS). Each emitted line carries a literal ``\\n``
+escape before the closing quote, as logrus renders embedded newlines.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import IO, List, Optional, Sequence
+
+import numpy as np
+
+from tpusim.constants import FRAG_CLASS_NAMES, Q3_SATISFIED
+
+_ALLOC_KEYS = ("MilliCpu", "Memory", "Gpu", "MilliGpu")
+
+
+class LogSink:
+    """Collects logrus-text-format info lines (`level=info msg="..."`)."""
+
+    def __init__(self, stream: Optional[IO] = None):
+        self.lines: List[str] = []
+        self.stream = stream
+
+    def info(self, msg: str):
+        line = f'time="2000-01-01T00:00:00Z" level=info msg="{msg}\\n"'
+        self.lines.append(line)
+        if self.stream is not None:
+            self.stream.write(line + "\n")
+
+    def infoln(self):
+        line = 'time="2000-01-01T00:00:00Z" level=info'
+        self.lines.append(line)
+        if self.stream is not None:
+            self.stream.write(line + "\n")
+
+    def dump(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def report_frag_line(log: LogSink, amounts: np.ndarray):
+    """Per-event `[Report] ... (origin)` line (analysis.go:109)."""
+    idle = float(amounts.sum())
+    frag = idle - float(amounts[Q3_SATISFIED])
+    q124 = float(amounts[0] + amounts[1] + amounts[3])
+    fr = 100.0 * frag / idle if idle else 0.0
+    qr = 100.0 * q124 / idle if idle else 0.0
+    log.info(
+        f"[Report]; Frag amount: {frag:.2f}; Frag ratio: {fr:.2f}%; "
+        f"Q124 ratio: {qr:.2f}%; (origin)"
+    )
+
+
+def report_bellman_line(log: LogSink, bellman: float, idle: float):
+    """`[Report] ... (bellman)` variant (analysis.go:110)."""
+    r = 100.0 * bellman / idle if idle else 0.0
+    log.info(f"[Report]; Frag amount: {bellman:.2f}; Frag ratio: {r:.2f}%; (bellman)")
+
+
+def report_alloc_lines(
+    log: LogSink,
+    used_nodes: int,
+    used_gpus: int,
+    used_gpu_milli: int,
+    total_gpus: int,
+    arrived_gpu_milli: int,
+    used_cpu_milli: int,
+    arrived_cpu_milli: int,
+):
+    """Per-event `[Alloc]`/`[AllocCPU]` lines (analysis.go:115-118)."""
+    log.info(
+        f"[Alloc]; Used nodes: {used_nodes}; Used GPUs: {used_gpus}; "
+        f"Used GPU Milli: {used_gpu_milli}; Total GPUs: {total_gpus}; "
+        f"Arrived GPU Milli: {arrived_gpu_milli}"
+    )
+    log.info(
+        f"[AllocCPU]; Used CPU Milli: {used_cpu_milli}; "
+        f"Arrived CPU Milli: {arrived_cpu_milli}"
+    )
+
+
+def report_power_line(log: LogSink, power_cpu: float, power_gpu: float):
+    """`[Power]` line (analysis.go:54-55)."""
+    log.info(
+        f"[Power]; cluster: {power_cpu + power_gpu:.1f}; "
+        f"ClusterCPU: {power_cpu:.1f}; ClusterGPU: {power_gpu:.1f}"
+    )
+
+
+def cluster_analysis_block(
+    log: LogSink,
+    tag: str,
+    frag_amounts: np.ndarray,  # f32[7]
+    alloc_requested: dict,
+    alloc_allocatable: dict,
+):
+    """The 16-line `Cluster Analysis Results` block
+    (analysis.go:177-199 + alloc.go:65-88)."""
+    log.infoln()
+    log.info(f"========== Cluster Analysis Results ({tag}) ==========")
+    log.info("Allocation Ratio:")
+    for k in _ALLOC_KEYS:
+        rval = alloc_requested[k]
+        aval = alloc_allocatable[k]
+        ratio = 100.0 * rval / aval if aval else 0.0
+        log.info(f"    {k:<8}: {ratio:4.1f}% ({rval}/{aval})")
+    total = float(frag_amounts.sum())
+    denom = total if total else 1.0
+    for v, name in enumerate(FRAG_CLASS_NAMES):
+        val = float(frag_amounts[v])
+        log.info(f"{name:<13}: {val / 1000:6.2f} x 10^3 ({100 * val / denom:5.2f}%)")
+    log.info("--------------------")
+    log.info(f"{'idle_gpu_milli':<13}: {total / 1000:6.2f} x 10^3 (100.0%)")
+    frag = total - float(frag_amounts[Q3_SATISFIED])
+    log.info(
+        f"{'frag_gpu_milli':<13}: {frag / 1000:6.2f} x 10^3 ({100 * frag / denom:5.2f}%)"
+    )
+    log.info("==============================================")
+    log.infoln()

@@ -1,0 +1,172 @@
+"""One scheduling cycle as a pure function (replaces vendored
+scheduleOne: Filter → Score → Normalize → selectHost → Reserve → Bind,
+generic_scheduler.go:143-210 + plugin/open_gpu_share.go Reserve).
+
+The reference's per-cycle node parallelism (a 16-way parallelize helper over
+nodes) becomes a vmap over the node axis; the annotation/patch round-trips of
+Reserve/Bind become a scatter update of the NodeState arrays.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpusim.constants import MAX_GPUS_PER_NODE, MILLI
+from tpusim.ops.resource import (
+    allocate_share_best,
+    allocate_share_random,
+    allocate_share_worst,
+    allocate_two_pointer,
+    can_allocate,
+    is_accessible,
+)
+from tpusim.policies import ScoreContext, minmax_normalize_i32, pwr_normalize_i32
+from tpusim.policies.clustering import pod_affinity_class
+from tpusim.types import NodeState, PodSpec
+
+_INT_MAX = jnp.int32(jnp.iinfo(jnp.int32).max)
+
+
+def filter_nodes(state: NodeState, pod: PodSpec) -> jnp.ndarray:
+    """Filter phase → bool[N] feasibility.
+
+    Combines the default NodeResourcesFit (cpu/mem request fit) with the
+    Open-Gpu-Share Filter (open_gpu_share.go:81-118): GPU pods need a GPU
+    node, a matching GPU model, and an AllocateGpuId packing
+    (gpunodeinfo.go:136-204 — can_allocate reproduces its feasibility).
+    """
+    fit = (state.cpu_left >= pod.cpu) & (state.mem_left >= pod.mem)
+    gpu_ok = (
+        (state.gpu_cnt > 0)
+        & is_accessible(state.gpu_type, pod.gpu_mask)
+        & jax.vmap(can_allocate, in_axes=(0, None, None))(
+            state.gpu_left, pod.gpu_milli, pod.gpu_num
+        )
+    )
+    needs_gpu = pod.total_gpu_milli() > 0
+    return fit & (~needs_gpu | gpu_ok)
+
+
+class Placement(NamedTuple):
+    """Result of one cycle. node == -1 → unschedulable (the reference marks
+    the pod condition and deletes it, simulator.go:444-455)."""
+
+    node: jnp.ndarray  # i32, -1 = failed
+    dev_mask: jnp.ndarray  # bool[8] devices taken (all False for CPU pods)
+
+
+def _choose_share_device(gpu_left, pod, policy_dev, gpu_sel: str, key):
+    """Reserve-phase device choice for a share-GPU pod
+    (open_gpu_share.go:252-343): the configured gpuSelMethod either delegates
+    to the scoring policy's own pick or uses best/worst/random fit."""
+    if gpu_sel == "best":
+        return allocate_share_best(gpu_left, pod.gpu_milli)
+    if gpu_sel == "worst":
+        return allocate_share_worst(gpu_left, pod.gpu_milli)
+    if gpu_sel == "random":
+        return allocate_share_random(gpu_left, pod.gpu_milli, key)
+    # policy-provided (FGDScore / PWRScore / DotProductScore): fall back to
+    # best-fit if the policy had no pick (defensive; post-Filter it has one).
+    return jnp.where(
+        policy_dev >= 0, policy_dev, allocate_share_best(gpu_left, pod.gpu_milli)
+    )
+
+
+def schedule_one(
+    state: NodeState,
+    pod: PodSpec,
+    key,
+    policies: Sequence[Tuple[object, int]],
+    gpu_sel: str = "best",
+    tp=None,
+    tiebreak_rank=None,
+) -> Tuple[NodeState, Placement]:
+    """Run one full scheduling cycle for `pod` and commit the binding.
+
+    policies: [(policy_fn, weight)] — the enabled Score plugins with their
+    config weights (policy selection in the reference = one plugin at weight
+    1000, §5.6). tiebreak_rank: i32[N] permutation standing in for the
+    random node-name prefixes + lexicographic selectHost tie-break
+    (simulator.go:584-588, generic_scheduler.go:185-210).
+    """
+    n = state.num_nodes
+    if tiebreak_rank is None:
+        tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
+
+    feasible = filter_nodes(state, pod)
+    k_rand, k_sel = jax.random.split(key)
+    ctx = ScoreContext(tp=tp, feasible=feasible, rng=k_rand)
+
+    total = jnp.zeros(n, jnp.int32)
+    policy_share_dev = jnp.full(n, -1, jnp.int32)
+    sel_policy_names = {"FGDScore", "PWRScore", "DotProductScore"}
+    for fn, weight in policies:
+        res = fn(state, pod, ctx)
+        raw = res.raw_scores
+        if fn.normalize == "minmax":
+            raw = minmax_normalize_i32(raw, feasible)
+        elif fn.normalize == "pwr":
+            raw = pwr_normalize_i32(raw, feasible)
+        total = total + jnp.int32(weight) * raw
+        if gpu_sel == fn.policy_name and fn.policy_name in sel_policy_names:
+            policy_share_dev = res.share_dev
+
+    # selectHost: max weighted score over feasible nodes, smallest tie-break
+    # rank wins (the reference's lexicographic order over prefixed names).
+    cand = jnp.where(feasible, total, -_INT_MAX)
+    best = jnp.max(cand)
+    winner_rank = jnp.where(feasible & (cand == best), tiebreak_rank, _INT_MAX)
+    node = jnp.argmin(winner_rank).astype(jnp.int32)
+    ok = feasible.any()
+
+    # Reserve: concrete device allocation on the chosen node.
+    gpu_left = state.gpu_left[node]
+    share_dev = _choose_share_device(
+        gpu_left, pod, policy_share_dev[node], gpu_sel, k_sel
+    )
+    share_mask = jax.nn.one_hot(share_dev, MAX_GPUS_PER_NODE, dtype=jnp.bool_) & (
+        share_dev >= 0
+    )
+    # Whole-GPU / multi-GPU pods: two-pointer pack in device-index order
+    # (gpunodeinfo.go:182-201; == first fully-free devices when milli == 1000).
+    units, _ = allocate_two_pointer(gpu_left, pod.gpu_milli, pod.gpu_num)
+    whole_mask = units > 0
+    is_share = pod.is_gpu_share()
+    has_gpu = pod.total_gpu_milli() > 0
+    dev_mask = jnp.where(has_gpu, jnp.where(is_share, share_mask, whole_mask), False)
+    dev_mask = dev_mask & ok
+
+    # Bind: scatter-commit the placement.
+    new_state = state._replace(
+        cpu_left=state.cpu_left.at[node].add(jnp.where(ok, -pod.cpu, 0)),
+        mem_left=state.mem_left.at[node].add(jnp.where(ok, -pod.mem, 0)),
+        gpu_left=state.gpu_left.at[node].add(
+            -dev_mask.astype(jnp.int32) * pod.gpu_milli
+        ),
+        aff_cnt=state.aff_cnt.at[
+            node, jnp.maximum(pod_affinity_class(pod), 0)
+        ].add(jnp.where(ok & (pod_affinity_class(pod) >= 0), 1, 0)),
+    )
+    return new_state, Placement(jnp.where(ok, node, -1).astype(jnp.int32), dev_mask)
+
+
+def unschedule(state: NodeState, pod: PodSpec, placement: Placement) -> NodeState:
+    """Evict a placed pod, returning resources to its recorded devices
+    (ref: deletePod → cache removal + NodeResource.Add, simulator.go:334-357,
+    resource.go:482-531)."""
+    node = jnp.maximum(placement.node, 0)
+    placed = placement.node >= 0
+    cls = pod_affinity_class(pod)
+    return state._replace(
+        cpu_left=state.cpu_left.at[node].add(jnp.where(placed, pod.cpu, 0)),
+        mem_left=state.mem_left.at[node].add(jnp.where(placed, pod.mem, 0)),
+        gpu_left=state.gpu_left.at[node].add(
+            jnp.where(placed, placement.dev_mask.astype(jnp.int32) * pod.gpu_milli, 0)
+        ),
+        aff_cnt=state.aff_cnt.at[node, jnp.maximum(cls, 0)].add(
+            jnp.where(placed & (cls >= 0), -1, 0)
+        ),
+    )

@@ -1,0 +1,105 @@
+"""Typical-pod and skyline-pod extraction (ref: pkg/utils/frag.go:285-409).
+
+Host-side (runs once per workload swap, core.go:195-209); the result is a
+fixed [T] TypicalPods array consumed by every frag kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+from tpusim.constants import (
+    DEFAULT_TYPICAL_POD_INCREASE_STEP,
+    DEFAULT_TYPICAL_POD_POPULARITY,
+    MILLI,
+    gpu_spec_to_mask,
+)
+from tpusim.io.trace import PodRow
+from tpusim.types import TypicalPods, make_typical_pods
+
+
+@dataclass
+class TypicalPodsConfig:
+    """ref: pkg/api/v1alpha1/types.go:104-109."""
+
+    is_involved_cpu_pods: bool = True
+    pod_popularity_threshold: int = 0  # 0 → default 60
+    pod_increase_step: int = 0  # 0 → default 10
+    gpu_res_weight: float = 0.0
+
+
+def get_typical_pods(
+    pods: Sequence[PodRow], cfg: TypicalPodsConfig = TypicalPodsConfig()
+) -> Tuple[TypicalPods, List[Tuple[tuple, float]]]:
+    """Histogram pod specs, keep the top specs covering the popularity
+    threshold in increase-step batches, renormalize to Σfreq = 1
+    (ref: frag.go:285-380 GetTypicalPods).
+
+    Returns (TypicalPods arrays, [(spec_key, freq)] for logging/debugging).
+    """
+    counts: dict = {}
+    total = 0.0
+    for p in pods:
+        if not cfg.is_involved_cpu_pods and p.num_gpu == 0:
+            continue
+        w = 1.0
+        if cfg.gpu_res_weight > 0 and p.gpu_milli == MILLI:
+            w = 1.0 + p.num_gpu * cfg.gpu_res_weight
+        key = p.spec_key()
+        counts[key] = counts.get(key, 0.0) + w
+        total += w
+    if not counts:
+        return make_typical_pods([]), []
+
+    # sort.Reverse over (Percentage, PodResource.Less): descending count,
+    # ties by descending (cpu, milli, gpu_num, gpu_type) (resource.go:18-42).
+    ordered = sorted(counts.items(), key=lambda kv: (-kv[1],) + _neg_key(kv[0]))
+
+    threshold = cfg.pod_popularity_threshold or DEFAULT_TYPICAL_POD_POPULARITY
+    step = cfg.pod_increase_step or DEFAULT_TYPICAL_POD_INCREASE_STEP
+    expected = threshold * total / 100.0
+    i, pod_res_num, cum = 0, 0, 0.0
+    while cum < expected:
+        pod_res_num += step
+        while i < pod_res_num and i < len(ordered):
+            cum += ordered[i][1]
+            i += 1
+        if pod_res_num >= len(ordered):
+            break
+
+    kept = ordered[:i]
+    denom = cum if i < len(ordered) else total
+    rows, info = [], []
+    for key, cnt in kept:
+        cpu, milli, num, spec = key
+        freq = cnt / denom
+        rows.append((cpu, milli, num, gpu_spec_to_mask(spec), freq))
+        info.append((key, freq))
+    return make_typical_pods(rows), info
+
+
+def _neg_key(key: tuple) -> tuple:
+    cpu, milli, num, spec = key
+    return (-cpu, -milli, -num, _neg_str(spec))
+
+
+class _neg_str(str):
+    """Reverses string comparison for the descending GpuType tie-break."""
+
+    def __lt__(self, other):  # noqa: D105
+        return str.__gt__(self, other)
+
+
+def get_skyline_pods(pods: Sequence[PodRow]) -> List[Tuple[int, int]]:
+    """Pareto skyline over (MilliCpu, MilliGpu) (ref: frag.go:382-409):
+    stable-sort ascending by (cpu, milli), then keep points with strictly
+    larger CPU and strictly smaller GPU than the last kept one."""
+    res = sorted(pods, key=lambda p: (p.cpu_milli, p.gpu_milli))
+    skyline: List[Tuple[int, int]] = []
+    for p in res:
+        if not skyline or (
+            p.cpu_milli > skyline[-1][0] and p.gpu_milli < skyline[-1][1]
+        ):
+            skyline.append((p.cpu_milli, p.gpu_milli))
+    return skyline

@@ -1,0 +1,117 @@
+"""Workload transformations: sort/shuffle, tuning, inflation pods
+(ref: pkg/simulator/simulator.go:975-1013 SortClusterPods, :1200-1282
+TunePodsByNodeTotalResource, :1015-1132 RunWorkloadInflationEvaluation).
+
+Host-side list manipulation over PodRow; RNG parity is distribution-level
+(numpy Generator seeded from the config seed vs Go's global math/rand,
+SURVEY.md §7.3 "RNG parity").
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import replace
+from typing import List, Sequence
+
+import numpy as np
+
+from tpusim.io.trace import PodRow
+
+
+def sort_cluster_pods(pods: List[PodRow], shuffle: bool, rng: np.random.Generator):
+    """shuffle=True: name-sort then random shuffle; else stable sort by
+    creation time with name tie-break (ref: simulator.go:975-1013; pods
+    without a creation annotation all collapse to 'now' i.e. keep order —
+    our trace rows always carry creation_time, matching the annotated path).
+    """
+    if shuffle:
+        pods.sort(key=lambda p: p.name)
+        rng.shuffle(pods)
+    else:
+        pods.sort(key=lambda p: (p.creation_time, p.name))
+    return pods
+
+
+def total_pod_gpu_milli(pods: Sequence[PodRow]) -> int:
+    return sum(p.total_gpu_milli for p in pods)
+
+
+def total_pod_cpu_milli(pods: Sequence[PodRow]) -> int:
+    return sum(p.cpu_milli for p in pods)
+
+
+def tune_pods(
+    pods: List[PodRow],
+    node_total_milli_gpu: int,
+    ratio: float,
+    rng: np.random.Generator,
+) -> List[PodRow]:
+    """Prune or clone-append random pods until total GPU request ≈
+    ratio × cluster GPU capacity (ref: simulator.go:1200-1282).
+
+    tuneUp preserves the reference's stopping rule bug-for-bug: the break
+    test adds the candidate's *per-GPU* milli, while the accumulator adds its
+    *total* milli (simulator.go:1271-1276).
+    """
+    if ratio <= 0:
+        return pods
+    total = total_pod_gpu_milli(pods)
+    tgt = ratio * node_total_milli_gpu
+    if total == tgt:
+        return pods
+    if total > tgt:
+        pods = list(pods)
+        while total > tgt:
+            if not pods:
+                raise RuntimeError("empty pod list while tuning down")
+            idx = int(rng.integers(len(pods)))
+            total -= pods[idx].total_gpu_milli
+            pods.pop(idx)
+        return pods
+    # tune up: clone uniform-random pods from the original workload,
+    # appended at the end (they schedule after the originals).
+    src = list(pods)
+    out = list(pods)
+    i = 0
+    while True:
+        idx = int(rng.integers(len(src)))
+        cand = src[idx]
+        if total + cand.gpu_milli > tgt:
+            break
+        clone = replace(cand, name=f"{cand.name}-tuned-{i}")
+        total += clone.total_gpu_milli
+        out.append(clone)
+        i += 1
+    return out
+
+
+def inflation_pods(
+    workload: Sequence[PodRow],
+    ratio: float,
+    rng: np.random.Generator,
+    cluster_cpu_milli: int,
+    cluster_gpu_milli: int,
+    current_cpu_milli: int,
+    current_gpu_milli: int,
+) -> List[PodRow]:
+    """Extra cloned pods for inflation evaluation
+    (ref: simulator.go:1015-1132 GenerateWorkloadInflationPods): clone
+    ceil(n×ratio)−n random workload pods, skipping clones that would push
+    the running totals past cluster capacity."""
+    if ratio <= 1.0 or not workload:
+        return []
+    n = len(workload)
+    extra = int(np.ceil(n * ratio)) - n
+    out: List[PodRow] = []
+    cpu, gpu = current_cpu_milli, current_gpu_milli
+    for i in range(extra):
+        idx = int(rng.integers(n))
+        cand = workload[idx]
+        if cpu + cand.cpu_milli > cluster_cpu_milli:
+            continue
+        if gpu + cand.total_gpu_milli > cluster_gpu_milli:
+            continue
+        cpu += cand.cpu_milli
+        gpu += cand.total_gpu_milli
+        out.append(replace(cand, name=f"{cand.name}-infl-{i}"))
+    return out
