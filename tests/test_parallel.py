@@ -1,0 +1,91 @@
+"""Multi-chip sharding: the node-axis-sharded replay must be bit-identical
+to the single-device replay (sharding is an execution detail, not semantics),
+and padding rows must be inert."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusim.io.trace import tiebreak_rank
+from tpusim.parallel import make_mesh, make_sharded_replay, pad_nodes, shard_state
+from tpusim.policies import make_policy
+from tpusim.sim.engine import EV_CREATE, EV_DELETE, make_replay
+from tpusim.types import PodSpec, make_node_state, make_typical_pods
+
+
+def _fixture(num_nodes=13, num_pods=24, seed=3):
+    rng = np.random.default_rng(seed)
+    state = make_node_state(
+        cpu_cap=rng.choice([32000, 64000], num_nodes),
+        mem_cap=np.full(num_nodes, 262144),
+        gpu_cnt=rng.choice([0, 2, 4, 8], num_nodes),
+        gpu_type=rng.integers(0, 3, num_nodes),
+    )
+    tp = make_typical_pods(
+        [(4000, 500, 1, 0, 0.5), (8000, 1000, 2, 0, 0.3), (2000, 0, 0, 0, 0.2)]
+    )
+    pods = PodSpec(
+        cpu=jnp.asarray(rng.choice([2000, 8000], num_pods).astype(np.int32)),
+        mem=jnp.asarray(np.full(num_pods, 4096, np.int32)),
+        gpu_milli=jnp.asarray(rng.choice([300, 1000], num_pods).astype(np.int32)),
+        gpu_num=jnp.asarray(rng.choice([0, 1, 2], num_pods).astype(np.int32)),
+        gpu_mask=jnp.zeros(num_pods, jnp.int32),
+    )
+    kind = np.full(num_pods, EV_CREATE, np.int32)
+    kind[5] = EV_DELETE  # delete of a never-placed pod is a no-op
+    return state, tp, pods, jnp.asarray(kind), jnp.arange(num_pods, dtype=jnp.int32)
+
+
+@pytest.mark.parametrize("policy", ["FGDScore", "BestFitScore"])
+def test_sharded_replay_matches_single_device(policy):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    state, tp, pods, ev_kind, ev_pod = _fixture()
+    rank = jnp.asarray(tiebreak_rank(state.num_nodes, seed=0))
+    key = jax.random.PRNGKey(7)
+    policies = [(make_policy(policy), 1000)]
+
+    base = make_replay(policies, gpu_sel="best", report=True)(
+        state, pods, ev_kind, ev_pod, tp, key, rank
+    )
+
+    mesh = make_mesh(8)
+    pstate, prank = pad_nodes(state, rank, 8)
+    pstate = shard_state(pstate, mesh)
+    sharded = make_sharded_replay(policies, mesh, gpu_sel="best", report=True)(
+        pstate, pods, ev_kind, ev_pod, tp, key, prank
+    )
+
+    np.testing.assert_array_equal(base.placed_node, sharded.placed_node)
+    np.testing.assert_array_equal(base.dev_mask, sharded.dev_mask)
+    n = state.num_nodes
+    for a, b in zip(base.state, sharded.state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[:n])
+    np.testing.assert_allclose(
+        np.asarray(base.metrics.frag_amounts),
+        np.asarray(sharded.metrics.frag_amounts),
+        rtol=1e-6,
+    )
+    # pad rows must be metric-inert too: usage/power identical
+    np.testing.assert_array_equal(base.metrics.used_nodes, sharded.metrics.used_nodes)
+    np.testing.assert_array_equal(
+        base.metrics.used_cpu_milli, sharded.metrics.used_cpu_milli
+    )
+    np.testing.assert_allclose(
+        np.asarray(base.metrics.power_cpu), np.asarray(sharded.metrics.power_cpu)
+    )
+
+
+def test_pad_nodes_inert():
+    state, tp, pods, ev_kind, ev_pod = _fixture(num_nodes=5)
+    rank = jnp.asarray(tiebreak_rank(5, seed=0))
+    pstate, prank = pad_nodes(state, rank, 8)
+    assert pstate.num_nodes == 8
+    # pad rows fail the fit test for every pod (mem_left = -1 < any request)
+    assert np.all(np.asarray(pstate.mem_left[5:]) == -1)
+    assert np.all(np.asarray(pstate.cpu_left[5:]) == 0)
+    assert np.all(np.asarray(prank[5:]) == np.iinfo(np.int32).max)
+    # cluster aggregates unchanged
+    assert int(pstate.gpu_cnt.sum()) == int(state.gpu_cnt.sum())
+    assert int(pstate.cpu_cap.sum()) == int(state.cpu_cap.sum())

@@ -1,0 +1,15 @@
+from tpusim.parallel.sharding import (
+    make_mesh,
+    make_sharded_replay,
+    pad_nodes,
+    shard_state,
+    state_sharding,
+)
+
+__all__ = [
+    "make_mesh",
+    "make_sharded_replay",
+    "pad_nodes",
+    "shard_state",
+    "state_sharding",
+]

@@ -1,0 +1,118 @@
+"""Multi-chip scale-out: shard the node axis over a device mesh.
+
+The reference's only scale-out is process-level fan-out (xargs --max-procs,
+experiments/README.md step 2) and a 16-way in-process parallelize helper over
+nodes (vendored generic_scheduler.go:473-560). Here the node dimension itself
+is sharded over a `jax.sharding.Mesh` axis ("nodes"): every policy/frag
+kernel is embarrassingly parallel over nodes, so Filter+Score run fully local
+to each chip and XLA inserts the cross-chip collectives (an all-reduce
+max/argmin pair) only for the selectHost reduction and the cluster-level
+metric sums — the natural ICI traffic pattern for this workload.
+
+The event loop stays a lax.scan whose carry (NodeState) keeps the node-axis
+sharding across iterations; per-event scatter updates touch one node row and
+XLA keeps them local to the owning chip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpusim.constants import NO_GPU
+from tpusim.types import NodeState
+
+NODE_AXIS = "nodes"
+
+_INT_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D device mesh over the node axis."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def pad_nodes(
+    state: NodeState, rank: jnp.ndarray, multiple: int
+) -> Tuple[NodeState, jnp.ndarray]:
+    """Pad the node axis to a multiple of the mesh size with never-feasible,
+    never-chosen, metric-inert rows: mem_left = -1 fails every fit test (pod
+    mem requests are >= 0), rank = INT_MAX loses every tie-break, and
+    cpu_left = cpu_cap = gpu_cnt = 0 keeps the row out of every cluster
+    aggregate (usage, power, frag all see an empty node)."""
+    n = state.num_nodes
+    pad = (-n) % multiple
+    if pad == 0:
+        return state, rank
+
+    def pad0(x, fill=0):
+        width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, width, constant_values=fill)
+
+    padded = NodeState(
+        cpu_left=pad0(state.cpu_left),
+        cpu_cap=pad0(state.cpu_cap),
+        mem_left=pad0(state.mem_left, -1),
+        mem_cap=pad0(state.mem_cap),
+        gpu_left=pad0(state.gpu_left),
+        gpu_cnt=pad0(state.gpu_cnt),
+        gpu_type=pad0(state.gpu_type, NO_GPU),
+        cpu_type=pad0(state.cpu_type),
+        aff_cnt=pad0(state.aff_cnt),
+    )
+    return padded, jnp.concatenate(
+        [rank, jnp.full(pad, _INT_MAX, jnp.int32)]
+    )
+
+
+def state_sharding(mesh: Mesh) -> NodeState:
+    """NodeState pytree of NamedShardings: every array split on axis 0."""
+    s = NamedSharding(mesh, P(NODE_AXIS))
+    return NodeState(*([s] * len(NodeState._fields)))
+
+
+def shard_state(state: NodeState, mesh: Mesh) -> NodeState:
+    """Place NodeState arrays onto the mesh, node axis sharded. The node
+    count must already be a multiple of the mesh size (see pad_nodes)."""
+    return jax.device_put(state, state_sharding(mesh))
+
+
+def make_sharded_replay(
+    policies: Sequence[Tuple[object, int]],
+    mesh: Mesh,
+    gpu_sel: str = "best",
+    report: bool = True,
+):
+    """Sharded twin of tpusim.sim.engine.make_replay: same trace-replay scan,
+    jitted with the node axis of the cluster state split over `mesh` and
+    everything else (pod batch, event stream, typical pods) replicated."""
+    from tpusim.sim.engine import make_replay
+
+    inner = make_replay(policies, gpu_sel=gpu_sel, report=report)
+    # make_replay returns a jit-wrapped function; re-jit with shardings.
+    fn = inner.__wrapped__ if hasattr(inner, "__wrapped__") else inner
+
+    st = state_sharding(mesh)
+    repl = NamedSharding(mesh, P())
+
+    sharded = jax.jit(
+        fn,
+        in_shardings=(
+            st,  # state
+            None,  # pods (replicated, let XLA decide)
+            repl,  # ev_kind
+            repl,  # ev_pod
+            None,  # typical pods
+            repl,  # key
+            NamedSharding(mesh, P(NODE_AXIS)),  # tiebreak_rank
+        ),
+    )
+    return sharded
