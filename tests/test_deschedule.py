@@ -1,0 +1,167 @@
+"""Descheduler tests (ref semantics: deschedule.go + deschedule_utils.go)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpusim.constants import MILLI
+from tpusim.io.trace import NodeRow, PodRow
+from tpusim.sim.deschedule import (
+    COS_SIM_CPU_BAR,
+    eviction_scores,
+    evict,
+    select_victims,
+)
+from tpusim.sim.driver import Simulator, SimulatorConfig
+from tpusim.types import PodSpec, make_node_state, make_typical_pods
+
+
+def _cluster():
+    # node 0: congested (little cpu left after pods), node 1: empty
+    state = make_node_state(
+        cpu_cap=[10000, 96000],
+        mem_cap=[262144, 262144],
+        gpu_cnt=[4, 8],
+        gpu_type=[0, 0],
+    )
+    tp = make_typical_pods([(4000, 500, 1, 0, 0.6), (8000, 1000, 1, 0, 0.4)])
+    return state, tp
+
+
+def _place(state, pods, placed, dev_mask):
+    """Apply placements by hand (tests drive the kernels directly)."""
+    placed = jnp.asarray(placed)
+    dev_mask = jnp.asarray(dev_mask)
+    state = state._replace(
+        cpu_left=state.cpu_left.at[placed].add(-pods.cpu),
+        mem_left=state.mem_left.at[placed].add(-pods.mem),
+        gpu_left=state.gpu_left.at[placed].add(
+            -dev_mask.astype(jnp.int32) * pods.gpu_milli[:, None]
+        ),
+    )
+    return state
+
+
+def _pods(rows):
+    cpu, milli, num, masks = zip(*rows)
+    p = len(rows)
+    dev = np.zeros((p, 8), bool)
+    for i, m in enumerate(masks):
+        dev[i, m] = True
+    return (
+        PodSpec(
+            cpu=jnp.asarray(np.array(cpu, np.int32)),
+            mem=jnp.asarray(np.zeros(p, np.int32)),
+            gpu_milli=jnp.asarray(np.array(milli, np.int32)),
+            gpu_num=jnp.asarray(np.array(num, np.int32)),
+            gpu_mask=jnp.asarray(np.zeros(p, np.int32)),
+            pinned=jnp.full(p, -1, jnp.int32),
+        ),
+        dev,
+    )
+
+
+def test_eviction_scores_roundtrip():
+    state, tp = _cluster()
+    pods, dev = _pods([(4000, 700, 1, [0]), (4000, 1000, 1, [1])])
+    placed = np.array([0, 0], np.int32)
+    state2 = _place(state, pods, placed, dev)
+    new_frag, cos_sim, old_frag = eviction_scores(
+        state2, pods, jnp.asarray(placed), jnp.asarray(dev), tp
+    )
+    # evicting pod 0 returns node 0 to "pod-1-only" occupancy; the frag score
+    # must equal directly computing it on that intermediate state
+    from tpusim.ops.frag import node_frag_score
+
+    inter = _place(state, jax.tree.map(lambda a: a[1:], pods), placed[1:], dev[1:])
+    want = node_frag_score(inter.cpu_left[0], inter.gpu_left[0], inter.gpu_type[0], tp)
+    np.testing.assert_allclose(float(new_frag[0]), float(want), rtol=1e-6)
+    assert 0.0 <= float(cos_sim[0]) <= 1.0
+    assert old_frag.shape == (2,)
+
+
+def test_evict_restores_resources():
+    state, tp = _cluster()
+    pods, dev = _pods([(4000, 700, 1, [0]), (2000, 500, 1, [1])])
+    placed = np.array([0, 0], np.int32)
+    state2 = _place(state, pods, placed, dev)
+    restored = evict(state2, pods, placed, dev, [0, 1])
+    np.testing.assert_array_equal(np.asarray(restored.cpu_left), np.asarray(state.cpu_left))
+    np.testing.assert_array_equal(np.asarray(restored.gpu_left), np.asarray(state.gpu_left))
+
+
+def test_cos_sim_only_congested_nodes():
+    state, tp = _cluster()
+    # node 0: cpu_left 10000-9000=1000 < bar, device 0 has 300 left (< bar),
+    # device 1 fully free (> bar) → passes both filters
+    pods, dev = _pods([(9000, 700, 1, [0]), (2000, 500, 1, [2])])
+    placed = np.array([0, 1], np.int32)
+    state2 = _place(state, pods, placed, dev)
+    victims = select_victims(
+        state2, pods, placed, dev, tp, "cosSim", ratio=1.0,
+        node_names=["a", "b"],
+    )
+    # only node 0 is congested; its single pod is the victim. node 1 has
+    # plenty of cpu left so pod 1 is never descheduled.
+    assert victims == [0]
+
+
+def test_frag_one_pod_needs_positive_gain():
+    state, tp = _cluster()
+    pods, dev = _pods([(4000, 700, 1, [0])])
+    placed = np.array([0], np.int32)
+    state2 = _place(state, pods, placed, dev)
+    new_frag, _, old_frag = (
+        np.asarray(x)
+        for x in eviction_scores(state2, pods, jnp.asarray(placed), jnp.asarray(dev), tp)
+    )
+    victims = select_victims(
+        state2, pods, placed, dev, tp, "fragOnePod", ratio=1.0
+    )
+    gain = int(old_frag[0] - new_frag[0])
+    assert (victims == [0]) == (gain > 0)
+
+
+def test_frag_multi_pod_budget_and_revisit():
+    state, tp = _cluster()
+    pods, dev = _pods(
+        [(1000, 700, 1, [0]), (1000, 700, 1, [1]), (1000, 700, 1, [2])]
+    )
+    placed = np.array([0, 0, 0], np.int32)
+    state2 = _place(state, pods, placed, dev)
+    victims = select_victims(
+        state2, pods, placed, dev, tp, "fragMultiPod", ratio=0.67
+    )
+    assert len(victims) <= 2  # ceil(0.67*3) = 3 but budget caps evictions
+    assert len(set(victims)) == len(victims)
+
+
+def test_driver_deschedule_end_to_end():
+    nodes = [
+        NodeRow("n0", 32000, 262144, 4, "A100"),
+        NodeRow("n1", 32000, 262144, 4, "A100"),
+    ]
+    pods = [
+        PodRow(f"p{i}", 2000, 1024, 1, 700, "", creation_time=i) for i in range(6)
+    ]
+    cfg = SimulatorConfig(
+        policies=(("FGDScore", 1000),),
+        deschedule_policy="fragOnePod",
+        deschedule_ratio=0.5,
+        report_per_event=False,
+    )
+    sim = Simulator(nodes, cfg)
+    sim.set_workload_pods(pods)
+    res = sim.run()
+    before_placed = int((res.placed_node >= 0).sum())
+    failed = sim.deschedule_cluster()
+    sim.cluster_analysis("PostDeschedule")
+    after_placed = int((sim.last_result.placed_node >= 0).sum())
+    # conservation: every pod is placed or accounted as unscheduled
+    assert after_placed + len(sim.last_result.unscheduled_pods) == len(pods)
+    assert after_placed >= before_placed - len(failed)
+    # resource conservation on the final state
+    s = sim.last_result.state
+    used_cpu = int((s.cpu_cap - s.cpu_left).sum())
+    assert used_cpu == 2000 * after_placed

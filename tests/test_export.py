@@ -1,0 +1,126 @@
+"""Snapshot export/import round-trips (ref: export.go +
+inject_origin_workload_into_snapshot.py) and workload inflation."""
+
+import csv
+
+import numpy as np
+import pytest
+
+from tpusim.io.export import (
+    export_node_snapshot_csv,
+    export_pod_snapshot_csv,
+    export_pod_snapshot_yaml,
+    inject_snapshot_workload,
+    load_pod_yaml,
+)
+from tpusim.io.trace import NodeRow, PodRow
+from tpusim.sim.driver import Simulator, SimulatorConfig
+from tpusim.sim.workload import inflation_pods
+
+
+def _sim():
+    nodes = [
+        NodeRow("node-a", 32000, 262144, 4, "V100M16"),
+        NodeRow("node-b", 64000, 262144, 8, "A100"),
+        NodeRow("node-c", 96000, 262144, 0, ""),
+    ]
+    pods = [
+        PodRow("p0", 4000, 1024, 1, 500, "V100M16", creation_time=1),
+        PodRow("p1", 8000, 2048, 2, 1000, "", creation_time=2),
+        PodRow("p2", 2000, 512, 0, 0, "", creation_time=3),
+        PodRow("p3", 999000, 512, 0, 0, "", creation_time=4),  # unschedulable
+    ]
+    cfg = SimulatorConfig(policies=(("FGDScore", 1000),), report_per_event=False)
+    sim = Simulator(nodes, cfg)
+    sim.set_workload_pods(pods)
+    sim.run()
+    return sim
+
+
+def test_pod_yaml_roundtrip(tmp_path):
+    sim = _sim()
+    path = str(tmp_path / "pod-snapshot.yaml")
+    sim.export_pod_snapshot_yaml(path)
+    back = load_pod_yaml(path)
+    assert len(back) == 4
+    by_name = {p.name: p for p in back}
+    assert by_name["p0"].pinned_node in ("node-a", "node-b")
+    assert by_name["p0"].gpu_milli == 500 and by_name["p0"].gpu_spec == "V100M16"
+    assert by_name["p1"].num_gpu == 2
+    assert by_name["p3"].unscheduled and by_name["p3"].pinned_node is None
+    assert by_name["p2"].cpu_milli == 2000 and by_name["p2"].memory_mib == 512
+
+
+def test_resume_rebinds_identically(tmp_path):
+    sim = _sim()
+    path = str(tmp_path / "pod-snapshot.yaml")
+    sim.export_pod_snapshot_yaml(path)
+    placed0 = {p.name: int(n) for p, n in zip(sim.last_result.pods, sim.last_result.placed_node)}
+
+    back = load_pod_yaml(path)
+    injected = inject_snapshot_workload(back, snapshot_id=1)
+    sim2 = Simulator(sim.nodes, sim.cfg)
+    sim2.set_workload_pods(injected)
+    res2 = sim2.run()
+    for p, n in zip(res2.pods, res2.placed_node):
+        orig = p.name.rsplit("-ss", 1)[0]
+        assert int(n) == placed0[orig], f"{p.name} rebound to {n} != {placed0[orig]}"
+    # the annotated-unscheduled pod is skipped, not rescheduled
+    # (simulator.go:391-399)
+    reasons = {u.pod.name: u.reason for u in res2.unscheduled_pods}
+    assert reasons.get("p3-ss1") == "pod-unscheduled annotation"
+
+
+def test_pin_to_unknown_node_is_unschedulable():
+    sim = _sim()
+    pods = [PodRow("pinx", 1000, 128, 0, 0, "", pinned_node="no-such-node")]
+    sim3 = Simulator(sim.nodes, sim.cfg)
+    sim3.set_workload_pods(pods)
+    res = sim3.run()
+    assert int(res.placed_node[0]) == -1
+    assert len(res.unscheduled_pods) == 1
+
+
+def test_node_csv_schema(tmp_path):
+    sim = _sim()
+    path = str(tmp_path / "node-snapshot.csv")
+    sim.export_node_snapshot_csv(path)
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 3
+    assert "gpu_milli_left_0" in rows[0] and "gpu_milli_left_7" in rows[0]
+    total = sum(int(r["gpu_milli_left"]) for r in rows)
+    s = sim.last_result.state
+    assert total == int(np.asarray(s.gpu_left).sum())
+    # schema matches the input-trace convention (data/README.md)
+    assert rows[0]["name"] == "node-a" and rows[0]["model"] == "V100M16"
+
+
+def test_pod_csv_schema(tmp_path):
+    sim = _sim()
+    path = str(tmp_path / "pod-snapshot.csv")
+    sim.export_pod_snapshot_csv(path)
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    by_name = {r["pod"]: r for r in rows}
+    assert by_name["p0"]["gpu_milli"] == "500"
+    assert by_name["p0"]["gpu_mem_ratio"] == "50"
+    assert by_name["p3"]["ip"] == ""  # unscheduled → no node
+
+
+def test_inflation_breaks_at_capacity():
+    rng = np.random.default_rng(0)
+    workload = [PodRow(f"p{i}", 1000, 0, 1, 1000, "") for i in range(10)]
+    # cluster gpu capacity 12000 milli, workload uses 10000 → room for 2 clones
+    out = inflation_pods(workload, 2.0, rng, 10**9, 12000, 10000, 10000)
+    assert len(out) == 2
+    assert all(p.name.endswith(f"-clone-{i}") for i, p in enumerate(out))
+
+
+def test_driver_inflation_restores_state():
+    sim = _sim()
+    sim.cfg.inflation_ratio = 1.5
+    before = np.asarray(sim.last_result.state.cpu_left).copy()
+    sim.run_workload_inflation_evaluation("ScheduleInflation")
+    after = np.asarray(sim.last_result.state.cpu_left)
+    np.testing.assert_array_equal(before, after)

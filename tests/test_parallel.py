@@ -31,6 +31,7 @@ def _fixture(num_nodes=13, num_pods=24, seed=3):
         gpu_milli=jnp.asarray(rng.choice([300, 1000], num_pods).astype(np.int32)),
         gpu_num=jnp.asarray(rng.choice([0, 1, 2], num_pods).astype(np.int32)),
         gpu_mask=jnp.zeros(num_pods, jnp.int32),
+        pinned=jnp.full(num_pods, -1, jnp.int32),
     )
     kind = np.full(num_pods, EV_CREATE, np.int32)
     kind[5] = EV_DELETE  # delete of a never-placed pod is a no-op

@@ -45,6 +45,10 @@ class PodRow:
     creation_time: int = 0
     deletion_time: int = 0
     scheduled_time: int = 0
+    # snapshot-resume fields (ref: export.go:44-58 nodeSelector pinning +
+    # the simon/pod-unscheduled annotation)
+    pinned_node: Optional[str] = None
+    unscheduled: bool = False
 
     @property
     def total_gpu_milli(self) -> int:
@@ -147,9 +151,18 @@ def nodes_to_state(nodes: Sequence[NodeRow]) -> NodeState:
     )
 
 
-def pods_to_specs(pods: Sequence[PodRow]) -> PodSpec:
-    """PodRow list → batched PodSpec arrays."""
+def pods_to_specs(pods: Sequence[PodRow], node_index: dict = None) -> PodSpec:
+    """PodRow list → batched PodSpec arrays. node_index maps node names to
+    row indices for nodeSelector-pinned pods (snapshot resume, export.go
+    hostname pinning); pods pinned to unknown nodes become unschedulable,
+    pinned to index len(node_index) which no arange(num_nodes) entry matches
+    (-1 is reserved for "unconstrained")."""
     import jax.numpy as jnp
+
+    def pin(p: PodRow) -> int:
+        if p.pinned_node is None or node_index is None:
+            return -1
+        return node_index.get(p.pinned_node, len(node_index))
 
     return PodSpec(
         cpu=jnp.asarray(np.array([p.cpu_milli for p in pods], np.int32)),
@@ -159,6 +172,7 @@ def pods_to_specs(pods: Sequence[PodRow]) -> PodSpec:
         gpu_mask=jnp.asarray(
             np.array([gpu_spec_to_mask(p.gpu_spec) for p in pods], np.int32)
         ),
+        pinned=jnp.asarray(np.array([pin(p) for p in pods], np.int32)),
     )
 
 
@@ -172,17 +186,24 @@ def build_events(
     event per pod in list order, no deletions. use_timestamps=True mirrors
     the annotation-driven path (simulator.go:672-717): creation + deletion
     events stable-sorted by timestamp.
+
+    Pods carrying the `simon/pod-unscheduled` annotation get EV_SKIP events:
+    the reference never re-schedules them, appending them straight to the
+    failed list (simulator.go:391-399).
     """
-    from tpusim.sim.engine import EV_CREATE, EV_DELETE
+    from tpusim.sim.engine import EV_CREATE, EV_DELETE, EV_SKIP
+
+    def kind_of(p: PodRow) -> int:
+        return EV_SKIP if p.unscheduled else EV_CREATE
 
     if not use_timestamps:
-        kind = np.zeros(len(pods), np.int32) + EV_CREATE
+        kind = np.array([kind_of(p) for p in pods], np.int32)
         idx = np.arange(len(pods), dtype=np.int32)
         return kind, idx
     events = []
     for i, p in enumerate(pods):
-        events.append((p.creation_time, EV_CREATE, i))
-        if p.deletion_time:
+        events.append((p.creation_time, kind_of(p), i))
+        if p.deletion_time and not p.unscheduled:
             events.append((p.deletion_time, EV_DELETE, i))
     events.sort(key=lambda e: e[0])  # python sort is stable
     kind = np.array([e[1] for e in events], np.int32)

@@ -1,0 +1,224 @@
+"""Descheduling: evict pods to reduce fragmentation, then reschedule
+(ref: pkg/simulator/deschedule.go + deschedule_utils.go).
+
+Three policies (deschedule.go:14-18):
+- cosSim:       on congested nodes (cpu_left < 2000, some device > 500 milli
+                free), evict the pod whose removal leaves the node's free
+                vector least similar to the pod's request vector
+                (deschedule_utils.go:15-45).
+- fragOnePod:   walk nodes in descending frag order, evict the single pod
+                whose removal reduces node frag the most (score > 0)
+                (deschedule.go:94-119).
+- fragMultiPod: same victim rule, but a max-heap over node frag amounts lets
+                one node be revisited after its priority drops
+                (deschedule.go:121-178).
+
+TPU-first structure: every candidate score — the hypothetical node frag /
+cosine similarity after evicting each placed pod — is one batched vmap over
+the pod axis (`eviction_scores`), computed once. The reference makes this
+exact precomputation legal: its nodeResMap snapshot is taken at entry and
+never refreshed during the eviction loop (deschedule.go:24 vs :111,160 —
+deletePod mutates the fake cluster, not the map), so victim scores are
+entry-state functions even under fragMultiPod's revisits. The remaining host
+loop is heap bookkeeping over a few hundred victims.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusim.constants import MILLI
+from tpusim.ops.frag import node_frag_score
+from tpusim.types import NodeState, PodSpec, TypicalPods
+
+COS_SIM_CPU_BAR = 2000  # deschedule.go:52-53, "temporarily hard-code"
+COS_SIM_GPU_BAR = 500
+
+DESCHEDULE_POLICIES = ("cosSim", "fragOnePod", "fragMultiPod")
+
+
+@jax.jit
+def eviction_scores(
+    state: NodeState, pods: PodSpec, placed, dev_mask, tp: TypicalPods
+):
+    """Batched candidate scoring for all placed pods.
+
+    Returns (new_frag f32[P], cos_sim f32[P], old_frag f32[N]):
+    - new_frag[p]: frag score of pod p's node after evicting p
+      (ref: nodeRes.Add(podRes) → NodeGpuShareFragAmount,
+      deschedule_utils.go:86-92)
+    - cos_sim[p]: similarity of the node's post-eviction free vector
+      [cpu_left, total_gpu_left] with p's request vector
+      (ref: GetResourceSimilarity, utils.go:1181-1212); -1 where undefined
+    - old_frag[n]: current frag score per node (the heap priorities)
+    """
+    n_idx = jnp.maximum(placed, 0)
+
+    def per_pod(i):
+        node = n_idx[i]
+        cpu_left = state.cpu_left[node] + pods.cpu[i]
+        gpu_left = state.gpu_left[node] + dev_mask[i].astype(jnp.int32) * pods.gpu_milli[i]
+        frag = node_frag_score(cpu_left, gpu_left, state.gpu_type[node], tp)
+        free = jnp.array(
+            [cpu_left, gpu_left.sum()], jnp.float32
+        )
+        req = jnp.array(
+            [pods.cpu[i], pods.gpu_milli[i] * pods.gpu_num[i]], jnp.float32
+        )
+        denom = jnp.linalg.norm(free) * jnp.linalg.norm(req)
+        sim = jnp.where(denom > 0, free @ req / denom, -1.0)
+        sim = jnp.where((sim >= -1e-3) & (sim <= 1 + 1e-3), jnp.clip(sim, 0, 1), -1.0)
+        return frag, sim
+
+    new_frag, cos_sim = jax.vmap(per_pod)(jnp.arange(placed.shape[0]))
+    old_frag = jax.vmap(
+        lambda c, g, t: node_frag_score(c, g, t, tp)
+    )(state.cpu_left, state.gpu_left, state.gpu_type)
+    return new_frag, cos_sim, old_frag
+
+
+def _pods_by_node(placed: np.ndarray, num_nodes: int) -> List[List[int]]:
+    by_node: List[List[int]] = [[] for _ in range(num_nodes)]
+    for i, n in enumerate(placed):
+        if n >= 0:
+            by_node[n].append(i)
+    return by_node
+
+
+def select_victims(
+    state: NodeState,
+    pods: PodSpec,
+    placed: np.ndarray,
+    dev_mask: np.ndarray,
+    tp: TypicalPods,
+    policy: str,
+    ratio: float,
+    node_names: Sequence[str] = None,
+) -> List[int]:
+    """Pick pods to deschedule; returns victim pod indices in eviction order
+    (ref: DescheduleCluster, deschedule.go:20-47; budget = ceil(ratio ×
+    current pods), deschedule.go:27)."""
+    placed = np.asarray(placed)
+    dev_mask = np.asarray(dev_mask)
+    n_pods_placed = int((placed >= 0).sum())
+    budget = math.ceil(ratio * n_pods_placed)
+    if budget <= 0 or n_pods_placed == 0:
+        return []
+
+    new_frag, cos_sim, old_frag = (
+        np.asarray(x)
+        for x in eviction_scores(
+            state, pods, jnp.asarray(placed), jnp.asarray(dev_mask), tp
+        )
+    )
+    num_nodes = state.num_nodes
+    by_node = _pods_by_node(placed, num_nodes)
+    s = jax.tree.map(np.asarray, state)
+    names = node_names or [f"node-{i:05d}" for i in range(num_nodes)]
+
+    if policy == "cosSim":
+        return _victims_cos_sim(s, by_node, cos_sim, names, budget)
+    if policy == "fragOnePod":
+        return _victims_frag_one(by_node, new_frag, old_frag, budget)
+    if policy == "fragMultiPod":
+        return _victims_frag_multi(by_node, new_frag, old_frag, names, budget)
+    raise ValueError(f"DeschedulePolicy not found: {policy!r}")
+
+
+def _victims_cos_sim(s, by_node, cos_sim, names, budget) -> List[int]:
+    """deschedule.go:49-92: congested-node walk, min-similarity victim."""
+    total_gpu_left = s.gpu_left.sum(-1)
+    below = s.cpu_left < COS_SIM_CPU_BAR
+    # stable partition: below-bar nodes first, each group by total GPU left
+    # desc then name asc (sortNodeStatusByResource, deschedule_utils.go:47-71)
+    order = sorted(
+        range(len(names)), key=lambda i: (~below[i], -total_gpu_left[i], names[i])
+    )
+    victims: List[int] = []
+    for n in order:
+        if len(victims) >= budget:
+            break
+        if s.cpu_left[n] >= COS_SIM_CPU_BAR:
+            continue
+        if not (s.gpu_left[n] > COS_SIM_GPU_BAR).any():
+            continue
+        best, best_sim = -1, 1.0  # strict < 1 (deschedule_utils.go:17,34)
+        for p in by_node[n]:
+            if 0 <= cos_sim[p] < best_sim:
+                best, best_sim = p, cos_sim[p]
+        if best >= 0:
+            victims.append(best)
+    return victims
+
+
+def _victims_frag_one(by_node, new_frag, old_frag, budget) -> List[int]:
+    """deschedule.go:94-119: one victim per node, desc frag order."""
+    order = np.argsort(-old_frag, kind="stable")
+    victims: List[int] = []
+    for n in order:
+        if len(victims) >= budget:
+            break
+        best, best_score = -1, 0  # strictly positive (deschedule_utils.go:75,93)
+        for p in by_node[n]:
+            score = int(old_frag[n] - new_frag[p])  # int64 truncation, :92
+            if score > best_score:
+                best, best_score = p, score
+        if best >= 0:
+            victims.append(best)
+    return victims
+
+
+def _victims_frag_multi(by_node, new_frag, old_frag, names, budget) -> List[int]:
+    """deschedule.go:121-178: max-heap over node frag; a node re-enters the
+    heap with its victim's post-eviction frag as the new priority. Scores
+    keep using the entry-state new_frag (the reference's stale nodeResMap)."""
+    heap = [(-old_frag[n], names[n], n) for n in range(len(by_node))]
+    heapq.heapify(heap)
+    remaining = [list(ps) for ps in by_node]
+    victims: List[int] = []
+    while len(victims) < budget and heap:
+        neg_pri, name, n = heapq.heappop(heap)
+        pri = -neg_pri
+        best, best_score = -1, 0
+        for p in remaining[n]:
+            score = int(pri - new_frag[p])
+            if score > best_score:
+                best, best_score = p, score
+        if best >= 0:
+            victims.append(best)
+            remaining[n].remove(best)
+            heapq.heappush(heap, (-float(new_frag[best]), name, n))
+    return victims
+
+
+def evict(
+    state: NodeState, pods: PodSpec, placed, dev_mask, victims: Sequence[int]
+) -> NodeState:
+    """Return resources of all victim pods at once (ref: deletePod per victim,
+    simulator.go:334-357; batched scatter-add here)."""
+    if len(victims) == 0:
+        return state
+    from tpusim.policies.clustering import pod_affinity_class
+
+    v = jnp.asarray(np.asarray(victims, np.int32))
+    placed = jnp.asarray(placed)
+    dev_mask = jnp.asarray(dev_mask)
+    nodes = placed[v]
+    vpods = jax.tree.map(lambda a: a[v], pods)
+    cls = jax.vmap(pod_affinity_class)(vpods)
+    return state._replace(
+        cpu_left=state.cpu_left.at[nodes].add(pods.cpu[v]),
+        mem_left=state.mem_left.at[nodes].add(pods.mem[v]),
+        gpu_left=state.gpu_left.at[nodes].add(
+            dev_mask[v].astype(jnp.int32) * pods.gpu_milli[v][:, None]
+        ),
+        aff_cnt=state.aff_cnt.at[nodes, jnp.maximum(cls, 0)].add(
+            jnp.where(cls >= 0, -1, 0)
+        ),
+    )

@@ -9,6 +9,7 @@ device via tpusim.sim.engine.make_replay.
 
 from __future__ import annotations
 
+import math
 import sys
 import time
 from dataclasses import dataclass, field
@@ -97,6 +98,7 @@ class Simulator:
         self.cfg = cfg or SimulatorConfig()
         self.nodes = list(nodes)
         self.node_names = [n.name for n in self.nodes]
+        self.node_index = {n.name: i for i, n in enumerate(self.nodes)}
         self.init_state = nodes_to_state(self.nodes)
         self.rank = jnp.asarray(tiebreak_rank(len(self.nodes), self.cfg.seed))
         self.log = LogSink(stream=None)
@@ -155,7 +157,7 @@ class Simulator:
     def schedule_pods(self, pods: Sequence[PodRow]) -> SimulateResult:
         if self.typical is None:
             self.set_typical_pods()
-        specs = pods_to_specs(pods)
+        specs = pods_to_specs(pods, self.node_index)
         ev_kind, ev_pod = build_events(pods, self.cfg.use_timestamps)
         key = jax.random.PRNGKey(self.cfg.seed)
         t0 = time.perf_counter()
@@ -175,8 +177,15 @@ class Simulator:
         if self.cfg.report_per_event and result.metrics is not None:
             self._emit_event_reports(result.metrics)
 
+        # pods carrying the simon/pod-unscheduled annotation are skipped by
+        # the event loop and reported as failed (simulator.go:391-399)
+        skipped = np.array([p.unscheduled for p in pods], bool)
         unscheduled = [
-            UnscheduledPod(pods[i]) for i in np.flatnonzero(failed)
+            UnscheduledPod(
+                pods[i],
+                reason="pod-unscheduled annotation" if skipped[i] else "unschedulable",
+            )
+            for i in np.flatnonzero(failed | skipped)
         ]
         self.last_result = SimulateResult(
             unscheduled_pods=unscheduled,
@@ -201,6 +210,133 @@ class Simulator:
         res = self.schedule_pods(pods)
         self.cluster_analysis("InitSchedule")
         return res
+
+    # ---- snapshot export (export.go) ----
+
+    def export_pod_snapshot_yaml(self, path: str):
+        from tpusim.io.export import export_pod_snapshot_yaml
+
+        r = self.last_result
+        export_pod_snapshot_yaml(r.pods, r.placed_node, r.dev_mask, self.node_names, path)
+
+    def export_pod_snapshot_csv(self, path: str):
+        from tpusim.io.export import export_pod_snapshot_csv
+
+        r = self.last_result
+        export_pod_snapshot_csv(r.pods, r.placed_node, r.dev_mask, self.nodes, path)
+
+    def export_node_snapshot_csv(self, path: str):
+        from tpusim.io.export import export_node_snapshot_csv
+
+        r = self.last_result
+        num_pods = np.zeros(len(self.nodes), np.int64)
+        placed = r.placed_node[r.placed_node >= 0]
+        np.add.at(num_pods, placed, 1)
+        export_node_snapshot_csv(r.state, self.nodes, num_pods, path)
+
+    # ---- workload inflation (simulator.go:1015-1132) ----
+
+    def run_workload_inflation_evaluation(self, tag: str):
+        """Clone extra pods onto the current cluster state, schedule them,
+        run ClusterAnalysis under `tag`, then drop them (the committed state
+        is untouched — we simply never persist the inflated one)."""
+        from tpusim.sim.workload import inflation_pods, total_pod_cpu_milli, total_pod_gpu_milli
+
+        rng = np.random.default_rng(self.cfg.inflation_seed)
+        extra = inflation_pods(
+            self.workload_pods,
+            self.cfg.inflation_ratio,
+            rng,
+            self.node_total_milli_cpu,
+            self.node_total_milli_gpu,
+            total_pod_cpu_milli(self.workload_pods),
+            total_pod_gpu_milli(self.workload_pods),
+        )
+        if not extra:
+            return None
+        self.log.info(f"(Inflation) Num of Total Pods: {len(extra)}")
+        state = jax.tree.map(jnp.asarray, self.last_result.state)
+        specs = pods_to_specs(extra)
+        out = self.replay_fn(
+            state,
+            specs,
+            jnp.zeros(len(extra), jnp.int32),
+            jnp.arange(len(extra), dtype=jnp.int32),
+            self.typical,
+            jax.random.PRNGKey(self.cfg.inflation_seed),
+            self.rank,
+        )
+        failed = int(np.asarray(out.placed_node < 0).sum())
+        self.log.info(f"[ReportFailedPods] {failed} unscheduled inflation pods")
+        saved = self.last_result.state
+        self.last_result.state = jax.tree.map(np.asarray, out.state)
+        analysis = self.cluster_analysis(tag)
+        self.last_result.state = saved  # inflation pods all deleted
+        return analysis
+
+    # ---- descheduling (deschedule.go) ----
+
+    def deschedule_cluster(self) -> List[UnscheduledPod]:
+        """Evict pods per the configured policy, report PostEviction, then
+        reschedule the victims (ref: DescheduleCluster, deschedule.go:20-47,
+        + the core.go:213-218 orchestration: the caller follows up with
+        ClusterAnalysis(PostDeschedule))."""
+        from tpusim.sim.deschedule import evict, select_victims
+
+        res = self.last_result
+        specs = pods_to_specs(res.pods)
+        state = jax.tree.map(jnp.asarray, res.state)
+        victims = select_victims(
+            state,
+            specs,
+            res.placed_node,
+            res.dev_mask,
+            self.typical,
+            self.cfg.deschedule_policy,
+            self.cfg.deschedule_ratio,
+            self.node_names,
+        )
+        self.log.info(
+            f"maximum number of pods that can be descheduled: "
+            f"{math.ceil(self.cfg.deschedule_ratio * int((res.placed_node >= 0).sum()))}, "
+            f"deschedule policy: {self.cfg.deschedule_policy}"
+        )
+        state = evict(state, specs, res.placed_node, res.dev_mask, victims)
+        res.state = jax.tree.map(np.asarray, state)
+        res.placed_node = res.placed_node.copy()
+        res.dev_mask = res.dev_mask.copy()
+        res.placed_node[victims] = -1
+        res.dev_mask[victims] = False
+        self.cluster_analysis("PostEviction")
+        self.log.info(f"[DescheduleCluster] Num of Descheduled Pods: {len(victims)}")
+
+        # reschedule the victims, in eviction order (deschedule.go:89-91)
+        if not victims:
+            return []
+        v = np.asarray(victims, np.int32)
+        vspecs = jax.tree.map(lambda a: a[jnp.asarray(v)], specs)
+        ev_kind = jnp.zeros(len(victims), jnp.int32)
+        ev_pod = jnp.arange(len(victims), dtype=jnp.int32)
+        out = self.replay_fn(
+            state,
+            vspecs,
+            ev_kind,
+            ev_pod,
+            self.typical,
+            jax.random.PRNGKey(self.cfg.seed + 1),
+            self.rank,
+        )
+        placed_v = np.asarray(out.placed_node)
+        mask_v = np.asarray(out.dev_mask)
+        res.placed_node[v] = placed_v
+        res.dev_mask[v] = mask_v
+        res.state = jax.tree.map(np.asarray, out.state)
+        failed = [
+            UnscheduledPod(res.pods[v[i]]) for i in np.flatnonzero(placed_v < 0)
+        ]
+        res.unscheduled_pods = list(res.unscheduled_pods) + failed
+        self.log.info(f"[DescheduleCluster] Num of Failed Pods: {len(failed)}")
+        return failed
 
     # ---- reporting (analysis.go) ----
 

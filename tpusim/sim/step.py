@@ -39,6 +39,12 @@ def filter_nodes(state: NodeState, pod: PodSpec) -> jnp.ndarray:
     (gpunodeinfo.go:136-204 — can_allocate reproduces its feasibility).
     """
     fit = (state.cpu_left >= pod.cpu) & (state.mem_left >= pod.mem)
+    # nodeSelector pinning (snapshot re-bind, export.go:44-58): a pinned pod
+    # is only feasible on its pinned node; pinned == -1 means unconstrained.
+    n = state.num_nodes
+    fit = fit & (
+        (pod.pinned < 0) | (jnp.arange(n, dtype=jnp.int32) == pod.pinned)
+    )
     gpu_ok = (
         (state.gpu_cnt > 0)
         & is_accessible(state.gpu_type, pod.gpu_mask)

@@ -95,9 +95,10 @@ def inflation_pods(
     current_gpu_milli: int,
 ) -> List[PodRow]:
     """Extra cloned pods for inflation evaluation
-    (ref: simulator.go:1015-1132 GenerateWorkloadInflationPods): clone
-    ceil(n×ratio)−n random workload pods, skipping clones that would push
-    the running totals past cluster capacity."""
+    (ref: simulator.go:1039-1132 generateWorkloadInflationPods): clone
+    ceil(n×ratio)−n random workload pods, stopping early — break, not skip
+    (simulator.go:1063-1070) — at the first clone that would push the running
+    request totals past cluster capacity."""
     if ratio <= 1.0 or not workload:
         return []
     n = len(workload)
@@ -107,11 +108,12 @@ def inflation_pods(
     for i in range(extra):
         idx = int(rng.integers(n))
         cand = workload[idx]
-        if cpu + cand.cpu_milli > cluster_cpu_milli:
-            continue
-        if gpu + cand.total_gpu_milli > cluster_gpu_milli:
-            continue
+        if (
+            cpu + cand.cpu_milli > cluster_cpu_milli
+            or gpu + cand.total_gpu_milli > cluster_gpu_milli
+        ):
+            break
         cpu += cand.cpu_milli
         gpu += cand.total_gpu_milli
-        out.append(replace(cand, name=f"{cand.name}-infl-{i}"))
+        out.append(replace(cand, name=f"{cand.name}-clone-{i}"))
     return out

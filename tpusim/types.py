@@ -98,6 +98,7 @@ class PodSpec(NamedTuple):
     gpu_milli: jnp.ndarray  # i32 per-GPU milli request
     gpu_num: jnp.ndarray  # i32 number of GPUs
     gpu_mask: jnp.ndarray  # i32 allowed GPU model bitmask
+    pinned: jnp.ndarray  # i32 nodeSelector-pinned node index, -1 = free
 
     def total_gpu_milli(self):
         """ref: resource.go:129-131 TotalMilliGpu."""
@@ -108,13 +109,14 @@ class PodSpec(NamedTuple):
         return (self.gpu_num == 1) & (self.gpu_milli < MILLI)
 
 
-def make_pod(cpu=0, mem=0, gpu_milli=0, gpu_num=0, gpu_mask=0) -> PodSpec:
+def make_pod(cpu=0, mem=0, gpu_milli=0, gpu_num=0, gpu_mask=0, pinned=-1) -> PodSpec:
     return PodSpec(
         cpu=jnp.int32(cpu),
         mem=jnp.int32(mem),
         gpu_milli=jnp.int32(gpu_milli),
         gpu_num=jnp.int32(gpu_num),
         gpu_mask=jnp.int32(gpu_mask),
+        pinned=jnp.int32(pinned),
     )
 
 
