@@ -1,0 +1,264 @@
+"""Config ingestion + applier/CLI layer tests (ref surfaces: pkg/apply,
+pkg/api/v1alpha1, pkg/algo, cmd/)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- k8s quantity / manifest parsing ----
+
+
+def test_parse_quantities():
+    from tpusim.io.k8s_yaml import parse_cpu_milli, parse_mem_mib
+
+    assert parse_cpu_milli("4") == 4000
+    assert parse_cpu_milli("250m") == 250
+    assert parse_cpu_milli(64) == 64000
+    assert parse_mem_mib("256000Mi") == 256000
+    assert parse_mem_mib("2Gi") == 2048
+    assert parse_mem_mib("1048576Ki") == 1024
+    assert parse_mem_mib(str(512 * 1024 * 1024)) == 512
+
+
+def test_node_pod_from_k8s():
+    from tpusim.io.k8s_yaml import load_cluster_from_dir
+
+    res = load_cluster_from_dir(os.path.join(REPO, "example/test-cluster"))
+    assert [n.name for n in res.nodes] == ["gpu-node-a", "gpu-node-b"]
+    a = res.nodes[0]
+    assert (a.cpu_milli, a.memory_mib, a.gpu, a.model) == (
+        48000,
+        196608,
+        4,
+        "V100M16",
+    )
+    pods = {p.name: p for p in res.pods}
+    t1 = pods["demo/train-pod-1"]
+    assert (t1.cpu_milli, t1.num_gpu, t1.gpu_milli, t1.gpu_spec) == (
+        16000,
+        2,
+        1000,
+        "A100",
+    )
+    cpu = pods["demo/cpu-pod-0"]
+    assert (cpu.num_gpu, cpu.gpu_milli) == (0, 0)
+
+
+def test_workload_expansion():
+    from tpusim.io.k8s_yaml import load_cluster_from_objects
+
+    deploy = {
+        "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "d"},
+        "spec": {
+            "replicas": 3,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {"resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
+                    ]
+                }
+            },
+        },
+    }
+    job = {
+        "kind": "Job",
+        "metadata": {"name": "batch"},
+        "spec": {
+            "completions": 2,
+            "template": {
+                "metadata": {
+                    "annotations": {
+                        "alibabacloud.com/gpu-count": "1",
+                        "alibabacloud.com/gpu-milli": "300",
+                    }
+                },
+                "spec": {
+                    "containers": [{"resources": {"requests": {"cpu": "500m"}}}]
+                },
+            },
+        },
+    }
+    node = {
+        "kind": "Node",
+        "metadata": {"name": "n0"},
+        "status": {"allocatable": {"cpu": "8", "memory": "16Gi"}},
+    }
+    ds = {
+        "kind": "DaemonSet",
+        "metadata": {"name": "agent"},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [{"resources": {"requests": {"cpu": "100m"}}}]
+                }
+            }
+        },
+    }
+    res = load_cluster_from_objects([deploy, job, node, ds])
+    names = sorted(p.name for p in res.workload_pods())
+    assert names == ["batch-0", "batch-1", "d/web-0", "d/web-1", "d/web-2"]
+    assert all(p.cpu_milli == 1000 for p in res.pods if "web" in p.name)
+    jobs = [p for p in res.pods if p.workload_kind == "Job"]
+    assert all((p.num_gpu, p.gpu_milli) == (1, 300) for p in jobs)
+    ds_pods = res.daemonset_pods()
+    assert len(ds_pods) == 1 and ds_pods[0].pinned_node == "n0"
+    assert ds_pods[0].workload_kind == "DaemonSet"
+
+
+# ---- Simon CR + scheduler config ----
+
+
+def test_simon_cr_parse_and_validate(tmp_path):
+    from tpusim.config import load_simon_cr
+    from tpusim.config.simon import ConfigError
+
+    cr = load_simon_cr(
+        os.path.join(REPO, "example/test-cluster-config.yaml"), REPO
+    )
+    assert cr.custom_cluster == os.path.join(REPO, "example/test-cluster")
+    assert cr.custom_config.typical_pods.pod_popularity_threshold == 95
+    assert cr.custom_config.tuning.ratio == 0.0
+
+    bad = {
+        "apiVersion": "simon/v1alpha1",
+        "kind": "Config",
+        "spec": {"cluster": {}},
+    }
+    p = tmp_path / "bad.yaml"
+    p.write_text(yaml.dump(bad))
+    with pytest.raises(ConfigError):
+        load_simon_cr(str(p))
+
+
+def test_scheduler_config_parse():
+    from tpusim.config import load_scheduler_config
+
+    cfg = load_scheduler_config(
+        os.path.join(REPO, "example/test-scheduler-config.yaml")
+    )
+    assert cfg.policies == [("FGDScore", 1000)]
+    assert cfg.gpu_sel_method == "FGDScore"
+    assert cfg.dim_ext_method == "share"
+    assert cfg.percentage_of_nodes_to_score == 100
+    default = load_scheduler_config("")
+    assert ("FGDScore", 1) in default.policies
+
+
+def test_scheduler_config_rejects_unknown(tmp_path):
+    from tpusim.config.scheduler import SchedulerConfigError, load_scheduler_config
+
+    doc = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1beta1",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [
+            {"plugins": {"score": {"enabled": [{"name": "NotAPlugin"}]}}}
+        ],
+    }
+    p = tmp_path / "sc.yaml"
+    p.write_text(yaml.dump(doc))
+    with pytest.raises(SchedulerConfigError):
+        load_scheduler_config(str(p))
+
+
+# ---- queue sorts (pkg/algo) ----
+
+
+def test_queue_sorts():
+    from tpusim.io.trace import NodeRow, PodRow
+    from tpusim.sim.queues import app_queue, greed_sort
+
+    nodes = [NodeRow("n0", 10000, 10000, 0)]
+    small = PodRow("small", 1000, 100, 0, 0)
+    big = PodRow("big", 8000, 100, 0, 0)
+    pinned = PodRow("pinned", 500, 100, 0, 0, pinned_node="n0")
+    sel = PodRow("sel", 500, 100, 0, 0, node_selector={"disk": "ssd"})
+    tol = PodRow("tol", 500, 100, 0, 0, tolerations=True)
+
+    out = greed_sort([small, big, pinned], nodes)
+    assert [p.name for p in out] == ["pinned", "big", "small"]
+
+    out = app_queue([small, sel, tol], nodes)
+    # toleration partition is the outermost sort; affinity breaks ties
+    assert out[0].name == "tol"
+    assert [p.name for p in out[1:]] == ["sel", "small"]
+
+
+# ---- helm chart rendering ----
+
+
+def test_chart_render(tmp_path):
+    from tpusim.io.chart import ChartError, chart_objects
+
+    chart = tmp_path / "mychart"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text("name: mychart\nversion: 1.0.0\n")
+    (chart / "values.yaml").write_text("replicas: 2\ncpu: 500m\n")
+    (chart / "templates" / "deploy.yaml").write_text(
+        """kind: Deployment
+metadata:
+  name: {{ .Release.Name }}-web
+spec:
+  replicas: {{ .Values.replicas }}
+  template:
+    spec:
+      containers:
+      - resources:
+          requests:
+            cpu: {{ .Values.cpu | quote }}
+"""
+    )
+    objs = chart_objects("demo", str(chart))
+    assert objs[0]["metadata"]["name"] == "demo-web"
+    assert objs[0]["spec"]["replicas"] == 2
+
+    (chart / "templates" / "loop.yaml").write_text(
+        "{{ range .Values.items }}\n{{ end }}\n"
+    )
+    with pytest.raises(ChartError):
+        chart_objects("demo", str(chart))
+
+
+# ---- applier end-to-end on the example cluster ----
+
+
+def test_applier_end_to_end():
+    from tpusim.apply import Applier, ApplyOptions
+
+    out = io.StringIO()
+    applier = Applier(
+        ApplyOptions(
+            simon_config=os.path.join(REPO, "example/test-cluster-config.yaml"),
+            default_scheduler_config=os.path.join(
+                REPO, "example/test-scheduler-config.yaml"
+            ),
+            base_dir=REPO,
+            report_tables=True,
+        )
+    )
+    result = applier.run(out=out)
+    text = out.getvalue()
+    assert not result.unscheduled_pods, text
+    assert "Success!" in text
+    assert "Pod Info" in text and "Node Info" in text
+    # the 2-GPU A100-constrained pod must land on the A100 node
+    pods = {p.name: i for i, p in enumerate(result.pods)}
+    i = pods["demo/train-pod-1"]
+    assert result.node_names[result.placed_node[i]] == "gpu-node-b"
+    assert result.dev_mask[i].sum() == 2
+
+
+def test_cli_version_and_gen_doc(tmp_path, capsys):
+    from tpusim.cli import main
+
+    assert main(["version"]) == 0
+    assert "tpusim version" in capsys.readouterr().out
+    assert main(["gen-doc", "-d", str(tmp_path)]) == 0
+    assert (tmp_path / "tpusim.md").exists()
+    assert main(["debug"]) == 0

@@ -1,0 +1,239 @@
+"""Applier: Simon-CR-driven experiment orchestration.
+
+The reference's pkg/apply/apply.go Run() + pkg/simulator/core.go Simulate()
+pipeline, driving the array-state Simulator:
+
+  load CR → load cluster YAML dir (+ apps / Helm charts) → daemonset pods →
+  typical pods → sort/tune workload → replay → ClusterAnalysis(InitSchedule)
+  → snapshot export → inflation eval → new-workload swap → deschedule +
+  reschedule → per-app scheduling → success/failure verdict.
+
+Env caps MaxCPU/MaxMemory (apply.go:550-631 satisfyResourceSetting) are
+honored for the final verdict; the reference's MaxVG cap belongs to the
+open-local storage extension, which this build does not model yet.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from tpusim.config.scheduler import SchedulerConfig, load_scheduler_config
+from tpusim.config.simon import SimonCR, load_simon_cr
+from tpusim.io.k8s_yaml import ClusterResource, load_cluster_from_dir
+from tpusim.io.trace import PodRow
+from tpusim.sim.driver import SimulateResult, Simulator, SimulatorConfig
+
+COLOR_RED = "\033[31m"
+COLOR_GREEN = "\033[32m"
+COLOR_RESET = "\033[0m"
+
+
+@dataclass
+class ApplyOptions:
+    """CLI surface (ref: cmd/apply/apply.go:26-40)."""
+
+    simon_config: str = ""
+    default_scheduler_config: str = ""
+    use_greed: bool = False
+    interactive: bool = False
+    extended_resources: List[str] = field(default_factory=lambda: ["gpu"])
+    base_dir: str = "."
+    report_tables: bool = False
+
+
+class Applier:
+    def __init__(self, options: ApplyOptions):
+        if not options.simon_config:
+            raise ValueError("--simon-config is required")
+        self.options = options
+        self.cr: SimonCR = load_simon_cr(options.simon_config, options.base_dir)
+        self.sched_cfg: SchedulerConfig = load_scheduler_config(
+            options.default_scheduler_config
+        )
+        if self.cr.kube_config:
+            raise NotImplementedError(
+                "real-cluster kubeConfig mode needs a live Kubernetes API; "
+                "this simulator build supports customConfig clusters "
+                "(ref parity: CreateClusterResourceFromClient)"
+            )
+
+    def _simulator_config(self) -> SimulatorConfig:
+        cc = self.cr.custom_config
+        return SimulatorConfig(
+            policies=self.sched_cfg.policy_tuple(),
+            gpu_sel_method=self.sched_cfg.gpu_sel_method,
+            dim_ext_method=self.sched_cfg.dim_ext_method,
+            norm_method=self.sched_cfg.norm_method,
+            shuffle_pod=cc.shuffle_pod,
+            tuning_ratio=cc.tuning.ratio,
+            tuning_seed=cc.tuning.seed,
+            inflation_ratio=cc.inflation.ratio,
+            inflation_seed=cc.inflation.seed,
+            typical_pods=cc.typical_pods,
+            deschedule_ratio=cc.deschedule.ratio,
+            deschedule_policy=cc.deschedule.policy,
+        )
+
+    def _load_apps(self, node_names: Sequence[str]) -> List[tuple]:
+        """appList → [(name, pods)] (apply.go:118-141; Helm charts render
+        through tpusim.io.chart). App DaemonSets expand over the CLUSTER's
+        nodes, which an app-only ClusterResource does not know about."""
+        from tpusim.io.chart import chart_objects
+        from tpusim.io.k8s_yaml import (
+            daemonset_pods,
+            load_cluster_from_objects,
+            load_objects,
+            yaml_files_in_dir,
+        )
+
+        apps = []
+        for app in self.cr.app_list:
+            if app.chart:
+                objs = chart_objects(app.name, app.path)
+            else:
+                objs = load_objects(yaml_files_in_dir(app.path))
+            res = load_cluster_from_objects(objs)
+            pods = list(res.workload_pods())
+            for ds in res.daemonsets:
+                pods.extend(daemonset_pods(ds, node_names))
+            apps.append((app.name, pods))
+        if self.options.interactive and apps:
+            apps = _interactive_select(apps)
+        return apps
+
+    def run(self, out=sys.stdout) -> SimulateResult:
+        cluster = load_cluster_from_dir(self.cr.custom_cluster)
+        if not cluster.nodes:
+            raise ValueError(f"no Node manifests under {self.cr.custom_cluster}")
+        cc = self.cr.custom_config
+
+        sim = Simulator(cluster.nodes, self._simulator_config())
+        sim.log.stream = out
+        self.sim = sim
+
+        # workload = trace pods + per-node daemonset pods (core.go:103-123)
+        workload = cluster.workload_pods()
+        ds_pods = cluster.daemonset_pods()
+        sim.set_workload_pods(workload + ds_pods)
+        result = sim.run()
+
+        # snapshot export at InitSchedule (core.go:160-185)
+        self._export_snapshots(sim, "init_schedule")
+
+        # workload inflation eval (core.go:189-192)
+        if cc.inflation.ratio > 1:
+            sim.run_workload_inflation_evaluation("ScheduleInflation")
+
+        # new-workload swap (core.go:195-209): replace the typical-pod
+        # distribution with the new workload's, then schedule it on top
+        if cc.new_workload_config:
+            nw_dir = cc.new_workload_config
+            if not os.path.isabs(nw_dir):
+                nw_dir = os.path.join(self.options.base_dir, nw_dir)
+            nw = load_cluster_from_dir(nw_dir)
+            nw_pods = nw.workload_pods()
+            sim.set_workload_pods(nw_pods)
+            sim.set_typical_pods()
+            sim.schedule_additional(nw_pods)
+            sim.cluster_analysis("InitSchedule")
+
+        # deschedule + reschedule (core.go:213-246)
+        if cc.deschedule.ratio > 0 and cc.deschedule.policy:
+            sim.deschedule_cluster()
+            sim.cluster_analysis("PostDeschedule")
+            self._export_snapshots(sim, "post_deschedule")
+            if cc.inflation.ratio > 1:
+                sim.run_workload_inflation_evaluation("DescheduleInflation")
+
+        # per-app scheduling (core.go:255-261)
+        for name, pods in self._load_apps(cluster.node_names):
+            sim.schedule_app(name, pods, self.options.use_greed)
+
+        result = sim.last_result
+        self._verdict(result, out)
+        if self.options.report_tables:
+            from tpusim.sim.report_tables import full_report
+
+            print(
+                full_report(
+                    result.pods,
+                    result.placed_node,
+                    result.dev_mask,
+                    cluster.nodes,
+                    self.options.extended_resources,
+                ),
+                file=out,
+            )
+        return result
+
+    def _export_snapshots(self, sim: Simulator, tag: str):
+        exp = self.cr.custom_config.export
+        if exp.pod_snapshot_yaml_file_prefix:
+            path = f"{exp.pod_snapshot_yaml_file_prefix}_{tag}.yaml"
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            sim.export_pod_snapshot_yaml(path)
+        if exp.node_snapshot_csv_file_prefix:
+            path = f"{exp.node_snapshot_csv_file_prefix}_{tag}.csv"
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            sim.export_node_snapshot_csv(path)
+            sim.export_pod_snapshot_csv(
+                f"{exp.node_snapshot_csv_file_prefix}_{tag}_pod.csv"
+            )
+
+    def _verdict(self, result: SimulateResult, out):
+        """Success print + env resource caps (apply.go:219-246, 550-631)."""
+        if result.unscheduled_pods:
+            print(
+                f"{COLOR_RED}there are {len(result.unscheduled_pods)} "
+                f"unscheduled pods{COLOR_RESET}",
+                file=out,
+            )
+            print(f"{COLOR_RED}Failed!{COLOR_RESET}", file=out)
+            return
+        ok, reason = self._satisfy_resource_setting(result)
+        if not ok:
+            print(f"{COLOR_RED}{reason}{COLOR_RESET}", file=out)
+            print(f"{COLOR_RED}Failed!{COLOR_RESET}", file=out)
+        else:
+            print(f"{COLOR_GREEN}Success!{COLOR_RESET}", file=out)
+
+    def _satisfy_resource_setting(self, result: SimulateResult):
+        """Env caps MaxCPU (cores) / MaxMemory (GiB) on per-node *occupied*
+        amounts (apply.go:550-631)."""
+        max_cpu = float(os.environ.get("MaxCPU", 0) or 0)
+        max_mem = float(os.environ.get("MaxMemory", 0) or 0)
+        if not max_cpu and not max_mem:
+            return True, ""
+        s = result.state
+        cpu_used = np.asarray(s.cpu_cap) - np.asarray(s.cpu_left)
+        mem_used = np.asarray(s.mem_cap) - np.asarray(s.mem_left)
+        if max_cpu and (cpu_used > max_cpu * 1000).any():
+            i = int(np.argmax(cpu_used))
+            return False, (
+                f"node {result.node_names[i]} cpu used "
+                f"{cpu_used[i] / 1000:.1f} cores exceeds MaxCPU {max_cpu}\n"
+            )
+        if max_mem and (mem_used > max_mem * 1024).any():
+            i = int(np.argmax(mem_used))
+            return False, (
+                f"node {result.node_names[i]} memory used "
+                f"{mem_used[i] / 1024:.1f}Gi exceeds MaxMemory {max_mem}\n"
+            )
+        return True, ""
+
+
+def _interactive_select(apps):
+    """Multi-select confirmation (apply.go:172-189, survey lib)."""
+    print("Confirm your apps (comma-separated indices, empty = all):")
+    for i, (name, pods) in enumerate(apps):
+        print(f"  [{i}] {name} ({len(pods)} pods)")
+    line = input("> ").strip()
+    if not line:
+        return apps
+    picked = {int(x) for x in line.split(",") if x.strip().isdigit()}
+    return [a for i, a in enumerate(apps) if i in picked]

@@ -1,0 +1,115 @@
+"""`tpusim` command-line interface (ref: cmd/, the cobra `simon` tree).
+
+Subcommands mirror the reference binary:
+  apply    run a simulation from a Simon-CR cluster config
+           (ref: cmd/apply/apply.go:14-40)
+  version  print version/commit (ref: cmd/version/version.go)
+  gen-doc  emit markdown docs for the CLI tree (ref: cmd/doc/)
+  debug    scaffold, intentionally empty (ref: cmd/debug/debug.go)
+
+Log level comes from env LOGLEVEL (debug|info|warn|error), matching
+cmd/simon/simon.go:52-72.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+VERSION = "0.1.0"
+COMMIT = os.environ.get("TPUSIM_COMMIT", "dev")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpusim",
+        description="TPU-native Kubernetes GPU-cluster scheduling simulator",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    p_apply = sub.add_parser("apply", help="run a simulation")
+    p_apply.add_argument(
+        "-f", "--simon-config", required=True, help="cluster-config YAML (Simon CR)"
+    )
+    p_apply.add_argument(
+        "-s",
+        "--default-scheduler-config",
+        default="",
+        help="KubeSchedulerConfiguration YAML",
+    )
+    p_apply.add_argument(
+        "--use-greed", action="store_true", help="greedy app-pod queue sort"
+    )
+    p_apply.add_argument(
+        "-i", "--interactive", action="store_true", help="confirm app list"
+    )
+    p_apply.add_argument(
+        "-e",
+        "--extended-resources",
+        default="gpu",
+        help="comma-separated: gpu, open-local",
+    )
+    p_apply.add_argument(
+        "--base-dir",
+        default=".",
+        help="root for relative paths inside the CR (default: cwd)",
+    )
+    p_apply.add_argument(
+        "--report", action="store_true", help="print placement report tables"
+    )
+
+    sub.add_parser("version", help="print version")
+
+    p_doc = sub.add_parser("gen-doc", help="generate markdown CLI docs")
+    p_doc.add_argument("-d", "--dir", default="docs", help="output directory")
+
+    sub.add_parser("debug", help="debug scaffold (no-op, ref parity)")
+    return parser
+
+
+def cmd_apply(args) -> int:
+    from tpusim.apply import Applier, ApplyOptions
+
+    opts = ApplyOptions(
+        simon_config=args.simon_config,
+        default_scheduler_config=args.default_scheduler_config,
+        use_greed=args.use_greed,
+        interactive=args.interactive,
+        extended_resources=[
+            e.strip() for e in args.extended_resources.split(",") if e.strip()
+        ],
+        base_dir=args.base_dir,
+        report_tables=args.report,
+    )
+    Applier(opts).run()
+    return 0
+
+
+def cmd_gen_doc(parser: argparse.ArgumentParser, args) -> int:
+    os.makedirs(args.dir, exist_ok=True)
+    path = os.path.join(args.dir, "tpusim.md")
+    with open(path, "w") as f:
+        f.write(f"# tpusim\n\n```\n{parser.format_help()}\n```\n")
+    print(f"wrote {path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+    if args.command == "apply":
+        return cmd_apply(args)
+    if args.command == "version":
+        print(f"tpusim version {VERSION} (commit {COMMIT})")
+        return 0
+    if args.command == "gen-doc":
+        return cmd_gen_doc(parser, args)
+    if args.command == "debug":
+        return 0  # ref: cmd/debug/debug.go run() is empty
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

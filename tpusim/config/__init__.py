@@ -1,0 +1,24 @@
+from tpusim.config.simon import (
+    AppInfo,
+    CustomConfig,
+    DescheduleConfig,
+    ExportConfig,
+    SimonCR,
+    WorkloadInflationConfig,
+    WorkloadTuningConfig,
+    load_simon_cr,
+)
+from tpusim.config.scheduler import SchedulerConfig, load_scheduler_config
+
+__all__ = [
+    "AppInfo",
+    "CustomConfig",
+    "DescheduleConfig",
+    "ExportConfig",
+    "SimonCR",
+    "WorkloadInflationConfig",
+    "WorkloadTuningConfig",
+    "load_simon_cr",
+    "SchedulerConfig",
+    "load_scheduler_config",
+]

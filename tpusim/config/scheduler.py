@@ -1,0 +1,153 @@
+"""KubeSchedulerConfiguration parsing + simulator defaulting.
+
+Accepts the reference's scheduler-config YAML surface
+(example/original/test-scheduler-config.yaml) and applies the same forced
+defaults as the reference (ref: GetAndSetSchedulerConfig,
+pkg/simulator/utils.go:217-323): percentageOfNodesToScore=100, scheduler
+name `simon-scheduler`, DefaultBinder disabled in favor of the Simon bind.
+
+Policy selection follows the reference convention: the enabled Score
+plugins (with weights) pick the policy mix; per-plugin `pluginConfig` args
+carry `dimExtMethod` / `normMethod` / `gpuSelMethod`
+(ref: pkg/type/config.go:50-61 plugin-config structs).
+
+k8s built-in score plugins that the simulator always disables
+(ImageLocality, NodeAffinity, …) are accepted in the YAML and ignored —
+they have no analogue over the array state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+import yaml
+
+SCHEDULER_NAME = "simon-scheduler"  # ref: pkg/type/const.go DefaultSchedulerName
+API_VERSIONS = (
+    "kubescheduler.config.k8s.io/v1beta1",
+    "kubescheduler.config.k8s.io/v1beta2",
+    "kubescheduler.config.k8s.io/v1",
+)
+
+# score plugins this framework implements (ref: pkg/type/const.go:4-13)
+KNOWN_SCORE_PLUGINS = (
+    "Simon",
+    "RandomScore",
+    "DotProductScore",
+    "GpuClusteringScore",
+    "GpuPackingScore",
+    "BestFitScore",
+    "FGDScore",
+    "PWRScore",
+)
+# vendored-k8s score plugins force-disabled by the reference; silently inert
+IGNORED_SCORE_PLUGINS = (
+    "ImageLocality",
+    "NodeAffinity",
+    "PodTopologySpread",
+    "TaintToleration",
+    "NodeResourcesBalancedAllocation",
+    "InterPodAffinity",
+    "NodeResourcesLeastAllocated",
+    "NodePreferAvoidPods",
+)
+
+
+@dataclass
+class SchedulerConfig:
+    policies: List[Tuple[str, int]] = field(default_factory=list)
+    gpu_sel_method: str = "best"  # best|worst|random|<score-plugin name>
+    dim_ext_method: str = "share"  # merge|share|divide|extend
+    norm_method: str = "max"  # node|pod|max
+    percentage_of_nodes_to_score: int = 100
+    scheduler_name: str = SCHEDULER_NAME
+
+    def policy_tuple(self) -> Tuple[Tuple[str, int], ...]:
+        return tuple(self.policies)
+
+
+class SchedulerConfigError(ValueError):
+    pass
+
+
+def default_scheduler_config() -> SchedulerConfig:
+    """No-config default (ref: GetAndSetSchedulerConfig's built-in profile:
+    Simon + BestFit + Random + DotProduct + FGD + PWR all enabled at weight
+    1, utils.go:251-272)."""
+    return SchedulerConfig(
+        policies=[
+            ("Simon", 1),
+            ("BestFitScore", 1),
+            ("RandomScore", 1),
+            ("DotProductScore", 1),
+            ("FGDScore", 1),
+            ("PWRScore", 1),
+        ]
+    )
+
+
+def parse_scheduler_config(doc: dict) -> SchedulerConfig:
+    if doc.get("kind") != "KubeSchedulerConfiguration":
+        raise SchedulerConfigError(
+            f"expected kind=KubeSchedulerConfiguration, got {doc.get('kind')}"
+        )
+    if doc.get("apiVersion") not in API_VERSIONS:
+        raise SchedulerConfigError(
+            f"unsupported apiVersion {doc.get('apiVersion')}"
+        )
+    profiles = doc.get("profiles") or []
+    if not profiles:
+        return default_scheduler_config()
+    profile = profiles[0]
+    plugins = profile.get("plugins") or {}
+    score = plugins.get("score") or {}
+    disabled = {p.get("name") for p in (score.get("disabled") or [])}
+
+    cfg = SchedulerConfig()
+    for p in score.get("enabled") or []:
+        name = p.get("name")
+        if name in disabled or name in IGNORED_SCORE_PLUGINS:
+            continue
+        if name not in KNOWN_SCORE_PLUGINS:
+            raise SchedulerConfigError(f"unknown score plugin: {name}")
+        cfg.policies.append((name, int(p.get("weight", 1) or 1)))
+    if not cfg.policies:
+        cfg = default_scheduler_config()
+
+    # pluginConfig args: last writer wins per arg, matching the reference's
+    # per-plugin structs all carrying the same three knobs
+    for pc in profile.get("pluginConfig") or []:
+        args = pc.get("args") or {}
+        if "dimExtMethod" in args:
+            cfg.dim_ext_method = str(args["dimExtMethod"])
+        if "normMethod" in args:
+            cfg.norm_method = str(args["normMethod"])
+        if "gpuSelMethod" in args:
+            cfg.gpu_sel_method = str(args["gpuSelMethod"])
+
+    # forced defaults (utils.go:234-235, 312)
+    cfg.percentage_of_nodes_to_score = 100
+    cfg.scheduler_name = profile.get("schedulerName") or SCHEDULER_NAME
+    _validate_methods(cfg)
+    return cfg
+
+
+def _validate_methods(cfg: SchedulerConfig) -> None:
+    if cfg.dim_ext_method not in ("merge", "share", "divide", "extend"):
+        raise SchedulerConfigError(f"bad dimExtMethod: {cfg.dim_ext_method}")
+    if cfg.norm_method not in ("node", "pod", "max"):
+        raise SchedulerConfigError(f"bad normMethod: {cfg.norm_method}")
+    sel_ok = ("best", "worst", "random") + tuple(KNOWN_SCORE_PLUGINS)
+    if cfg.gpu_sel_method not in sel_ok:
+        raise SchedulerConfigError(f"bad gpuSelMethod: {cfg.gpu_sel_method}")
+
+
+def load_scheduler_config(path: str = "") -> SchedulerConfig:
+    if not path:
+        return default_scheduler_config()
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if not isinstance(doc, dict):
+        raise SchedulerConfigError(f"{path}: not a YAML mapping")
+    return parse_scheduler_config(doc)

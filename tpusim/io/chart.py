@@ -1,0 +1,106 @@
+"""Minimal Helm-chart rendering for app manifests (ref: pkg/chart/chart.go
+ProcessChart, which renders a chart through the Helm engine to YAML docs).
+
+This framework supports the common simulator use-case — charts whose
+templates only interpolate scalar values — without a Go-template engine:
+`{{ .Values.x.y }}`, `{{ .Release.Name }}`, `{{ .Chart.Name }}` and the
+`default`/`quote` pipe forms are substituted; any other template directive
+raises ChartError with a pointer to pre-render the chart with `helm
+template` instead (the rendered YAML is then a plain app path).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+import yaml
+
+
+class ChartError(ValueError):
+    pass
+
+
+_EXPR = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+_COMMENT = re.compile(r"\{\{-?\s*/\*.*?\*/\s*-?\}\}", re.S)
+
+
+def _lookup(path: str, scope: dict):
+    cur = scope
+    for part in path.split("."):
+        if not part:
+            continue
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(path)
+        cur = cur[part]
+    return cur
+
+
+def _render_expr(expr: str, scope: dict) -> str:
+    # pipe forms: `.Values.x | default "v"`, `... | quote`
+    parts = [p.strip() for p in expr.split("|")]
+    head = parts[0]
+    if not head.startswith("."):
+        raise ChartError(f"unsupported template directive: {{{{ {expr} }}}}")
+    try:
+        val = _lookup(head[1:], scope)
+    except KeyError:
+        val = None
+    for pipe in parts[1:]:
+        if pipe.startswith("default"):
+            if val in (None, ""):
+                arg = pipe[len("default") :].strip().strip("\"'")
+                val = arg
+        elif pipe == "quote":
+            if val is None:
+                raise ChartError(f"undefined value: {{{{ {expr} }}}}")
+            val = f'"{val}"'
+        else:
+            raise ChartError(f"unsupported pipe: {pipe}")
+    if val is None:
+        raise ChartError(f"undefined value: {{{{ {expr} }}}}")
+    return str(val)
+
+
+def render_chart(name: str, path: str) -> List[str]:
+    """Chart dir → list of rendered YAML document strings."""
+    chart_yaml = os.path.join(path, "Chart.yaml")
+    values_yaml = os.path.join(path, "values.yaml")
+    tmpl_dir = os.path.join(path, "templates")
+    if not os.path.isdir(tmpl_dir):
+        raise ChartError(f"{path}: no templates/ directory")
+    chart_meta = {}
+    if os.path.exists(chart_yaml):
+        with open(chart_yaml) as f:
+            chart_meta = yaml.safe_load(f) or {}
+    values = {}
+    if os.path.exists(values_yaml):
+        with open(values_yaml) as f:
+            values = yaml.safe_load(f) or {}
+    scope = {
+        "Values": values,
+        "Release": {"Name": name, "Namespace": "default"},
+        "Chart": {"Name": chart_meta.get("name", name),
+                  "Version": chart_meta.get("version", "")},
+    }
+    docs = []
+    for fname in sorted(os.listdir(tmpl_dir)):
+        if not fname.endswith((".yaml", ".yml")):
+            continue
+        if fname.startswith("_"):  # helpers need real Go templates
+            raise ChartError(f"{fname}: helper templates unsupported")
+        with open(os.path.join(tmpl_dir, fname)) as f:
+            text = _COMMENT.sub("", f.read())
+        rendered = _EXPR.sub(lambda m: _render_expr(m.group(1), scope), text)
+        docs.append(rendered)
+    return docs
+
+
+def chart_objects(name: str, path: str) -> List[dict]:
+    objs = []
+    for doc in render_chart(name, path):
+        for obj in yaml.safe_load_all(doc):
+            if isinstance(obj, dict) and obj.get("kind"):
+                objs.append(obj)
+    return objs

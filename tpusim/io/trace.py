@@ -49,6 +49,12 @@ class PodRow:
     # the simon/pod-unscheduled annotation)
     pinned_node: Optional[str] = None
     unscheduled: bool = False
+    # k8s-manifest fields (tpusim.io.k8s_yaml): queue-sort inputs
+    # (pkg/algo) and workload provenance (AddWorkloadInfoToPod)
+    node_selector: Optional[dict] = None
+    tolerations: bool = False
+    workload_kind: str = ""
+    workload_name: str = ""
 
     @property
     def total_gpu_milli(self) -> int:

@@ -154,15 +154,15 @@ class Simulator:
 
     # ---- the run (core.go:148 RunCluster → SchedulePods) ----
 
-    def schedule_pods(self, pods: Sequence[PodRow]) -> SimulateResult:
-        if self.typical is None:
-            self.set_typical_pods()
+    def _replay_pods(self, state, pods: Sequence[PodRow], key, use_timestamps: bool):
+        """Run the compiled replay for `pods` on `state`. Returns
+        (replay output, events, unscheduled list). Pods carrying the
+        simon/pod-unscheduled annotation are skipped by the event loop and
+        reported as failed (simulator.go:391-399)."""
         specs = pods_to_specs(pods, self.node_index)
-        ev_kind, ev_pod = build_events(pods, self.cfg.use_timestamps)
-        key = jax.random.PRNGKey(self.cfg.seed)
-        t0 = time.perf_counter()
-        result = self.replay_fn(
-            self.init_state,
+        ev_kind, ev_pod = build_events(pods, use_timestamps)
+        out = self.replay_fn(
+            state,
             specs,
             jnp.asarray(ev_kind),
             jnp.asarray(ev_pod),
@@ -170,23 +170,31 @@ class Simulator:
             key,
             self.rank,
         )
-        placed = np.asarray(result.placed_node)
-        failed = np.asarray(result.ever_failed)
-        wall = time.perf_counter() - t0
-
-        if self.cfg.report_per_event and result.metrics is not None:
-            self._emit_event_reports(result.metrics)
-
-        # pods carrying the simon/pod-unscheduled annotation are skipped by
-        # the event loop and reported as failed (simulator.go:391-399)
+        if self.cfg.report_per_event and out.metrics is not None:
+            self._emit_event_reports(out.metrics)
         skipped = np.array([p.unscheduled for p in pods], bool)
+        failed_mask = np.asarray(out.ever_failed) | skipped
         unscheduled = [
             UnscheduledPod(
                 pods[i],
                 reason="pod-unscheduled annotation" if skipped[i] else "unschedulable",
             )
-            for i in np.flatnonzero(failed | skipped)
+            for i in np.flatnonzero(failed_mask)
         ]
+        return out, len(ev_kind), unscheduled
+
+    def schedule_pods(self, pods: Sequence[PodRow]) -> SimulateResult:
+        if self.typical is None:
+            self.set_typical_pods()
+        t0 = time.perf_counter()
+        result, events, unscheduled = self._replay_pods(
+            self.init_state,
+            pods,
+            jax.random.PRNGKey(self.cfg.seed),
+            self.cfg.use_timestamps,
+        )
+        placed = np.asarray(result.placed_node)
+        wall = time.perf_counter() - t0
         self.last_result = SimulateResult(
             unscheduled_pods=unscheduled,
             placed_node=placed,
@@ -195,10 +203,46 @@ class Simulator:
             pods=list(pods),
             node_names=self.node_names,
             wall_seconds=wall,
-            events=len(ev_kind),
+            events=events,
         )
         self.log.info(f"there are {len(unscheduled)} unscheduled pods")
         return self.last_result
+
+    def schedule_additional(self, pods: Sequence[PodRow]) -> List[UnscheduledPod]:
+        """Continue scheduling `pods` on the CURRENT cluster state, appending
+        them to the run's bookkeeping. This is the engine behind ScheduleApp
+        (core.go:255-261) and the new-workload swap (core.go:195-209) — both
+        schedule extra pods on top of the already-placed cluster."""
+        if self.typical is None:
+            self.set_typical_pods()
+        res = self.last_result
+        out, events, failed = self._replay_pods(
+            jax.tree.map(jnp.asarray, res.state),
+            pods,
+            jax.random.PRNGKey(self.cfg.seed + len(res.pods)),
+            use_timestamps=False,
+        )
+        res.state = jax.tree.map(np.asarray, out.state)
+        res.pods = list(res.pods) + list(pods)
+        res.placed_node = np.concatenate(
+            [res.placed_node, np.asarray(out.placed_node)]
+        )
+        res.dev_mask = np.concatenate([res.dev_mask, np.asarray(out.dev_mask)])
+        res.unscheduled_pods = list(res.unscheduled_pods) + failed
+        res.events += events
+        return failed
+
+    def schedule_app(
+        self, name: str, pods: Sequence[PodRow], use_greed: bool = False
+    ) -> List[UnscheduledPod]:
+        """ScheduleApp (simulator.go:224-237): sort the app's pods through
+        the affinity → toleration queues (greed first when --use-greed),
+        then schedule them on the current state."""
+        from tpusim.sim.queues import app_queue
+
+        ordered = app_queue(pods, self.nodes, use_greed)
+        self.log.info(f"Scheduling app {name}: {len(ordered)} pods")
+        return self.schedule_additional(ordered)
 
     def run(self) -> SimulateResult:
         """Full experiment (core.go:86-268 minus deschedule/inflation, which

@@ -1,0 +1,67 @@
+"""Scheduling-queue sort orders for app pods (ref: pkg/algo/).
+
+The reference sorts app pods with sort.Sort over boolean Less predicates —
+with a constant-per-element key this is a partition; we implement each
+queue as a stable partition/sort so the intent (strict-requirement pods
+first) is preserved deterministically.
+
+Used by ScheduleApp (pkg/simulator/simulator.go:224-237): affinity sort,
+then toleration sort; `--use-greed` additionally pre-sorts by dominant
+resource share (pkg/apply + algo/greed.go).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from tpusim.io.trace import NodeRow, PodRow
+
+
+def affinity_sort(pods: Sequence[PodRow]) -> List[PodRow]:
+    """Node-selector pods first (ref: algo/affinity.go:8-32)."""
+    return sorted(
+        pods,
+        key=lambda p: 0 if (p.node_selector or p.pinned_node) else 1,
+    )
+
+
+def toleration_sort(pods: Sequence[PodRow]) -> List[PodRow]:
+    """Toleration-bearing pods first (ref: algo/toleration.go:7-22)."""
+    return sorted(pods, key=lambda p: 0 if p.tolerations else 1)
+
+
+def _share(alloc: float, total: float) -> float:
+    """ref: algo/greed.go Share."""
+    if total == 0:
+        return 0.0 if alloc == 0 else 1.0
+    return alloc / total
+
+
+def greed_sort(pods: Sequence[PodRow], nodes: Sequence[NodeRow]) -> List[PodRow]:
+    """Dominant-resource-share descending, pinned pods first
+    (ref: algo/greed.go:12-91: NodeName-assigned pods lead; otherwise the
+    larger max(cpu-share, memory-share) schedules earlier)."""
+    total_cpu = float(sum(n.cpu_milli for n in nodes))
+    total_mem = float(sum(n.memory_mib for n in nodes))
+
+    def key(p: PodRow):
+        pinned = 0 if p.pinned_node else 1
+        share = max(_share(p.cpu_milli, total_cpu), _share(p.memory_mib, total_mem))
+        return (pinned, -share)
+
+    return sorted(pods, key=key)
+
+
+def app_queue(
+    pods: Sequence[PodRow],
+    nodes: Sequence[NodeRow],
+    use_greed: bool = False,
+) -> List[PodRow]:
+    """ScheduleApp's composite order (simulator.go:230-233): greed
+    (optional) → affinity → toleration; later sorts are stable, so earlier
+    keys act as tie-breaks."""
+    out = list(pods)
+    if use_greed:
+        out = greed_sort(out, nodes)
+    out = affinity_sort(out)
+    return toleration_sort(out)
