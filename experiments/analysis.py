@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Log → CSV analysis (ref: scripts/analysis.py in the Go simulator repo).
+
+Parses the simulator's logrus-format log lines into the same four CSV
+families the reference harness produces per experiment:
+
+  analysis.csv       one summary row: meta + unscheduled count + per-tag
+                     allocation ratios/amounts + frag-class percentages
+                     (from the 16-line Cluster Analysis block)
+  analysis_frag.csv  per-event frag series: origin_milli/origin_ratio/
+                     origin_q124 (ref parses `[Report]; Frag amount: ...`)
+  analysis_allo.csv  per-event allocation series: used_nodes/used_gpus/
+                     used_gpu_milli/total_gpus/arrived_gpu_milli (+ CPU)
+  analysis_cdol.csv  per-event create/delete timeline with cumulative pods
+  analysis_pwr.csv   per-event power series: cluster/CPU/GPU watts
+
+Line formats are identical to the reference's (tpusim.sim.reports emits
+them), so either harness's analyzer can read either simulator's logs.
+The parser stops at the `there are N unscheduled pods` stop marker, like
+the reference's log_to_csv.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List
+
+ALLO_KEYS = ["MilliCpu", "Memory", "Gpu", "MilliGpu"]
+QUAD_KEYS = [
+    "q1_lack_both",
+    "q2_lack_gpu",
+    "q3_satisfied",
+    "q4_lack_cpu",
+    "xl_satisfied",
+    "xr_lack_cpu",
+    "no_access",
+    "frag_gpu_milli",
+]
+INFOMSG = "level=info msg="
+
+
+def camel_to_snake(name: str) -> str:
+    name = re.sub("(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub("([a-z0-9])([A-Z])", r"\1_\2", name).lower()
+
+
+def parse_log(path: str, meta: Dict[str, str] = None) -> Dict[str, dict]:
+    """One log file → {'summary': {...}, 'frag': {col: [...]}, 'allo': ...,
+    'cdol': ..., 'pwr': ...}."""
+    summary: Dict[str, object] = dict(meta or {})
+    summary["unscheduled"] = 0
+    frag: Dict[str, List[float]] = {}
+    allo: Dict[str, List[int]] = {}
+    pwr: Dict[str, List[float]] = {}
+    cdol = {"id": [], "event": [], "pod_name": [], "cum_pod": []}
+    cum = 0
+    tag = ""
+    analysis_countdown = 0
+
+    with open(path) as f:
+        for raw in f:
+            if INFOMSG not in raw:
+                continue
+            line = raw.split(INFOMSG, 1)[1].strip()
+            if line.startswith('"'):
+                line = line[1:]
+            line = line.rstrip('"').rstrip()
+            if line.endswith("\\n"):
+                line = line[:-2]
+
+            if "Number of original workload pods" in line:
+                summary["origin_pods"] = int(line.split(":")[1].strip())
+            if "there are" in line and "unscheduled pods" in line:
+                summary["unscheduled"] = int(
+                    line.split("unscheduled pods")[0].split("there are")[1].strip()
+                )
+                break
+
+            if "Cluster Analysis" in line and "(" in line:
+                tag = line.split(")")[0].split("(")[1]
+                analysis_countdown = 16
+                continue
+            if analysis_countdown > 0:
+                analysis_countdown -= 1
+                item = line.strip().split(":")
+                if len(item) > 1:
+                    key, value = item[0].strip(), item[1].strip()
+                    if key in ALLO_KEYS:
+                        summary[camel_to_snake(key + tag)] = float(
+                            value.split("%")[0]
+                        )
+                        summary[camel_to_snake(key + "Amount" + tag)] = float(
+                            value.split("(")[1].split("/")[0]
+                        )
+                        summary[camel_to_snake(key + "Total")] = float(
+                            value.split(")")[0].split("/")[1]
+                        )
+                    elif key in QUAD_KEYS:
+                        summary[camel_to_snake(key + tag)] = float(
+                            value.split("(")[1].split("%")[0].strip()
+                        )
+                continue
+
+            if line.startswith("[Report]"):
+                parts = line.split(";")
+                if len(parts) == 5:  # origin variant
+                    remark = parts[4].split("(")[1].split(")")[0].strip()
+                    frag.setdefault(f"{remark}_milli", []).append(
+                        float(parts[1].split(":")[1])
+                    )
+                    frag.setdefault(f"{remark}_ratio", []).append(
+                        float(parts[2].split(":")[1].strip().rstrip("%"))
+                    )
+                    frag.setdefault(f"{remark}_q124", []).append(
+                        float(parts[3].split(":")[1].strip().rstrip("%"))
+                    )
+                elif len(parts) == 4:  # bellman variant
+                    remark = parts[3].split("(")[1].split(")")[0].strip()
+                    frag.setdefault(f"{remark}_milli", []).append(
+                        float(parts[1].split(":")[1])
+                    )
+                    frag.setdefault(f"{remark}_ratio", []).append(
+                        float(parts[2].split(":")[1].strip().rstrip("%"))
+                    )
+            elif line.startswith("[Alloc]"):
+                parts = line.split(";")
+                keys = [
+                    "used_nodes",
+                    "used_gpus",
+                    "used_gpu_milli",
+                    "total_gpus",
+                    "arrived_gpu_milli",
+                ]
+                for key, part in zip(keys, parts[1:]):
+                    allo.setdefault(key, []).append(int(part.split(":")[1].strip()))
+            elif line.startswith("[AllocCPU]"):
+                parts = line.split(";")
+                for key, part in zip(
+                    ["used_cpu_milli", "arrived_cpu_milli"], parts[1:]
+                ):
+                    allo.setdefault(key, []).append(int(part.split(":")[1].strip()))
+            elif line.startswith("[Power]"):
+                parts = line.split(";")
+                for key, part in zip(
+                    ["power_cluster", "power_cluster_CPU", "power_cluster_GPU"],
+                    parts[1:],
+                ):
+                    pwr.setdefault(key, []).append(float(part.split(":")[1].strip()))
+            elif line.startswith("[deletePod]") and "non-scheduled" in line:
+                if cdol["event"]:  # the preceding create failed — roll back
+                    cdol["event"][-1] = "failed"
+                    cdol["cum_pod"][-1] = cum = cum - 1
+            elif "attempt to" in line and " pod(" in line and line.startswith("["):
+                event_id = int(line.split("]")[0][1:])
+                verb = line.split("attempt to ")[1].split()[0]
+                pod_name = line.split("pod(")[1].split(")")[0]
+                cum += 1 if verb == "create" else -1
+                cdol["id"].append(event_id)
+                cdol["event"].append(verb)
+                cdol["pod_name"].append(pod_name)
+                cdol["cum_pod"].append(cum)
+
+    return {"summary": summary, "frag": frag, "allo": allo, "cdol": cdol, "pwr": pwr}
+
+
+def _write_series_csv(path: Path, series: Dict[str, list]):
+    if not series:
+        return
+    n = max(len(v) for v in series.values())
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(series.keys())
+        for i in range(n):
+            w.writerow([v[i] if i < len(v) else "" for v in series.values()])
+
+
+def analyze_dir(exp_dir: str, meta: Dict[str, str] = None) -> dict:
+    """Parse every *.log under exp_dir, write analysis{,_frag,_allo,_cdol,
+    _pwr}.csv beside them (one experiment per directory in this harness)."""
+    exp = Path(exp_dir)
+    logs = sorted(exp.glob("*.log"))
+    if not logs:
+        raise FileNotFoundError(f"no *.log under {exp_dir}")
+    rows = []
+    result = None
+    for log in logs:
+        result = parse_log(str(log), meta)
+        rows.append(result["summary"])
+    cols: List[str] = []
+    for r in rows:
+        cols.extend(k for k in r if k not in cols)
+    with open(exp / "analysis.csv", "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=cols)
+        w.writeheader()
+        w.writerows(rows)
+    # series CSVs reflect the last log (harness runs one log per dir)
+    _write_series_csv(exp / "analysis_frag.csv", result["frag"])
+    _write_series_csv(exp / "analysis_allo.csv", result["allo"])
+    _write_series_csv(exp / "analysis_cdol.csv", result["cdol"])
+    _write_series_csv(exp / "analysis_pwr.csv", result["pwr"])
+    return result
+
+
+def main():
+    ap = argparse.ArgumentParser(description="simulator log → analysis CSVs")
+    ap.add_argument("-g", "--log-dir", required=True, help="experiment directory")
+    ap.add_argument(
+        "-f",
+        "--failed-pods",
+        action="store_true",
+        help="also list failed pods (ref: failed_pods_in_detail)",
+    )
+    args = ap.parse_args()
+    result = analyze_dir(args.log_dir)
+    s = result["summary"]
+    print(
+        f"[analysis] {args.log_dir}: unscheduled={s.get('unscheduled')}"
+        f" milli_gpu_init={s.get('milli_gpu_init_schedule')}"
+    )
+    if args.failed_pods:
+        fails = [
+            e
+            for e, name in zip(result["cdol"]["event"], result["cdol"]["pod_name"])
+            if e == "failed"
+        ]
+        print(f"[analysis] failed pods: {len(fails)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

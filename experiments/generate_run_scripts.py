@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Emit one `experiments/run.py` bash line per (trace × policy × seed)
+(ref: experiments/run_scripts/generate_run_scripts.py).
+
+Usage: python experiments/generate_run_scripts.py > run_scripts.sh
+       bash run_scripts.sh                      # or: xargs -P for parallel
+
+The default sweep mirrors the reference's 1020-experiment artifact matrix:
+6 headline policies × 17 openb trace variants × 10 seeds at tuning ratio
+1.3 (experiments/README.md "Structure of the 1020 Experiments").
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+TRACES = [
+    "openb_pod_list_default",
+    "openb_pod_list_cpu037",
+    "openb_pod_list_cpu050",
+    "openb_pod_list_cpu072",
+    "openb_pod_list_cpu100",
+    "openb_pod_list_cpu200",
+    "openb_pod_list_cpu250",
+    "openb_pod_list_cpu300",
+    "openb_pod_list_gpushare20",
+    "openb_pod_list_gpushare40",
+    "openb_pod_list_gpushare60",
+    "openb_pod_list_gpushare80",
+    "openb_pod_list_gpushare100",
+    "openb_pod_list_gpuspec10",
+    "openb_pod_list_gpuspec20",
+    "openb_pod_list_gpuspec25",
+    "openb_pod_list_gpuspec33",
+    "openb_pod_list_multigpu20",
+    "openb_pod_list_multigpu30",
+    "openb_pod_list_multigpu40",
+    "openb_pod_list_multigpu50",
+]
+
+# (id, policy flags, gpusel, dimext, norm) — ref AllMethodList
+METHODS = [
+    ("01-Random", "-Random 1000", "random", "merge", "max"),
+    ("02-DotProd", "-DotProd 1000", "best", "merge", "max"),
+    ("03-GpuClustering", "-GpuClustering 1000", "best", "share", "max"),
+    ("04-GpuPacking", "-GpuPacking 1000", "best", "share", "max"),
+    ("05-BestFit", "-BestFit 1000", "best", "share", "max"),
+    ("06-FGD", "-FGD 1000", "FGDScore", "share", "max"),
+    ("07-PWR", "-PWR 1000", "PWRScore", "share", "max"),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-root", default="experiments/data")
+    ap.add_argument("--tune", type=float, default=1.3)
+    ap.add_argument("--seeds", type=int, default=10, help="seeds 42..42+n-1")
+    ap.add_argument("--traces", nargs="*", default=None)
+    ap.add_argument("--methods", nargs="*", default=None, help="method ids")
+    ap.add_argument(
+        "--fast", action="store_true", help="skip per-event reporting"
+    )
+    args = ap.parse_args()
+
+    traces = args.traces or TRACES
+    methods = [
+        m for m in METHODS if args.methods is None or m[0] in args.methods
+    ]
+    fast = " --no-per-event-report" if args.fast else ""
+    for trace in traces:
+        for mid, flags, gpusel, dimext, norm in methods:
+            for seed in range(42, 42 + args.seeds):
+                outdir = f"{args.out_root}/{trace}/{mid}/{args.tune}/{seed}"
+                print(
+                    f"mkdir -p {outdir} && "
+                    f"python experiments/run.py -d {outdir} -f {trace} "
+                    f"{flags} -gpusel {gpusel} -dimext {dimext} -norm {norm} "
+                    f"-tune {args.tune} -tuneseed {seed}{fast} "
+                    f"> {outdir}/terminal.out 2>&1"
+                )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Merge per-experiment analysis CSVs into the discrete cross-experiment
+tables (ref: experiments/analysis/merge_{alloc,frag,frag_ratio}_discrete.py
++ merge_fail_pods.py + analysis_merge.sh, all in one tool).
+
+Walks <data-root>/<workload>/<policy>/<tune>/<seed>/analysis_allo.csv (the
+layout experiments/run.py + generate_run_scripts.py produce) and emits:
+
+  analysis_allo_discrete.csv        GPU allocation ratio (%) sampled at each
+                                    integer arrived-load percent 0..130
+  analysis_frag_discrete.csv        frag amount (milli-GPU) at same samples
+  analysis_frag_ratio_discrete.csv  frag ratio (%) at same samples
+  analysis_fail_pods.csv            unscheduled-pod count per experiment
+
+Row key: (workload, sc_policy, tune, seed) — the schema of
+experiments/analysis/expected_results/*.csv in the reference, so its
+plotting notebooks work on these files unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+from pathlib import Path
+
+
+def read_csv_dict(path: Path):
+    with open(path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    return rows
+
+
+def discretize(series_x, series_y, lo=0, hi=130):
+    """Sample y at each integer percent of x (ref merge_alloc_discrete.py:
+    exact-match bucket, else mean of x within ±1)."""
+    out = {}
+    for target in range(lo, hi + 1):
+        exact = [y for x, y in zip(series_x, series_y) if round(x) == target]
+        if not exact:
+            exact = [
+                y
+                for x, y in zip(series_x, series_y)
+                if target - 1 <= x <= target + 1
+            ]
+        if exact:
+            out[target] = round(sum(exact) / len(exact), 2)
+    return out
+
+
+def merge(data_root: Path, out_dir: Path):
+    allo_rows, frag_rows, fratio_rows, fail_rows = [], [], [], []
+    for allo_file in sorted(data_root.glob("*/*/*/*/analysis_allo.csv")):
+        exp_dir = allo_file.parent
+        seed = exp_dir.name
+        tune = exp_dir.parent.name
+        policy = exp_dir.parent.parent.name
+        workload = exp_dir.parent.parent.parent.name
+        key = {
+            "workload": workload,
+            "sc_policy": policy,
+            "tune": tune,
+            "seed": seed,
+        }
+
+        allo = read_csv_dict(allo_file)
+        if not allo:
+            continue
+        total_gpus = int(float(allo[0]["total_gpus"]))
+        # percent of cluster GPU capacity: milli / total_gpus / 10
+        arrive = [float(r["arrived_gpu_milli"]) / total_gpus / 10 for r in allo]
+        alloc = [float(r["used_gpu_milli"]) / total_gpus / 10 for r in allo]
+        row = dict(key, total_gpus=total_gpus)
+        row.update(discretize(arrive, alloc))
+        allo_rows.append(row)
+
+        frag_file = exp_dir / "analysis_frag.csv"
+        if frag_file.is_file():
+            frag = read_csv_dict(frag_file)
+            n = min(len(frag), len(arrive))
+            fmilli = [float(r["origin_milli"]) / 1000 for r in frag[:n]]
+            fratio = [float(r["origin_ratio"]) for r in frag[:n]]
+            row = dict(key, total_gpus=total_gpus)
+            row.update(discretize(arrive[:n], fmilli))
+            frag_rows.append(row)
+            row = dict(key, total_gpus=total_gpus)
+            row.update(discretize(arrive[:n], fratio))
+            fratio_rows.append(row)
+
+        summary_file = exp_dir / "analysis.csv"
+        if summary_file.is_file():
+            summary = read_csv_dict(summary_file)
+            if summary:
+                fail_rows.append(
+                    dict(key, unscheduled=summary[0].get("unscheduled", ""))
+                )
+
+    out_dir.mkdir(parents=True, exist_ok=True)
+    for name, rows in (
+        ("analysis_allo_discrete.csv", allo_rows),
+        ("analysis_frag_discrete.csv", frag_rows),
+        ("analysis_frag_ratio_discrete.csv", fratio_rows),
+        ("analysis_fail_pods.csv", fail_rows),
+    ):
+        if not rows:
+            continue
+        cols = ["workload", "sc_policy", "tune", "seed", "total_gpus"]
+        extra = sorted(
+            {k for r in rows for k in r if k not in cols},
+            key=lambda k: (isinstance(k, str), k),
+        )
+        cols = [c for c in cols if any(c in r for r in rows)] + extra
+        with open(out_dir / name, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=cols)
+            w.writeheader()
+            w.writerows(rows)
+        print(f"[merge] {len(rows)} rows → {out_dir / name}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-root", default="experiments/data")
+    ap.add_argument("--out-dir", default="experiments/analysis_results")
+    args = ap.parse_args()
+    merge(Path(args.data_root), Path(args.out_dir))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Paper-figure plots from the merged discrete CSVs
+(ref: experiments/plot/plot_openb_{alloc,frag_amount,frag_ratio}.py and the
+*_alloc_bar.py family → Fig 7, 9, 11-14 of the FGD paper).
+
+Input: experiments/analysis_results/analysis_{allo,frag,frag_ratio}_discrete.csv
+(from experiments/merge.py). Output: PNGs under --out-dir.
+
+Design notes (dataviz method): line charts for the load-sweep curves
+(change-over-time job), grouped bars for per-variant allocation (magnitude
+across categories). Policies take a fixed categorical palette slot —
+validated 8-hue set, assigned by policy id order, never cycled — with a
+legend always present and direct terminal labels on ≤4-series figures.
+Static matplotlib renders: the hover layer is N/A.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+from collections import defaultdict
+from pathlib import Path
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+# validated categorical palette (dataviz reference instance, light mode),
+# fixed slot per policy id — identity follows the policy, never its rank
+PALETTE = {
+    "01-Random": "#2a78d6",
+    "02-DotProd": "#eb6834",
+    "03-GpuClustering": "#1baf7a",
+    "04-GpuPacking": "#eda100",
+    "05-BestFit": "#e87ba4",
+    "06-FGD": "#008300",
+    "07-PWR": "#4a3aa7",
+    "08-Custom": "#e34948",
+}
+TEXT_PRIMARY = "#0b0b0b"
+TEXT_SECONDARY = "#52514e"
+SURFACE = "#fcfcfb"
+GRID = "#e4e3df"
+
+LOAD_COLS = [str(x) for x in range(0, 131)]
+
+
+def _style(ax, xlabel, ylabel, title):
+    ax.set_facecolor(SURFACE)
+    ax.grid(True, color=GRID, linewidth=0.8, zorder=0)
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    for side in ("left", "bottom"):
+        ax.spines[side].set_color(GRID)
+    ax.tick_params(colors=TEXT_SECONDARY, labelsize=9)
+    ax.set_xlabel(xlabel, color=TEXT_SECONDARY, fontsize=10)
+    ax.set_ylabel(ylabel, color=TEXT_SECONDARY, fontsize=10)
+    ax.set_title(title, color=TEXT_PRIMARY, fontsize=11, loc="left")
+
+
+def load_discrete(path: Path):
+    """→ {(workload, policy): [(load%, mean value over seeds)]}"""
+    acc = defaultdict(lambda: defaultdict(list))
+    with open(path, newline="") as f:
+        for r in csv.DictReader(f):
+            key = (r["workload"], r["sc_policy"])
+            for col in LOAD_COLS:
+                v = r.get(col)
+                if v not in (None, ""):
+                    acc[key][int(col)].append(float(v))
+    return {
+        key: sorted((x, sum(vs) / len(vs)) for x, vs in series.items())
+        for key, series in acc.items()
+    }
+
+
+def plot_curves(data, workload, ylabel, title, out_png):
+    fig, ax = plt.subplots(figsize=(6.4, 4.2), dpi=150)
+    fig.patch.set_facecolor(SURFACE)
+    policies = sorted({p for w, p in data if w == workload})
+    for policy in policies:
+        series = data[(workload, policy)]
+        xs = [x for x, _ in series]
+        ys = [y for _, y in series]
+        ax.plot(
+            xs,
+            ys,
+            color=PALETTE.get(policy, TEXT_SECONDARY),
+            linewidth=2,
+            label=policy,
+            zorder=3,
+        )
+    _style(ax, "Arrived workload (% of cluster GPU capacity)", ylabel, title)
+    ax.legend(
+        frameon=False, fontsize=8, labelcolor=TEXT_PRIMARY, loc="upper left"
+    )
+    fig.tight_layout()
+    fig.savefig(out_png, facecolor=SURFACE)
+    plt.close(fig)
+    print(f"[plot] {out_png}")
+
+
+def plot_variant_bars(data, variant_prefix, at_load, ylabel, title, out_png):
+    """Grouped bars: x = trace variants of one family, group = policy
+    (ref: plot_openb_{gpushare,gpuspec,multigpu,nongpu}_alloc_bar.py)."""
+    workloads = sorted({w for w, _ in data if variant_prefix in w})
+    policies = sorted({p for _, p in data})
+    if not workloads:
+        print(f"[plot] no workloads matching {variant_prefix}, skipping")
+        return
+    fig, ax = plt.subplots(figsize=(7.2, 4.2), dpi=150)
+    fig.patch.set_facecolor(SURFACE)
+    n = len(policies)
+    width = 0.8 / n
+    for j, policy in enumerate(policies):
+        xs, ys = [], []
+        for i, w in enumerate(workloads):
+            series = dict(data.get((w, policy), []))
+            if at_load in series:
+                xs.append(i + (j - n / 2 + 0.5) * width)
+                ys.append(series[at_load])
+        ax.bar(
+            xs,
+            ys,
+            width=width * 0.92,  # 2px-equivalent gap between adjacent bars
+            color=PALETTE.get(policy, TEXT_SECONDARY),
+            label=policy,
+            zorder=3,
+        )
+    ax.set_xticks(range(len(workloads)))
+    ax.set_xticklabels(
+        [w.replace("openb_pod_list_", "") for w in workloads],
+        rotation=20,
+        ha="right",
+    )
+    _style(ax, "Trace variant", ylabel, title)
+    ax.legend(frameon=False, fontsize=8, labelcolor=TEXT_PRIMARY, ncol=2)
+    fig.tight_layout()
+    fig.savefig(out_png, facecolor=SURFACE)
+    plt.close(fig)
+    print(f"[plot] {out_png}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--results", default="experiments/analysis_results")
+    ap.add_argument("--out-dir", default="experiments/plot/figures")
+    ap.add_argument("--workload", default="openb_pod_list_default")
+    ap.add_argument("--at-load", type=int, default=130)
+    args = ap.parse_args()
+    results = Path(args.results)
+    out = Path(args.out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    allo = results / "analysis_allo_discrete.csv"
+    if allo.is_file():
+        data = load_discrete(allo)
+        plot_curves(
+            data,
+            args.workload,
+            "GPU allocation ratio (%)",
+            f"GPU allocation vs arrived load — {args.workload}",
+            out / "openb_alloc.png",
+        )
+        for fam, label in (
+            ("gpushare", "GPU-sharing"),
+            ("gpuspec", "GPU-type-constrained"),
+            ("multigpu", "multi-GPU"),
+            ("cpu", "non-GPU"),
+        ):
+            plot_variant_bars(
+                data,
+                fam,
+                args.at_load,
+                f"GPU allocation ratio @ {args.at_load}% (%)",
+                f"Allocation across {label} trace variants",
+                out / f"openb_{fam}_alloc_bar.png",
+            )
+    frag = results / "analysis_frag_discrete.csv"
+    if frag.is_file():
+        plot_curves(
+            load_discrete(frag),
+            args.workload,
+            "Fragmented GPU milli (×10³)",
+            f"Fragmentation amount vs arrived load — {args.workload}",
+            out / "openb_frag_amount.png",
+        )
+    fratio = results / "analysis_frag_ratio_discrete.csv"
+    if fratio.is_file():
+        plot_curves(
+            load_discrete(fratio),
+            args.workload,
+            "Fragmentation ratio (%)",
+            f"Fragmentation ratio vs arrived load — {args.workload}",
+            out / "openb_frag_ratio.png",
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -175,7 +175,10 @@ class TestDriverEndToEnd:
         )
         assert state_used == total_gpu_used
         # log contract: per-event lines + 16-line analysis block present
+        sim.finish()
         text = sim.log.dump()
+        # one [Report] block per create/delete event (skip events emit none,
+        # simulator.go:391-399; this workload has no skips)
         assert text.count("[Report]") == res.events
         assert "Cluster Analysis Results (InitSchedule)" in text
         assert "there are 0 unscheduled pods" in text

@@ -1,0 +1,144 @@
+"""Experiment-harness tests: run.py → log → analysis CSVs → merge → plots
+(ref: scripts/generate_config_and_run.py + scripts/analysis.py +
+experiments/analysis/merge_*.py, exercised on a tiny synthetic trace)."""
+
+import csv
+import importlib.util
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+EXP = REPO / "experiments"
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_tiny_trace(dirpath: Path):
+    node_csv = dirpath / "nodes.csv"
+    pod_csv = dirpath / "tiny_trace.csv"
+    with open(node_csv, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["sn", "cpu_milli", "memory_mib", "gpu", "model"])
+        w.writerow(["n-0", 32000, 65536, 2, "V100M16"])
+        w.writerow(["n-1", 64000, 131072, 4, "A100"])
+    with open(pod_csv, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(
+            [
+                "name",
+                "cpu_milli",
+                "memory_mib",
+                "num_gpu",
+                "gpu_milli",
+                "gpu_spec",
+                "qos",
+                "pod_phase",
+                "creation_time",
+                "deletion_time",
+                "scheduled_time",
+            ]
+        )
+        for i in range(8):
+            w.writerow(
+                [f"pod-{i}", 2000, 4096, 1, 500 if i % 2 else 1000, "", "LS", "Running", 0, 0, 0]
+            )
+    return node_csv, pod_csv
+
+
+def test_run_analysis_merge_plot(tmp_path):
+    run = _load("exp_run", EXP / "run.py")
+    node_csv, pod_csv = _write_tiny_trace(tmp_path)
+    outdir = tmp_path / "data" / "tiny_trace" / "06-FGD" / "1.0" / "42"
+    args = run.get_args(
+        [
+            "-d",
+            str(outdir),
+            "-f",
+            str(pod_csv),
+            "--node-trace",
+            str(node_csv),
+            "-FGD",
+            "1000",
+            "-gpusel",
+            "FGDScore",
+            "--emit-configs",
+        ]
+    )
+    result = run.run_experiment(args)
+    assert result["summary"]["unscheduled"] == 0
+    assert (outdir / "simon.log").is_file()
+    # per-event series parsed back out of the log
+    assert len(result["allo"]["used_gpu_milli"]) == 8
+    assert result["allo"]["used_gpu_milli"][-1] == 6000  # 4×1000 + 4×500
+    assert result["cdol"]["event"] == ["create"] * 8
+    assert result["cdol"]["cum_pod"][-1] == 8
+    # the cluster-analysis block made it into the summary row
+    assert result["summary"]["milli_gpu_init_schedule"] == 100.0
+    # emit-configs wrote the reproducible YAML pair
+    assert list(outdir.glob("cc_md*.yaml")) and list(outdir.glob("sc_md*.yaml"))
+
+    # merge into discrete tables
+    merge = _load("exp_merge", EXP / "merge.py")
+    results_dir = tmp_path / "results"
+    merge.merge(tmp_path / "data", results_dir)
+    with open(results_dir / "analysis_allo_discrete.csv", newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert rows[0]["workload"] == "tiny_trace"
+    assert rows[0]["sc_policy"] == "06-FGD"
+    assert float(rows[0]["100"]) == 100.0  # fully allocated at 100% load
+
+    # plots render from the merged tables
+    plot = _load("exp_plot", EXP / "plot" / "plot_openb.py")
+    figdir = tmp_path / "figures"
+    sys.argv = [
+        "plot_openb.py",
+        "--results",
+        str(results_dir),
+        "--out-dir",
+        str(figdir),
+        "--workload",
+        "tiny_trace",
+    ]
+    plot.main()
+    assert (figdir / "openb_alloc.png").is_file()
+
+
+def test_generate_run_scripts(capsys):
+    gen = _load("exp_gen", EXP / "generate_run_scripts.py")
+    sys.argv = [
+        "generate_run_scripts.py",
+        "--seeds",
+        "2",
+        "--traces",
+        "openb_pod_list_default",
+        "--methods",
+        "06-FGD",
+        "01-Random",
+    ]
+    gen.main()
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 4  # 1 trace × 2 methods × 2 seeds
+    assert all("experiments/run.py" in l for l in lines)
+    assert any("-FGD 1000" in l and "-tuneseed 43" in l for l in lines)
+
+
+def test_analysis_stop_marker(tmp_path):
+    """Lines after `there are N unscheduled pods` are ignored, matching the
+    reference parser's break (scripts/analysis.py log_to_csv)."""
+    ana = _load("exp_ana", EXP / "analysis.py")
+    log = tmp_path / "x.log"
+    log.write_text(
+        'time="t" level=info msg="[Report]; Frag amount: 10.00; Frag ratio: 5.00%; Q124 ratio: 1.00%; (origin)\\n"\n'
+        'time="t" level=info msg="there are 3 unscheduled pods\\n"\n'
+        'time="t" level=info msg="[Report]; Frag amount: 99.00; Frag ratio: 9.00%; Q124 ratio: 9.00%; (origin)\\n"\n'
+    )
+    out = ana.parse_log(str(log))
+    assert out["summary"]["unscheduled"] == 3
+    assert out["frag"]["origin_milli"] == [10.0]

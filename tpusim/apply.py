@@ -120,7 +120,7 @@ class Applier:
         workload = cluster.workload_pods()
         ds_pods = cluster.daemonset_pods()
         sim.set_workload_pods(workload + ds_pods)
-        result = sim.run()
+        sim.run()
 
         # snapshot export at InitSchedule (core.go:160-185)
         self._export_snapshots(sim, "init_schedule")
@@ -155,6 +155,7 @@ class Applier:
             sim.schedule_app(name, pods, self.options.use_greed)
 
         result = sim.last_result
+        sim.finish()
         self._verdict(result, out)
         if self.options.report_tables:
             from tpusim.sim.report_tables import full_report

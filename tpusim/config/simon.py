@@ -146,16 +146,20 @@ def parse_simon_cr(doc: dict, base_dir: str = ".") -> SimonCR:
         typical_pods=_typical(cc_raw.get("typicalPodsConfig") or {}),
     )
 
-    apps = [
-        AppInfo(
-            name=a.get("name", ""),
-            path=os.path.join(base_dir, a["path"])
-            if not os.path.isabs(a.get("path", ""))
-            else a["path"],
-            chart=bool(a.get("chart", False)),
+    apps = []
+    for a in spec.get("appList") or []:
+        path = a.get("path", "")
+        if not path:
+            raise ConfigError(f"appList entry {a.get('name')!r} has no path")
+        if not os.path.isabs(path):
+            path = os.path.join(base_dir, path)
+        apps.append(
+            AppInfo(
+                name=a.get("name", ""),
+                path=path,
+                chart=bool(a.get("chart", False)),
+            )
         )
-        for a in (spec.get("appList") or [])
-    ]
     if custom_cluster and not os.path.isabs(custom_cluster):
         custom_cluster = os.path.join(base_dir, custom_cluster)
     return SimonCR(

@@ -102,14 +102,16 @@ def node_from_k8s(obj: dict) -> NodeRow:
 
 
 def _container_requests(spec: dict) -> Tuple[int, int]:
-    """Sum of non-zero container requests (falling back to limits), matching
-    resourcehelper.PodRequestsAndLimits semantics for cpu/memory."""
+    """Sum of container requests, falling back to the limit PER RESOURCE
+    when a request is unset (k8s defaulting semantics, as in
+    resourcehelper.PodRequestsAndLimits)."""
     cpu = mem = 0
     for c in spec.get("containers") or []:
         res = c.get("resources") or {}
-        req = res.get("requests") or res.get("limits") or {}
-        cpu += parse_cpu_milli(req.get("cpu"))
-        mem += parse_mem_mib(req.get("memory"))
+        req = res.get("requests") or {}
+        lim = res.get("limits") or {}
+        cpu += parse_cpu_milli(req.get("cpu", lim.get("cpu")))
+        mem += parse_mem_mib(req.get("memory", lim.get("memory")))
     return cpu, mem
 
 

@@ -171,7 +171,9 @@ class Simulator:
             self.rank,
         )
         if self.cfg.report_per_event and out.metrics is not None:
-            self._emit_event_reports(out.metrics)
+            self._emit_event_reports(
+                out.metrics, pods, ev_kind, ev_pod, np.asarray(out.ever_failed)
+            )
         skipped = np.array([p.unscheduled for p in pods], bool)
         failed_mask = np.asarray(out.ever_failed) | skipped
         unscheduled = [
@@ -205,7 +207,6 @@ class Simulator:
             wall_seconds=wall,
             events=events,
         )
-        self.log.info(f"there are {len(unscheduled)} unscheduled pods")
         return self.last_result
 
     def schedule_additional(self, pods: Sequence[PodRow]) -> List[UnscheduledPod]:
@@ -254,6 +255,14 @@ class Simulator:
         res = self.schedule_pods(pods)
         self.cluster_analysis("InitSchedule")
         return res
+
+    def finish(self):
+        """Emit the unscheduled-count line (apply.go:228). It is the
+        analysis parser's stop marker, so it must come after the LAST
+        Cluster Analysis block of the experiment — call once, at the end."""
+        self.log.info(
+            f"there are {len(self.last_result.unscheduled_pods)} unscheduled pods"
+        )
 
     # ---- snapshot export (export.go) ----
 
@@ -384,7 +393,13 @@ class Simulator:
 
     # ---- reporting (analysis.go) ----
 
-    def _emit_event_reports(self, m):
+    def _emit_event_reports(self, m, pods=None, ev_kind=None, ev_pod=None, failed=None):
+        """Per-event log block: `[i] attempt to ...` line (simulator.go:410,
+        420; failures echo the deletePod rollback line :354), then the
+        frag/alloc/power report lines (simulator.go:426-427). Skip events
+        (pod-unscheduled annotation) emit nothing (simulator.go:391-399)."""
+        from tpusim.sim.engine import EV_CREATE, EV_DELETE
+
         amounts = np.asarray(m.frag_amounts)
         un = np.asarray(m.used_nodes)
         ug = np.asarray(m.used_gpus)
@@ -395,7 +410,21 @@ class Simulator:
         pc = np.asarray(m.power_cpu)
         pg = np.asarray(m.power_gpu)
         total_gpus = int(np.asarray(self.init_state.gpu_cnt).sum())
+        kinds = None if ev_kind is None else np.asarray(ev_kind)
+        ev_pods = None if ev_pod is None else np.asarray(ev_pod)
         for e in range(amounts.shape[0]):
+            if kinds is not None:
+                kind = int(kinds[e])
+                if kind not in (EV_CREATE, EV_DELETE):
+                    continue
+                pi = int(ev_pods[e])
+                p = pods[pi]
+                verb = "create" if kind == EV_CREATE else "delete"
+                self.log.info(f"[{e}] attempt to {verb} pod({p.name})")
+                if kind == EV_CREATE and failed is not None and failed[pi]:
+                    self.log.info(
+                        f"[deletePod] attempt to delete a non-scheduled pod({p.name})"
+                    )
             report_frag_line(self.log, amounts[e])
             report_alloc_lines(
                 self.log, int(un[e]), int(ug[e]), int(um[e]), total_gpus,
