@@ -44,12 +44,16 @@ def main():
     from tpusim.sim.typical import TypicalPodsConfig
 
     nodes, pods = load_trace()
+    # exact flags of the reference's 1020-experiment protocol (FGD row):
+    # -FGD 1000 -gpusel FGD -dimext share -norm max -tune 1.3 -tuneseed 42
+    # --shuffle-pod=true (experiments/run_scripts/generate_run_scripts.py)
     cfg = SimulatorConfig(
         policies=(("FGDScore", 1000),),
         gpu_sel_method="FGDScore",
         tuning_ratio=1.3,
         tuning_seed=42,
         seed=42,
+        shuffle_pod=True,
         report_per_event=False,
         typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
     )

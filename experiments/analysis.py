@@ -59,6 +59,7 @@ def parse_log(path: str, meta: Dict[str, str] = None) -> Dict[str, dict]:
     pwr: Dict[str, List[float]] = {}
     cdol = {"id": [], "event": [], "pod_name": [], "cum_pod": []}
     cum = 0
+    live = set()  # pods currently created (ref: analysis.py cdol_pod_dict)
     tag = ""
     analysis_countdown = 0
 
@@ -155,11 +156,22 @@ def parse_log(path: str, meta: Dict[str, str] = None) -> Dict[str, dict]:
                 if cdol["event"]:  # the preceding create failed — roll back
                     cdol["event"][-1] = "failed"
                     cdol["cum_pod"][-1] = cum = cum - 1
+                    live.discard(cdol["pod_name"][-1])
             elif "attempt to" in line and " pod(" in line and line.startswith("["):
                 event_id = int(line.split("]")[0][1:])
                 verb = line.split("attempt to ")[1].split()[0]
                 pod_name = line.split("pod(")[1].split(")")[0]
-                cum += 1 if verb == "create" else -1
+                if verb == "create":
+                    cum += 1
+                    live.add(pod_name)
+                elif pod_name in live:
+                    cum -= 1
+                    live.discard(pod_name)
+                else:
+                    # delete of a pod whose creation failed: no cumsum change,
+                    # renamed to keep event counts aligned (ref: analysis.py
+                    # "skipped" branch)
+                    verb = "skipped"
                 cdol["id"].append(event_id)
                 cdol["event"].append(verb)
                 cdol["pod_name"].append(pod_name)

@@ -5,9 +5,12 @@
 Usage: python experiments/generate_run_scripts.py > run_scripts.sh
        bash run_scripts.sh                      # or: xargs -P for parallel
 
-The default sweep mirrors the reference's 1020-experiment artifact matrix:
-6 headline policies × 17 openb trace variants × 10 seeds at tuning ratio
-1.3 (experiments/README.md "Structure of the 1020 Experiments").
+The default sweep covers the reference's full policy/trace grid: 7 policies
+(the artifact's 6 headline ones + 07-PWR) × 21 openb trace variants × 10
+seeds at tuning ratio 1.3 and shuffled pod order = 1470 commands. The
+reference's cached 1020-experiment matrix is the 6-policy × 17-trace subset
+(experiments/README.md "Structure of the 1020 Experiments"); restrict with
+--methods / --traces to reproduce it exactly.
 """
 
 from __future__ import annotations
@@ -78,7 +81,8 @@ def main():
                     f"mkdir -p {outdir} && "
                     f"python experiments/run.py -d {outdir} -f {trace} "
                     f"{flags} -gpusel {gpusel} -dimext {dimext} -norm {norm} "
-                    f"-tune {args.tune} -tuneseed {seed}{fast} "
+                    f"-tune {args.tune} -tuneseed {seed} --shuffle-pod true"
+                    f"{fast} "
                     f"> {outdir}/terminal.out 2>&1"
                 )
 

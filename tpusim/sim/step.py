@@ -38,7 +38,9 @@ def filter_nodes(state: NodeState, pod: PodSpec) -> jnp.ndarray:
     node, a matching GPU model, and an AllocateGpuId packing
     (gpunodeinfo.go:136-204 — can_allocate reproduces its feasibility).
     """
-    fit = (state.cpu_left >= pod.cpu) & (state.mem_left >= pod.mem)
+    # cpu_cap > 0 excludes node-axis padding rows (parallel.pad_nodes), which
+    # could otherwise win a zero-request pod's tie-break.
+    fit = (state.cpu_cap > 0) & (state.cpu_left >= pod.cpu) & (state.mem_left >= pod.mem)
     # nodeSelector pinning (snapshot re-bind, export.go:44-58): a pinned pod
     # is only feasible on its pinned node; pinned == -1 means unconstrained.
     n = state.num_nodes
@@ -94,16 +96,20 @@ def schedule_one(
 
     policies: [(policy_fn, weight)] — the enabled Score plugins with their
     config weights (policy selection in the reference = one plugin at weight
-    1000, §5.6). tiebreak_rank: i32[N] permutation standing in for the
-    random node-name prefixes + lexicographic selectHost tie-break
-    (simulator.go:584-588, generic_scheduler.go:185-210).
+    1000, §5.6). tiebreak_rank: i32[N] fixed per-run permutation. This models
+    the reference exactly: its vendored selectHost REPLACES upstream k8s's
+    random reservoir sampling with "smallest lexicographic name among ties"
+    (generic_scheduler.go:187-212, the rand.Intn branch is commented out),
+    and node names carry a random 4-digit per-run prefix
+    (simulator.go:584-588) — i.e. a fixed random permutation as tie-break
+    order. A per-pod random draw instead costs ~2pt of FGD allocation ratio
+    (spreads load across tied idle nodes instead of packing).
     """
     n = state.num_nodes
-    if tiebreak_rank is None:
-        tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
-
     feasible = filter_nodes(state, pod)
     k_rand, k_sel = jax.random.split(key)
+    if tiebreak_rank is None:
+        tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
     ctx = ScoreContext(tp=tp, feasible=feasible, rng=k_rand)
 
     total = jnp.zeros(n, jnp.int32)
