@@ -68,9 +68,9 @@ def main():
     key = jax.random.PRNGKey(cfg.seed)
 
     def run():
-        res = sim.replay_fn(
-            sim.init_state, specs, ev_kind, ev_pod, sim.typical, key, sim.rank
-        )
+        # auto-selects the incremental score-table engine (exact-equivalent
+        # to the sequential oracle; tests/test_table_engine.py)
+        res = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key)
         jax.block_until_ready(res.state)
         return res
 

@@ -103,3 +103,52 @@ def typical_pods_with_nongpu():
 def typical_rows_gpu_host():
     """Same distribution as host-side tuples for the Bellman reference."""
     return _rows(_TYPICAL_GPU)
+
+
+def random_cluster(rng, num_nodes=16):
+    """Heterogeneous random cluster + the gpu typical-pod distribution, for
+    engine-equivalence tests."""
+    from tpusim.types import make_node_state
+
+    gpu_cnt = rng.choice([0, 2, 4, 8], num_nodes, p=[0.15, 0.25, 0.35, 0.25])
+    state = make_node_state(
+        cpu_cap=rng.choice([32000, 64000, 96000, 128000], num_nodes),
+        mem_cap=rng.choice([131072, 262144, 393216], num_nodes),
+        gpu_cnt=gpu_cnt,
+        gpu_type=[int(rng.integers(0, 4)) if g else -1 for g in gpu_cnt],
+        cpu_type=rng.integers(0, 3, num_nodes),
+    )
+    return state, typical_pods_gpu()
+
+
+def random_pods(rng, num_pods=40):
+    """Random pod batch spanning cpu-only / share-GPU / multi-GPU kinds."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusim.types import PodSpec
+
+    kind = rng.integers(0, 3, num_pods)  # 0 cpu-only, 1 share, 2 whole
+    cpu = rng.choice([1000, 2000, 4000, 8000, 16000], num_pods).astype(np.int32)
+    mem = rng.choice([1024, 4096, 16384], num_pods).astype(np.int32)
+    gpu_milli = np.where(
+        kind == 1, rng.choice([100, 250, 500, 750], num_pods), 1000
+    ).astype(np.int32)
+    gpu_milli = np.where(kind == 0, 0, gpu_milli)
+    gpu_num = np.where(
+        kind == 2, rng.choice([1, 2, 4], num_pods), np.where(kind == 1, 1, 0)
+    ).astype(np.int32)
+    # ~1/4 of GPU pods carry a model constraint over 2 random models
+    mask = np.where(
+        (kind > 0) & (rng.random(num_pods) < 0.25),
+        (1 << rng.integers(0, 4, num_pods)) | (1 << rng.integers(0, 4, num_pods)),
+        0,
+    ).astype(np.int32)
+    return PodSpec(
+        cpu=jnp.asarray(cpu),
+        mem=jnp.asarray(mem),
+        gpu_milli=jnp.asarray(gpu_milli),
+        gpu_num=jnp.asarray(gpu_num),
+        gpu_mask=jnp.asarray(mask),
+        pinned=jnp.full(num_pods, -1, jnp.int32),
+    )

@@ -1,0 +1,140 @@
+"""Incremental score-table engine (tpusim.sim.table_engine) must be
+bit-identical to the sequential oracle engine (tpusim.sim.engine) — same
+kernels, different evaluation schedule. Randomized create/delete mixes over
+heterogeneous clusters pin the equivalence for every table-izable policy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.fixtures import random_cluster, random_pods
+from tpusim.policies import make_policy
+from tpusim.sim.engine import EV_CREATE, EV_DELETE, make_replay
+from tpusim.sim.table_engine import build_pod_types, make_table_replay
+
+
+def _events_with_deletes(num_pods, rng):
+    """Creation for every pod; ~1/3 get a later deletion (stable order)."""
+    kinds, idxs = [], []
+    for i in range(num_pods):
+        kinds.append(EV_CREATE)
+        idxs.append(i)
+        if rng.random() < 0.34 and i > 0:
+            victim = int(rng.integers(0, i + 1))
+            kinds.append(EV_DELETE)
+            idxs.append(victim)
+    # dedup double-deletes (unschedule of an already-deleted pod is a no-op
+    # in both engines, but keep the trace clean)
+    seen = set()
+    ek, ei = [], []
+    for k, i in zip(kinds, idxs):
+        if k == EV_DELETE:
+            if i in seen:
+                continue
+            seen.add(i)
+        ek.append(k)
+        ei.append(i)
+    return jnp.asarray(ek, jnp.int32), jnp.asarray(ei, jnp.int32)
+
+
+def _assert_equal(r0, r1):
+    assert np.array_equal(np.asarray(r0.placed_node), np.asarray(r1.placed_node))
+    assert np.array_equal(np.asarray(r0.dev_mask), np.asarray(r1.dev_mask))
+    assert np.array_equal(np.asarray(r0.ever_failed), np.asarray(r1.ever_failed))
+    for a, b in zip(jax.tree.leaves(r0.state), jax.tree.leaves(r1.state)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize(
+    "policy,gpu_sel",
+    [
+        ("FGDScore", "FGDScore"),
+        ("BestFitScore", "best"),
+        ("GpuPackingScore", "worst"),
+        ("GpuClusteringScore", "best"),
+        ("DotProductScore", "DotProductScore"),
+        ("PWRScore", "PWRScore"),
+        ("Simon", "best"),
+    ],
+    ids=lambda p: str(p),
+)
+def test_table_engine_matches_sequential(policy, gpu_sel):
+    rng = np.random.default_rng(7)
+    state, tp = random_cluster(rng, num_nodes=24)
+    pods = random_pods(rng, num_pods=60)
+    ev_kind, ev_pod = _events_with_deletes(60, rng)
+    policies = [(make_policy(policy), 1000)]
+    key = jax.random.PRNGKey(3)
+    rank = jnp.asarray(rng.permutation(24).astype(np.int32))
+
+    seq = make_replay(policies, gpu_sel=gpu_sel, report=False)
+    r0 = seq(state, pods, ev_kind, ev_pod, tp, key, rank)
+    tab = make_table_replay(policies, gpu_sel=gpu_sel)
+    r1 = tab(state, pods, build_pod_types(pods), ev_kind, ev_pod, tp, key, rank)
+    _assert_equal(r0, r1)
+
+
+def test_table_engine_weighted_multi_policy():
+    """Two weighted score plugins (the reference's PWR+FGD mixes,
+    generate_run_scripts.py AllMethodList rows 08/11/12)."""
+    rng = np.random.default_rng(11)
+    state, tp = random_cluster(rng, num_nodes=16)
+    pods = random_pods(rng, num_pods=40)
+    ev_kind, ev_pod = _events_with_deletes(40, rng)
+    policies = [(make_policy("PWRScore"), 500), (make_policy("FGDScore"), 500)]
+    key = jax.random.PRNGKey(5)
+    rank = jnp.asarray(rng.permutation(16).astype(np.int32))
+
+    seq = make_replay(policies, gpu_sel="FGDScore", report=False)
+    r0 = seq(state, pods, ev_kind, ev_pod, tp, key, rank)
+    tab = make_table_replay(policies, gpu_sel="FGDScore")
+    r1 = tab(state, pods, build_pod_types(pods), ev_kind, ev_pod, tp, key, rank)
+    _assert_equal(r0, r1)
+
+
+def test_table_engine_pinned_pods():
+    """nodeSelector-pinned pods (snapshot re-bind path) stay a per-event
+    feasibility mask, not part of the type key."""
+    rng = np.random.default_rng(13)
+    state, tp = random_cluster(rng, num_nodes=8)
+    pods = random_pods(rng, num_pods=12)
+    pinned = np.full(12, -1, np.int32)
+    pinned[3] = 5
+    pinned[7] = 2
+    pods = pods._replace(pinned=jnp.asarray(pinned))
+    ev_kind = jnp.zeros(12, jnp.int32)
+    ev_pod = jnp.arange(12, dtype=jnp.int32)
+    policies = [(make_policy("FGDScore"), 1000)]
+    key = jax.random.PRNGKey(1)
+
+    seq = make_replay(policies, gpu_sel="FGDScore", report=False)
+    r0 = seq(state, pods, ev_kind, ev_pod, tp, key)
+    tab = make_table_replay(policies, gpu_sel="FGDScore")
+    r1 = tab(state, pods, build_pod_types(pods), ev_kind, ev_pod, tp, key)
+    _assert_equal(r0, r1)
+    placed = np.asarray(r1.placed_node)
+    assert placed[3] in (5, -1) and placed[7] in (2, -1)
+
+
+def test_random_policy_rejected():
+    with pytest.raises(ValueError):
+        make_table_replay([(make_policy("RandomScore"), 1000)])
+
+
+def test_pod_type_partition():
+    rng = np.random.default_rng(17)
+    pods = random_pods(rng, num_pods=50)
+    t = build_pod_types(pods)
+    ks = int(t.share.cpu.shape[0])
+    kw = int(t.whole.cpu.shape[0])
+    # share group: exactly-one-GPU fractional requests
+    assert bool(
+        ((t.share.gpu_num == 1) & (t.share.gpu_milli > 0) & (t.share.gpu_milli < 1000)).all()
+    )
+    # ids must map each pod onto a type with identical resources
+    tid = np.asarray(t.type_id)
+    assert tid.min() >= 0 and tid.max() < ks + kw
+    cat = lambda f: np.concatenate([np.asarray(getattr(t.share, f)), np.asarray(getattr(t.whole, f))])
+    for f in ("cpu", "mem", "gpu_milli", "gpu_num", "gpu_mask"):
+        assert np.array_equal(cat(f)[tid], np.asarray(getattr(pods, f)))

@@ -117,12 +117,48 @@ class Simulator:
             )
             for name, weight in self.cfg.policies
         ]
-        # public compiled-replay handle (timing-sensitive callers like
-        # bench.py invoke it directly to separate compile from steady state)
+        # the sequential oracle replay; run_events() below picks between it
+        # and the incremental table engine per call
         self.replay_fn = make_replay(
             self._policy_fns,
             gpu_sel=self.cfg.gpu_sel_method,
             report=self.cfg.report_per_event,
+        )
+        # incremental score-table engine (tpusim.sim.table_engine): exact
+        # same results, ~4x faster — usable whenever per-event report rows
+        # aren't needed and nothing in the cycle draws per-event randomness
+        # (neither a RandomScore plugin nor a `random` Reserve gpuSelMethod,
+        # whose PRNG stream would differ between the engines)
+        self._table_ok = (
+            (not self.cfg.report_per_event)
+            and self.cfg.gpu_sel_method != "random"
+            and all(fn.policy_name != "RandomScore" for fn, _ in self._policy_fns)
+        )
+        if self._table_ok:
+            from tpusim.sim.table_engine import make_table_replay
+
+            self._table_fn = make_table_replay(
+                self._policy_fns, gpu_sel=self.cfg.gpu_sel_method
+            )
+
+    def run_events(self, state, specs, ev_kind, ev_pod, key):
+        """Run the compiled replay on prepared arrays, auto-selecting the
+        fastest engine that supports the configuration. Small batches
+        (descheduler victims, inflation clones) stay on the sequential
+        engine: the table init alone costs K full node-sweeps, which only
+        amortizes when there are more events than distinct pod types."""
+        if self._table_ok:
+            from tpusim.sim.table_engine import build_pod_types
+
+            types = build_pod_types(specs)
+            k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+            if ev_kind.shape[0] >= 2 * k:
+                return self._table_fn(
+                    state, specs, types, ev_kind, ev_pod, self.typical, key,
+                    self.rank,
+                )
+        return self.replay_fn(
+            state, specs, ev_kind, ev_pod, self.typical, key, self.rank
         )
 
     # ---- workload prep (core.go:103-142) ----
@@ -161,14 +197,8 @@ class Simulator:
         reported as failed (simulator.go:391-399)."""
         specs = pods_to_specs(pods, self.node_index)
         ev_kind, ev_pod = build_events(pods, use_timestamps)
-        out = self.replay_fn(
-            state,
-            specs,
-            jnp.asarray(ev_kind),
-            jnp.asarray(ev_pod),
-            self.typical,
-            key,
-            self.rank,
+        out = self.run_events(
+            state, specs, jnp.asarray(ev_kind), jnp.asarray(ev_pod), key
         )
         if self.cfg.report_per_event and out.metrics is not None:
             self._emit_event_reports(
@@ -310,14 +340,12 @@ class Simulator:
         self.log.info(f"(Inflation) Num of Total Pods: {len(extra)}")
         state = jax.tree.map(jnp.asarray, self.last_result.state)
         specs = pods_to_specs(extra)
-        out = self.replay_fn(
+        out = self.run_events(
             state,
             specs,
             jnp.zeros(len(extra), jnp.int32),
             jnp.arange(len(extra), dtype=jnp.int32),
-            self.typical,
             jax.random.PRNGKey(self.cfg.inflation_seed),
-            self.rank,
         )
         failed = int(np.asarray(out.placed_node < 0).sum())
         self.log.info(f"[ReportFailedPods] {failed} unscheduled inflation pods")
@@ -370,14 +398,8 @@ class Simulator:
         vspecs = jax.tree.map(lambda a: a[jnp.asarray(v)], specs)
         ev_kind = jnp.zeros(len(victims), jnp.int32)
         ev_pod = jnp.arange(len(victims), dtype=jnp.int32)
-        out = self.replay_fn(
-            state,
-            vspecs,
-            ev_kind,
-            ev_pod,
-            self.typical,
-            jax.random.PRNGKey(self.cfg.seed + 1),
-            self.rank,
+        out = self.run_events(
+            state, vspecs, ev_kind, ev_pod, jax.random.PRNGKey(self.cfg.seed + 1)
         )
         placed_v = np.asarray(out.placed_node)
         mask_v = np.asarray(out.dev_mask)

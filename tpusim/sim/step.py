@@ -38,9 +38,9 @@ def filter_nodes(state: NodeState, pod: PodSpec) -> jnp.ndarray:
     node, a matching GPU model, and an AllocateGpuId packing
     (gpunodeinfo.go:136-204 — can_allocate reproduces its feasibility).
     """
-    # cpu_cap > 0 excludes node-axis padding rows (parallel.pad_nodes), which
-    # could otherwise win a zero-request pod's tie-break.
-    fit = (state.cpu_cap > 0) & (state.cpu_left >= pod.cpu) & (state.mem_left >= pod.mem)
+    # node-axis padding rows (parallel.pad_nodes) need no special casing:
+    # they carry mem_left == -1, failing the mem check for every request
+    fit = (state.cpu_left >= pod.cpu) & (state.mem_left >= pod.mem)
     # nodeSelector pinning (snapshot re-bind, export.go:44-58): a pinned pod
     # is only feasible on its pinned node; pinned == -1 means unconstrained.
     n = state.num_nodes
