@@ -1,0 +1,131 @@
+"""open-local storage extension: parsing, PVC synthesis, VG occupancy caps,
+and the Node Local Storage report table (ref: pkg/utils/utils.go:555-668,
+pkg/apply/apply.go:440-631)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpusim.io.storage import (
+    cluster_vg_totals,
+    match_local_storage_files,
+    parse_node_storage,
+    parse_pod_storage,
+    pod_local_pvcs,
+)
+from tpusim.io.trace import NodeRow
+
+NODE_STORAGE = {
+    "vgs": [{"name": "share", "capacity": 500 * 1024**3, "requested": 100 * 1024**3}],
+    "devices": [
+        {"device": "/dev/vdb", "capacity": 1024**4, "mediaType": "HDD", "isAllocated": True}
+    ],
+}
+
+POD_STORAGE = {
+    "volumes": [
+        {"size": "10737418240", "kind": "LVM", "scName": "open-local-lvm"},
+        {"size": "1099511627776", "kind": "HDD", "scName": "open-local-device-hdd"},
+        {"size": "42", "kind": "NAS", "scName": "whatever"},  # unsupported → skipped
+    ]
+}
+
+
+def test_parse_node_storage():
+    st = parse_node_storage(json.dumps(NODE_STORAGE))
+    assert st.vgs[0].name == "share"
+    assert st.vgs[0].capacity == 500 * 1024**3
+    assert st.vgs[0].requested == 100 * 1024**3
+    assert st.devices[0].media_type == "HDD" and st.devices[0].is_allocated
+    assert parse_node_storage(None) is None
+
+
+def test_parse_pod_storage_and_pvcs():
+    vols = parse_pod_storage(json.dumps(POD_STORAGE))
+    assert len(vols) == 3 and vols[0].size == 10737418240
+    lvm, dev = pod_local_pvcs("p0", "ns", vols)
+    assert [p.name for p in lvm] == ["pvc-p0-0"]
+    assert [p.name for p in dev] == ["pvc-p0-1"]  # NAS volume skipped
+    assert lvm[0].sc_name == "open-local-lvm"
+
+
+def test_match_local_storage_files(tmp_path):
+    (tmp_path / "node-a.json").write_text(json.dumps(NODE_STORAGE))
+    (tmp_path / "other.json").write_text(json.dumps(NODE_STORAGE))
+    (tmp_path / "bad.json").write_text("{nope")
+    found = match_local_storage_files(["node-a", "node-b"], str(tmp_path))
+    assert set(found) == {"node-a"}
+
+
+def test_cluster_vg_totals():
+    st = parse_node_storage(NODE_STORAGE)
+    req, cap = cluster_vg_totals([st, None, st])
+    assert req == 200 * 1024**3 and cap == 1000 * 1024**3
+
+
+def test_node_storage_report_table():
+    from tpusim.sim.report_tables import node_storage_table
+
+    nodes = [
+        NodeRow("n0", 1000, 1024, 0, local_storage=NODE_STORAGE),
+        NodeRow("n1", 1000, 1024, 0),
+    ]
+    out = node_storage_table(nodes)
+    assert "VG" in out and "share" in out and "500Gi" in out and "(20%)" in out
+    assert "Device(HDD)" in out and "used" in out
+    assert "n1" not in out
+
+
+def test_yaml_ingest_storage_annotation(tmp_path):
+    import yaml as pyyaml
+
+    from tpusim.io.k8s_yaml import load_cluster_from_dir
+
+    node = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": "stor-node",
+            "annotations": {"simon/node-local-storage": json.dumps(NODE_STORAGE)},
+        },
+        "status": {"allocatable": {"cpu": "4", "memory": "8Gi"}},
+    }
+    (tmp_path / "node.yaml").write_text(pyyaml.dump(node))
+    # sidecar json for a second node
+    node2 = dict(node, metadata={"name": "stor-node2"})
+    (tmp_path / "node2.yaml").write_text(pyyaml.dump(node2))
+    (tmp_path / "stor-node2.json").write_text(json.dumps(NODE_STORAGE))
+    res = load_cluster_from_dir(str(tmp_path))
+    by_name = {n.name: n for n in res.nodes}
+    assert parse_node_storage(by_name["stor-node"].local_storage).vgs[0].name == "share"
+    assert parse_node_storage(by_name["stor-node2"].local_storage).vgs[0].name == "share"
+
+
+def test_maxvg_verdict(monkeypatch, tmp_path):
+    """MaxVG percent cap fails the run when VG occupancy exceeds it
+    (apply.go:617-623)."""
+    from tpusim.apply import Applier
+
+    class FakeState:
+        cpu_cap = np.array([4000]); cpu_left = np.array([4000])
+        mem_cap = np.array([8192]); mem_left = np.array([8192])
+
+    class FakeResult:
+        state = FakeState(); node_names = ["n0"]
+
+    class FakeSim:
+        nodes = [NodeRow("n0", 4000, 8192, 0, local_storage=NODE_STORAGE)]
+
+    app = Applier.__new__(Applier)
+    app.sim = FakeSim()
+    monkeypatch.setenv("MaxVG", "10")  # VG occupancy is 20%
+    ok, reason = app._satisfy_resource_setting(FakeResult())
+    assert not ok and "vg" in reason
+    monkeypatch.setenv("MaxVG", "50")
+    ok, _ = app._satisfy_resource_setting(FakeResult())
+    assert ok
+    monkeypatch.setenv("MaxVG", "150")  # out of range → clamp to 100 → ok
+    ok, _ = app._satisfy_resource_setting(FakeResult())
+    assert ok

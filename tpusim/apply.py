@@ -204,27 +204,50 @@ class Applier:
             print(f"{COLOR_GREEN}Success!{COLOR_RESET}", file=out)
 
     def _satisfy_resource_setting(self, result: SimulateResult):
-        """Env caps MaxCPU (cores) / MaxMemory (GiB) on per-node *occupied*
-        amounts (apply.go:550-631)."""
-        max_cpu = float(os.environ.get("MaxCPU", 0) or 0)
-        max_mem = float(os.environ.get("MaxMemory", 0) or 0)
-        if not max_cpu and not max_mem:
-            return True, ""
+        """Env caps MaxCPU / MaxMemory / MaxVG as PERCENT occupancy-rate
+        ceilings over cluster totals (ref: satisfyResourceSetting,
+        apply.go:550-631: defaults 100, out-of-range values clamp to 100;
+        VG totals come from the open-local node storage annotations)."""
+
+        def _cap(env: str) -> int:
+            raw = os.environ.get(env, "")
+            if not raw:
+                return 100
+            v = int(raw)  # non-integers are an error in the reference too
+            return 100 if v > 100 or v < 0 else v
+
+        max_cpu, max_mem, max_vg = _cap("MaxCPU"), _cap("MaxMemory"), _cap("MaxVG")
         s = result.state
-        cpu_used = np.asarray(s.cpu_cap) - np.asarray(s.cpu_left)
-        mem_used = np.asarray(s.mem_cap) - np.asarray(s.mem_left)
-        if max_cpu and (cpu_used > max_cpu * 1000).any():
-            i = int(np.argmax(cpu_used))
+        cpu_rate = int(
+            100.0 * (np.asarray(s.cpu_cap) - np.asarray(s.cpu_left)).sum()
+            / max(1, np.asarray(s.cpu_cap, np.int64).sum())
+        )
+        mem_rate = int(
+            100.0 * (np.asarray(s.mem_cap) - np.asarray(s.mem_left)).sum()
+            / max(1, np.asarray(s.mem_cap, np.int64).sum())
+        )
+        if cpu_rate > max_cpu:
             return False, (
-                f"node {result.node_names[i]} cpu used "
-                f"{cpu_used[i] / 1000:.1f} cores exceeds MaxCPU {max_cpu}\n"
+                f"the average occupancy rate({cpu_rate}%) of cpu goes beyond "
+                f"the env setting({max_cpu}%)\n"
             )
-        if max_mem and (mem_used > max_mem * 1024).any():
-            i = int(np.argmax(mem_used))
+        if mem_rate > max_mem:
             return False, (
-                f"node {result.node_names[i]} memory used "
-                f"{mem_used[i] / 1024:.1f}Gi exceeds MaxMemory {max_mem}\n"
+                f"the average occupancy rate({mem_rate}%) of memory goes "
+                f"beyond the env setting({max_mem}%)\n"
             )
+        from tpusim.io.storage import cluster_vg_totals, parse_node_storage
+
+        vg_req, vg_cap = cluster_vg_totals(
+            parse_node_storage(n.local_storage) for n in self.sim.nodes
+        )
+        if vg_cap:
+            vg_rate = int(100.0 * vg_req / vg_cap)
+            if vg_rate > max_vg:
+                return False, (
+                    f"the average occupancy rate({vg_rate}%) of vg goes "
+                    f"beyond the env setting({max_vg}%)\n"
+                )
         return True, ""
 
 

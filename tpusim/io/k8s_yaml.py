@@ -38,6 +38,11 @@ ANNO_CREATION_TIME = "alibabacloud.com/creation-time"
 ANNO_DELETION_TIME = "alibabacloud.com/deletion-time"
 ANNO_UNSCHEDULED = "simon/pod-unscheduled"
 LABEL_HOSTNAME = "kubernetes.io/hostname"
+from tpusim.io.storage import (
+    ANNO_NODE_LOCAL_STORAGE,
+    ANNO_POD_LOCAL_STORAGE,
+    maybe_json,
+)
 
 _BINARY_SUFFIX = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4}
 _DECIMAL_SUFFIX = {"k": 10**3, "K": 10**3, "M": 10**6, "G": 10**9, "T": 10**12}
@@ -91,6 +96,7 @@ def node_from_k8s(obj: dict) -> NodeRow:
     gpu = int(float(alloc.get(ANNO_GPU_COUNT, 0) or 0))
     model = labels.get(ANNO_GPU_MODEL, "") or annotations.get(ANNO_GPU_MODEL, "")
     cpu_model = labels.get(ANNO_CPU_MODEL, "") or annotations.get(ANNO_CPU_MODEL, "")
+    storage = maybe_json(annotations.get(ANNO_NODE_LOCAL_STORAGE))
     return NodeRow(
         name=name,
         cpu_milli=parse_cpu_milli(alloc.get("cpu")),
@@ -98,6 +104,7 @@ def node_from_k8s(obj: dict) -> NodeRow:
         gpu=gpu,
         model=model if gpu > 0 else "",
         cpu_model=cpu_model,
+        local_storage=storage,
     )
 
 
@@ -145,6 +152,7 @@ def pod_from_k8s(obj: dict) -> PodRow:
         unscheduled=str(annotations.get(ANNO_UNSCHEDULED, "")).lower() == "true",
         node_selector=dict(selector) or None,
         tolerations=bool(spec.get("tolerations")),
+        local_storage=maybe_json(annotations.get(ANNO_POD_LOCAL_STORAGE)),
         # DaemonSet-owned raw pods are excluded from the schedulable
         # workload, like GetValidPodExcludeDaemonSet's ownerReference check
         workload_kind=owner_kind,
@@ -279,10 +287,19 @@ class ClusterResource:
 
 def load_cluster_from_dir(path: str) -> ClusterResource:
     """YAML dir → ClusterResource (ref:
-    simulator.CreateClusterResourceFromClusterConfig, simulator.go:880-895)."""
+    simulator.CreateClusterResourceFromClusterConfig, simulator.go:880-895;
+    per-node `<name>.json` storage files attach open-local inventories like
+    MatchAndSetLocalStorageAnnotationOnNode)."""
     if not os.path.isdir(path):
         raise FileNotFoundError(f"cluster config directory not found: {path}")
-    return load_cluster_from_objects(load_objects(yaml_files_in_dir(path)))
+    res = load_cluster_from_objects(load_objects(yaml_files_in_dir(path)))
+    from tpusim.io.storage import match_local_storage_files
+
+    storage = match_local_storage_files(res.node_names, path)
+    for n in res.nodes:
+        if n.name in storage and n.local_storage is None:
+            n.local_storage = storage[n.name]
+    return res
 
 
 def load_cluster_from_objects(objs: Sequence[dict]) -> ClusterResource:

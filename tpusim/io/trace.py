@@ -55,6 +55,9 @@ class PodRow:
     tolerations: bool = False
     workload_kind: str = ""
     workload_name: str = ""
+    # open-local volume request (tpusim.io.storage; ref: the
+    # simon/pod-local-storage annotation, pkg/utils/utils.go:606-618)
+    local_storage: Optional[dict] = None
 
     @property
     def total_gpu_milli(self) -> int:
@@ -74,6 +77,9 @@ class NodeRow:
     gpu: int
     model: str = ""
     cpu_model: str = ""
+    # open-local storage inventory (tpusim.io.storage; ref: the
+    # simon/node-local-storage annotation, pkg/utils/utils.go:572-585)
+    local_storage: Optional[dict] = None
 
 
 def _sanitize_gpu_milli(num_gpu: int, gpu_milli) -> int:

@@ -152,7 +152,7 @@ class Simulator:
 
             types = build_pod_types(specs)
             k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
-            if ev_kind.shape[0] >= 2 * k:
+            if k > 0 and ev_kind.shape[0] >= 2 * k:
                 return self._table_fn(
                     state, specs, types, ev_kind, ev_pod, self.typical, key,
                     self.rank,
@@ -175,6 +175,35 @@ class Simulator:
 
     def set_skyline_pods(self):
         self.skyline = get_skyline_pods(self.workload_pods)
+
+    def get_custom_config(self) -> SimulatorConfig:
+        """ref: GetCustomConfig (core.go:69)."""
+        return self.cfg
+
+    def record_pod_total_resource(self, pods: Sequence[PodRow] = None):
+        """Total workload CPU/GPU milli (ref: RecordPodTotalResource,
+        core.go:132; consumed by tuning/inflation ratios)."""
+        from tpusim.sim.workload import total_pod_cpu_milli, total_pod_gpu_milli
+
+        pods = self.workload_pods if pods is None else pods
+        self.pod_total_milli_cpu = total_pod_cpu_milli(pods)
+        self.pod_total_milli_gpu = total_pod_gpu_milli(pods)
+        return self.pod_total_milli_cpu, self.pod_total_milli_gpu
+
+    def record_node_total_resource(self):
+        """Total cluster CPU/GPU milli (ref: RecordNodeTotalResource,
+        core.go:133). Computed at construction; exposed for parity."""
+        return self.node_total_milli_cpu, self.node_total_milli_gpu
+
+    def get_cluster_node_status(self):
+        """[(NodeRow, [PodRow placed on it])] (ref: GetClusterNodeStatus,
+        core.go:56 → simontype.NodeStatus)."""
+        res = self.last_result
+        by_node = [[] for _ in self.nodes]
+        for i, n in enumerate(res.placed_node):
+            if n >= 0:
+                by_node[int(n)].append(res.pods[i])
+        return list(zip(self.nodes, by_node))
 
     def prepare_pods(self) -> List[PodRow]:
         """SortClusterPods + tuning (core.go:131-142)."""

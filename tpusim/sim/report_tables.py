@@ -142,6 +142,45 @@ def gpu_device_table(
     )
 
 
+def _bytes_str(n: int) -> str:
+    """Binary-SI quantity rendering like k8s resource.Quantity.String()."""
+    for suf, div in (("Ti", 1024**4), ("Gi", 1024**3), ("Mi", 1024**2), ("Ki", 1024)):
+        if n and n % div == 0:
+            return f"{n // div}{suf}"
+        if n >= div:
+            return f"{n / div:.1f}{suf}"
+    return str(n)
+
+
+def node_storage_table(nodes: Sequence[NodeRow]) -> str:
+    """Node Local Storage table (ref: apply.go:440-490): one VG row per
+    volume group with requested% and one row per exclusive device."""
+    from tpusim.io.storage import parse_node_storage
+
+    rows = []
+    for n in nodes:
+        st = parse_node_storage(n.local_storage)
+        if st is None:
+            continue
+        for vg in st.vgs:
+            pct = int(vg.requested / vg.capacity * 100) if vg.capacity else 0
+            rows.append(
+                [n.name, "VG", vg.name, _bytes_str(vg.capacity),
+                 f"{_bytes_str(vg.requested)}({pct}%)"]
+            )
+        for dev in st.devices:
+            rows.append(
+                [n.name, f"Device({dev.media_type})", dev.device,
+                 _bytes_str(dev.capacity),
+                 "used" if dev.is_allocated else "unused"]
+            )
+    return "Node Local Storage\n" + _table(
+        ["Node", "Storage Kind", "Storage Name", "Storage Allocatable",
+         "Storage Requests"],
+        rows,
+    )
+
+
 def full_report(
     pods: Sequence[PodRow],
     placed_node: np.ndarray,
@@ -156,4 +195,6 @@ def full_report(
     ]
     if gpu:
         parts.append(gpu_device_table(pods, placed_node, dev_mask, nodes))
+    if "open-local" in extended_resources:
+        parts.append(node_storage_table(nodes))
     return "\n\n".join(parts)

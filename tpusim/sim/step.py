@@ -29,6 +29,12 @@ from tpusim.types import NodeState, PodSpec
 
 _INT_MAX = jnp.int32(jnp.iinfo(jnp.int32).max)
 
+# Score policies whose kernel hands its own Reserve-phase GPU choice to the
+# gpuSelMethod machinery (ref: the allocateGpuIdFunc registry,
+# plugin/open_gpu_share.go:39 + fgd_score.go:36 / pwr_score.go:41 /
+# dot_product_score.go:37)
+SELF_SELECT_POLICIES = frozenset({"FGDScore", "PWRScore", "DotProductScore"})
+
 
 def filter_nodes(state: NodeState, pod: PodSpec) -> jnp.ndarray:
     """Filter phase → bool[N] feasibility.
@@ -83,6 +89,59 @@ def _choose_share_device(gpu_left, pod, policy_dev, gpu_sel: str, key):
     )
 
 
+def select_and_bind(
+    state: NodeState,
+    pod: PodSpec,
+    feasible: jnp.ndarray,  # bool[N]
+    total: jnp.ndarray,  # i32[N] weighted scores
+    policy_dev: jnp.ndarray,  # i32[N] per-node policy device pick (-1 none)
+    gpu_sel: str,
+    key,
+    tiebreak_rank: jnp.ndarray,
+) -> Tuple[NodeState, Placement]:
+    """selectHost + Reserve + Bind for already-computed scores — the single
+    source of truth shared by the sequential engine (schedule_one) and the
+    incremental table engine, so the two stay bit-identical by construction.
+
+    selectHost: max weighted score over feasible nodes, smallest tie-break
+    rank wins (the reference's lexicographic order over randomly-prefixed
+    node names; generic_scheduler.go:187-212)."""
+    cand = jnp.where(feasible, total, -_INT_MAX)
+    best = jnp.max(cand)
+    winner_rank = jnp.where(feasible & (cand == best), tiebreak_rank, _INT_MAX)
+    node = jnp.argmin(winner_rank).astype(jnp.int32)
+    ok = feasible.any()
+
+    # Reserve: concrete device allocation on the chosen node.
+    gpu_left = state.gpu_left[node]
+    share_dev = _choose_share_device(gpu_left, pod, policy_dev[node], gpu_sel, key)
+    share_mask = jax.nn.one_hot(share_dev, MAX_GPUS_PER_NODE, dtype=jnp.bool_) & (
+        share_dev >= 0
+    )
+    # Whole-GPU / multi-GPU pods: two-pointer pack in device-index order
+    # (gpunodeinfo.go:182-201; == first fully-free devices when milli == 1000).
+    units, _ = allocate_two_pointer(gpu_left, pod.gpu_milli, pod.gpu_num)
+    whole_mask = units > 0
+    is_share = pod.is_gpu_share()
+    has_gpu = pod.total_gpu_milli() > 0
+    dev_mask = jnp.where(has_gpu, jnp.where(is_share, share_mask, whole_mask), False)
+    dev_mask = dev_mask & ok
+
+    # Bind: scatter-commit the placement.
+    cls = pod_affinity_class(pod)
+    new_state = state._replace(
+        cpu_left=state.cpu_left.at[node].add(jnp.where(ok, -pod.cpu, 0)),
+        mem_left=state.mem_left.at[node].add(jnp.where(ok, -pod.mem, 0)),
+        gpu_left=state.gpu_left.at[node].add(
+            -dev_mask.astype(jnp.int32) * pod.gpu_milli
+        ),
+        aff_cnt=state.aff_cnt.at[node, jnp.maximum(cls, 0)].add(
+            jnp.where(ok & (cls >= 0), 1, 0)
+        ),
+    )
+    return new_state, Placement(jnp.where(ok, node, -1).astype(jnp.int32), dev_mask)
+
+
 def schedule_one(
     state: NodeState,
     pod: PodSpec,
@@ -114,7 +173,6 @@ def schedule_one(
 
     total = jnp.zeros(n, jnp.int32)
     policy_share_dev = jnp.full(n, -1, jnp.int32)
-    sel_policy_names = {"FGDScore", "PWRScore", "DotProductScore"}
     for fn, weight in policies:
         res = fn(state, pod, ctx)
         raw = res.raw_scores
@@ -123,46 +181,13 @@ def schedule_one(
         elif fn.normalize == "pwr":
             raw = pwr_normalize_i32(raw, feasible)
         total = total + jnp.int32(weight) * raw
-        if gpu_sel == fn.policy_name and fn.policy_name in sel_policy_names:
+        if gpu_sel == fn.policy_name and fn.policy_name in SELF_SELECT_POLICIES:
             policy_share_dev = res.share_dev
 
-    # selectHost: max weighted score over feasible nodes, smallest tie-break
-    # rank wins (the reference's lexicographic order over prefixed names).
-    cand = jnp.where(feasible, total, -_INT_MAX)
-    best = jnp.max(cand)
-    winner_rank = jnp.where(feasible & (cand == best), tiebreak_rank, _INT_MAX)
-    node = jnp.argmin(winner_rank).astype(jnp.int32)
-    ok = feasible.any()
-
-    # Reserve: concrete device allocation on the chosen node.
-    gpu_left = state.gpu_left[node]
-    share_dev = _choose_share_device(
-        gpu_left, pod, policy_share_dev[node], gpu_sel, k_sel
+    return select_and_bind(
+        state, pod, feasible, total, policy_share_dev, gpu_sel, k_sel,
+        tiebreak_rank,
     )
-    share_mask = jax.nn.one_hot(share_dev, MAX_GPUS_PER_NODE, dtype=jnp.bool_) & (
-        share_dev >= 0
-    )
-    # Whole-GPU / multi-GPU pods: two-pointer pack in device-index order
-    # (gpunodeinfo.go:182-201; == first fully-free devices when milli == 1000).
-    units, _ = allocate_two_pointer(gpu_left, pod.gpu_milli, pod.gpu_num)
-    whole_mask = units > 0
-    is_share = pod.is_gpu_share()
-    has_gpu = pod.total_gpu_milli() > 0
-    dev_mask = jnp.where(has_gpu, jnp.where(is_share, share_mask, whole_mask), False)
-    dev_mask = dev_mask & ok
-
-    # Bind: scatter-commit the placement.
-    new_state = state._replace(
-        cpu_left=state.cpu_left.at[node].add(jnp.where(ok, -pod.cpu, 0)),
-        mem_left=state.mem_left.at[node].add(jnp.where(ok, -pod.mem, 0)),
-        gpu_left=state.gpu_left.at[node].add(
-            -dev_mask.astype(jnp.int32) * pod.gpu_milli
-        ),
-        aff_cnt=state.aff_cnt.at[
-            node, jnp.maximum(pod_affinity_class(pod), 0)
-        ].add(jnp.where(ok & (pod_affinity_class(pod) >= 0), 1, 0)),
-    )
-    return new_state, Placement(jnp.where(ok, node, -1).astype(jnp.int32), dev_mask)
 
 
 def unschedule(state: NodeState, pod: PodSpec, placement: Placement) -> NodeState:

@@ -32,16 +32,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpusim.constants import MAX_GPUS_PER_NODE
-from tpusim.ops.resource import allocate_two_pointer
 from tpusim.policies import ScoreContext, minmax_normalize_i32, pwr_normalize_i32
-from tpusim.policies.clustering import pod_affinity_class
-from tpusim.sim.engine import EV_CREATE, EV_DELETE, ReplayResult
-from tpusim.sim.step import Placement, _choose_share_device, filter_nodes, unschedule
+from tpusim.sim.engine import ReplayResult
+from tpusim.sim.step import (
+    SELF_SELECT_POLICIES,
+    Placement,
+    filter_nodes,
+    select_and_bind,
+    unschedule,
+)
 from tpusim.types import NodeState, PodSpec
 
 _INT_MAX = jnp.int32(jnp.iinfo(jnp.int32).max)
-
-_SELF_SELECT = {"FGDScore", "PWRScore", "DotProductScore"}
 
 
 class PodTypes(NamedTuple):
@@ -115,12 +117,19 @@ def make_table_replay(policies, gpu_sel: str = "best"):
                 "RandomScore draws per-event randomness; use the sequential "
                 "engine (make_replay) for it"
             )
+    if gpu_sel == "random":
+        # the per-event PRNG stream would diverge from the sequential
+        # engine's, silently breaking the bit-identical contract
+        raise ValueError(
+            "gpu_sel='random' draws per-event randomness; use the "
+            "sequential engine (make_replay) for it"
+        )
     num_pol = len(policies)
     sel_idx = next(
         (
             i
             for i, (fn, _) in enumerate(policies)
-            if gpu_sel == fn.policy_name and fn.policy_name in _SELF_SELECT
+            if gpu_sel == fn.policy_name and fn.policy_name in SELF_SELECT_POLICIES
         ),
         -1,
     )
@@ -229,48 +238,17 @@ def make_table_replay(policies, gpu_sel: str = "best"):
                     elif fn.normalize == "pwr":
                         raw = pwr_normalize_i32(raw, feasible)
                     total = total + jnp.int32(weight) * raw
-                cand = jnp.where(feasible, total, -_INT_MAX)
-                best = jnp.max(cand)
-                winner = jnp.where(feasible & (cand == best), tiebreak_rank, _INT_MAX)
-                node = jnp.argmin(winner).astype(jnp.int32)
-                ok = feasible.any()
-
-                gpu_left = state.gpu_left[node]
-                share_dev = _choose_share_device(
-                    gpu_left, pod, sdev_tbl[t_id, node], gpu_sel, k_sel
+                new_state, pl = select_and_bind(
+                    state, pod, feasible, total, sdev_tbl[t_id], gpu_sel,
+                    k_sel, tiebreak_rank,
                 )
-                share_mask = jax.nn.one_hot(
-                    share_dev, MAX_GPUS_PER_NODE, dtype=jnp.bool_
-                ) & (share_dev >= 0)
-                units, _ = allocate_two_pointer(gpu_left, pod.gpu_milli, pod.gpu_num)
-                whole_mask = units > 0
-                has_gpu = pod.total_gpu_milli() > 0
-                dev_mask = jnp.where(
-                    has_gpu,
-                    jnp.where(pod.is_gpu_share(), share_mask, whole_mask),
-                    False,
-                )
-                dev_mask = dev_mask & ok
-
-                cls = pod_affinity_class(pod)
-                new_state = state._replace(
-                    cpu_left=state.cpu_left.at[node].add(jnp.where(ok, -pod.cpu, 0)),
-                    mem_left=state.mem_left.at[node].add(jnp.where(ok, -pod.mem, 0)),
-                    gpu_left=state.gpu_left.at[node].add(
-                        -dev_mask.astype(jnp.int32) * pod.gpu_milli
-                    ),
-                    aff_cnt=state.aff_cnt.at[node, jnp.maximum(cls, 0)].add(
-                        jnp.where(ok & (cls >= 0), 1, 0)
-                    ),
-                )
-                pnode = jnp.where(ok, node, -1).astype(jnp.int32)
                 return (
                     new_state,
-                    placed.at[idx].set(pnode),
-                    masks.at[idx].set(dev_mask),
-                    failed.at[idx].set(~ok),
-                    jnp.maximum(node, 0),
-                    pnode,
+                    placed.at[idx].set(pl.node),
+                    masks.at[idx].set(pl.dev_mask),
+                    failed.at[idx].set(pl.node < 0),
+                    jnp.maximum(pl.node, 0),
+                    pl.node,
                 )
 
             def do_delete():
