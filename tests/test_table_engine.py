@@ -138,3 +138,23 @@ def test_pod_type_partition():
     cat = lambda f: np.concatenate([np.asarray(getattr(t.share, f)), np.asarray(getattr(t.whole, f))])
     for f in ("cpu", "mem", "gpu_milli", "gpu_num", "gpu_mask"):
         assert np.array_equal(cat(f)[tid], np.asarray(getattr(pods, f)))
+
+
+def test_table_engine_report_rows_match_sequential():
+    """report=True: per-event frag/alloc/power rows must equal the
+    sequential engine's (same per-node kernels, same reduce order)."""
+    rng = np.random.default_rng(23)
+    state, tp = random_cluster(rng, num_nodes=12)
+    pods = random_pods(rng, num_pods=30)
+    ev_kind, ev_pod = _events_with_deletes(30, rng)
+    policies = [(make_policy("FGDScore"), 1000)]
+    key = jax.random.PRNGKey(9)
+    rank = jnp.asarray(rng.permutation(12).astype(np.int32))
+
+    seq = make_replay(policies, gpu_sel="FGDScore", report=True)
+    r0 = seq(state, pods, ev_kind, ev_pod, tp, key, rank)
+    tab = make_table_replay(policies, gpu_sel="FGDScore", report=True)
+    r1 = tab(state, pods, build_pod_types(pods), ev_kind, ev_pod, tp, key, rank)
+    _assert_equal(r0, r1)
+    for a, b in zip(r0.metrics, r1.metrics):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

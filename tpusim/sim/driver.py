@@ -125,20 +125,21 @@ class Simulator:
             report=self.cfg.report_per_event,
         )
         # incremental score-table engine (tpusim.sim.table_engine): exact
-        # same results, ~4x faster — usable whenever per-event report rows
-        # aren't needed and nothing in the cycle draws per-event randomness
+        # same placements/state (report rows agree within float tolerance),
+        # ~4x faster —
+        # usable whenever nothing in the cycle draws per-event randomness
         # (neither a RandomScore plugin nor a `random` Reserve gpuSelMethod,
         # whose PRNG stream would differ between the engines)
-        self._table_ok = (
-            (not self.cfg.report_per_event)
-            and self.cfg.gpu_sel_method != "random"
-            and all(fn.policy_name != "RandomScore" for fn, _ in self._policy_fns)
+        self._table_ok = self.cfg.gpu_sel_method != "random" and all(
+            fn.policy_name != "RandomScore" for fn, _ in self._policy_fns
         )
         if self._table_ok:
             from tpusim.sim.table_engine import make_table_replay
 
             self._table_fn = make_table_replay(
-                self._policy_fns, gpu_sel=self.cfg.gpu_sel_method
+                self._policy_fns,
+                gpu_sel=self.cfg.gpu_sel_method,
+                report=self.cfg.report_per_event,
             )
 
     def run_events(self, state, specs, ev_kind, ev_pod, key):

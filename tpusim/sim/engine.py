@@ -85,17 +85,29 @@ def cluster_usage(state: NodeState):
     return used_nodes, used_gpus, used_gpu_milli, used_cpu_milli
 
 
-def _metrics_row(state, tp, arr_cpu, arr_gpu):
-    amounts = cluster_frag_amounts(state, tp).sum(0)
-    used_nodes, used_gpus, used_gpu_milli, used_cpu_milli = cluster_usage(state)
-    pc, pg = _power_nodes(
+def power_rows(state: NodeState):
+    """(cpu_watts, gpu_watts) per node (ref: ClusterPowerConsumptionReport,
+    analysis.go:24-56)."""
+    return _power_nodes(
         state.cpu_left, state.cpu_cap, state.gpu_left, state.gpu_cnt,
         state.gpu_type, state.cpu_type,
     )
+
+
+def assemble_metrics_row(amounts, state, arr_cpu, arr_gpu, power_cpu, power_gpu):
+    """The EventMetrics row layout, single-sourced so every engine stays
+    positionally aligned with the NamedTuple fields."""
+    used_nodes, used_gpus, used_gpu_milli, used_cpu_milli = cluster_usage(state)
     return (
         amounts, used_nodes, used_gpus, used_gpu_milli, used_cpu_milli,
-        arr_gpu, arr_cpu, pc.sum(), pg.sum(),
+        arr_gpu, arr_cpu, power_cpu, power_gpu,
     )
+
+
+def _metrics_row(state, tp, arr_cpu, arr_gpu):
+    amounts = cluster_frag_amounts(state, tp).sum(0)
+    pc, pg = power_rows(state)
+    return assemble_metrics_row(amounts, state, arr_cpu, arr_gpu, pc.sum(), pg.sum())
 
 
 def make_replay(policies, gpu_sel: str = "best", report: bool = True):

@@ -32,8 +32,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpusim.constants import MAX_GPUS_PER_NODE
+from tpusim.ops.frag import cluster_frag_amounts
 from tpusim.policies import ScoreContext, minmax_normalize_i32, pwr_normalize_i32
-from tpusim.sim.engine import ReplayResult
+from tpusim.sim.engine import (
+    EventMetrics,
+    ReplayResult,
+    assemble_metrics_row,
+    power_rows,
+)
 from tpusim.sim.step import (
     SELF_SELECT_POLICIES,
     Placement,
@@ -105,11 +111,20 @@ def _row_state(state: NodeState, node) -> NodeState:
     )
 
 
-def make_table_replay(policies, gpu_sel: str = "best"):
+def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
     """Build the jitted incremental replayer for a static policy config.
 
     policies: [(policy_fn, weight)] — all must be table-izable (raw score a
     pure function of node state + pod spec; RandomScore is not).
+
+    report=True emits the per-event metric rows (frag/alloc/power — the
+    reference recomputes these cluster-wide after every event,
+    simulator.go:426-427, its dominant cost). Here per-node frag/power
+    metric tables are refreshed only for the event's touched node and
+    reduced per event. Placements/devices/state stay bit-identical to the
+    sequential engine; the float metric rows agree within last-ulp
+    tolerance (the same kernels run, but XLA may fuse the single-row
+    refresh differently from the full-cluster sweep).
     """
     for fn, _ in policies:
         if fn.policy_name == "RandomScore":
@@ -203,10 +218,17 @@ def make_table_replay(policies, gpu_sel: str = "best"):
         placed = jnp.full(num_pods, -1, jnp.int32)
         masks = jnp.zeros((num_pods, MAX_GPUS_PER_NODE), jnp.bool_)
         failed = jnp.zeros(num_pods, jnp.bool_)
+        if report:
+            frag_tbl = cluster_frag_amounts(state, tp)  # f32[N, 7]
+            pc0, pg0 = power_rows(state)
+            power_tbl = jnp.stack([pc0, pg0], -1)  # f32[N, 2]
+        else:
+            frag_tbl = power_tbl = jnp.zeros((0,))
 
         def body(carry, ev):
             (state, score_tbl, sdev_tbl, feas_tbl, dirty,
-             placed, masks, failed, key) = carry
+             placed, masks, failed, arr_cpu, arr_gpu,
+             frag_tbl, power_tbl, key) = carry
             kind, idx = ev
             pod = jax.tree.map(lambda a: a[idx], pods)
             t_id = type_id[idx]
@@ -248,6 +270,10 @@ def make_table_replay(policies, gpu_sel: str = "best"):
                     masks.at[idx].set(pl.dev_mask),
                     failed.at[idx].set(pl.node < 0),
                     jnp.maximum(pl.node, 0),
+                    # arrived counters accumulate per creation event
+                    # regardless of outcome (simulator.go:406-408)
+                    arr_cpu + pod.cpu,
+                    arr_gpu + pod.total_gpu_milli(),
                     pl.node,
                 )
 
@@ -260,27 +286,55 @@ def make_table_replay(policies, gpu_sel: str = "best"):
                     masks.at[idx].set(False),
                     failed,
                     jnp.maximum(pl.node, 0),
+                    arr_cpu,
+                    arr_gpu,
                     jnp.int32(-1),
                 )
 
             def do_skip():
-                return (state, placed, masks, failed, dirty, jnp.int32(-1))
+                return (
+                    state, placed, masks, failed, dirty, arr_cpu, arr_gpu,
+                    jnp.int32(-1),
+                )
 
-            state2, placed2, masks2, failed2, dirty2, node = jax.lax.switch(
+            (state2, placed2, masks2, failed2, dirty2, arr_cpu2, arr_gpu2,
+             node) = jax.lax.switch(
                 jnp.clip(kind, 0, 2), [do_create, do_delete, do_skip]
             )
+            if report:
+                # refresh the touched node's metric rows post-commit (via
+                # the SAME vmapped kernels the init/sequential paths use),
+                # then reduce the per-row-recomputed tables
+                row = _row_state(state2, dirty2)
+                fr = cluster_frag_amounts(row, tp)  # [1, 7]
+                pc, pg = power_rows(row)
+                frag_tbl2 = jax.lax.dynamic_update_slice(
+                    frag_tbl, fr, (dirty2, 0)
+                )
+                power_tbl2 = jax.lax.dynamic_update_slice(
+                    power_tbl, jnp.stack([pc[0], pg[0]])[None, :], (dirty2, 0)
+                )
+                mrow = assemble_metrics_row(
+                    frag_tbl2.sum(0), state2, arr_cpu2, arr_gpu2,
+                    power_tbl2[:, 0].sum(), power_tbl2[:, 1].sum(),
+                )
+            else:
+                frag_tbl2, power_tbl2, mrow = frag_tbl, power_tbl, ()
             return (
                 state2, score_tbl, sdev_tbl, feas_tbl, dirty2,
-                placed2, masks2, failed2, key,
-            ), node
+                placed2, masks2, failed2, arr_cpu2, arr_gpu2,
+                frag_tbl2, power_tbl2, key,
+            ), (mrow, node)
 
         init = (state, score_tbl, sdev_tbl, feas_tbl, jnp.int32(0),
-                placed, masks, failed, key)
+                placed, masks, failed, jnp.int32(0), jnp.int32(0),
+                frag_tbl, power_tbl, key)
         # unroll amortizes per-iteration fixed costs (~20% wall on the openb
         # replay); higher factors showed no further gain
-        (state, _, _, _, _, placed, masks, failed, _), nodes = jax.lax.scan(
-            body, init, (ev_kind, ev_pod), unroll=4
-        )
-        return ReplayResult(state, placed, masks, failed, None, nodes)
+        (state, _, _, _, _, placed, masks, failed, _, _, _, _, _), (
+            rows, nodes
+        ) = jax.lax.scan(body, init, (ev_kind, ev_pod), unroll=4)
+        metrics = EventMetrics(*rows) if report else None
+        return ReplayResult(state, placed, masks, failed, metrics, nodes)
 
     return replay
