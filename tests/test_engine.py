@@ -177,9 +177,11 @@ class TestDriverEndToEnd:
         # log contract: per-event lines + 16-line analysis block present
         sim.finish()
         text = sim.log.dump()
-        # one [Report] block per create/delete event (skip events emit none,
+        # two [Report] lines per create/delete event — the (origin) and
+        # (bellman) variants (analysis.go:109-110; skip events emit none,
         # simulator.go:391-399; this workload has no skips)
-        assert text.count("[Report]") == res.events
+        assert text.count("(origin)") == res.events
+        assert text.count("(bellman)") == res.events
         assert "Cluster Analysis Results (InitSchedule)" in text
         assert "there are 0 unscheduled pods" in text
 
@@ -211,3 +213,69 @@ class TestDriverEndToEnd:
                 (np.asarray(sim.init_state.gpu_left) - res.state.gpu_left).sum()
             )
             assert state_used == used, name
+
+
+class TestBellmanSeries:
+    def test_incremental_matches_direct_sweep(self):
+        """_bellman_series's host-side state reconstruction + one-node
+        updates must equal a direct node_frag_bellman sweep over the true
+        post-event states."""
+        from tpusim.ops.frag import node_frag_bellman
+        from tpusim.sim.engine import EV_CREATE, EV_DELETE
+
+        nodes = [
+            NodeRow("n0", 16000, 65536, 2, "V100M16"),
+            NodeRow("n1", 32000, 65536, 4, "V100M16"),
+        ]
+        pods = [
+            PodRow("a", 2000, 1024, 1, 500),
+            PodRow("b", 4000, 1024, 1, 1000),
+            PodRow("c", 1000, 1024, 1, 250),
+        ]
+        sim = Simulator(
+            nodes,
+            SimulatorConfig(
+                policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore"
+            ),
+        )
+        sim.set_workload_pods(pods)
+        sim.set_typical_pods()
+        import jax
+
+        from tpusim.io.trace import pods_to_specs
+
+        specs = pods_to_specs(pods)
+        ev_kind = jnp.asarray([EV_CREATE, EV_CREATE, EV_DELETE, EV_CREATE], jnp.int32)
+        ev_pod = jnp.asarray([0, 1, 0, 2], jnp.int32)
+        out = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, jax.random.PRNGKey(0))
+        series = sim._bellman_series(sim.init_state, pods, ev_kind, ev_pod, out)
+
+        # direct sweep: replay states host-side and evaluate every node
+        t = sim.typical
+        typ = list(zip(
+            np.asarray(t.cpu).tolist(), np.asarray(t.gpu_milli).tolist(),
+            np.asarray(t.gpu_num).tolist(), np.asarray(t.gpu_mask).tolist(),
+            np.asarray(t.freq).tolist(),
+        ))
+        cpu = np.asarray(sim.init_state.cpu_left).copy()
+        gpu = np.asarray(sim.init_state.gpu_left).copy()
+        gt = np.asarray(sim.init_state.gpu_type)
+        ev_node = np.asarray(out.event_node)
+        ev_dev = np.asarray(out.event_dev)
+        for e in range(len(ev_kind)):
+            n = int(ev_node[e])
+            if n >= 0:
+                p = pods[int(ev_pod[e])]
+                sign = 1 if int(ev_kind[e]) == EV_CREATE else -1
+                cpu[n] -= sign * p.cpu_milli
+                gpu[n][ev_dev[e]] -= sign * p.gpu_milli
+            direct = sum(
+                node_frag_bellman(
+                    (int(cpu[i]), tuple(int(g) for g in gpu[i]), int(gt[i])), typ
+                )
+                for i in range(len(nodes))
+            )
+            assert abs(direct - series[e]) < 1e-6, e
+        # and the reconstruction matches the device end state exactly
+        np.testing.assert_array_equal(cpu, np.asarray(out.state.cpu_left))
+        np.testing.assert_array_equal(gpu, np.asarray(out.state.gpu_left))

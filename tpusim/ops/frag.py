@@ -124,18 +124,20 @@ def cluster_frag_report(state: NodeState, tp: TypicalPods):
     return amounts, frag, 100.0 * frag / idle, 100.0 * q124 / idle
 
 
-def node_frag_bellman(node, typical, max_depth: int = 64):
+def node_frag_bellman(node, typical, max_depth: int = 64, memo=None):
     """Host-side Bellman expected-frag value function
     (ref: frag.go:231-283 NodeGpuFragBellman).
 
     Unbounded memoized recursion is hostile to XLA (SURVEY.md §7.3), so this
     stays a pure-Python reference implementation used for reporting/tests.
     `node` is (cpu_left:int, gpu_left:tuple[int,...], gpu_type:int); `typical`
-    is a list of (cpu, gpu_milli, gpu_num, gpu_mask, freq) tuples.
+    is a list of (cpu, gpu_milli, gpu_num, gpu_mask, freq) tuples. Pass a
+    dict as `memo` to share the flattened-state cache across calls (the
+    reference's cross-event `fragMemo sync.Map`, simulator.go:58).
     """
     import numpy as np
 
-    memo = {}
+    memo = {} if memo is None else memo
     t_arr = list(typical)
 
     def classify(cpu_left, gpu_left, gpu_type, t):

@@ -102,6 +102,7 @@ class Simulator:
         self.init_state = nodes_to_state(self.nodes)
         self.rank = jnp.asarray(tiebreak_rank(len(self.nodes), self.cfg.seed))
         self.log = LogSink(stream=None)
+        self._bellman_memo = {}
         self.workload_pods: List[PodRow] = []
         self.typical: Optional[TypicalPods] = None
         self.node_total_milli_cpu = int(sum(n.cpu_milli for n in self.nodes))
@@ -171,6 +172,10 @@ class Simulator:
         self.typical, self._typical_info = get_typical_pods(
             self.workload_pods, self.cfg.typical_pods
         )
+        # Bellman memo is keyed on flattened node state only, so it must
+        # reset when the typical-pod distribution changes (the reference
+        # keeps one fragMemo per run, simulator.go:58)
+        self._bellman_memo = {}
         self.log.info(f"Num of Total Pods: {len(self.workload_pods)}")
         self.log.info(f"Num of Total Pod Sepc: {len(self._typical_info)}")
 
@@ -232,7 +237,8 @@ class Simulator:
         )
         if self.cfg.report_per_event and out.metrics is not None:
             self._emit_event_reports(
-                out.metrics, pods, ev_kind, ev_pod, np.asarray(out.ever_failed)
+                out.metrics, pods, ev_kind, ev_pod,
+                np.asarray(out.ever_failed), out, state,
             )
         skipped = np.array([p.unscheduled for p in pods], bool)
         failed_mask = np.asarray(out.ever_failed) | skipped
@@ -445,12 +451,70 @@ class Simulator:
 
     # ---- reporting (analysis.go) ----
 
-    def _emit_event_reports(self, m, pods=None, ev_kind=None, ev_pod=None, failed=None):
+    def _bellman_series(self, start_state, pods, ev_kind, ev_pod, out):
+        """Per-event cluster Bellman frag (ref: the `(bellman)` [Report]
+        variant, analysis.go:110): reconstruct each event's touched node
+        host-side from the replay's (event_node, event_dev) telemetry and
+        update only that node's memoized value — mathematically equal to the
+        reference's per-event full-cluster sweep because the value function
+        depends on node state alone."""
+        from tpusim.ops.frag import node_frag_bellman
+        from tpusim.sim.engine import EV_CREATE
+
+        memo = self._bellman_memo
+        t = self.typical
+        typ = list(
+            zip(
+                np.asarray(t.cpu).tolist(),
+                np.asarray(t.gpu_milli).tolist(),
+                np.asarray(t.gpu_num).tolist(),
+                np.asarray(t.gpu_mask).tolist(),
+                np.asarray(t.freq).tolist(),
+            )
+        )
+        cpu_left = np.asarray(start_state.cpu_left).copy()
+        gpu_left = np.asarray(start_state.gpu_left).copy()
+        gpu_type = np.asarray(start_state.gpu_type)
+
+        def node_val(i):
+            return node_frag_bellman(
+                (int(cpu_left[i]), tuple(int(g) for g in gpu_left[i]),
+                 int(gpu_type[i])),
+                typ,
+                memo=memo,
+            )
+
+        per_node = np.array([node_val(i) for i in range(len(cpu_left))])
+        total = float(per_node.sum())
+        ev_node = np.asarray(out.event_node)
+        ev_dev = np.asarray(out.event_dev)
+        kinds = np.asarray(ev_kind)
+        ev_pods = np.asarray(ev_pod)
+        series = np.empty(len(kinds))
+        for e in range(len(kinds)):
+            node = int(ev_node[e])
+            if node >= 0:
+                p = pods[int(ev_pods[e])]
+                sign = 1 if kinds[e] == EV_CREATE else -1
+                cpu_left[node] -= sign * p.cpu_milli
+                gpu_left[node][ev_dev[e]] -= sign * p.gpu_milli
+                total -= float(per_node[node])
+                per_node[node] = node_val(node)
+                total += float(per_node[node])
+            series[e] = total
+        return series
+
+    def _emit_event_reports(
+        self, m, pods=None, ev_kind=None, ev_pod=None, failed=None,
+        out=None, start_state=None,
+    ):
         """Per-event log block: `[i] attempt to ...` line (simulator.go:410,
         420; failures echo the deletePod rollback line :354), then the
-        frag/alloc/power report lines (simulator.go:426-427). Skip events
+        frag/alloc/power report lines incl. the bellman variant
+        (simulator.go:426-427, analysis.go:109-110). Skip events
         (pod-unscheduled annotation) emit nothing (simulator.go:391-399)."""
         from tpusim.sim.engine import EV_CREATE, EV_DELETE
+        from tpusim.sim.reports import report_bellman_line
 
         amounts = np.asarray(m.frag_amounts)
         un = np.asarray(m.used_nodes)
@@ -464,6 +528,9 @@ class Simulator:
         total_gpus = int(np.asarray(self.init_state.gpu_cnt).sum())
         kinds = None if ev_kind is None else np.asarray(ev_kind)
         ev_pods = None if ev_pod is None else np.asarray(ev_pod)
+        bellman = None
+        if out is not None and start_state is not None and pods is not None:
+            bellman = self._bellman_series(start_state, pods, ev_kind, ev_pod, out)
         for e in range(amounts.shape[0]):
             if kinds is not None:
                 kind = int(kinds[e])
@@ -478,6 +545,8 @@ class Simulator:
                         f"[deletePod] attempt to delete a non-scheduled pod({p.name})"
                     )
             report_frag_line(self.log, amounts[e])
+            if bellman is not None:
+                report_bellman_line(self.log, float(bellman[e]), float(amounts[e].sum()))
             report_alloc_lines(
                 self.log, int(un[e]), int(ug[e]), int(um[e]), total_gpus,
                 int(ag[e]), int(uc[e]), int(ac[e]),

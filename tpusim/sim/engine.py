@@ -17,7 +17,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from tpusim.constants import MILLI
+from tpusim.constants import MAX_GPUS_PER_NODE, MILLI
 from tpusim.ops.energy import node_power
 from tpusim.ops.frag import cluster_frag_amounts, frag_sum_except_q3, frag_sum_q1q2q4
 from tpusim.sim.step import Placement, schedule_one, unschedule
@@ -62,7 +62,9 @@ class ReplayResult(NamedTuple):
     dev_mask: jnp.ndarray  # bool[P, 8]
     ever_failed: jnp.ndarray  # bool[P] creation attempted and rejected
     metrics: EventMetrics
-    event_node: jnp.ndarray  # i32[E] node chosen at each event (-1 otherwise)
+    event_node: jnp.ndarray  # i32[E] node touched at each event (-1 none):
+    # the chosen node for creations, the freed node for deletions
+    event_dev: jnp.ndarray  # bool[E, 8] devices touched at each event
 
 
 def cluster_usage(state: NodeState):
@@ -152,6 +154,7 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True):
                     arr_cpu + pod.cpu,
                     arr_gpu + pod.total_gpu_milli(),
                     pl.node,
+                    pl.dev_mask,
                 )
 
             def do_delete(_):
@@ -164,13 +167,18 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True):
                     failed,
                     arr_cpu,
                     arr_gpu,
-                    jnp.int32(-1),
+                    pl.node,
+                    pl.dev_mask,
                 )
 
             def do_skip(_):
-                return (state, placed, masks, failed, arr_cpu, arr_gpu, jnp.int32(-1))
+                return (
+                    state, placed, masks, failed, arr_cpu, arr_gpu,
+                    jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
+                )
 
-            state2, placed2, masks2, failed2, arr_cpu2, arr_gpu2, node = jax.lax.switch(
+            (state2, placed2, masks2, failed2, arr_cpu2, arr_gpu2, node,
+             dev) = jax.lax.switch(
                 jnp.clip(kind, 0, 2), [do_create, do_delete, do_skip], None
             )
             if report:
@@ -180,13 +188,14 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True):
             return (state2, placed2, masks2, failed2, arr_cpu2, arr_gpu2, key), (
                 row,
                 node,
+                dev,
             )
 
         init = (state, placed, masks, failed, jnp.int32(0), jnp.int32(0), key)
-        (state, placed, masks, failed, _, _, _), (rows, nodes) = jax.lax.scan(
+        (state, placed, masks, failed, _, _, _), (rows, nodes, devs) = jax.lax.scan(
             body, init, (ev_kind, ev_pod)
         )
         metrics = EventMetrics(*rows) if report else None
-        return ReplayResult(state, placed, masks, failed, metrics, nodes)
+        return ReplayResult(state, placed, masks, failed, metrics, nodes, devs)
 
     return replay

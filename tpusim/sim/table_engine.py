@@ -275,6 +275,7 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
                     arr_cpu + pod.cpu,
                     arr_gpu + pod.total_gpu_milli(),
                     pl.node,
+                    pl.dev_mask,
                 )
 
             def do_delete():
@@ -288,17 +289,18 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
                     jnp.maximum(pl.node, 0),
                     arr_cpu,
                     arr_gpu,
-                    jnp.int32(-1),
+                    pl.node,
+                    pl.dev_mask,
                 )
 
             def do_skip():
                 return (
                     state, placed, masks, failed, dirty, arr_cpu, arr_gpu,
-                    jnp.int32(-1),
+                    jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
                 )
 
             (state2, placed2, masks2, failed2, dirty2, arr_cpu2, arr_gpu2,
-             node) = jax.lax.switch(
+             node, dev) = jax.lax.switch(
                 jnp.clip(kind, 0, 2), [do_create, do_delete, do_skip]
             )
             if report:
@@ -324,7 +326,7 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
                 state2, score_tbl, sdev_tbl, feas_tbl, dirty2,
                 placed2, masks2, failed2, arr_cpu2, arr_gpu2,
                 frag_tbl2, power_tbl2, key,
-            ), (mrow, node)
+            ), (mrow, node, dev)
 
         init = (state, score_tbl, sdev_tbl, feas_tbl, jnp.int32(0),
                 placed, masks, failed, jnp.int32(0), jnp.int32(0),
@@ -332,9 +334,9 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
         # unroll amortizes per-iteration fixed costs (~20% wall on the openb
         # replay); higher factors showed no further gain
         (state, _, _, _, _, placed, masks, failed, _, _, _, _, _), (
-            rows, nodes
+            rows, nodes, devs
         ) = jax.lax.scan(body, init, (ev_kind, ev_pod), unroll=4)
         metrics = EventMetrics(*rows) if report else None
-        return ReplayResult(state, placed, masks, failed, metrics, nodes)
+        return ReplayResult(state, placed, masks, failed, metrics, nodes, devs)
 
     return replay
