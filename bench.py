@@ -69,8 +69,9 @@ def main():
 
     def run():
         # auto-selects the incremental score-table engine (exact-equivalent
-        # to the sequential oracle; tests/test_table_engine.py)
-        res = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key)
+        # to the sequential oracle; tests/test_table_engine.py). bucket=1:
+        # a single-config benchmark needs no sweep shape-bucketing padding.
+        res = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key, bucket=1)
         jax.block_until_ready(res.state)
         return res
 

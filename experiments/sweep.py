@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Single-process experiment sweep (replaces the reference's
+`run_scripts.sh | xargs --max-procs=128` fleet, experiments/README.md
+step 2: 1020 experiments, ~10 h on a 256-vCPU machine).
+
+Runs the (trace × policy × seed) grid in ONE process so every experiment
+after the first reuses the compiled replay engines (tpusim.sim.engine /
+table_engine caches + the driver's shape bucketing) and the shared Bellman
+memo. On a single TPU chip the full grid runs in minutes.
+
+    python experiments/sweep.py --traces openb_pod_list_default \
+        --methods 06-FGD 01-Random --seeds 3
+    python experiments/sweep.py            # full 7×21×10 grid
+    python experiments/sweep.py --fast     # skip per-event report lines
+
+Each experiment writes the same per-directory outputs as experiments/run.py
+(simon.log + analysis CSVs) under --out-root/<trace>/<method>/<tune>/<seed>,
+so experiments/merge.py and the plot scripts work unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "experiments"))
+
+from generate_run_scripts import METHODS, TRACES  # noqa: E402
+
+import run as runner  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-root", default="experiments/data")
+    ap.add_argument("--tune", type=float, default=1.3)
+    ap.add_argument("--seeds", type=int, default=10, help="seeds 42..42+n-1")
+    ap.add_argument("--traces", nargs="*", default=None)
+    ap.add_argument("--methods", nargs="*", default=None, help="method ids")
+    ap.add_argument("--fast", action="store_true", help="no per-event report")
+    args = ap.parse_args(argv)
+
+    traces = args.traces or TRACES
+    methods = [m for m in METHODS if args.methods is None or m[0] in args.methods]
+    grid = [
+        (trace, m, seed)
+        for trace in traces
+        for m in methods
+        for seed in range(42, 42 + args.seeds)
+    ]
+    t_all = time.perf_counter()
+    for i, (trace, (mid, flags, gpusel, dimext, norm), seed) in enumerate(grid):
+        outdir = f"{args.out_root}/{trace}/{mid}/{args.tune}/{seed}"
+        argv_exp = (
+            ["-d", outdir, "-f", trace]
+            + flags.split()
+            + ["-gpusel", gpusel, "-dimext", dimext, "-norm", norm,
+               "-tune", str(args.tune), "-tuneseed", str(seed),
+               "--shuffle-pod", "true"]
+            + (["--no-per-event-report"] if args.fast else [])
+        )
+        t0 = time.perf_counter()
+        runner.run_experiment(runner.get_args(argv_exp))
+        print(
+            f"[sweep {i + 1}/{len(grid)}] {trace} {mid} seed={seed} "
+            f"{time.perf_counter() - t0:.1f}s "
+            f"(total {time.perf_counter() - t_all:.0f}s)",
+            flush=True,
+        )
+    print(f"[sweep] {len(grid)} experiments in {time.perf_counter() - t_all:.0f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -127,3 +127,37 @@ def test_cluster_report_shapes():
         ),
         rel=1e-5,
     )
+
+
+def test_bellman_optimized_matches_naive():
+    """The canonical-sorted/fit-count Bellman must equal the direct
+    transcription of the definition on randomized states."""
+    import numpy as np
+
+    from tests.fixtures import typical_rows_gpu_host
+    from tpusim.ops.frag import _node_frag_bellman_naive, node_frag_bellman
+
+    t = typical_rows_gpu_host()
+    rng = np.random.default_rng(5)
+    for _ in range(20):
+        g = tuple(int(x) for x in rng.choice([0, 100, 250, 500, 750, 1000], 8))
+        node = (
+            int(rng.choice([2000, 8000, 32000, 64000])),
+            g,
+            int(rng.integers(-1, 4)),
+        )
+        assert abs(
+            node_frag_bellman(node, t) - _node_frag_bellman_naive(node, t)
+        ) < 1e-9
+
+
+def test_bellman_zero_milli_multi_gpu_pod():
+    """A degenerate typical pod (gpu_num>0, gpu_milli==0) must not crash and
+    must match the naive oracle."""
+    from tpusim.ops.frag import _node_frag_bellman_naive, node_frag_bellman
+
+    t = [(4000, 0, 2, 0, 0.5), (8000, 500, 1, 0, 0.5)]
+    node = (16000, (1000, 1000, 500, 0, 0, 0, 0, 0), 1)
+    assert abs(
+        node_frag_bellman(node, t) - _node_frag_bellman_naive(node, t)
+    ) < 1e-9

@@ -158,3 +158,36 @@ def test_table_engine_report_rows_match_sequential():
     _assert_equal(r0, r1)
     for a, b in zip(r0.metrics, r1.metrics):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_bucketed_padding_equivalence():
+    """run_events' shape bucketing (inert pods + EV_SKIP events + dummy
+    types) must not change results."""
+    from tpusim.io.trace import NodeRow, PodRow, pods_to_specs
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+
+    rng = np.random.default_rng(31)
+    nodes = [
+        NodeRow(f"n{i}", 32000, 131072, int(g), "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], 10))
+    ]
+    pods = [
+        PodRow(f"p{i}", int(rng.choice([1000, 4000])), 1024,
+               int(rng.choice([0, 1])), 500)
+        for i in range(23)
+    ]
+    sim = Simulator(nodes, SimulatorConfig(
+        policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+        report_per_event=True,
+    ))
+    sim.set_workload_pods(pods)
+    sim.set_typical_pods()
+    specs = pods_to_specs(pods)
+    ev_kind = jnp.zeros(23, jnp.int32)
+    ev_pod = jnp.arange(23, dtype=jnp.int32)
+    key = jax.random.PRNGKey(2)
+    r0 = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key, bucket=1)
+    r1 = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key, bucket=512)
+    _assert_equal(r0, r1)
+    for a, b in zip(r0.metrics, r1.metrics):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

@@ -169,9 +169,15 @@ def _extend_node(row: NodeState, pod: PodSpec, norm: str):
     return scores[best], jnp.where(scores[best] == _NEG, -1, share_dev)
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
 def make_dotprod(dim_ext: str = "share", norm: str = "max"):
     """Build the DotProduct policy for a (dimExtMethod, normMethod) config
-    (ref: example scheduler configs use share/max)."""
+    (ref: example scheduler configs use share/max). Cached per config so
+    repeated Simulator constructions share one kernel object (and therefore
+    one jit cache entry for the replay engines built around it)."""
     assert dim_ext in ("merge", "share", "divide", "extend"), dim_ext
     assert norm in ("node", "pod", "max"), norm
 

@@ -143,24 +143,82 @@ class Simulator:
                 report=self.cfg.report_per_event,
             )
 
-    def run_events(self, state, specs, ev_kind, ev_pod, key):
+    def run_events(self, state, specs, ev_kind, ev_pod, key, bucket: int = 512):
         """Run the compiled replay on prepared arrays, auto-selecting the
         fastest engine that supports the configuration. Small batches
         (descheduler victims, inflation clones) stay on the sequential
         engine: the table init alone costs K full node-sweeps, which only
-        amortizes when there are more events than distinct pod types."""
+        amortizes when there are more events than distinct pod types.
+
+        Pod/event axes are padded to `bucket` multiples (inert zero pods +
+        EV_SKIP events) so that different seeds/traces of a sweep hit the
+        same compiled executable instead of re-jitting per experiment;
+        outputs are sliced back to true sizes."""
+        from tpusim.sim.engine import EV_SKIP
+        from tpusim.types import PodSpec
+
+        p, e = int(specs.cpu.shape[0]), int(ev_kind.shape[0])
+        # size-adaptive: large runs share one bucketed executable; small
+        # runs (descheduler victims, inflation clones) round to the next
+        # power of two so padding waste stays <= 2x
+        b = bucket if max(p, e) >= bucket else max(32, 1 << (max(p, e) - 1).bit_length())
+        p2, e2 = -(-p // b) * b, -(-e // b) * b
+        # dedup types from the UNPADDED specs (no spurious zero type); the
+        # type_id axis is padded alongside the pod axis (padded events only
+        # ever reference pod 0)
+        types = None
         if self._table_ok:
-            from tpusim.sim.table_engine import build_pod_types
+            from tpusim.sim.table_engine import build_pod_types, pad_pod_types
 
             types = build_pod_types(specs)
+        if p2 != p:
+            pad = p2 - p
+            z = jnp.zeros(pad, jnp.int32)
+            specs = PodSpec(
+                cpu=jnp.concatenate([specs.cpu, z]),
+                mem=jnp.concatenate([specs.mem, z]),
+                gpu_milli=jnp.concatenate([specs.gpu_milli, z]),
+                gpu_num=jnp.concatenate([specs.gpu_num, z]),
+                gpu_mask=jnp.concatenate([specs.gpu_mask, z]),
+                pinned=jnp.concatenate([specs.pinned, jnp.full(pad, -1, jnp.int32)]),
+            )
+            if types is not None:
+                types = types._replace(
+                    type_id=jnp.concatenate([types.type_id, z])
+                )
+        if e2 != e:
+            ev_kind = jnp.concatenate(
+                [ev_kind, jnp.full(e2 - e, EV_SKIP, ev_kind.dtype)]
+            )
+            ev_pod = jnp.concatenate([ev_pod, jnp.zeros(e2 - e, ev_pod.dtype)])
+
+        out = None
+        if types is not None:
             k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
-            if k > 0 and ev_kind.shape[0] >= 2 * k:
-                return self._table_fn(
+            if k > 0 and e >= 2 * k:
+                if p2 != p or e2 != e:  # bucketed run: stabilize K too
+                    types = pad_pod_types(types)
+                out = self._table_fn(
                     state, specs, types, ev_kind, ev_pod, self.typical, key,
                     self.rank,
                 )
-        return self.replay_fn(
-            state, specs, ev_kind, ev_pod, self.typical, key, self.rank
+        if out is None:
+            out = self.replay_fn(
+                state, specs, ev_kind, ev_pod, self.typical, key, self.rank
+            )
+        if p2 == p and e2 == e:
+            return out
+        return out._replace(
+            placed_node=out.placed_node[:p],
+            dev_mask=out.dev_mask[:p],
+            ever_failed=out.ever_failed[:p],
+            event_node=out.event_node[:e],
+            event_dev=out.event_dev[:e],
+            metrics=(
+                None
+                if out.metrics is None
+                else jax.tree.map(lambda a: a[:e], out.metrics)
+            ),
         )
 
     # ---- workload prep (core.go:103-142) ----
@@ -172,9 +230,11 @@ class Simulator:
         self.typical, self._typical_info = get_typical_pods(
             self.workload_pods, self.cfg.typical_pods
         )
-        # Bellman memo is keyed on flattened node state only, so it must
-        # reset when the typical-pod distribution changes (the reference
-        # keeps one fragMemo per run, simulator.go:58)
+        # Bellman memo is scoped to ONE experiment run, like the
+        # reference's fragMemo (simulator.go:58): memoized values embed the
+        # cum_prob cutoff context of their first computation, so sharing a
+        # memo across experiments would make report values depend on sweep
+        # order (and diverge from a standalone run of the same config).
         self._bellman_memo = {}
         self.log.info(f"Num of Total Pods: {len(self.workload_pods)}")
         self.log.info(f"Num of Total Pod Sepc: {len(self._typical_info)}")

@@ -112,12 +112,22 @@ def _metrics_row(state, tp, arr_cpu, arr_gpu):
     return assemble_metrics_row(amounts, state, arr_cpu, arr_gpu, pc.sum(), pg.sum())
 
 
+_REPLAY_CACHE = {}
+
+
 def make_replay(policies, gpu_sel: str = "best", report: bool = True):
     """Build a jitted trace replayer for a static policy configuration.
 
     policies: [(policy_fn, weight)]; gpu_sel: Reserve-phase gpuSelMethod.
     report=False skips per-event metric rows (pure-throughput mode).
+
+    Replayers are cached per (policy kernels, gpu_sel, report) so that a
+    sweep constructing many Simulators (experiments/sweep.py) reuses one
+    compiled engine per configuration instead of re-jitting per experiment.
     """
+    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report)
+    if cache_key in _REPLAY_CACHE:
+        return _REPLAY_CACHE[cache_key]
 
     @jax.jit
     def replay(
@@ -198,4 +208,5 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True):
         metrics = EventMetrics(*rows) if report else None
         return ReplayResult(state, placed, masks, failed, metrics, nodes, devs)
 
+    _REPLAY_CACHE[cache_key] = replay
     return replay

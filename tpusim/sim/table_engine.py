@@ -104,11 +104,50 @@ def build_pod_types(specs: PodSpec) -> PodTypes:
     )
 
 
+def pad_pod_types(types: PodTypes, multiple: int = 16) -> PodTypes:
+    """Pad each type group to a `multiple` with inert dummy types so sweeps
+    over seeds/traces (whose K varies slightly) share one compiled replay.
+    Dummies request 2^30 milli-CPU — infeasible on any node — and are never
+    referenced by type_id, so they only cost dead table columns."""
+
+    def pad_group(spec: PodSpec, share: bool) -> PodSpec:
+        k = int(spec.cpu.shape[0])
+        k2 = -(-k // multiple) * multiple
+        if k2 == k:  # includes k == 0: empty groups keep their static skip
+            return spec
+        pad = k2 - k
+        big = jnp.full(pad, 2**30, jnp.int32)
+        return PodSpec(
+            cpu=jnp.concatenate([spec.cpu, big]),
+            mem=jnp.concatenate([spec.mem, big]),
+            gpu_milli=jnp.concatenate(
+                [spec.gpu_milli, jnp.full(pad, 1 if share else 0, jnp.int32)]
+            ),
+            gpu_num=jnp.concatenate(
+                [spec.gpu_num, jnp.full(pad, 1 if share else 0, jnp.int32)]
+            ),
+            gpu_mask=jnp.concatenate([spec.gpu_mask, jnp.zeros(pad, jnp.int32)]),
+            pinned=jnp.concatenate([spec.pinned, jnp.full(pad, -1, jnp.int32)]),
+        )
+
+    # type_id indexes share types at [0, Ks) and whole types at [Ks, K);
+    # padding shifts the whole-group base, so remap ids past the share group
+    ks = int(types.share.cpu.shape[0])
+    share2 = pad_group(types.share, True)
+    ks2 = int(share2.cpu.shape[0])
+    tid = types.type_id
+    tid = jnp.where(tid >= ks, tid + (ks2 - ks), tid)
+    return PodTypes(share2, pad_group(types.whole, False), tid)
+
+
 def _row_state(state: NodeState, node) -> NodeState:
     """1-node slice of the cluster state at a dynamic index."""
     return jax.tree.map(
         lambda a: jax.lax.dynamic_slice_in_dim(a, node, 1, axis=0), state
     )
+
+
+_TABLE_REPLAY_CACHE = {}
 
 
 def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
@@ -139,6 +178,9 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
             "gpu_sel='random' draws per-event randomness; use the "
             "sequential engine (make_replay) for it"
         )
+    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report)
+    if cache_key in _TABLE_REPLAY_CACHE:
+        return _TABLE_REPLAY_CACHE[cache_key]
     num_pol = len(policies)
     sel_idx = next(
         (
@@ -339,4 +381,5 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
         metrics = EventMetrics(*rows) if report else None
         return ReplayResult(state, placed, masks, failed, metrics, nodes, devs)
 
+    _TABLE_REPLAY_CACHE[cache_key] = replay
     return replay
