@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Synthetic scale stress: 100k-node cluster / 1M-pod stream under FGD
+(BASELINE.json config 5 — "Synthetic 100k-node / 1M-pod stress").
+
+The openb cluster (1523 nodes) is tiled out to --nodes heterogeneous nodes
+(same SKU mix) and a --pods creation stream is sampled from the openb
+typical-pod distribution. Replays on the incremental table engine; with
+--mesh N the node axis additionally runs under an N-device sharding (on one
+real chip use XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu for a virtual mesh validation at reduced sizes).
+
+    python bench_scale.py                     # 100k nodes, 1M pods, 1 chip
+    python bench_scale.py --nodes 10000 --pods 100000
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+def synth_cluster(num_nodes: int, seed: int = 0):
+    import numpy as np
+
+    from tpusim.io.trace import load_node_csv
+
+    base = load_node_csv(os.path.join(REPO, "data/csv/openb_node_list_gpu_node.csv"))
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, len(base), num_nodes)
+    rows = []
+    for i, j in enumerate(idx):
+        b = base[int(j)]
+        rows.append(
+            type(b)(
+                name=f"synth-{i:06d}",
+                cpu_milli=b.cpu_milli,
+                memory_mib=b.memory_mib,
+                gpu=b.gpu,
+                model=b.model,
+                cpu_model=b.cpu_model,
+            )
+        )
+    return rows
+
+
+def synth_pods(num_pods: int, seed: int = 1):
+    import numpy as np
+
+    from tpusim.io.trace import load_pod_csv
+
+    base = load_pod_csv(os.path.join(REPO, "data/csv/openb_pod_list_default.csv"))
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, len(base), num_pods)
+    rows = []
+    for i, j in enumerate(idx):
+        b = base[int(j)]
+        rows.append(
+            type(b)(
+                name=f"sp-{i:07d}",
+                cpu_milli=b.cpu_milli,
+                memory_mib=b.memory_mib,
+                num_gpu=b.num_gpu,
+                gpu_milli=b.gpu_milli,
+                gpu_spec=b.gpu_spec,
+            )
+        )
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100_000)
+    ap.add_argument("--pods", type=int, default=1_000_000)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusim.constants import MILLI
+    from tpusim.io.trace import build_events, pods_to_specs
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+    from tpusim.sim.typical import TypicalPodsConfig
+
+    nodes = synth_cluster(args.nodes, args.seed)
+    pods = synth_pods(args.pods, args.seed + 1)
+    cfg = SimulatorConfig(
+        policies=(("FGDScore", 1000),),
+        gpu_sel_method="FGDScore",
+        seed=args.seed,
+        report_per_event=False,
+        typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
+    )
+    sim = Simulator(nodes, cfg)
+    sim.set_workload_pods(pods)
+    sim.set_typical_pods()
+
+    specs = pods_to_specs(pods)
+    ev_kind, ev_pod = build_events(pods)
+    ev_kind, ev_pod = jnp.asarray(ev_kind), jnp.asarray(ev_pod)
+    key = jax.random.PRNGKey(args.seed)
+
+    t0 = time.perf_counter()
+    res = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key, bucket=1)
+    jax.block_until_ready(res.state)
+    first = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    res = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key, bucket=1)
+    jax.block_until_ready(res.state)
+    wall = time.perf_counter() - t0
+
+    placed = int(args.pods - np.asarray(res.ever_failed).sum())
+    s = jax.tree.map(np.asarray, res.state)
+    slot = np.arange(s.gpu_left.shape[1])[None, :] < s.gpu_cnt[:, None]
+    alloc = 100.0 * np.where(slot, MILLI - s.gpu_left, 0).sum() / (
+        s.gpu_cnt.sum() * MILLI
+    )
+    print(
+        f"[scale] nodes={args.nodes} pods={args.pods} wall={wall:.1f}s "
+        f"(first incl. compile {first:.1f}s) placed={placed} "
+        f"throughput={placed / wall:.0f} placements/s gpu_alloc={alloc:.2f}%"
+    )
+
+
+if __name__ == "__main__":
+    main()

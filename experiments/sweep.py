@@ -5,8 +5,9 @@ step 2: 1020 experiments, ~10 h on a 256-vCPU machine).
 
 Runs the (trace × policy × seed) grid in ONE process so every experiment
 after the first reuses the compiled replay engines (tpusim.sim.engine /
-table_engine caches + the driver's shape bucketing) and the shared Bellman
-memo. On a single TPU chip the full grid runs in minutes.
+table_engine caches + the driver's shape bucketing over pod/event/typical
+axes). Bellman memos stay scoped per experiment — sharing them would make
+report values depend on sweep order (see tpusim/sim/driver.py).
 
     python experiments/sweep.py --traces openb_pod_list_default \
         --methods 06-FGD 01-Random --seeds 3
@@ -63,8 +64,19 @@ def main(argv=None):
                "--shuffle-pod", "true"]
             + (["--no-per-event-report"] if args.fast else [])
         )
+        # resume marker: written only after a fully-finished experiment,
+        # keyed on the exact argv so --fast and full runs never alias
+        marker = Path(outdir) / ".sweep_done"
+        if marker.exists() and marker.read_text() == " ".join(argv_exp):
+            print(
+                f"[sweep {i + 1}/{len(grid)}] {trace} {mid} seed={seed} "
+                f"cached, skipping",
+                flush=True,
+            )
+            continue
         t0 = time.perf_counter()
         runner.run_experiment(runner.get_args(argv_exp))
+        marker.write_text(" ".join(argv_exp))
         print(
             f"[sweep {i + 1}/{len(grid)}] {trace} {mid} seed={seed} "
             f"{time.perf_counter() - t0:.1f}s "

@@ -1,0 +1,129 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The compute path of this framework is JAX/XLA on TPU; these are the
+host-runtime pieces where CPython overhead dominates, compiled on demand
+with the system toolchain (no pybind11 dependency). Every component has a
+pure-Python fallback so the package works without a compiler.
+
+Currently: the Bellman expected-frag evaluator (tpusim/native/bellman.cpp),
+the per-event reporting hot spot (see tpusim.sim.driver._bellman_series).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "bellman.cpp")
+_LIB = os.path.join(_DIR, "_bellman.so")
+
+_lib = None
+_load_failed = False
+
+
+def _ensure_lib():
+    """Compile (if stale) and dlopen the shared library; None on failure."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    try:
+        if (
+            not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        ):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB + ".tmp", _SRC],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(_LIB + ".tmp", _LIB)
+        lib = ctypes.CDLL(_LIB)
+        lib.bellman_new.restype = ctypes.c_void_p
+        lib.bellman_new.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32,
+            ctypes.c_int32,
+        ]
+        lib.bellman_eval.restype = ctypes.c_double
+        lib.bellman_eval.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.bellman_memo_size.restype = ctypes.c_int64
+        lib.bellman_memo_size.argtypes = [ctypes.c_void_p]
+        lib.bellman_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except (OSError, subprocess.CalledProcessError):
+        _load_failed = True
+    return _lib
+
+
+class BellmanEvaluator:
+    """Memoized Bellman value function over node states for ONE typical-pod
+    distribution (the memo lifetime contract of the reference's per-run
+    fragMemo — construct one evaluator per experiment).
+
+    Falls back to tpusim.ops.frag.node_frag_bellman when the native library
+    is unavailable; `native` reports which path is active.
+    """
+
+    def __init__(self, typical: Sequence[tuple], max_depth: int = 64):
+        """typical: [(cpu, gpu_milli, gpu_num, gpu_mask, freq)]."""
+        self._typical = [
+            (int(c), int(m), int(n), int(k), float(f))
+            for c, m, n, k, f in typical
+        ]
+        self._handle: Optional[int] = None
+        self._pymemo: dict = {}
+        lib = _ensure_lib()
+        if lib is not None:
+            t = len(self._typical)
+            arr = lambda ctype, vals: (ctype * t)(*vals)
+            self._handle = lib.bellman_new(
+                arr(ctypes.c_int32, (p[0] for p in self._typical)),
+                arr(ctypes.c_int32, (p[1] for p in self._typical)),
+                arr(ctypes.c_int32, (p[2] for p in self._typical)),
+                arr(ctypes.c_int64, (p[3] for p in self._typical)),
+                arr(ctypes.c_double, (p[4] for p in self._typical)),
+                t,
+                max_depth,
+            )
+        self._max_depth = max_depth
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def eval(self, cpu_left: int, gpu_left: Sequence[int], gpu_type: int) -> float:
+        if self._handle is not None:
+            g = (ctypes.c_int32 * 8)(*[int(x) for x in gpu_left])
+            return _lib.bellman_eval(
+                self._handle, int(cpu_left), g, int(gpu_type)
+            )
+        from tpusim.ops.frag import node_frag_bellman
+
+        return node_frag_bellman(
+            (int(cpu_left), tuple(int(x) for x in gpu_left), int(gpu_type)),
+            self._typical,
+            max_depth=self._max_depth,
+            memo=self._pymemo,
+        )
+
+    def memo_size(self) -> int:
+        if self._handle is not None:
+            return int(_lib.bellman_memo_size(self._handle))
+        return len(self._pymemo)
+
+    def __del__(self):
+        if self._handle is not None and _lib is not None:
+            _lib.bellman_free(self._handle)
+            self._handle = None

@@ -1,0 +1,182 @@
+// Bellman expected-fragmentation value function — native evaluator.
+//
+// Exact port of tpusim/ops/frag.py::node_frag_bellman (itself the host
+// re-derivation of the reference's NodeGpuFragBellman, frag.go:231-283):
+// memoized recursion over (cpu_left, sorted-desc gpu vector, gpu_type)
+// states against a typical-pod distribution, with the same cum_prob cutoff,
+// 0.999 ratio-except-Q3 shortcut, and non-memoized max-depth truncation.
+// The per-event series evaluation in tpusim/sim/driver.py is ~5 us/call in
+// CPython; this evaluator brings the dominant per-experiment host cost down
+// ~20x. Equivalence is pinned by tests/test_native.py against the Python
+// implementation.
+//
+// C ABI (consumed via ctypes from tpusim/native/__init__.py):
+//   bellman_new(cpu[], milli[], num[], mask[], freq[], T, max_depth) -> handle
+//   bellman_eval(handle, cpu_left, gpu[8], gpu_type) -> double
+//   bellman_memo_size(handle) -> size
+//   bellman_free(handle)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxGpus = 8;
+
+struct Key {
+    int32_t cpu;
+    int32_t type;
+    int16_t g[kMaxGpus];
+    bool operator==(const Key& o) const {
+        return cpu == o.cpu && type == o.type &&
+               std::memcmp(g, o.g, sizeof(g)) == 0;
+    }
+};
+
+struct KeyHash {
+    size_t operator()(const Key& k) const {
+        // FNV-1a over the packed bytes
+        const unsigned char* p = reinterpret_cast<const unsigned char*>(&k);
+        size_t h = 1469598103934665603ull;
+        for (size_t i = 0; i < sizeof(Key); ++i) {
+            h ^= p[i];
+            h *= 1099511628211ull;
+        }
+        return h;
+    }
+};
+
+struct TypicalPod {
+    int32_t cpu;
+    int32_t milli;
+    int32_t num;
+    int64_t mask;
+    double freq;
+};
+
+struct Evaluator {
+    std::vector<TypicalPod> pods;
+    std::vector<int32_t> millis;  // distinct positive, ascending
+    int max_depth;
+    std::unordered_map<Key, double, KeyHash> memo;
+
+    double rec(int32_t cpu_left, int16_t* g /* sorted desc */, int32_t type,
+               double cum_prob, int depth) {
+        Key key;
+        key.cpu = cpu_left;
+        key.type = type;
+        std::memcpy(key.g, g, sizeof(key.g));
+        auto it = memo.find(key);
+        if (it != memo.end()) return it->second;
+
+        int64_t total = 0;
+        for (int i = 0; i < kMaxGpus; ++i) total += g[i];
+        if (total == 0 || static_cast<double>(total) * cum_prob < 1.0)
+            return 0.0;
+
+        // fit count per distinct milli (g sorted desc -> prefix counts)
+        int nfit[64];
+        {
+            int i = kMaxGpus;
+            for (size_t mi = 0; mi < millis.size(); ++mi) {
+                int32_t m = millis[mi];
+                while (i > 0 && g[i - 1] < m) --i;
+                nfit[mi] = i;
+            }
+        }
+        auto fit_of = [&](int32_t milli) {
+            // millis is tiny (<= ~16); linear lookup
+            for (size_t mi = 0; mi < millis.size(); ++mi)
+                if (millis[mi] == milli) return nfit[mi];
+            return 0;
+        };
+        int64_t node_bit = type >= 0 ? (1ll << type) : 0;
+
+        double ratio_except_q3 = 0.0;
+        for (const auto& t : pods) {
+            if (t.milli == 0 || (t.mask != 0 && !(t.mask & node_bit)) ||
+                fit_of(t.milli) < t.num || cpu_left < t.cpu)
+                ratio_except_q3 += t.freq;
+        }
+        if (depth >= max_depth) return static_cast<double>(total);
+
+        double frag;
+        if (ratio_except_q3 < 0.999) {
+            double pv = 0.0;
+            for (const auto& t : pods) {
+                if (t.freq == 0.0) continue;  // zero-frequency padding rows
+                if (cpu_left < t.cpu || kMaxGpus < t.num) {
+                    pv += static_cast<double>(total) * t.freq;
+                    continue;
+                }
+                if (t.num == 0 || t.milli == 0) {
+                    pv += t.freq * rec(cpu_left - t.cpu, g, type,
+                                       cum_prob * t.freq, depth + 1);
+                    continue;
+                }
+                int j = fit_of(t.milli);
+                if (j < t.num) {
+                    pv += static_cast<double>(total) * t.freq;
+                    continue;
+                }
+                // take the t.num least-free fitting: g[j-num..j), each
+                // -milli; re-sort desc
+                int16_t g2[kMaxGpus];
+                std::memcpy(g2, g, sizeof(g2));
+                for (int d = j - t.num; d < j; ++d)
+                    g2[d] = static_cast<int16_t>(g2[d] - t.milli);
+                std::sort(g2, g2 + kMaxGpus, std::greater<int16_t>());
+                pv += t.freq * rec(cpu_left - t.cpu, g2, type,
+                                   cum_prob * t.freq, depth + 1);
+            }
+            frag = pv;
+        } else {
+            frag = static_cast<double>(total);
+        }
+        memo.emplace(key, frag);
+        return frag;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bellman_new(const int32_t* cpu, const int32_t* milli,
+                  const int32_t* num, const int64_t* mask,
+                  const double* freq, int32_t t, int32_t max_depth) {
+    auto* ev = new Evaluator();
+    ev->max_depth = max_depth;
+    ev->pods.reserve(t);
+    for (int i = 0; i < t; ++i)
+        ev->pods.push_back({cpu[i], milli[i], num[i], mask[i], freq[i]});
+    std::vector<int32_t> ms;
+    for (int i = 0; i < t; ++i)
+        if (milli[i] > 0) ms.push_back(milli[i]);
+    std::sort(ms.begin(), ms.end());
+    ms.erase(std::unique(ms.begin(), ms.end()), ms.end());
+    if (ms.size() > 64) { delete ev; return nullptr; }
+    ev->millis = std::move(ms);
+    return ev;
+}
+
+double bellman_eval(void* handle, int32_t cpu_left, const int32_t* gpu,
+                    int32_t gpu_type) {
+    auto* ev = static_cast<Evaluator*>(handle);
+    int16_t g[kMaxGpus];
+    for (int i = 0; i < kMaxGpus; ++i) g[i] = static_cast<int16_t>(gpu[i]);
+    std::sort(g, g + kMaxGpus, std::greater<int16_t>());
+    return ev->rec(cpu_left, g, gpu_type, 1.0, 0);
+}
+
+int64_t bellman_memo_size(void* handle) {
+    return static_cast<int64_t>(
+        static_cast<Evaluator*>(handle)->memo.size());
+}
+
+void bellman_free(void* handle) { delete static_cast<Evaluator*>(handle); }
+
+}  // extern "C"

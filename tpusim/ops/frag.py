@@ -185,6 +185,8 @@ def node_frag_bellman(node, typical, max_depth: int = 64, memo=None):
         if ratio_except_q3 < 0.999:
             pv = 0.0
             for cpu, milli, num, mask, p in t_arr:
+                if p == 0.0:  # zero-frequency padding rows contribute 0
+                    continue
                 # sub (least-free fitting devices; no accessibility check,
                 # matching the definition's Sub)
                 if cpu_left < cpu or len(g) < num:

@@ -37,7 +37,12 @@ from tpusim.sim.reports import (
     report_frag_line,
     report_power_line,
 )
-from tpusim.sim.typical import TypicalPodsConfig, get_skyline_pods, get_typical_pods
+from tpusim.sim.typical import (
+    TypicalPodsConfig,
+    get_skyline_pods,
+    get_typical_pods,
+    pad_typical_pods,
+)
 from tpusim.sim.workload import sort_cluster_pods, tune_pods
 from tpusim.types import NodeState, TypicalPods
 
@@ -102,7 +107,7 @@ class Simulator:
         self.init_state = nodes_to_state(self.nodes)
         self.rank = jnp.asarray(tiebreak_rank(len(self.nodes), self.cfg.seed))
         self.log = LogSink(stream=None)
-        self._bellman_memo = {}
+        self._bellman_eval = None
         self.workload_pods: List[PodRow] = []
         self.typical: Optional[TypicalPods] = None
         self.node_total_milli_cpu = int(sum(n.cpu_milli for n in self.nodes))
@@ -230,12 +235,17 @@ class Simulator:
         self.typical, self._typical_info = get_typical_pods(
             self.workload_pods, self.cfg.typical_pods
         )
-        # Bellman memo is scoped to ONE experiment run, like the
-        # reference's fragMemo (simulator.go:58): memoized values embed the
-        # cum_prob cutoff context of their first computation, so sharing a
-        # memo across experiments would make report values depend on sweep
-        # order (and diverge from a standalone run of the same config).
-        self._bellman_memo = {}
+        # pad the typical axis to a bucket with zero-frequency rows: every
+        # frag/score kernel weights contributions by freq, so zero rows are
+        # exact no-ops, and a stable T means sweeps across trace variants
+        # (whose distribution sizes differ) reuse one compiled replay
+        self.typical = pad_typical_pods(self.typical)
+        # The Bellman evaluator (and its memo) is scoped to ONE experiment
+        # run, like the reference's fragMemo (simulator.go:58): memoized
+        # values embed the cum_prob cutoff context of their first
+        # computation, so sharing across experiments would make report
+        # values depend on sweep order.
+        self._bellman_eval = None
         self.log.info(f"Num of Total Pods: {len(self.workload_pods)}")
         self.log.info(f"Num of Total Pod Sepc: {len(self._typical_info)}")
 
@@ -518,31 +528,30 @@ class Simulator:
         update only that node's memoized value — mathematically equal to the
         reference's per-event full-cluster sweep because the value function
         depends on node state alone."""
-        from tpusim.ops.frag import node_frag_bellman
         from tpusim.sim.engine import EV_CREATE
 
-        memo = self._bellman_memo
-        t = self.typical
-        typ = list(
-            zip(
-                np.asarray(t.cpu).tolist(),
-                np.asarray(t.gpu_milli).tolist(),
-                np.asarray(t.gpu_num).tolist(),
-                np.asarray(t.gpu_mask).tolist(),
-                np.asarray(t.freq).tolist(),
+        if self._bellman_eval is None:
+            from tpusim.native import BellmanEvaluator
+
+            t = self.typical
+            self._bellman_eval = BellmanEvaluator(
+                list(
+                    zip(
+                        np.asarray(t.cpu).tolist(),
+                        np.asarray(t.gpu_milli).tolist(),
+                        np.asarray(t.gpu_num).tolist(),
+                        np.asarray(t.gpu_mask).tolist(),
+                        np.asarray(t.freq).tolist(),
+                    )
+                )
             )
-        )
+        ev = self._bellman_eval
         cpu_left = np.asarray(start_state.cpu_left).copy()
         gpu_left = np.asarray(start_state.gpu_left).copy()
         gpu_type = np.asarray(start_state.gpu_type)
 
         def node_val(i):
-            return node_frag_bellman(
-                (int(cpu_left[i]), tuple(int(g) for g in gpu_left[i]),
-                 int(gpu_type[i])),
-                typ,
-                memo=memo,
-            )
+            return ev.eval(int(cpu_left[i]), gpu_left[i], int(gpu_type[i]))
 
         per_node = np.array([node_val(i) for i in range(len(cpu_left))])
         total = float(per_node.sum())

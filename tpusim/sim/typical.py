@@ -103,3 +103,25 @@ def get_skyline_pods(pods: Sequence[PodRow]) -> List[Tuple[int, int]]:
         ):
             skyline.append((p.cpu_milli, p.gpu_milli))
     return skyline
+
+
+def pad_typical_pods(tp: TypicalPods, multiple: int = 16) -> TypicalPods:
+    """Pad the typical-pod axis with zero-frequency rows to a stable
+    multiple. freq == 0 rows contribute nothing to any frag amount, score,
+    or Bellman value (all are freq-weighted sums), so results are unchanged;
+    the stable T lets a sweep over trace variants share compiled replays."""
+    import jax.numpy as jnp
+
+    t = int(tp.cpu.shape[0])
+    t2 = -(-max(t, 1) // multiple) * multiple
+    if t2 == t:
+        return tp
+    pad = t2 - t
+    z = jnp.zeros(pad, tp.cpu.dtype)
+    return TypicalPods(
+        cpu=jnp.concatenate([tp.cpu, z]),
+        gpu_milli=jnp.concatenate([tp.gpu_milli, z]),
+        gpu_num=jnp.concatenate([tp.gpu_num, z]),
+        gpu_mask=jnp.concatenate([tp.gpu_mask, z]),
+        freq=jnp.concatenate([tp.freq, jnp.zeros(pad, tp.freq.dtype)]),
+    )
