@@ -90,3 +90,46 @@ def test_pad_nodes_inert():
     # cluster aggregates unchanged
     assert int(pstate.gpu_cnt.sum()) == int(state.gpu_cnt.sum())
     assert int(pstate.cpu_cap.sum()) == int(state.cpu_cap.sum())
+
+
+def test_sharded_table_replay_matches_unsharded():
+    """The sharded table engine must reproduce the unsharded one bit-for-bit
+    (and therefore the sequential oracle) on the virtual 8-device mesh."""
+    import numpy as np
+
+    from tests.fixtures import random_cluster, random_pods
+    from tpusim.parallel import (
+        make_mesh,
+        make_sharded_table_replay,
+        pad_nodes,
+        shard_state,
+    )
+    from tpusim.policies import make_policy
+    from tpusim.sim.table_engine import build_pod_types, make_table_replay
+
+    rng = np.random.default_rng(41)
+    state, tp = random_cluster(rng, num_nodes=21)
+    pods = random_pods(rng, num_pods=40)
+    types = build_pod_types(pods)
+    ev_kind = jnp.zeros(40, jnp.int32)
+    ev_pod = jnp.arange(40, dtype=jnp.int32)
+    policies = [(make_policy("FGDScore"), 1000)]
+    key = jax.random.PRNGKey(7)
+    rank = jnp.asarray(tiebreak_rank(21, seed=3))
+
+    plain = make_table_replay(policies, gpu_sel="FGDScore")
+    r0 = plain(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+
+    mesh = make_mesh(8)
+    pstate, prank = pad_nodes(state, rank, 8)
+    pstate = shard_state(pstate, mesh)
+    sharded = make_sharded_table_replay(policies, mesh, gpu_sel="FGDScore")
+    r1 = sharded(pstate, pods, types, ev_kind, ev_pod, tp, key, prank)
+
+    np.testing.assert_array_equal(
+        np.asarray(r0.placed_node), np.asarray(r1.placed_node)
+    )
+    np.testing.assert_array_equal(np.asarray(r0.dev_mask), np.asarray(r1.dev_mask))
+    n = state.num_nodes
+    for a, b in zip(jax.tree.leaves(r0.state), jax.tree.leaves(r1.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[:n])

@@ -178,11 +178,17 @@ class TestPWR:
 
 class TestSimonAndRandom:
     def test_simon_share(self):
-        st = mk_state([1000, 1000], cpu_left=10000, mem=100000)
+        # Simon scores against static ALLOCATABLE capacity, not free
+        # resources (simon.go:59-64 reads node.Status.Allocatable, which the
+        # fake cluster never decrements)
+        st = mk_state([1000, 1000], cpu_left=10000, cpu_cap=10000, mem=100000)
         pod = make_pod(cpu=5000, mem=0, gpu_milli=0, gpu_num=0)
         fn = make_policy("Simon")
         # cpu share = 5000/(10000-5000) = 1.0 → score 100
         assert int(jit_policy(fn)(st, pod, ctx_for(st)).raw_scores[0]) == 100
+        st2 = mk_state([1000, 1000], cpu_left=10000, cpu_cap=96000, mem=100000)
+        # cpu share = 5000/91000, mem 0, gpu 0 → round(100 x 0.0549) = 5
+        assert int(jit_policy(fn)(st2, pod, ctx_for(st2)).raw_scores[0]) == 5
 
     def test_random_single_winner(self):
         st = make_node_state(

@@ -1,6 +1,7 @@
 from tpusim.parallel.sharding import (
     make_mesh,
     make_sharded_replay,
+    make_sharded_table_replay,
     pad_nodes,
     shard_state,
     state_sharding,
@@ -9,6 +10,7 @@ from tpusim.parallel.sharding import (
 __all__ = [
     "make_mesh",
     "make_sharded_replay",
+    "make_sharded_table_replay",
     "pad_nodes",
     "shard_state",
     "state_sharding",

@@ -85,6 +85,30 @@ def shard_state(state: NodeState, mesh: Mesh) -> NodeState:
     return jax.device_put(state, state_sharding(mesh))
 
 
+def _shard_replay_fn(inner, mesh: Mesh, extra_replicated: int):
+    """Re-jit a (jit-wrapped) replay with the node axis of the cluster state
+    split over `mesh`. Replay signatures are
+    (state, pods, [types,] ev_kind, ev_pod, tp, key, tiebreak_rank); the
+    state is node-sharded, the tie-break rank follows it, everything else is
+    replicated. extra_replicated = number of extra leading args between
+    `pods` and `ev_kind` (the table engine's PodTypes)."""
+    fn = inner.__wrapped__ if hasattr(inner, "__wrapped__") else inner
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        fn,
+        in_shardings=(
+            state_sharding(mesh),  # state
+            None,  # pods (replicated, let XLA decide)
+            *([None] * extra_replicated),
+            repl,  # ev_kind
+            repl,  # ev_pod
+            None,  # typical pods
+            repl,  # key
+            NamedSharding(mesh, P(NODE_AXIS)),  # tiebreak_rank
+        ),
+    )
+
+
 def make_sharded_replay(
     policies: Sequence[Tuple[object, int]],
     mesh: Mesh,
@@ -96,23 +120,24 @@ def make_sharded_replay(
     everything else (pod batch, event stream, typical pods) replicated."""
     from tpusim.sim.engine import make_replay
 
-    inner = make_replay(policies, gpu_sel=gpu_sel, report=report)
-    # make_replay returns a jit-wrapped function; re-jit with shardings.
-    fn = inner.__wrapped__ if hasattr(inner, "__wrapped__") else inner
-
-    st = state_sharding(mesh)
-    repl = NamedSharding(mesh, P())
-
-    sharded = jax.jit(
-        fn,
-        in_shardings=(
-            st,  # state
-            None,  # pods (replicated, let XLA decide)
-            repl,  # ev_kind
-            repl,  # ev_pod
-            None,  # typical pods
-            repl,  # key
-            NamedSharding(mesh, P(NODE_AXIS)),  # tiebreak_rank
-        ),
+    return _shard_replay_fn(
+        make_replay(policies, gpu_sel=gpu_sel, report=report), mesh, 0
     )
-    return sharded
+
+
+def make_sharded_table_replay(
+    policies: Sequence[Tuple[object, int]],
+    mesh: Mesh,
+    gpu_sel: str = "best",
+    report: bool = False,
+):
+    """Sharded twin of tpusim.sim.table_engine.make_table_replay: the
+    [policy, K, N] score/feasibility/device tables (and, with report=True,
+    the per-node metric tables) inherit the node-axis sharding from the
+    cluster state, so per-event work is the one-column refresh local to the
+    owning chip plus the selectHost all-reduce."""
+    from tpusim.sim.table_engine import make_table_replay
+
+    return _shard_replay_fn(
+        make_table_replay(policies, gpu_sel=gpu_sel, report=report), mesh, 1
+    )

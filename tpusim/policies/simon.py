@@ -1,16 +1,19 @@
 """Simon scoring (ref: plugin/simon.go:47-71).
 
-score = round(100 × max over resource dims of share(podReq_d, free_d − req_d))
+score = round(100 × max over resource dims of share(podReq_d, alloc_d − req_d))
 with share(a, t) = a/t, or 1 when t == 0 and a > 0 (algo/greed.go:78-91).
-Dims here: milli-CPU, memory MiB, total milli-GPU (the node allocatable map).
-Min-max normalized by the shared NormalizeScore extension.
+Dims: milli-CPU, memory MiB, total milli-GPU. NOTE the reference reads
+`node.Status.Allocatable` — static CAPACITY, which the fake cluster never
+decrements on binding (usage lives in pod objects) — so the score base is
+capacity, not free resources. Min-max normalized by the shared
+NormalizeScore extension.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from tpusim.constants import MAX_NODE_SCORE
+from tpusim.constants import MAX_NODE_SCORE, MILLI
 from tpusim.policies.base import PolicyResult, ScoreContext
 from tpusim.types import NodeState, PodSpec
 
@@ -29,13 +32,13 @@ def simon_score(state: NodeState, pod: PodSpec, ctx: ScoreContext) -> PolicyResu
         pod.mem.astype(jnp.float32),
         pod.total_gpu_milli().astype(jnp.float32),
     ]
-    free = [
-        state.cpu_left.astype(jnp.float32),
-        state.mem_left.astype(jnp.float32),
-        state.total_gpu_left().astype(jnp.float32),
+    alloc = [
+        state.cpu_cap.astype(jnp.float32),
+        state.mem_cap.astype(jnp.float32),
+        (state.gpu_cnt * MILLI).astype(jnp.float32),
     ]
     res = jnp.zeros(state.num_nodes, jnp.float32)
-    for a, f in zip(req, free):
+    for a, f in zip(req, alloc):
         res = jnp.maximum(res, _share(a, f - a))
     scores = jnp.round(MAX_NODE_SCORE * res).astype(jnp.int32)
     share_dev = jnp.full(state.num_nodes, -1, jnp.int32)
