@@ -1,0 +1,23 @@
+# Convenience targets (the package is pure Python + an optional on-demand
+# C++ component; there is no build step — ref parity: Makefile builds bin/simon).
+
+.PHONY: test bench bench-scale sweep native clean
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+bench-scale:
+	python bench_scale.py
+
+sweep:
+	python experiments/sweep.py
+
+native:
+	g++ -O2 -shared -fPIC -o tpusim/native/_bellman.so tpusim/native/bellman.cpp
+
+clean:
+	rm -f tpusim/native/_bellman.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -4,10 +4,9 @@
 
 The openb cluster (1523 nodes) is tiled out to --nodes heterogeneous nodes
 (same SKU mix) and a --pods creation stream is sampled from the openb
-typical-pod distribution. Replays on the incremental table engine; with
---mesh N the node axis additionally runs under an N-device sharding (on one
-real chip use XLA_FLAGS=--xla_force_host_platform_device_count=8
-JAX_PLATFORMS=cpu for a virtual mesh validation at reduced sizes).
+typical-pod distribution. Replays on the incremental table engine (single
+device; for the node-axis sharded multi-device path see
+tpusim.parallel.make_sharded_table_replay and tests/test_parallel.py).
 
     python bench_scale.py                     # 100k nodes, 1M pods, 1 chip
     python bench_scale.py --nodes 10000 --pods 100000
@@ -77,7 +76,18 @@ def main():
     ap.add_argument("--nodes", type=int, default=100_000)
     ap.add_argument("--pods", type=int, default=1_000_000)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--chunk",
+        type=int,
+        default=200_000,
+        help="events per device dispatch (a single multi-minute XLA "
+        "execution can exceed the TPU transport's per-call limits; state "
+        "carries across chunks, which is exact for this creation-only "
+        "stream — mixed create/delete streams must replay in one call)",
+    )
     args = ap.parse_args()
+    if args.chunk <= 0:
+        ap.error("--chunk must be positive")
 
     import jax
     import jax.numpy as jnp
@@ -106,18 +116,35 @@ def main():
     ev_kind, ev_pod = jnp.asarray(ev_kind), jnp.asarray(ev_pod)
     key = jax.random.PRNGKey(args.seed)
 
+    from tpusim.sim.table_engine import build_pod_types
+
+    types = build_pod_types(specs)  # hoisted: identical for every chunk
+
+    def run_chunked():
+        state = sim.init_state
+        failed_chunks = []
+        for lo in range(0, int(ev_kind.shape[0]), args.chunk):
+            hi = min(lo + args.chunk, int(ev_kind.shape[0]))
+            res = sim.run_events(
+                state, specs, ev_kind[lo:hi], ev_pod[lo:hi], key,
+                bucket=args.chunk, types=types,
+            )
+            state = res.state
+            # keep the reduction on device; pull once after the run
+            failed_chunks.append(res.ever_failed.sum())
+        jax.block_until_ready(state)
+        return state, int(sum(int(np.asarray(f)) for f in failed_chunks))
+
     t0 = time.perf_counter()
-    res = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key, bucket=1)
-    jax.block_until_ready(res.state)
+    final_state, failed = run_chunked()
     first = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    res = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key, bucket=1)
-    jax.block_until_ready(res.state)
+    final_state, failed = run_chunked()
     wall = time.perf_counter() - t0
 
-    placed = int(args.pods - np.asarray(res.ever_failed).sum())
-    s = jax.tree.map(np.asarray, res.state)
+    placed = int(args.pods - failed)
+    s = jax.tree.map(np.asarray, final_state)
     slot = np.arange(s.gpu_left.shape[1])[None, :] < s.gpu_cnt[:, None]
     alloc = 100.0 * np.where(slot, MILLI - s.gpu_left, 0).sum() / (
         s.gpu_cnt.sum() * MILLI

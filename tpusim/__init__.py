@@ -3,16 +3,18 @@
 Re-implements the capabilities of hkust-adsl/kubernetes-scheduler-simulator
 (USENIX ATC'23 "Beware of Fragmentation", FGD) as a JAX/XLA program: cluster
 state is a struct-of-arrays over nodes, every scoring policy is a vmapped
-kernel, and the trace replay loop is a `lax.scan` (oracle-parity mode) or a
-batched wave dispatcher (throughput mode).
+kernel, and the trace replay loop is a compiled `lax.scan` — either the
+sequential oracle engine or the exact-equivalent incremental score-table
+engine (tpusim.sim.table_engine, the throughput path).
 
 Layer map (mirrors SURVEY.md §1 of this repo):
   tpusim.ops       — resource algebra + fragmentation math   (ref: pkg/type, pkg/utils)
   tpusim.policies  — node-scoring policy kernels             (ref: pkg/simulator/plugin)
-  tpusim.sim       — scheduler step, event loop, analysis    (ref: pkg/simulator, vendor scheduler)
-  tpusim.io        — trace/config ingestion, export          (ref: data/, pkg/api, scripts)
-  tpusim.parallel  — mesh-sharded scoring for large clusters (ref: §2.9 — replaces goroutine fan-out)
-  tpusim.utils     — vector math, misc helpers               (ref: pkg/utils/utils.go)
+  tpusim.sim       — scheduler step, replay engines, analysis (ref: pkg/simulator, vendor scheduler)
+  tpusim.io        — trace/config/storage ingestion, export  (ref: data/, pkg/api, scripts)
+  tpusim.parallel  — mesh-sharded replay for large clusters  (ref: §2.9 — replaces goroutine fan-out)
+  tpusim.native    — C++ host-runtime components (Bellman)   (ctypes-bound, Python fallback)
+  tpusim.config    — Simon CR + scheduler-config planes      (ref: pkg/api, pkg/simulator/utils.go)
 """
 
 from tpusim import constants

@@ -148,7 +148,9 @@ class Simulator:
                 report=self.cfg.report_per_event,
             )
 
-    def run_events(self, state, specs, ev_kind, ev_pod, key, bucket: int = 512):
+    def run_events(
+        self, state, specs, ev_kind, ev_pod, key, bucket: int = 512, types=None
+    ):
         """Run the compiled replay on prepared arrays, auto-selecting the
         fastest engine that supports the configuration. Small batches
         (descheduler victims, inflation clones) stay on the sequential
@@ -158,7 +160,9 @@ class Simulator:
         Pod/event axes are padded to `bucket` multiples (inert zero pods +
         EV_SKIP events) so that different seeds/traces of a sweep hit the
         same compiled executable instead of re-jitting per experiment;
-        outputs are sliced back to true sizes."""
+        outputs are sliced back to true sizes. Callers replaying the same
+        pod specs repeatedly (chunked streams) may pass a prebuilt
+        `types = build_pod_types(specs)` to skip the host-side dedup."""
         from tpusim.sim.engine import EV_SKIP
         from tpusim.types import PodSpec
 
@@ -171,10 +175,11 @@ class Simulator:
         # dedup types from the UNPADDED specs (no spurious zero type); the
         # type_id axis is padded alongside the pod axis (padded events only
         # ever reference pod 0)
-        types = None
-        if self._table_ok:
-            from tpusim.sim.table_engine import build_pod_types, pad_pod_types
+        from tpusim.sim.table_engine import build_pod_types, pad_pod_types
 
+        if not self._table_ok:
+            types = None
+        elif types is None:
             types = build_pod_types(specs)
         if p2 != p:
             pad = p2 - p
