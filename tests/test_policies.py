@@ -198,3 +198,74 @@ class TestSimonAndRandom:
         fn = make_policy("RandomScore")
         scores = np.asarray(jit_policy(fn)(st, make_pod(cpu=1), ctx_for(st)).raw_scores)
         assert (scores == 100).sum() == 1 and (scores == 0).sum() == 3
+
+
+def test_pwr_matches_direct_form():
+    """The incremental PWR delta must equal re-running the full power model
+    on every hypothetical, across random states incl. zero-milli share pods."""
+    import jax
+
+    from tpusim.constants import MAX_GPUS_PER_NODE
+    from tpusim.ops.energy import node_power
+    from tpusim.ops.resource import sub_pod
+    from tpusim.policies.pwr import _pwr_node
+    from tpusim.types import PodSpec
+
+    def direct(row, pod):
+        def power(cpu_left, gpu_left):
+            c, g = node_power(
+                cpu_left, row.cpu_cap, gpu_left, row.gpu_cnt, row.gpu_type,
+                row.cpu_type,
+            )
+            return c + g
+
+        old = power(row.cpu_left, row.gpu_left)
+
+        def per_dev(d):
+            return power(row.cpu_left - pod.cpu, row.gpu_left.at[d].add(-pod.gpu_milli))
+
+        new_per_dev = jax.vmap(per_dev)(jnp.arange(MAX_GPUS_PER_NODE))
+        fits = row.gpu_left >= pod.gpu_milli
+        neg = jnp.int32(-(2**31) + 1)
+        dev_scores = jnp.where(fits, (old - new_per_dev).astype(jnp.int32), neg)
+        best = jnp.argmax(dev_scores)
+        share = (jnp.where(fits.any(), dev_scores[best], neg),
+                 jnp.where(fits.any(), best, -1))
+        c2, _, g2, _, _ = sub_pod(row.cpu_left, row.mem_left, row.gpu_left, pod)
+        whole = (old - power(c2, g2)).astype(jnp.int32)
+        is_share = pod.is_gpu_share()
+        return (jnp.where(is_share, share[0], whole),
+                jnp.where(is_share, share[1], -1))
+
+    rng = np.random.default_rng(77)
+    from tpusim.types import make_node_state
+
+    for trial in range(60):
+        gcnt = int(rng.choice([0, 2, 4, 8]))
+        st = make_node_state(
+            cpu_cap=[int(rng.choice([32000, 96000]))],
+            mem_cap=[262144],
+            gpu_cnt=[gcnt],
+            gpu_type=[int(rng.integers(0, 4)) if gcnt else -1],
+            cpu_type=[int(rng.integers(0, 3))],
+        )
+        gl = np.zeros((1, 8), np.int32)
+        gl[0, :gcnt] = rng.choice([0, 250, 500, 999, 1000], gcnt)
+        st = st._replace(
+            gpu_left=jnp.asarray(gl),
+            cpu_left=jnp.asarray([int(rng.integers(0, 32000))], jnp.int32),
+        )
+        row = jax.tree.map(lambda a: a[0], st)
+        pod = PodSpec(
+            cpu=jnp.int32(int(rng.integers(0, 8000))),
+            mem=jnp.int32(1024),
+            gpu_milli=jnp.int32(int(rng.choice([0, 250, 500, 1000]))),
+            gpu_num=jnp.int32(int(rng.choice([0, 1, 2]))),
+            gpu_mask=jnp.int32(0),
+            pinned=jnp.int32(-1),
+        )
+        a = jax.jit(_pwr_node)(row, pod)
+        b = jax.jit(direct)(row, pod)
+        assert int(a[0]) == int(b[0]) and int(a[1]) == int(b[1]), (
+            trial, gl, pod, int(a[0]), int(b[0]), int(a[1]), int(b[1])
+        )

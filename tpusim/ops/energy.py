@@ -20,22 +20,35 @@ from tpusim.constants import (
 )
 
 
-def node_power(cpu_left, cpu_cap, gpu_left, gpu_cnt, gpu_type, cpu_type):
-    """Returns (cpu_watts, gpu_watts) for one node; vmap over nodes."""
+def gpu_power_watts(gpu_left, gpu_cnt, gpu_type):
+    """GPU watts for one node (ref: resource.go:537-545): fully-idle devices
+    draw idle watts, every other device draws full watts."""
     gpu_idle_w = jnp.asarray(GPU_IDLE_W)
     gpu_full_w = jnp.asarray(GPU_FULL_W)
-    cpu_idle_w = jnp.asarray(CPU_IDLE_W)
-    cpu_full_w = jnp.asarray(CPU_FULL_W)
-    cpu_ncores = jnp.asarray(CPU_NCORES)
-
-    # --- GPU side (ref: resource.go:537-545) ---
     num_idle_gpus = (gpu_left == MILLI).sum().astype(jnp.float32)
     num_working = gpu_cnt.astype(jnp.float32) - num_idle_gpus
     idle_w = jnp.where(gpu_type >= 0, gpu_idle_w[jnp.maximum(gpu_type, 0)], 0.0)
     full_w = jnp.where(gpu_type >= 0, gpu_full_w[jnp.maximum(gpu_type, 0)], 0.0)
-    gpu_watts = idle_w * num_idle_gpus + full_w * num_working
+    return idle_w * num_idle_gpus + full_w * num_working
 
-    # --- CPU side (ref: resource.go:547-559) ---
+
+def gpu_busy_delta_watts(gpu_type):
+    """Per-device watts increase when a fully-idle device becomes working."""
+    gpu_idle_w = jnp.asarray(GPU_IDLE_W)
+    gpu_full_w = jnp.asarray(GPU_FULL_W)
+    return jnp.where(
+        gpu_type >= 0,
+        gpu_full_w[jnp.maximum(gpu_type, 0)] - gpu_idle_w[jnp.maximum(gpu_type, 0)],
+        0.0,
+    )
+
+
+def cpu_power_watts(cpu_left, cpu_cap, cpu_type):
+    """CPU watts for one node (ref: resource.go:547-559): 2 vCPUs per
+    physical core; whole packages flip from idle to full wattage."""
+    cpu_idle_w = jnp.asarray(CPU_IDLE_W)
+    cpu_full_w = jnp.asarray(CPU_FULL_W)
+    cpu_ncores = jnp.asarray(CPU_NCORES)
     real_cores = jnp.ceil(cpu_cap.astype(jnp.float32) / MILLI / 2)
     idle_cores = jnp.floor(cpu_left.astype(jnp.float32) / MILLI / 2)
     working_cores = real_cores - idle_cores
@@ -43,5 +56,12 @@ def node_power(cpu_left, cpu_cap, gpu_left, gpu_cnt, gpu_type, cpu_type):
     num_cpus = jnp.ceil(real_cores / ncores)
     active_cpus = jnp.ceil(working_cores / ncores)
     idle_cpus = num_cpus - active_cpus
-    cpu_watts = cpu_idle_w[cpu_type] * idle_cpus + cpu_full_w[cpu_type] * active_cpus
-    return cpu_watts, gpu_watts
+    return cpu_idle_w[cpu_type] * idle_cpus + cpu_full_w[cpu_type] * active_cpus
+
+
+def node_power(cpu_left, cpu_cap, gpu_left, gpu_cnt, gpu_type, cpu_type):
+    """Returns (cpu_watts, gpu_watts) for one node; vmap over nodes."""
+    return (
+        cpu_power_watts(cpu_left, cpu_cap, cpu_type),
+        gpu_power_watts(gpu_left, gpu_cnt, gpu_type),
+    )

@@ -12,8 +12,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from tpusim.constants import MAX_GPUS_PER_NODE
-from tpusim.ops.energy import node_power
+from tpusim.constants import MILLI
+from tpusim.ops.energy import cpu_power_watts, gpu_busy_delta_watts, gpu_power_watts
 from tpusim.ops.resource import sub_pod
 from tpusim.policies.base import PolicyResult, ScoreContext
 from tpusim.types import NodeState, PodSpec
@@ -21,34 +21,37 @@ from tpusim.types import NodeState, PodSpec
 _NEG_INF = jnp.int32(-(2**31) + 1)  # stands in for Go's math.MinInt64 init
 
 
-def _power(cpu_left, cpu_cap, gpu_left, gpu_cnt, gpu_type, cpu_type):
-    c, g = node_power(cpu_left, cpu_cap, gpu_left, gpu_cnt, gpu_type, cpu_type)
-    return c + g
-
-
 def _pwr_node(row: NodeState, pod: PodSpec):
-    old = _power(
-        row.cpu_left, row.cpu_cap, row.gpu_left, row.gpu_cnt, row.gpu_type, row.cpu_type
+    """Placing a pod changes power through exactly two channels: the CPU
+    package count (recomputed once from cpu_left − pod.cpu) and devices
+    flipping from fully-idle to working. Per-device hypotheticals are thus
+    derived without re-running the whole power model 9 times; watt tables
+    times small integer counts are exact in f32, so the scores equal the
+    direct form (randomized old-vs-new equivalence in
+    tests/test_policies.py::test_pwr_matches_direct_form)."""
+    cpu_old = cpu_power_watts(row.cpu_left, row.cpu_cap, row.cpu_type)
+    gpu_old = gpu_power_watts(row.gpu_left, row.gpu_cnt, row.gpu_type)
+    old = cpu_old + gpu_old
+    cpu_new = cpu_power_watts(row.cpu_left - pod.cpu, row.cpu_cap, row.cpu_type)
+    busy_delta = gpu_busy_delta_watts(row.gpu_type)
+
+    # share-GPU: device d flips idle->working iff it was fully idle AND the
+    # pod actually takes milli from it (zero-milli share pods — num_gpu=1
+    # with a sanitized-to-0 request — change nothing)
+    was_idle = row.gpu_left == MILLI
+    new_per_dev = cpu_new + gpu_old + jnp.where(
+        was_idle & (pod.gpu_milli > 0), busy_delta, 0.0
     )
-
-    def per_dev(d):
-        hyp = row.gpu_left.at[d].add(-pod.gpu_milli)
-        return _power(
-            row.cpu_left - pod.cpu, row.cpu_cap, hyp, row.gpu_cnt, row.gpu_type,
-            row.cpu_type,
-        )
-
-    new_per_dev = jax.vmap(per_dev)(jnp.arange(MAX_GPUS_PER_NODE))
     fits = row.gpu_left >= pod.gpu_milli
     dev_scores = jnp.where(fits, (old - new_per_dev).astype(jnp.int32), _NEG_INF)
     best_dev = jnp.argmax(dev_scores).astype(jnp.int32)
     share_score = jnp.where(fits.any(), dev_scores[best_dev], _NEG_INF)
     share_dev = jnp.where(fits.any(), best_dev, -1).astype(jnp.int32)
 
-    c2, _, g2, _, _ = sub_pod(row.cpu_left, row.mem_left, row.gpu_left, pod)
-    whole_score = (
-        old - _power(c2, row.cpu_cap, g2, row.gpu_cnt, row.gpu_type, row.cpu_type)
-    ).astype(jnp.int32)
+    # whole-GPU / CPU-only: Sub's taken devices flip iff previously idle
+    _, _, _, dev_mask, _ = sub_pod(row.cpu_left, row.mem_left, row.gpu_left, pod)
+    flips = (dev_mask & was_idle).sum().astype(jnp.float32)
+    whole_score = (old - (cpu_new + gpu_old + flips * busy_delta)).astype(jnp.int32)
 
     is_share = pod.is_gpu_share()
     return (
