@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Compare merged sweep results against the reference's cached
+expected-results matrix (the E2E validation step of the reference's
+artifact workflow, experiments/README.md step 3).
+
+    python experiments/compare.py --merged <dir-with-analysis_*_discrete.csv>
+    python experiments/compare.py --merged /tmp/cmp10/merged --metric frag_ratio --at 90
+
+Prints one table per requested metric: mean per (workload, policy) for both
+sides plus the delta. Reference CSVs default to the read-only tree at
+/root/reference; point --expected elsewhere if the artifact lives elsewhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+from pathlib import Path
+
+METRIC_FILES = {
+    "alloc": "analysis_allo_discrete.csv",
+    "frag": "analysis_frag_discrete.csv",
+    "frag_ratio": "analysis_frag_ratio_discrete.csv",
+}
+
+
+def load(path: Path):
+    with open(path, newline="") as f:
+        return list(csv.DictReader(f))
+
+
+def mean(rows, wl, pol, col, tune=None):
+    vals = [
+        float(r[col])
+        for r in rows
+        if r["workload"] == wl
+        and r["sc_policy"] == pol
+        and (tune is None or r.get("tune") == tune)
+        and r.get(col)
+    ]
+    return sum(vals) / len(vals) if vals else None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--merged", required=True, help="dir with analysis_*_discrete.csv")
+    ap.add_argument(
+        "--expected",
+        default="/root/reference/experiments/analysis/expected_results",
+        help="reference expected-results dir",
+    )
+    ap.add_argument("--metric", choices=sorted(METRIC_FILES), default="alloc")
+    ap.add_argument("--at", default="130", help="arrived-load percent column")
+    ap.add_argument(
+        "--tune", default=None,
+        help="restrict to one tuning ratio (required if the merged dir "
+        "holds several)",
+    )
+    args = ap.parse_args()
+
+    fname = METRIC_FILES[args.metric]
+    merged_path = Path(args.merged) / fname
+    if not merged_path.exists():
+        ap.error(f"no {fname} under {args.merged} (run experiments/merge.py first)")
+    ours = load(merged_path)
+    tunes = sorted({r.get("tune", "") for r in ours})
+    tune = args.tune
+    if tune is None:
+        if len(tunes) > 1:
+            ap.error(
+                f"merged dir mixes tuning ratios {tunes}; pass --tune to "
+                "pick one (averaging across tunes is meaningless)"
+            )
+        tune = tunes[0] if tunes else None
+    ref_path = Path(args.expected) / fname
+    ref = load(ref_path) if ref_path.exists() else []
+
+    workloads = sorted({r["workload"] for r in ours})
+    policies = sorted({r["sc_policy"] for r in ours})
+    print(f"== {args.metric} @ {args.at}% arrived load (ref | ours | delta) ==")
+    width = 27
+    print(
+        f"{'workload':28s}"
+        + "".join(f"{p.split('-', 1)[-1]:>{width}s}" for p in policies)
+    )
+    worst, compared = 0.0, 0
+    for wl in workloads:
+        cells = []
+        for pol in policies:
+            r = mean(ref, wl, pol, args.at, tune)
+            o = mean(ours, wl, pol, args.at, tune)
+            if o is None:
+                cells.append(f"{'-':>{width}s}")
+            elif r is None:
+                cells.append(f"{'- |':>12s}{o:8.2f}{'':7s}")
+            else:
+                d = o - r
+                worst = max(worst, abs(d))
+                compared += 1
+                cells.append(f"{r:9.2f} |{o:8.2f} ({d:+5.2f})")
+        print(f"{wl:28s}" + "".join(cells))
+    if compared:
+        print(
+            f"\nmax |delta| over {compared} cells with reference data: "
+            f"{worst:.2f}"
+        )
+    else:
+        print("\n(no overlapping reference cells)")
+
+
+if __name__ == "__main__":
+    main()

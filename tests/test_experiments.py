@@ -109,6 +109,22 @@ def test_run_analysis_merge_plot(tmp_path):
     plot.main()
     assert (figdir / "openb_alloc.png").is_file()
 
+    # compare tool runs over the merged tables (no reference rows for the
+    # tiny trace — prints ours-only cells and says so)
+    import contextlib
+    import io
+
+    cmp_mod = _load("exp_compare", EXP / "compare.py")
+    # the tiny workload tops out at 100% arrived load, so compare at 100
+    sys.argv = ["compare.py", "--merged", str(results_dir), "--at", "100"]
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cmp_mod.main()
+    out = buf.getvalue()
+    assert "tiny_trace" in out and "FGD" in out
+    assert "100.00" in out  # the fully-allocated @100 cell
+    assert "no overlapping reference cells" in out
+
 
 def test_generate_run_scripts(capsys):
     gen = _load("exp_gen", EXP / "generate_run_scripts.py")
