@@ -5,12 +5,14 @@
 Usage: python experiments/generate_run_scripts.py > run_scripts.sh
        bash run_scripts.sh                      # or: xargs -P for parallel
 
-The default sweep covers the reference's full policy/trace grid: 7 policies
-(the artifact's 6 headline ones + 07-PWR) × 21 openb trace variants × 10
-seeds at tuning ratio 1.3 and shuffled pod order = 1470 commands. The
-reference's cached 1020-experiment matrix is the 6-policy × 17-trace subset
-(experiments/README.md "Structure of the 1020 Experiments"); restrict with
---methods / --traces to reproduce it exactly.
+The default sweep covers the reference's full AllMethodList × trace grid:
+10 method rows (6 headline policies, 07-PWR, and the PWR/FGD weighted mixes
+08/11/12) × 21 openb trace variants × 10 seeds at tuning ratio 1.3 and
+shuffled pod order = 2100 commands. The reference's cached 1020-experiment
+matrix is the 6-headline-policy × 17-trace subset; reproduce it with
+
+  --methods 01-Random 02-DotProd 03-GpuClustering 04-GpuPacking \
+            05-BestFit 06-FGD
 """
 
 from __future__ import annotations
@@ -44,7 +46,9 @@ TRACES = [
     "openb_pod_list_multigpu50",
 ]
 
-# (id, policy flags, gpusel, dimext, norm) — ref AllMethodList
+# (id, policy flags, gpusel, dimext, norm) — the reference's AllMethodList
+# rows 01-07 plus the PWR/FGD weighted mixes 08/11/12 (its 09/10 ids are
+# unused there too)
 METHODS = [
     ("01-Random", "-Random 1000", "random", "merge", "max"),
     ("02-DotProd", "-DotProd 1000", "best", "merge", "max"),
@@ -53,6 +57,9 @@ METHODS = [
     ("05-BestFit", "-BestFit 1000", "best", "share", "max"),
     ("06-FGD", "-FGD 1000", "FGDScore", "share", "max"),
     ("07-PWR", "-PWR 1000", "PWRScore", "share", "max"),
+    ("08-PWR_500_FGD_500", "-PWR 500 -FGD 500", "FGDScore", "share", "max"),
+    ("11-PWR_100_FGD_900", "-PWR 100 -FGD 900", "FGDScore", "share", "max"),
+    ("12-PWR_50_FGD_950", "-PWR 50 -FGD 950", "FGDScore", "share", "max"),
 ]
 
 

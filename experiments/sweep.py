@@ -11,7 +11,7 @@ report values depend on sweep order (see tpusim/sim/driver.py).
 
     python experiments/sweep.py --traces openb_pod_list_default \
         --methods 06-FGD 01-Random --seeds 3
-    python experiments/sweep.py            # full 7×21×10 grid
+    python experiments/sweep.py            # full 10-method × 21 × 10 grid
     python experiments/sweep.py --fast     # skip per-event report lines
 
 Each experiment writes the same per-directory outputs as experiments/run.py
