@@ -140,20 +140,30 @@ def test_pod_type_partition():
         assert np.array_equal(cat(f)[tid], np.asarray(getattr(pods, f)))
 
 
-def test_table_engine_report_rows_match_sequential():
+@pytest.mark.parametrize(
+    "policy,gpu_sel",
+    [
+        ("FGDScore", "FGDScore"),
+        ("BestFitScore", "best"),
+        ("PWRScore", "PWRScore"),
+        ("GpuPackingScore", "worst"),
+    ],
+    ids=lambda p: str(p),
+)
+def test_table_engine_report_rows_match_sequential(policy, gpu_sel):
     """report=True: per-event frag/alloc/power rows must equal the
     sequential engine's (same per-node kernels, same reduce order)."""
     rng = np.random.default_rng(23)
     state, tp = random_cluster(rng, num_nodes=12)
     pods = random_pods(rng, num_pods=30)
     ev_kind, ev_pod = _events_with_deletes(30, rng)
-    policies = [(make_policy("FGDScore"), 1000)]
+    policies = [(make_policy(policy), 1000)]
     key = jax.random.PRNGKey(9)
     rank = jnp.asarray(rng.permutation(12).astype(np.int32))
 
-    seq = make_replay(policies, gpu_sel="FGDScore", report=True)
+    seq = make_replay(policies, gpu_sel=gpu_sel, report=True)
     r0 = seq(state, pods, ev_kind, ev_pod, tp, key, rank)
-    tab = make_table_replay(policies, gpu_sel="FGDScore", report=True)
+    tab = make_table_replay(policies, gpu_sel=gpu_sel, report=True)
     r1 = tab(state, pods, build_pod_types(pods), ev_kind, ev_pod, tp, key, rank)
     _assert_equal(r0, r1)
     for a, b in zip(r0.metrics, r1.metrics):
