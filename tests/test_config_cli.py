@@ -167,6 +167,32 @@ def test_scheduler_config_rejects_unknown(tmp_path):
         load_scheduler_config(str(p))
 
 
+def test_scheduler_config_rejects_extenders_and_pct(tmp_path):
+    """Configs asking for capabilities this build doesn't have (extender
+    protocol, partial node scoring) must fail loudly, not silently compute
+    something different (ref accepts both: simulator.go:185-197 extenders;
+    utils.go:234 forces percentageOfNodesToScore=100)."""
+    from tpusim.config.scheduler import SchedulerConfigError, load_scheduler_config
+
+    base = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1beta1",
+        "kind": "KubeSchedulerConfiguration",
+    }
+    p = tmp_path / "sc.yaml"
+
+    p.write_text(yaml.dump({**base, "percentageOfNodesToScore": 50}))
+    with pytest.raises(SchedulerConfigError, match="percentageOfNodesToScore"):
+        load_scheduler_config(str(p))
+    p.write_text(yaml.dump({**base, "percentageOfNodesToScore": 100}))
+    load_scheduler_config(str(p))  # explicit 100 is fine
+
+    p.write_text(
+        yaml.dump({**base, "extenders": [{"urlPrefix": "http://x/"}]})
+    )
+    with pytest.raises(SchedulerConfigError, match="extender"):
+        load_scheduler_config(str(p))
+
+
 # ---- queue sorts (pkg/algo) ----
 
 

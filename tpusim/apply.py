@@ -8,9 +8,9 @@ pipeline, driving the array-state Simulator:
   → snapshot export → inflation eval → new-workload swap → deschedule +
   reschedule → per-app scheduling → success/failure verdict.
 
-Env caps MaxCPU/MaxMemory (apply.go:550-631 satisfyResourceSetting) are
-honored for the final verdict; the reference's MaxVG cap belongs to the
-open-local storage extension, which this build does not model yet.
+Env caps MaxCPU/MaxMemory/MaxVG (apply.go:550-631 satisfyResourceSetting)
+are honored for the final verdict; MaxVG reads the open-local VG totals
+from the node storage annotations (see _satisfy_resource_setting).
 """
 
 from __future__ import annotations

@@ -96,6 +96,23 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
         raise SchedulerConfigError(
             f"unsupported apiVersion {doc.get('apiVersion')}"
         )
+    # The reference accepts but overrides these (utils.go:234-235 forces
+    # percentageOfNodesToScore=100; extenders pass through to the vendored
+    # scheduler, simulator.go:185-197). This build has no extender protocol
+    # and always scores every node, so reject configs that ask otherwise
+    # rather than silently computing something different.
+    pct = doc.get("percentageOfNodesToScore")
+    if pct is not None and int(pct) != 100:
+        raise SchedulerConfigError(
+            f"percentageOfNodesToScore={pct} unsupported: this simulator "
+            "always scores 100% of nodes (the reference forces the same, "
+            "utils.go:234)"
+        )
+    if doc.get("extenders"):
+        raise SchedulerConfigError(
+            "scheduler extenders are not supported: there is no external "
+            "extender protocol over the array state"
+        )
     profiles = doc.get("profiles") or []
     if not profiles:
         return default_scheduler_config()
