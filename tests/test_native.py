@@ -41,6 +41,49 @@ def test_native_degenerate_pods():
         )
 
 
+def test_eval_series_matches_per_event_loop():
+    """bellman_series (one native call over the event stream) must equal the
+    per-event eval() bookkeeping it replaced (driver._bellman_series's old
+    loop): same touched-node updates, same memo evolution."""
+    t = typical_rows_gpu_host()
+    rng = np.random.default_rng(3)
+    n, e = 12, 60
+    cpu_left = rng.choice([16000, 32000, 64000], n).astype(np.int32)
+    gpu_left = rng.choice([0, 250, 500, 1000], (n, 8)).astype(np.int32)
+    gpu_type = rng.integers(-1, 4, n).astype(np.int32)
+    ev_node = rng.integers(-1, n, e).astype(np.int32)
+    ev_dev = np.zeros((e, 8), bool)
+    for k in range(e):
+        ev_dev[k, rng.integers(0, 8)] = True
+    ev_sign = rng.choice([1, -1], e).astype(np.int8)
+    ev_cpu = rng.choice([0, 1000, 4000], e).astype(np.int32)
+    ev_gpu = rng.choice([0, 100, 250], e).astype(np.int32)
+
+    native = BellmanEvaluator(t)
+    got = native.eval_series(
+        cpu_left, gpu_left, gpu_type, ev_node, ev_dev, ev_sign, ev_cpu, ev_gpu
+    )
+
+    # reference loop through eval() on a fresh evaluator (fresh memo)
+    ref_ev = BellmanEvaluator(t)
+    cpu, gpu = cpu_left.copy(), gpu_left.copy()
+    val = np.array(
+        [ref_ev.eval(int(cpu[i]), gpu[i], int(gpu_type[i])) for i in range(n)]
+    )
+    total = float(val.sum())
+    want = np.empty(e)
+    for k in range(e):
+        node = int(ev_node[k])
+        if node >= 0:
+            cpu[node] -= int(ev_sign[k]) * ev_cpu[k]
+            gpu[node][ev_dev[k]] -= int(ev_sign[k]) * ev_gpu[k]
+            total -= float(val[node])
+            val[node] = ref_ev.eval(int(cpu[node]), gpu[node], int(gpu_type[node]))
+            total += float(val[node])
+        want[k] = total
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-9)
+
+
 def test_memo_reuse_matches_python_order_dependence():
     """Memo-carrying evaluations must match a Python memo evolved in the
     same order (memoized values embed first-visit cum_prob context)."""

@@ -58,6 +58,21 @@ def _ensure_lib():
             ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int32,
         ]
+        lib.bellman_series.restype = ctypes.c_int32
+        lib.bellman_series.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_double),
+        ]
         lib.bellman_memo_size.restype = ctypes.c_int64
         lib.bellman_memo_size.argtypes = [ctypes.c_void_p]
         lib.bellman_free.argtypes = [ctypes.c_void_p]
@@ -117,6 +132,73 @@ class BellmanEvaluator:
             max_depth=self._max_depth,
             memo=self._pymemo,
         )
+
+    def eval_series(
+        self,
+        cpu_left,
+        gpu_left,
+        gpu_type,
+        ev_node,
+        ev_dev,
+        ev_sign,
+        ev_cpu,
+        ev_gpu,
+    ):
+        """Whole-event-stream cluster value series in one native call.
+
+        cpu_left i32[N], gpu_left i32[N,8], gpu_type i32[N] are the INITIAL
+        node state; ev_node i32[E] (-1 = untouched event), ev_dev bool[E,8],
+        ev_sign i8[E] (+1 create / -1 delete), ev_cpu/ev_gpu i32[E] the
+        event pod's milli requests. Returns f64[E]: the cluster total after
+        each event (the `(bellman)` report series, analysis.go:110).
+        """
+        import numpy as np
+
+        cpu_left = np.ascontiguousarray(cpu_left, np.int32)
+        gpu_left = np.ascontiguousarray(gpu_left, np.int32)
+        gpu_type = np.ascontiguousarray(gpu_type, np.int32)
+        ev_node = np.ascontiguousarray(ev_node, np.int32)
+        ev_dev = np.ascontiguousarray(ev_dev, np.uint8)
+        ev_sign = np.ascontiguousarray(ev_sign, np.int8)
+        ev_cpu = np.ascontiguousarray(ev_cpu, np.int32)
+        ev_gpu = np.ascontiguousarray(ev_gpu, np.int32)
+        n, e = len(cpu_left), len(ev_node)
+        out = np.empty(e, np.float64)
+        if self._handle is not None:
+            ptr = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
+            _lib.bellman_series(
+                self._handle,
+                n,
+                ptr(cpu_left, ctypes.c_int32),
+                ptr(gpu_left, ctypes.c_int32),
+                ptr(gpu_type, ctypes.c_int32),
+                e,
+                ptr(ev_node, ctypes.c_int32),
+                ptr(ev_dev, ctypes.c_uint8),
+                ptr(ev_sign, ctypes.c_int8),
+                ptr(ev_cpu, ctypes.c_int32),
+                ptr(ev_gpu, ctypes.c_int32),
+                ptr(out, ctypes.c_double),
+            )
+            return out
+        # pure-Python fallback: same bookkeeping through eval()
+        cpu = cpu_left.copy()
+        gpu = gpu_left.copy()
+        val = np.array(
+            [self.eval(int(cpu[i]), gpu[i], int(gpu_type[i])) for i in range(n)]
+        )
+        total = float(val.sum())
+        for k in range(e):
+            node = int(ev_node[k])
+            if node >= 0:
+                sign = int(ev_sign[k])
+                cpu[node] -= sign * ev_cpu[k]
+                gpu[node][ev_dev[k].astype(bool)] -= sign * ev_gpu[k]
+                total -= float(val[node])
+                val[node] = self.eval(int(cpu[node]), gpu[node], int(gpu_type[node]))
+                total += float(val[node])
+            out[k] = total
+        return out
 
     def memo_size(self) -> int:
         if self._handle is not None:

@@ -30,13 +30,8 @@ from tpusim.io.trace import (
 )
 from tpusim.policies import make_policy
 from tpusim.sim.engine import make_replay
-from tpusim.sim.reports import (
-    LogSink,
-    cluster_analysis_block,
-    report_alloc_lines,
-    report_frag_line,
-    report_power_line,
-)
+from tpusim.sim.fetch import device_fetch
+from tpusim.sim.reports import LogSink, cluster_analysis_block
 from tpusim.sim.typical import (
     TypicalPodsConfig,
     get_skyline_pods,
@@ -112,6 +107,7 @@ class Simulator:
         self.typical: Optional[TypicalPods] = None
         self.node_total_milli_cpu = int(sum(n.cpu_milli for n in self.nodes))
         self.node_total_milli_gpu = int(sum(n.gpu * MILLI for n in self.nodes))
+        self.total_gpus = int(sum(n.gpu for n in self.nodes))
         self._policy_fns = [
             (
                 make_policy(
@@ -245,6 +241,9 @@ class Simulator:
         # exact no-ops, and a stable T means sweeps across trace variants
         # (whose distribution sizes differ) reuse one compiled replay
         self.typical = pad_typical_pods(self.typical)
+        # host copy for the native Bellman evaluator, one transfer
+
+        self._typical_host = device_fetch(self.typical)
         # The Bellman evaluator (and its memo) is scoped to ONE experiment
         # run, like the reference's fragMemo (simulator.go:58): memoized
         # values embed the cum_prob cutoff context of their first
@@ -304,12 +303,16 @@ class Simulator:
         """Run the compiled replay for `pods` on `state`. Returns
         (replay output, events, unscheduled list). Pods carrying the
         simon/pod-unscheduled annotation are skipped by the event loop and
-        reported as failed (simulator.go:391-399)."""
+        reported as failed (simulator.go:391-399). The full replay output
+        moves to host in ONE transfer (fetch.device_fetch) — per-leaf
+        readbacks pay ~100 ms tunnel latency each on the axon backend."""
+
         specs = pods_to_specs(pods, self.node_index)
         ev_kind, ev_pod = build_events(pods, use_timestamps)
         out = self.run_events(
             state, specs, jnp.asarray(ev_kind), jnp.asarray(ev_pod), key
         )
+        out = device_fetch(out)
         if self.cfg.report_per_event and out.metrics is not None:
             self._emit_event_reports(
                 out.metrics, pods, ev_kind, ev_pod,
@@ -451,12 +454,15 @@ class Simulator:
         self.log.info(f"(Inflation) Num of Total Pods: {len(extra)}")
         state = jax.tree.map(jnp.asarray, self.last_result.state)
         specs = pods_to_specs(extra)
-        out = self.run_events(
-            state,
-            specs,
-            jnp.zeros(len(extra), jnp.int32),
-            jnp.arange(len(extra), dtype=jnp.int32),
-            jax.random.PRNGKey(self.cfg.inflation_seed),
+
+        out = device_fetch(
+            self.run_events(
+                state,
+                specs,
+                jnp.zeros(len(extra), jnp.int32),
+                jnp.arange(len(extra), dtype=jnp.int32),
+                jax.random.PRNGKey(self.cfg.inflation_seed),
+            )
         )
         failed = int(np.asarray(out.placed_node < 0).sum())
         self.log.info(f"[ReportFailedPods] {failed} unscheduled inflation pods")
@@ -509,8 +515,11 @@ class Simulator:
         vspecs = jax.tree.map(lambda a: a[jnp.asarray(v)], specs)
         ev_kind = jnp.zeros(len(victims), jnp.int32)
         ev_pod = jnp.arange(len(victims), dtype=jnp.int32)
-        out = self.run_events(
-            state, vspecs, ev_kind, ev_pod, jax.random.PRNGKey(self.cfg.seed + 1)
+
+        out = device_fetch(
+            self.run_events(
+                state, vspecs, ev_kind, ev_pod, jax.random.PRNGKey(self.cfg.seed + 1)
+            )
         )
         placed_v = np.asarray(out.placed_node)
         mask_v = np.asarray(out.dev_mask)
@@ -529,16 +538,21 @@ class Simulator:
     def _bellman_series(self, start_state, pods, ev_kind, ev_pod, out):
         """Per-event cluster Bellman frag (ref: the `(bellman)` [Report]
         variant, analysis.go:110): reconstruct each event's touched node
-        host-side from the replay's (event_node, event_dev) telemetry and
-        update only that node's memoized value — mathematically equal to the
-        reference's per-event full-cluster sweep because the value function
-        depends on node state alone."""
+        from the replay's (event_node, event_dev) telemetry and update only
+        that node's memoized value — mathematically equal to the reference's
+        per-event full-cluster sweep because the value function depends on
+        node state alone. The whole event stream is evaluated in ONE native
+        call (BellmanEvaluator.eval_series) instead of per-event ctypes
+        round-trips."""
         from tpusim.sim.engine import EV_CREATE
 
         if self._bellman_eval is None:
             from tpusim.native import BellmanEvaluator
 
-            t = self.typical
+            t = getattr(self, "_typical_host", None)
+            if t is None:
+
+                t = self._typical_host = device_fetch(self.typical)
             self._bellman_eval = BellmanEvaluator(
                 list(
                     zip(
@@ -550,33 +564,26 @@ class Simulator:
                     )
                 )
             )
-        ev = self._bellman_eval
-        cpu_left = np.asarray(start_state.cpu_left).copy()
-        gpu_left = np.asarray(start_state.gpu_left).copy()
-        gpu_type = np.asarray(start_state.gpu_type)
-
-        def node_val(i):
-            return ev.eval(int(cpu_left[i]), gpu_left[i], int(gpu_type[i]))
-
-        per_node = np.array([node_val(i) for i in range(len(cpu_left))])
-        total = float(per_node.sum())
-        ev_node = np.asarray(out.event_node)
-        ev_dev = np.asarray(out.event_dev)
         kinds = np.asarray(ev_kind)
         ev_pods = np.asarray(ev_pod)
-        series = np.empty(len(kinds))
-        for e in range(len(kinds)):
-            node = int(ev_node[e])
-            if node >= 0:
-                p = pods[int(ev_pods[e])]
-                sign = 1 if kinds[e] == EV_CREATE else -1
-                cpu_left[node] -= sign * p.cpu_milli
-                gpu_left[node][ev_dev[e]] -= sign * p.gpu_milli
-                total -= float(per_node[node])
-                per_node[node] = node_val(node)
-                total += float(per_node[node])
-            series[e] = total
-        return series
+        pod_cpu = np.fromiter(
+            (p.cpu_milli for p in pods), np.int32, count=len(pods)
+        )
+        pod_gpu = np.fromiter(
+            (p.gpu_milli for p in pods), np.int32, count=len(pods)
+        )
+
+        start_state = device_fetch(start_state)
+        return self._bellman_eval.eval_series(
+            np.asarray(start_state.cpu_left),
+            np.asarray(start_state.gpu_left),
+            np.asarray(start_state.gpu_type),
+            np.asarray(out.event_node),
+            np.asarray(out.event_dev),
+            np.where(kinds == EV_CREATE, 1, -1).astype(np.int8),
+            pod_cpu[ev_pods],
+            pod_gpu[ev_pods],
+        )
 
     def _emit_event_reports(
         self, m, pods=None, ev_kind=None, ev_pod=None, failed=None,
@@ -586,46 +593,45 @@ class Simulator:
         420; failures echo the deletePod rollback line :354), then the
         frag/alloc/power report lines incl. the bellman variant
         (simulator.go:426-427, analysis.go:109-110). Skip events
-        (pod-unscheduled annotation) emit nothing (simulator.go:391-399)."""
+        (pod-unscheduled annotation) emit nothing (simulator.go:391-399).
+        All line families format vectorized over the event axis
+        (reports.batch_event_report_msgs) and append in one bulk call."""
         from tpusim.sim.engine import EV_CREATE, EV_DELETE
-        from tpusim.sim.reports import report_bellman_line
+        from tpusim.sim.reports import batch_event_report_msgs
 
         amounts = np.asarray(m.frag_amounts)
-        un = np.asarray(m.used_nodes)
-        ug = np.asarray(m.used_gpus)
-        um = np.asarray(m.used_gpu_milli)
-        uc = np.asarray(m.used_cpu_milli)
-        ag = np.asarray(m.arrived_gpu_milli)
-        ac = np.asarray(m.arrived_cpu_milli)
-        pc = np.asarray(m.power_cpu)
-        pg = np.asarray(m.power_gpu)
-        total_gpus = int(np.asarray(self.init_state.gpu_cnt).sum())
+        total_gpus = self.total_gpus
         kinds = None if ev_kind is None else np.asarray(ev_kind)
-        ev_pods = None if ev_pod is None else np.asarray(ev_pod)
         bellman = None
         if out is not None and start_state is not None and pods is not None:
             bellman = self._bellman_series(start_state, pods, ev_kind, ev_pod, out)
-        for e in range(amounts.shape[0]):
-            if kinds is not None:
-                kind = int(kinds[e])
-                if kind not in (EV_CREATE, EV_DELETE):
-                    continue
-                pi = int(ev_pods[e])
-                p = pods[pi]
-                verb = "create" if kind == EV_CREATE else "delete"
-                self.log.info(f"[{e}] attempt to {verb} pod({p.name})")
-                if kind == EV_CREATE and failed is not None and failed[pi]:
-                    self.log.info(
-                        f"[deletePod] attempt to delete a non-scheduled pod({p.name})"
-                    )
-            report_frag_line(self.log, amounts[e])
-            if bellman is not None:
-                report_bellman_line(self.log, float(bellman[e]), float(amounts[e].sum()))
-            report_alloc_lines(
-                self.log, int(un[e]), int(ug[e]), int(um[e]), total_gpus,
-                int(ag[e]), int(uc[e]), int(ac[e]),
+        pod_names = ev_failed = None
+        if kinds is not None and pods is not None:
+            names = np.array([p.name for p in pods])
+            ev_pods = np.asarray(ev_pod)
+            pod_names = names[ev_pods]
+            if failed is not None:
+                ev_failed = np.asarray(failed)[ev_pods]
+        self.log.info_many(
+            batch_event_report_msgs(
+                amounts,
+                total_gpus,
+                np.asarray(m.used_nodes),
+                np.asarray(m.used_gpus),
+                np.asarray(m.used_gpu_milli),
+                np.asarray(m.arrived_gpu_milli),
+                np.asarray(m.used_cpu_milli),
+                np.asarray(m.arrived_cpu_milli),
+                np.asarray(m.power_cpu),
+                np.asarray(m.power_gpu),
+                bellman=bellman,
+                kinds=kinds,
+                ev_create=EV_CREATE,
+                ev_delete=EV_DELETE,
+                pod_names=pod_names,
+                failed=ev_failed,
             )
-            report_power_line(self.log, float(pc[e]), float(pg[e]))
+        )
 
     def alloc_maps(self, state: NodeState):
         """Cluster requested/allocatable per resource (ref: alloc.go:90-127
