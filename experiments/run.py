@@ -72,6 +72,13 @@ def get_args(argv=None):
     p.add_argument("-dimext", "--dim-ext-method", type=str, default="share")
     p.add_argument("-norm", "--norm-method", type=str, default="max")
     p.add_argument(
+        "--use-timestamps",
+        action="store_true",
+        help="annotation-driven create+delete replay: expand each pod into "
+        "creation (+deletion, when deletion_time is set) events stable-"
+        "sorted by timestamp (ref: simulator.go:672-717)",
+    )
+    p.add_argument(
         "--no-per-event-report",
         action="store_true",
         help="skip per-event [Report]/[Alloc]/[Power] lines (faster, "
@@ -117,6 +124,7 @@ def emit_configs(args, policies, outdir: Path):
             "cluster": {"customConfig": str(args.trace)},
             "customConfig": {
                 "shufflePod": args.shuffle_pod.lower() == "true",
+                "useTimestamps": args.use_timestamps,
                 "workloadInflationConfig": {
                     "ratio": args.workload_inflation_ratio,
                     "seed": args.workload_inflation_seed,
@@ -199,6 +207,7 @@ def run_experiment(args) -> dict:
         deschedule_policy=args.deschedule_policy,
         seed=args.workload_tuning_seed,
         report_per_event=not args.no_per_event_report,
+        use_timestamps=args.use_timestamps,
         typical_pods=TypicalPodsConfig(
             is_involved_cpu_pods=args.is_involved_cpu_pods.lower() == "true",
             pod_popularity_threshold=args.pod_popularity_threshold,

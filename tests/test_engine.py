@@ -279,3 +279,83 @@ class TestBellmanSeries:
         # and the reconstruction matches the device end state exactly
         np.testing.assert_array_equal(cpu, np.asarray(out.state.cpu_left))
         np.testing.assert_array_equal(gpu, np.asarray(out.state.gpu_left))
+
+
+class TestTimestampReplay:
+    """Annotation-driven create+delete replay (ref: simulator.go:672-717):
+    event expansion, stable timestamp sort, and end-to-end resource reuse
+    after deletions."""
+
+    def test_build_events_expansion_and_stable_sort(self):
+        from tpusim.io.trace import build_events
+        from tpusim.sim.engine import EV_SKIP
+
+        pods = [
+            PodRow("a", 1000, 0, 0, 0, creation_time=5, deletion_time=10),
+            PodRow("b", 1000, 0, 0, 0, creation_time=5),  # tie with a: stable
+            PodRow("c", 1000, 0, 0, 0, creation_time=0),  # zero sorts first
+            PodRow("d", 1000, 0, 0, 0, creation_time=7, deletion_time=8),
+            PodRow("e", 1000, 0, 0, 0, creation_time=6, unscheduled=True,
+                   deletion_time=9),
+        ]
+        kind, idx = build_events(pods, use_timestamps=True)
+        # timeline: c@0, a@5, b@5 (stable: a appended first), e@6 (skip,
+        # no deletion event for an unscheduled pod — the reference skips
+        # both its events at processing, simulator.go:391-399), d@7,
+        # d-delete@8, a-delete@10
+        assert [int(k) for k in kind] == [
+            EV_CREATE, EV_CREATE, EV_CREATE, EV_SKIP, EV_CREATE,
+            EV_DELETE, EV_DELETE,
+        ]
+        assert [int(i) for i in idx] == [2, 0, 1, 4, 3, 3, 0]
+
+    def test_build_events_no_deletion_without_timestamp(self):
+        from tpusim.io.trace import build_events
+
+        pods = [PodRow("a", 1000, 0, 0, 0, creation_time=3)]
+        kind, idx = build_events(pods, use_timestamps=True)
+        assert len(kind) == 1 and int(kind[0]) == EV_CREATE
+
+    def test_timestamp_replay_frees_resources(self):
+        """A full-GPU pod deleted mid-stream must make room for a later
+        arrival that would otherwise be unschedulable."""
+        nodes = [NodeRow("n0", 16000, 65536, 1, "V100M16")]
+        pods = [
+            PodRow("first", 1000, 1024, 1, 1000, creation_time=1,
+                   deletion_time=5),
+            PodRow("second", 1000, 1024, 1, 1000, creation_time=9),
+        ]
+        cfg = SimulatorConfig(
+            policies=(("BestFitScore", 1000),), use_timestamps=True
+        )
+        sim = Simulator(nodes, cfg)
+        sim.set_workload_pods(pods)
+        res = sim.run()
+        assert not res.unscheduled_pods
+        assert res.events == 3  # create, delete, create
+        # "first" was deleted (placed_node reflects final placement state)
+        assert res.placed_node[0] == -1 and res.placed_node[1] == 0
+        assert int(np.asarray(res.state.gpu_left).sum()) == 0  # second holds it
+
+        # without the knob the same workload cannot fit both pods
+        sim2 = Simulator(nodes, SimulatorConfig(policies=(("BestFitScore", 1000),)))
+        sim2.set_workload_pods(pods)
+        res2 = sim2.run()
+        assert len(res2.unscheduled_pods) == 1
+
+    def test_simon_cr_knob_reaches_simulator_config(self, tmp_path):
+        from tpusim.config.simon import parse_simon_cr
+
+        doc = {
+            "apiVersion": "simon/v1alpha1",
+            "kind": "Config",
+            "spec": {
+                "cluster": {"customConfig": str(tmp_path)},
+                "customConfig": {"useTimestamps": True},
+            },
+        }
+        cr = parse_simon_cr(doc)
+        assert cr.custom_config.use_timestamps is True
+        assert parse_simon_cr(
+            {**doc, "spec": {**doc["spec"], "customConfig": {}}}
+        ).custom_config.use_timestamps is False

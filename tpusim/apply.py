@@ -55,12 +55,12 @@ class Applier:
         self.sched_cfg: SchedulerConfig = load_scheduler_config(
             options.default_scheduler_config
         )
-        if self.cr.kube_config:
-            raise NotImplementedError(
-                "real-cluster kubeConfig mode needs a live Kubernetes API; "
-                "this simulator build supports customConfig clusters "
-                "(ref parity: CreateClusterResourceFromClient)"
-            )
+        # kubeConfig mode: the reference connects a kube-client and lists
+        # the cluster's objects (CreateClusterResourceFromClient,
+        # simulator.go:746-830). This build preserves the capability via a
+        # `kubectl get ... -o yaml` dump at the kubeConfig path — a
+        # credential file pointing at a live API server is rejected inside
+        # load_cluster_from_dump with guidance.
 
     def _simulator_config(self) -> SimulatorConfig:
         cc = self.cr.custom_config
@@ -77,6 +77,7 @@ class Applier:
             typical_pods=cc.typical_pods,
             deschedule_ratio=cc.deschedule.ratio,
             deschedule_policy=cc.deschedule.policy,
+            use_timestamps=cc.use_timestamps,
         )
 
     def _load_apps(self, node_names: Sequence[str]) -> List[tuple]:
@@ -107,7 +108,16 @@ class Applier:
         return apps
 
     def run(self, out=sys.stdout) -> SimulateResult:
-        cluster = load_cluster_from_dir(self.cr.custom_cluster)
+        if self.cr.kube_config:
+            from tpusim.io.k8s_yaml import load_cluster_from_dump
+
+            cluster = load_cluster_from_dump(self.cr.kube_config)
+            if not cluster.nodes:
+                raise ValueError(
+                    f"no Node objects in cluster dump {self.cr.kube_config}"
+                )
+        else:
+            cluster = load_cluster_from_dir(self.cr.custom_cluster)
         if not cluster.nodes:
             raise ValueError(f"no Node manifests under {self.cr.custom_cluster}")
         cc = self.cr.custom_config

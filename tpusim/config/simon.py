@@ -64,6 +64,14 @@ class CustomConfig:
     new_workload_config: str = ""
     deschedule: DescheduleConfig = field(default_factory=DescheduleConfig)
     typical_pods: TypicalPodsConfig = field(default_factory=TypicalPodsConfig)
+    # Annotation-driven create+delete replay (ref: simulator.go:672-717).
+    # The reference has no CR knob for this — the mode is implied by
+    # creation-time/deletion-time annotations being present on the pods
+    # (its experiment pipeline strips them, pod_csv_to_yaml.py:119-120,
+    # which degrades the stable timestamp sort to list order). Since this
+    # build ingests traces that always carry timestamps, the switch is
+    # explicit.
+    use_timestamps: bool = False
 
 
 @dataclass
@@ -144,6 +152,7 @@ def parse_simon_cr(doc: dict, base_dir: str = ".") -> SimonCR:
             policy=str(desch.get("policy") or ""),
         ),
         typical_pods=_typical(cc_raw.get("typicalPodsConfig") or {}),
+        use_timestamps=bool(cc_raw.get("useTimestamps", False)),
     )
 
     apps = []
@@ -162,6 +171,8 @@ def parse_simon_cr(doc: dict, base_dir: str = ".") -> SimonCR:
         )
     if custom_cluster and not os.path.isabs(custom_cluster):
         custom_cluster = os.path.join(base_dir, custom_cluster)
+    if kube_config and not os.path.isabs(kube_config):
+        kube_config = os.path.join(base_dir, kube_config)
     return SimonCR(
         name=(doc.get("metadata") or {}).get("name", ""),
         custom_cluster=custom_cluster,

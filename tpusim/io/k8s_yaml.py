@@ -302,6 +302,58 @@ def load_cluster_from_dir(path: str) -> ClusterResource:
     return res
 
 
+ANNO_CONFIG_MIRROR = "kubernetes.io/config.mirror"
+ANNO_CONFIG_SOURCE = "kubernetes.io/config.source"
+
+
+def is_static_pod(obj: dict) -> bool:
+    """A pod whose config source is not the API server (ref:
+    kubetypes.IsStaticPod, used by CreateClusterResourceFromClient at
+    simulator.go:766-771 to decide which raw pods survive ingestion)."""
+    ann = (obj.get("metadata") or {}).get("annotations") or {}
+    src = ann.get(ANNO_CONFIG_SOURCE, "")
+    return bool(src and src != "api") or ANNO_CONFIG_MIRROR in ann
+
+
+def load_cluster_from_dump(path: str) -> ClusterResource:
+    """Real-cluster snapshot ingestion: a `kubectl get
+    nodes,pods,deployments,... -o yaml` dump file (or a directory of such
+    files) → ClusterResource.
+
+    Preserves the capability of the reference's kubeConfig mode
+    (CreateClusterResourceFromClient, simulator.go:746-830) without a live
+    API server, with the same object semantics: every Node is kept; raw
+    Pods are kept only when static (non-static pods are dropped because the
+    workload objects re-expand into fresh pods that the simulation
+    re-schedules — simulator.go:759-771); workload controllers
+    (Deployment/RS/RC/Job/CronJob/StatefulSet/DaemonSet) expand as usual.
+
+    `kind: List` envelopes (kubectl's multi-object output) are flattened.
+    A kubeconfig credential file is rejected with guidance — it names a
+    live cluster this environment cannot reach.
+    """
+    paths = yaml_files_in_dir(path) if os.path.isdir(path) else [path]
+    objs: List[dict] = []
+    for obj in load_objects(paths):
+        if obj.get("kind") == "List":
+            objs.extend(
+                i
+                for i in obj.get("items") or []
+                if isinstance(i, dict) and i.get("kind")
+            )
+        elif obj.get("kind") == "Config" and "clusters" in obj:
+            raise ValueError(
+                f"{path} is a kubeconfig credential file; this build cannot "
+                "reach a live API server. Ingest a cluster dump instead: "
+                "kubectl get nodes,pods,deployments,statefulsets,daemonsets "
+                "-A -o yaml > dump.yaml"
+            )
+        else:
+            objs.append(obj)
+    objs = [o for o in objs if o.get("kind") != "Pod" or is_static_pod(o)]
+    return load_cluster_from_objects(objs)
+
+
 def load_cluster_from_objects(objs: Sequence[dict]) -> ClusterResource:
     res = ClusterResource()
     for obj in objs:
