@@ -244,11 +244,129 @@ spec:
     assert objs[0]["metadata"]["name"] == "demo-web"
     assert objs[0]["spec"]["replicas"] == 2
 
+    # genuinely unsupported directives still fail loudly with the file name
     (chart / "templates" / "loop.yaml").write_text(
-        "{{ range .Values.items }}\n{{ end }}\n"
+        '{{ lookup "v1" "Pod" "ns" "x" }}\n'
     )
-    with pytest.raises(ChartError):
+    with pytest.raises(ChartError, match="loop.yaml"):
         chart_objects("demo", str(chart))
+
+
+def test_chart_render_full_engine(tmp_path):
+    """helm-create-style chart: helpers, include, if/with/range, variables,
+    nindent/toYaml pipelines — rendered to the same docs `helm template`
+    produces (ref engine: pkg/chart/chart.go:40-140)."""
+    from tpusim.io.chart import chart_objects, render_chart
+
+    chart = tmp_path / "web"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text(
+        "name: web\nversion: 0.1.0\nappVersion: '2.4'\n"
+    )
+    (chart / "values.yaml").write_text(
+        """nameOverride: ""
+replicaCount: 3
+autoscaling:
+  enabled: false
+image:
+  repository: nginx
+  tag: ""
+resources:
+  requests:
+    cpu: 250m
+    memory: 64Mi
+nodeSelector:
+  disktype: ssd
+service:
+  enabled: true
+  ports: [80, 443]
+"""
+    )
+    (chart / "templates" / "_helpers.tpl").write_text(
+        """{{/* boilerplate comment */}}
+{{- define "web.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+{{- define "web.fullname" -}}
+{{- printf "%s-%s" .Release.Name (include "web.name" .) | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+{{- define "web.labels" -}}
+app: {{ include "web.name" . }}
+release: {{ .Release.Name }}
+{{- end -}}
+"""
+    )
+    (chart / "templates" / "deployment.yaml").write_text(
+        """apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {{ include "web.fullname" . }}
+  labels:
+    {{- include "web.labels" . | nindent 4 }}
+spec:
+  {{- if not .Values.autoscaling.enabled }}
+  replicas: {{ .Values.replicaCount }}
+  {{- end }}
+  template:
+    spec:
+      containers:
+        - name: {{ .Chart.Name }}
+          image: "{{ .Values.image.repository }}:{{ .Values.image.tag | default .Chart.AppVersion }}"
+          resources:
+            {{- toYaml .Values.resources | nindent 12 }}
+      {{- with .Values.nodeSelector }}
+      nodeSelector:
+        {{- toYaml . | nindent 8 }}
+      {{- end }}
+"""
+    )
+    (chart / "templates" / "service.yaml").write_text(
+        """{{- if .Values.service.enabled }}
+apiVersion: v1
+kind: Service
+metadata:
+  name: {{ include "web.fullname" . }}
+spec:
+  ports:
+    {{- range $i, $port := .Values.service.ports }}
+    - name: {{ printf "port-%d" $i | quote }}
+      port: {{ $port }}
+    {{- end }}
+{{- end }}
+"""
+    )
+    (chart / "templates" / "NOTES.txt").write_text(
+        "Visit {{ include \"web.fullname\" . }}!\n"
+    )
+
+    objs = {o["kind"]: o for o in chart_objects("rel", str(chart))}
+    dep = objs["Deployment"]
+    assert dep["metadata"]["name"] == "rel-web"
+    assert dep["metadata"]["labels"] == {"app": "web", "release": "rel"}
+    assert dep["spec"]["replicas"] == 3
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "nginx:2.4"
+    assert c["resources"] == {"requests": {"cpu": "250m", "memory": "64Mi"}}
+    assert dep["spec"]["template"]["spec"]["nodeSelector"] == {
+        "disktype": "ssd"
+    }
+    svc = objs["Service"]
+    assert svc["spec"]["ports"] == [
+        {"name": "port-0", "port": 80},
+        {"name": "port-1", "port": 443},
+    ]
+    # NOTES.txt excluded from manifests (chart.go:116-130)
+    assert len(render_chart("rel", str(chart))) == 2
+
+    # flipping the if guard drops the service manifest entirely
+    (chart / "values.yaml").write_text(
+        (chart / "values.yaml").read_text().replace(
+            "service:\n  enabled: true", "service:\n  enabled: false"
+        )
+    )
+    assert "Service" not in {
+        o["kind"] for o in chart_objects("rel", str(chart))
+    }
 
 
 # ---- applier end-to-end on the example cluster ----
@@ -288,3 +406,138 @@ def test_cli_version_and_gen_doc(tmp_path, capsys):
     assert main(["gen-doc", "-d", str(tmp_path)]) == 0
     assert (tmp_path / "tpusim.md").exists()
     assert main(["debug"]) == 0
+
+
+# ---- real-cluster snapshot (kubeConfig dump) ingestion ----
+
+
+def _dump_doc():
+    """A `kubectl get nodes,pods,deployments -A -o yaml` style List dump."""
+    node = lambda name, gpus, model: {
+        "kind": "Node",
+        "apiVersion": "v1",
+        "metadata": {
+            "name": name,
+            "labels": (
+                {"alibabacloud.com/gpu-card-model": model} if model else {}
+            ),
+        },
+        "status": {
+            "allocatable": {
+                "cpu": "64",
+                "memory": "256Gi",
+                "alibabacloud.com/gpu-count": str(gpus),
+            }
+        },
+    }
+    return {
+        "kind": "List",
+        "apiVersion": "v1",
+        "items": [
+            node("real-a", 0, ""),
+            node("real-b", 4, "V100M16"),
+            {  # API-sourced pod: dropped, its Deployment re-expands it
+                "kind": "Pod",
+                "apiVersion": "v1",
+                "metadata": {"name": "web-abc12", "namespace": "prod"},
+                "spec": {
+                    "nodeName": "real-a",
+                    "containers": [
+                        {"resources": {"requests": {"cpu": "2"}}}
+                    ],
+                },
+            },
+            {  # static pod: survives ingestion (IsStaticPod semantics)
+                "kind": "Pod",
+                "apiVersion": "v1",
+                "metadata": {
+                    "name": "kube-proxy-real-a",
+                    "namespace": "kube-system",
+                    "annotations": {"kubernetes.io/config.source": "file"},
+                },
+                "spec": {
+                    "nodeName": "real-a",
+                    "containers": [
+                        {"resources": {"requests": {"cpu": "250m"}}}
+                    ],
+                },
+            },
+            {
+                "kind": "Deployment",
+                "apiVersion": "apps/v1",
+                "metadata": {"name": "web", "namespace": "prod"},
+                "spec": {
+                    "replicas": 2,
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {"resources": {"requests": {"cpu": "2"}}}
+                            ]
+                        }
+                    },
+                },
+            },
+        ],
+    }
+
+
+def test_cluster_dump_ingestion(tmp_path):
+    from tpusim.io.k8s_yaml import load_cluster_from_dump
+
+    dump = tmp_path / "dump.yaml"
+    dump.write_text(yaml.dump(_dump_doc()))
+    res = load_cluster_from_dump(str(dump))
+    assert res.node_names == ["real-a", "real-b"]
+    names = [p.name for p in res.pods]
+    # API-sourced pod dropped; static pod kept; deployment re-expanded
+    assert "prod/web-abc12" not in names
+    assert "kube-system/kube-proxy-real-a" in names
+    assert "prod/web-0" in names and "prod/web-1" in names
+
+
+def test_cluster_dump_rejects_kubeconfig(tmp_path):
+    from tpusim.io.k8s_yaml import load_cluster_from_dump
+
+    kc = tmp_path / "kubeconfig"
+    kc.write_text(
+        yaml.dump(
+            {
+                "apiVersion": "v1",
+                "kind": "Config",
+                "clusters": [{"name": "c", "cluster": {"server": "https://x"}}],
+                "users": [],
+                "contexts": [],
+            }
+        )
+    )
+    with pytest.raises(ValueError, match="kubeconfig credential"):
+        load_cluster_from_dump(str(kc))
+
+
+def test_applier_kube_config_dump_end_to_end(tmp_path):
+    """spec.cluster.kubeConfig pointing at a dump simulates the snapshot
+    (capability parity with CreateClusterResourceFromClient)."""
+    from tpusim.apply import Applier, ApplyOptions
+
+    dump = tmp_path / "dump.yaml"
+    dump.write_text(yaml.dump(_dump_doc()))
+    cr = tmp_path / "cc.yaml"
+    cr.write_text(
+        yaml.dump(
+            {
+                "apiVersion": "simon/v1alpha1",
+                "kind": "Config",
+                "metadata": {"name": "dump-sim"},
+                "spec": {"cluster": {"kubeConfig": str(dump)}},
+            }
+        )
+    )
+    out = io.StringIO()
+    applier = Applier(ApplyOptions(simon_config=str(cr)))
+    result = applier.run(out=out)
+    assert not result.unscheduled_pods, out.getvalue()
+    assert "Success!" in out.getvalue()
+    names = {p.name: i for i, p in enumerate(result.pods)}
+    # static pod pinned to its node
+    i = names["kube-system/kube-proxy-real-a"]
+    assert result.node_names[result.placed_node[i]] == "real-a"
