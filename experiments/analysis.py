@@ -58,6 +58,8 @@ def parse_log(path: str, meta: Dict[str, str] = None) -> Dict[str, dict]:
     allo: Dict[str, List[int]] = {}
     pwr: Dict[str, List[float]] = {}
     cdol = {"id": [], "event": [], "pod_name": [], "cum_pod": []}
+    fail_specs: Dict[tuple, int] = {}  # (cpu, ngpu, milli, type) -> count
+    in_fail_block = False
     cum = 0
     live = set()  # pods currently created (ref: analysis.py cdol_pod_dict)
     tag = ""
@@ -81,6 +83,29 @@ def parse_log(path: str, meta: Dict[str, str] = None) -> Dict[str, dict]:
                     line.split("unscheduled pods")[0].split("there are")[1].strip()
                 )
                 break
+
+            # "Failed Pods in detail:" block (utils.go:1344-1354): group the
+            # PodResource.Repr lines by request spec, like the reference's
+            # merge_fail_pods.py does to its analysis_fail.out
+            if line.startswith("Failed Pods in detail"):
+                in_fail_block = True
+                continue
+            if in_fail_block:
+                m = re.search(
+                    r"<CPU:\s*([\d.]+), GPU: (\d+) x \{(\d+)\s*\}m "
+                    r"\(CPUREQ: [^)]*\) \(GPUREQ: ([^)]*)\)>",
+                    line,
+                )
+                if m:
+                    key = (
+                        round(float(m.group(1)) * 1000),
+                        int(m.group(2)),
+                        int(m.group(3)),
+                        m.group(4),
+                    )
+                    fail_specs[key] = fail_specs.get(key, 0) + 1
+                    continue
+                in_fail_block = False
 
             if "Cluster Analysis" in line and "(" in line:
                 tag = line.split(")")[0].split("(")[1]
@@ -177,7 +202,34 @@ def parse_log(path: str, meta: Dict[str, str] = None) -> Dict[str, dict]:
                 cdol["pod_name"].append(pod_name)
                 cdol["cum_pod"].append(cum)
 
-    return {"summary": summary, "frag": frag, "allo": allo, "cdol": cdol, "pwr": pwr}
+    # reference merged schema (merge_fail_pods.py): one row per distinct
+    # failed request spec, ordered by frequency, gpu_type "" → "<none>"
+    fail = {
+        "order": [],
+        "num_pod": [],
+        "cpu_milli": [],
+        "num_gpu": [],
+        "gpu_milli": [],
+        "gpu_type_req": [],
+    }
+    ranked = sorted(fail_specs.items(), key=lambda kv: (-kv[1], kv[0]))
+    for order, ((cpu, ngpu, milli, gtype), count) in enumerate(ranked):
+        fail["order"].append(order)
+        fail["num_pod"].append(count)
+        fail["cpu_milli"].append(cpu)
+        fail["num_gpu"].append(ngpu)
+        fail["gpu_milli"].append(milli)
+        fail["gpu_type_req"].append(
+            "<none>" if gtype in ("", "ANY", "NONE") else gtype
+        )
+    return {
+        "summary": summary,
+        "frag": frag,
+        "allo": allo,
+        "cdol": cdol,
+        "pwr": pwr,
+        "fail": fail,
+    }
 
 
 def _write_series_csv(path: Path, series: Dict[str, list]):
@@ -215,6 +267,8 @@ def analyze_dir(exp_dir: str, meta: Dict[str, str] = None) -> dict:
     _write_series_csv(exp / "analysis_allo.csv", result["allo"])
     _write_series_csv(exp / "analysis_cdol.csv", result["cdol"])
     _write_series_csv(exp / "analysis_pwr.csv", result["pwr"])
+    if result["fail"]["order"]:
+        _write_series_csv(exp / "analysis_fail.csv", result["fail"])
     return result
 
 

@@ -60,6 +60,7 @@ def discretize(series_x, series_y, lo=0, hi=130):
 
 def merge(data_root: Path, out_dir: Path):
     allo_rows, frag_rows, fratio_rows, fail_rows = [], [], [], []
+    fail_detail_rows = []  # ref: merge_fail_pods.py → analysis_fail.csv
     for allo_file in sorted(data_root.glob("*/*/*/*/analysis_allo.csv")):
         exp_dir = allo_file.parent
         seed = exp_dir.name
@@ -105,7 +106,25 @@ def merge(data_root: Path, out_dir: Path):
                     dict(key, unscheduled=summary[0].get("unscheduled", ""))
                 )
 
+        detail_file = exp_dir / "analysis_fail.csv"
+        if detail_file.is_file():
+            for r in read_csv_dict(detail_file):
+                fail_detail_rows.append(dict(key, **r))
+
     out_dir.mkdir(parents=True, exist_ok=True)
+    if fail_detail_rows:
+        cols = [
+            "workload", "sc_policy", "tune", "seed", "order", "num_pod",
+            "cpu_milli", "num_gpu", "gpu_milli", "gpu_type_req",
+        ]
+        with open(out_dir / "analysis_fail.csv", "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=cols)
+            w.writeheader()
+            w.writerows(fail_detail_rows)
+        print(
+            f"[merge] {len(fail_detail_rows)} rows → "
+            f"{out_dir / 'analysis_fail.csv'}"
+        )
     for name, rows in (
         ("analysis_allo_discrete.csv", allo_rows),
         ("analysis_frag_discrete.csv", frag_rows),

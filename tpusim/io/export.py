@@ -41,6 +41,7 @@ ANNO_CPU_MODEL = "alibabacloud.com/cpu-model"
 ANNO_CREATION_TIME = "alibabacloud.com/creation-time"
 ANNO_DELETION_TIME = "alibabacloud.com/deletion-time"
 ANNO_UNSCHEDULED = "simon/pod-unscheduled"  # ref: pkg/type/const.go
+ANNO_ASSUME_TIME = "alibabacloud.com/assume-time"  # scheduling latency
 HOSTNAME_LABEL = "kubernetes.io/hostname"
 SCHEDULER_NAME = "simon-scheduler"
 
@@ -55,8 +56,17 @@ def pod_to_yaml_obj(
     node_name: Optional[str] = None,
     dev_mask=None,
     unscheduled: bool = False,
+    assume_time_ns: Optional[int] = None,
 ) -> dict:
-    """One trace pod → k8s Pod object (dict), reference-schema annotations."""
+    """One trace pod → k8s Pod object (dict), reference-schema annotations.
+
+    assume_time_ns stamps `alibabacloud.com/assume-time` alongside the
+    gpu-index annotation, like the reference's Reserve step
+    (UpdatePodDeviceAnnoSpec, open-gpu-share/utils/pod.go:164-174 writes
+    time.Now().UnixNano()). The replay is compiled, so per-pod wall times
+    do not exist; callers pass a deterministic nanotime series that
+    preserves scheduling order (the annotation's purpose is latency/order
+    tracing, utils/const.go:9)."""
     annotations = {}
     if pod.num_gpu > 0:
         annotations[ANNO_GPU_MILLI] = str(pod.gpu_milli)
@@ -67,6 +77,8 @@ def pod_to_yaml_obj(
             idx = _gpu_index_str(dev_mask)
             if idx:
                 annotations[ANNO_GPU_INDEX] = idx
+                if assume_time_ns is not None:
+                    annotations[ANNO_ASSUME_TIME] = str(int(assume_time_ns))
     if pod.creation_time:
         annotations[ANNO_CREATION_TIME] = str(pod.creation_time)
     if pod.deletion_time:
@@ -104,12 +116,21 @@ def export_pod_snapshot_yaml(
     path: str,
 ):
     """ref: ExportPodSnapshotInYaml (export.go:20-77): scheduled pods pinned
-    via nodeSelector, unscheduled ones annotated."""
+    via nodeSelector, unscheduled ones annotated. Placed GPU pods carry the
+    assume-time annotation: a fixed epoch base + scheduling order, standing
+    in for the reference's per-Reserve time.Now() stamps — fixed (not wall
+    clock) so identical runs export byte-identical snapshots, like the
+    pinned LogSink timestamps."""
+    base_ns = 946684800_000_000_000  # 2000-01-01T00:00:00Z in unix nanos
     docs = []
     for i, p in enumerate(pods):
         n = int(placed_node[i])
         if n >= 0:
-            docs.append(pod_to_yaml_obj(p, node_names[n], dev_mask[i]))
+            docs.append(
+                pod_to_yaml_obj(
+                    p, node_names[n], dev_mask[i], assume_time_ns=base_ns + i
+                )
+            )
         else:
             docs.append(pod_to_yaml_obj(p, unscheduled=True))
     with open(path, "w") as f:

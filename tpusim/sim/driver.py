@@ -397,6 +397,10 @@ class Simulator:
         pods = self.prepare_pods()
         self.log.info(f"Number of original workload pods: {len(self.workload_pods)}")
         res = self.schedule_pods(pods)
+        # failed-pods detail block (core.go:156 ReportFailedPods)
+        from tpusim.sim.reports import report_failed_pods
+
+        report_failed_pods(self.log, [u.pod for u in res.unscheduled_pods])
         self.cluster_analysis("InitSchedule")
         return res
 

@@ -36,6 +36,8 @@ class LogSink:
         """Bulk append of preformatted messages (the per-event report block
         builds its ~7 lines x |events| in vectorized numpy string ops; one
         write instead of per-line stream writes)."""
+        if not msgs:
+            return
         prefix = 'time="2000-01-01T00:00:00Z" level=info msg="'
         lines = [f'{prefix}{m}\\n"' for m in msgs]
         self.lines.extend(lines)
@@ -99,6 +101,36 @@ def report_power_line(log: LogSink, power_cpu: float, power_gpu: float):
         f"[Power]; cluster: {power_cpu + power_gpu:.1f}; "
         f"ClusterCPU: {power_cpu:.1f}; ClusterGPU: {power_gpu:.1f}"
     )
+
+
+def pod_resource_repr(
+    cpu_milli: int, gpu_num: int, gpu_milli: int, gpu_spec: str = "",
+    cpu_spec: str = "",
+) -> str:
+    """PodResource.Repr (ref: pkg/type/resource.go:104-127): empty CPU type
+    renders ANY; empty GPU type renders ANY for GPU pods, NONE otherwise."""
+    cputype = cpu_spec or "ANY"
+    gputype = gpu_spec or ("ANY" if gpu_milli > 0 else "NONE")
+    return (
+        f"<CPU: {cpu_milli / 1000:6.2f}, GPU: {gpu_num}"
+        f" x {{{gpu_milli:<4d}}}m (CPUREQ: {cputype}) (GPUREQ: {gputype})>"
+    )
+
+
+def report_failed_pods(log: LogSink, pods) -> None:
+    """`Failed Pods in detail:` block (ref: utils.ReportFailedPods,
+    pkg/utils/utils.go:1344-1354, called from core.go:156 after RunCluster).
+    `pods` is a sequence of PodRow-likes with name/cpu_milli/num_gpu/
+    gpu_milli/gpu_spec."""
+    if not pods:
+        return
+    log.info("Failed Pods in detail:")
+    for p in pods:
+        log.info(
+            f"  {p.name}: "
+            + pod_resource_repr(p.cpu_milli, p.num_gpu, p.gpu_milli, p.gpu_spec)
+        )
+    log.infoln()
 
 
 def batch_event_report_msgs(
