@@ -1,10 +1,14 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test bench bench-scale sweep native clean
+.PHONY: test test-tpu bench bench-scale sweep native clean
 
 test:
 	python -m pytest tests/ -q
+
+# on-accelerator lane: golden frag values + engine equivalence on the chip
+test-tpu:
+	TPUSIM_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
 
 bench:
 	python bench.py

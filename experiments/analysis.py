@@ -267,8 +267,13 @@ def analyze_dir(exp_dir: str, meta: Dict[str, str] = None) -> dict:
     _write_series_csv(exp / "analysis_allo.csv", result["allo"])
     _write_series_csv(exp / "analysis_cdol.csv", result["cdol"])
     _write_series_csv(exp / "analysis_pwr.csv", result["pwr"])
+    # always reconcile (a stale file from a previous run of this directory
+    # would otherwise be merged as current data)
+    fail_csv = exp / "analysis_fail.csv"
     if result["fail"]["order"]:
-        _write_series_csv(exp / "analysis_fail.csv", result["fail"])
+        _write_series_csv(fail_csv, result["fail"])
+    elif fail_csv.exists():
+        fail_csv.unlink()
     return result
 
 

@@ -152,3 +152,38 @@ def random_pods(rng, num_pods=40):
         gpu_mask=jnp.asarray(mask),
         pinned=jnp.full(num_pods, -1, jnp.int32),
     )
+
+
+# Golden node-frag-score cases (frag_test.go:100-163): shared between the
+# CPU suite (tests/test_frag.py) and the on-TPU lane (tests/test_tpu.py)
+# so the two cannot silently diverge.
+# (cpu_left, gpus, gpu_model, distribution, expected_score)
+FRAG_SCORE_GOLDENS = [
+    (1000, [200, 1000, 1000, 500], "1080", "gpu", 2566.62),
+    (1000, [1000, 1000, 1000, 1000], "1080", "gpu", 3802.40),
+    (1000, [1000] * 8, "1080", "gpu", 7604.80),
+    (64000, [1000] * 8, "P100", "nongpu", 887.20),
+    (32000, [1000] * 4 + [0] * 4, "P100", "nongpu", 554.4),
+    (0, [1000] * 4 + [0] * 4, "P100", "nongpu", 4000.0),
+]
+
+
+def frag_golden_score(case):
+    """Evaluate one FRAG_SCORE_GOLDENS case → (actual, expected)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpusim.constants import GPU_MODEL_IDS
+    from tpusim.ops import frag
+
+    cpu_left, gpus, model, dist, expected = case
+    tp = typical_pods_gpu() if dist == "gpu" else typical_pods_with_nongpu()
+    g = np.zeros(8, np.int32)
+    g[: len(gpus)] = gpus
+    actual = float(
+        frag.node_frag_score(
+            jnp.int32(cpu_left), jnp.asarray(g),
+            jnp.int32(GPU_MODEL_IDS[model]), tp,
+        )
+    )
+    return actual, expected

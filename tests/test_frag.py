@@ -11,6 +11,8 @@ import numpy as np
 import pytest
 
 from tests.fixtures import (
+    FRAG_SCORE_GOLDENS,
+    frag_golden_score,
     typical_pods_gpu,
     typical_pods_with_nongpu,
     typical_rows_gpu_host,
@@ -33,22 +35,14 @@ def score(cpu_left, gpus, gpu_type, tp):
 
 
 class TestNodeGpuShareFragAmountScore:
-    # frag_test.go:100-121 / 142-163
-    def test_4x1080_used(self):
-        tp = typical_pods_gpu()
-        assert score(1000, [200, 1000, 1000, 500], "1080", tp) == pytest.approx(
-            2566.62, abs=0.05
-        )
-
-    def test_4x1080_full(self):
-        tp = typical_pods_gpu()
-        assert score(1000, [1000, 1000, 1000, 1000], "1080", tp) == pytest.approx(
-            3802.40, abs=0.05
-        )
-
-    def test_8x1080_full(self):
-        tp = typical_pods_gpu()
-        assert score(1000, [1000] * 8, "1080", tp) == pytest.approx(7604.80, abs=0.05)
+    # frag_test.go:100-121 / 142-163 — the golden cases live in
+    # fixtures.FRAG_SCORE_GOLDENS, shared with the on-TPU lane
+    @pytest.mark.parametrize(
+        "case", FRAG_SCORE_GOLDENS, ids=lambda c: f"{c[2]}-{c[0]}cpu"
+    )
+    def test_golden_scores(self, case):
+        actual, expected = frag_golden_score(case)
+        assert actual == pytest.approx(expected, abs=0.05), case
 
     def test_single_spec_lack_cpu(self):
         tp = make_typical_pods([(6000, 465, 1, 0, 9.33 / 100)])
@@ -60,23 +54,8 @@ class TestNodeGpuShareFragAmountScore:
         )
 
 
-class TestNodeGpuShareFragAmountWithNonGpu:
-    # frag_test.go:123-140
-    def test_8xP100_empty(self):
-        tp = typical_pods_with_nongpu()
-        assert score(64000, [1000] * 8, "P100", tp) == pytest.approx(887.20, abs=0.05)
-
-    def test_8xP100_halved(self):
-        tp = typical_pods_with_nongpu()
-        assert score(32000, [1000] * 4 + [0] * 4, "P100", tp) == pytest.approx(
-            554.4, abs=0.05
-        )
-
-    def test_8xP100_nocpu(self):
-        tp = typical_pods_with_nongpu()
-        assert score(0, [1000] * 4 + [0] * 4, "P100", tp) == pytest.approx(
-            4000, abs=0.05
-        )
+# The with-nongpu distribution cases (frag_test.go:123-140) are covered by
+# the "nongpu" rows of FRAG_SCORE_GOLDENS above.
 
 
 class TestGetGpuFragMilli:

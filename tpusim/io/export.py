@@ -114,21 +114,26 @@ def export_pod_snapshot_yaml(
     dev_mask: np.ndarray,
     node_names: Sequence[str],
     path: str,
+    creation_rank: Optional[np.ndarray] = None,
 ):
     """ref: ExportPodSnapshotInYaml (export.go:20-77): scheduled pods pinned
     via nodeSelector, unscheduled ones annotated. Placed GPU pods carry the
-    assume-time annotation: a fixed epoch base + scheduling order, standing
-    in for the reference's per-Reserve time.Now() stamps — fixed (not wall
-    clock) so identical runs export byte-identical snapshots, like the
-    pinned LogSink timestamps."""
+    assume-time annotation: a fixed epoch base + the pod's creation-event
+    position (`creation_rank`, falling back to list order), standing in for
+    the reference's per-Reserve time.Now() stamps — fixed (not wall clock)
+    so identical runs export byte-identical snapshots, like the pinned
+    LogSink timestamps, while sorting by assume-time still recovers
+    scheduling order."""
     base_ns = 946684800_000_000_000  # 2000-01-01T00:00:00Z in unix nanos
     docs = []
     for i, p in enumerate(pods):
         n = int(placed_node[i])
+        order = i if creation_rank is None else int(creation_rank[i])
         if n >= 0:
             docs.append(
                 pod_to_yaml_obj(
-                    p, node_names[n], dev_mask[i], assume_time_ns=base_ns + i
+                    p, node_names[n], dev_mask[i],
+                    assume_time_ns=base_ns + max(order, 0),
                 )
             )
         else:

@@ -31,7 +31,11 @@ from tpusim.io.trace import (
 from tpusim.policies import make_policy
 from tpusim.sim.engine import make_replay
 from tpusim.sim.fetch import device_fetch
-from tpusim.sim.reports import LogSink, cluster_analysis_block
+from tpusim.sim.reports import (
+    LogSink,
+    cluster_analysis_block,
+    report_failed_pods,
+)
 from tpusim.sim.typical import (
     TypicalPodsConfig,
     get_skyline_pods,
@@ -84,6 +88,10 @@ class SimulateResult:
     node_names: List[str]
     wall_seconds: float
     events: int
+    # i64[P] position of each pod's creation event in scheduling order
+    # (-1 = never created); feeds the assume-time annotation, whose purpose
+    # is recovering scheduling order from a snapshot
+    creation_rank: np.ndarray = None
 
 
 class Simulator:
@@ -327,13 +335,18 @@ class Simulator:
             )
             for i in np.flatnonzero(failed_mask)
         ]
-        return out, len(ev_kind), unscheduled
+        from tpusim.sim.engine import EV_CREATE
+
+        rank = np.full(len(pods), -1, np.int64)
+        creates = np.asarray(ev_pod)[np.asarray(ev_kind) == EV_CREATE]
+        rank[creates] = np.arange(len(creates))
+        return out, len(ev_kind), unscheduled, rank
 
     def schedule_pods(self, pods: Sequence[PodRow]) -> SimulateResult:
         if self.typical is None:
             self.set_typical_pods()
         t0 = time.perf_counter()
-        result, events, unscheduled = self._replay_pods(
+        result, events, unscheduled, rank = self._replay_pods(
             self.init_state,
             pods,
             jax.random.PRNGKey(self.cfg.seed),
@@ -350,6 +363,7 @@ class Simulator:
             node_names=self.node_names,
             wall_seconds=wall,
             events=events,
+            creation_rank=rank,
         )
         return self.last_result
 
@@ -361,7 +375,7 @@ class Simulator:
         if self.typical is None:
             self.set_typical_pods()
         res = self.last_result
-        out, events, failed = self._replay_pods(
+        out, events, failed, rank = self._replay_pods(
             jax.tree.map(jnp.asarray, res.state),
             pods,
             jax.random.PRNGKey(self.cfg.seed + len(res.pods)),
@@ -375,6 +389,10 @@ class Simulator:
         res.dev_mask = np.concatenate([res.dev_mask, np.asarray(out.dev_mask)])
         res.unscheduled_pods = list(res.unscheduled_pods) + failed
         res.events += events
+        base = int(res.creation_rank.max(initial=-1)) + 1
+        res.creation_rank = np.concatenate(
+            [res.creation_rank, np.where(rank >= 0, rank + base, -1)]
+        )
         return failed
 
     def schedule_app(
@@ -398,8 +416,6 @@ class Simulator:
         self.log.info(f"Number of original workload pods: {len(self.workload_pods)}")
         res = self.schedule_pods(pods)
         # failed-pods detail block (core.go:156 ReportFailedPods)
-        from tpusim.sim.reports import report_failed_pods
-
         report_failed_pods(self.log, [u.pod for u in res.unscheduled_pods])
         self.cluster_analysis("InitSchedule")
         return res
@@ -418,7 +434,10 @@ class Simulator:
         from tpusim.io.export import export_pod_snapshot_yaml
 
         r = self.last_result
-        export_pod_snapshot_yaml(r.pods, r.placed_node, r.dev_mask, self.node_names, path)
+        export_pod_snapshot_yaml(
+            r.pods, r.placed_node, r.dev_mask, self.node_names, path,
+            creation_rank=r.creation_rank,
+        )
 
     def export_pod_snapshot_csv(self, path: str):
         from tpusim.io.export import export_pod_snapshot_csv
@@ -530,6 +549,10 @@ class Simulator:
         res.placed_node[v] = placed_v
         res.dev_mask[v] = mask_v
         res.state = jax.tree.map(np.asarray, out.state)
+        if res.creation_rank is not None:  # victims re-enter last, in order
+            base = int(res.creation_rank.max(initial=-1)) + 1
+            res.creation_rank = res.creation_rank.copy()
+            res.creation_rank[v] = base + np.arange(len(v))
         failed = [
             UnscheduledPod(res.pods[v[i]]) for i in np.flatnonzero(placed_v < 0)
         ]
