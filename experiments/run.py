@@ -27,6 +27,7 @@ REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
 SCORE_POLICY_ABBR = {
+    "Simon": "Simon",
     "RandomScore": "Random",
     "DotProductScore": "DotProd",
     "GpuClusteringScore": "GpuClustering",

@@ -75,7 +75,25 @@ def main(argv=None):
             )
             continue
         t0 = time.perf_counter()
-        runner.run_experiment(runner.get_args(argv_exp))
+        # the TPU tunnel occasionally drops a remote_compile call mid-sweep;
+        # a transient runtime/RPC failure must not kill a multi-hour grid.
+        # Deterministic errors (bad flags, missing traces, assertion bugs)
+        # surface immediately — only backend/transport errors retry.
+        import jax
+
+        for attempt in range(3):
+            try:
+                runner.run_experiment(runner.get_args(argv_exp))
+                break
+            except (jax.errors.JaxRuntimeError, ConnectionError, OSError) as e:
+                if attempt == 2:
+                    raise
+                print(
+                    f"[sweep] {trace} {mid} seed={seed} attempt "
+                    f"{attempt + 1} failed ({e}); retrying",
+                    flush=True,
+                )
+                time.sleep(5)
         marker.write_text(" ".join(argv_exp))
         print(
             f"[sweep {i + 1}/{len(grid)}] {trace} {mid} seed={seed} "

@@ -151,6 +151,44 @@ def test_scheduler_config_parse():
     assert ("FGDScore", 1) in default.policies
 
 
+def test_scheduler_config_enabled_wins_over_disabled(tmp_path):
+    """The reference's example configs use disable-everything boilerplate
+    and then re-enable the chosen policy; k8s merge semantics make the
+    enabled entry win (disabled strips DEFAULT plugins only), so the parse
+    must yield exactly the enabled policy — not fall back to the default
+    profile (ref: example/original/test-scheduler-config.yaml)."""
+    from tpusim.config.scheduler import load_scheduler_config
+
+    doc = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1beta1",
+        "kind": "KubeSchedulerConfiguration",
+        "percentageOfNodesToScore": 100,
+        "profiles": [
+            {
+                "schedulerName": "simon-scheduler",
+                "plugins": {
+                    "score": {
+                        "disabled": [
+                            {"name": n}
+                            for n in (
+                                "RandomScore", "DotProductScore",
+                                "GpuClusteringScore", "GpuPackingScore",
+                                "BestFitScore", "FGDScore", "ImageLocality",
+                                "NodeAffinity", "TaintToleration",
+                            )
+                        ],
+                        "enabled": [{"name": "FGDScore", "weight": 1000}],
+                    }
+                },
+            }
+        ],
+    }
+    p = tmp_path / "sc.yaml"
+    p.write_text(yaml.dump(doc))
+    cfg = load_scheduler_config(str(p))
+    assert cfg.policies == [("FGDScore", 1000)]
+
+
 def test_scheduler_config_rejects_unknown(tmp_path):
     from tpusim.config.scheduler import SchedulerConfigError, load_scheduler_config
 
@@ -541,3 +579,71 @@ def test_applier_kube_config_dump_end_to_end(tmp_path):
     # static pod pinned to its node
     i = names["kube-system/kube-proxy-real-a"]
     assert result.node_names[result.placed_node[i]] == "real-a"
+
+
+def test_reference_example_config_compat(tmp_path):
+    """The reference's shipped example configs use tab-indented YAML
+    comments (Go's yaml lib accepts them) and a GPU model ("V100") outside
+    the trace's 14-model table; both must work drop-in (ref:
+    example/original/*). Unknown models register dynamically with zeroed
+    energy/memory tables."""
+    from tpusim.apply import Applier, ApplyOptions
+    from tpusim.constants import GPU_MODEL_IDS
+
+    base = tmp_path / "example" / "original"
+    cluster = base / "test-cluster"
+    (cluster / "node").mkdir(parents=True)
+    (cluster / "pod").mkdir(parents=True)
+    (base / "cc.yaml").write_text(
+        "apiVersion: simon/v1alpha1 \t# tab-indented comment\n"
+        "kind: Config\n"
+        "metadata:\n  name: tab-config\n"
+        "spec:\t\t\t# more tabs\n"
+        "  cluster:\n"
+        f"    customConfig: {cluster}\n"
+    )
+    (cluster / "node" / "n0.yaml").write_text(
+        yaml.dump(
+            {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {
+                    "name": "pai-n0",
+                    "labels": {"alibabacloud.com/gpu-card-model": "V100"},
+                },
+                "status": {
+                    "allocatable": {
+                        "cpu": "64",
+                        "memory": "256Gi",
+                        "alibabacloud.com/gpu-count": "8",
+                    }
+                },
+            }
+        )
+    )
+    (cluster / "pod" / "p0.yaml").write_text(
+        yaml.dump(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": "gpu-pod-00",
+                    "annotations": {
+                        "alibabacloud.com/gpu-count": "1",
+                        "alibabacloud.com/gpu-milli": "500",
+                        "alibabacloud.com/gpu-card-model": "V100",
+                    },
+                },
+                "spec": {
+                    "containers": [
+                        {"resources": {"requests": {"cpu": "4"}}}
+                    ]
+                },
+            }
+        )
+    )
+    out = io.StringIO()
+    result = Applier(ApplyOptions(simon_config=str(base / "cc.yaml"))).run(out=out)
+    assert not result.unscheduled_pods, out.getvalue()
+    assert "V100" in GPU_MODEL_IDS  # dynamically registered
+    assert result.placed_node[0] == 0

@@ -119,12 +119,20 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
     profile = profiles[0]
     plugins = profile.get("plugins") or {}
     score = plugins.get("score") or {}
-    disabled = {p.get("name") for p in (score.get("disabled") or [])}
 
+    # k8s profile-merge semantics (vendored defaultPlugins.Apply): the
+    # `disabled` list strips plugins from the DEFAULT set only; `enabled`
+    # entries are then appended and always win. The reference's own example
+    # configs list a plugin in both (disable-everything boilerplate + the
+    # chosen policy re-enabled), so skipping enabled-plugins-in-disabled
+    # would silently fall back to the wrong profile. The k8s built-in score
+    # defaults the boilerplate strips are exactly IGNORED_SCORE_PLUGINS,
+    # which have no analogue over the array state — so `disabled` carries
+    # no further information here.
     cfg = SchedulerConfig()
     for p in score.get("enabled") or []:
         name = p.get("name")
-        if name in disabled or name in IGNORED_SCORE_PLUGINS:
+        if name in IGNORED_SCORE_PLUGINS:
             continue
         if name not in KNOWN_SCORE_PLUGINS:
             raise SchedulerConfigError(f"unknown score plugin: {name}")
@@ -163,8 +171,9 @@ def _validate_methods(cfg: SchedulerConfig) -> None:
 def load_scheduler_config(path: str = "") -> SchedulerConfig:
     if not path:
         return default_scheduler_config()
-    with open(path) as f:
-        doc = yaml.safe_load(f)
+    from tpusim.config.simon import load_yaml_lenient
+
+    doc = load_yaml_lenient(path)
     if not isinstance(doc, dict):
         raise SchedulerConfigError(f"{path}: not a YAML mapping")
     return parse_scheduler_config(doc)

@@ -98,6 +98,45 @@ class ConfigError(ValueError):
     pass
 
 
+def _expand_tabs_outside_quotes(text: str) -> str:
+    """Replace whitespace tabs with spaces, leaving tabs inside single/
+    double-quoted scalars intact (those are valid YAML data)."""
+    out = []
+    for line in text.split("\n"):
+        quote = ""
+        buf = []
+        for ch in line:
+            if quote:
+                if ch == quote:
+                    quote = ""
+                buf.append(ch)
+            elif ch in "\"'":
+                quote = ch
+                buf.append(ch)
+            elif ch == "\t":
+                buf.append("    ")
+            else:
+                buf.append(ch)
+        out.append("".join(buf))
+    return "\n".join(out)
+
+
+def load_yaml_lenient(path: str):
+    """YAML load tolerating literal TABs (the reference's example configs
+    use tab-indented comments, which Go's sigs.k8s.io/yaml accepts but
+    strict YAML rejects). On a tab ScannerError, retry with whitespace tabs
+    expanded to spaces (quoted scalars untouched) — drop-in compatibility
+    with the reference's shipped files."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        return yaml.safe_load(text)
+    except yaml.error.YAMLError as e:
+        if "\\t" not in str(e) and "'\t'" not in str(e):
+            raise
+        return yaml.safe_load(_expand_tabs_outside_quotes(text))
+
+
 def _typical(d: dict) -> TypicalPodsConfig:
     return TypicalPodsConfig(
         is_involved_cpu_pods=bool(d.get("isInvolvedCpuPods", False)),
@@ -187,8 +226,7 @@ def load_simon_cr(path: str, base_dir: Optional[str] = None) -> SimonCR:
     """Read + validate a cluster-config YAML. Relative paths inside the CR
     resolve against `base_dir` (default: cwd, matching the reference's
     project-relative convention)."""
-    with open(path) as f:
-        doc = yaml.safe_load(f)
+    doc = load_yaml_lenient(path)
     if not isinstance(doc, dict):
         raise ConfigError(f"{path}: not a YAML mapping")
     return parse_simon_cr(doc, base_dir or ".")

@@ -43,7 +43,11 @@ FRAG_CLASS_NAMES = (
 # GPU model registry. Index == integer id used in device arrays; a pod's
 # gpu_spec "A|B" OR-list becomes a bitmask over these ids
 # (ref: utils/const.go:23-38 MapGpuTypeMemoryMiB; data/README.md gpu_spec).
-GPU_MODELS = (
+# The reference treats the model as an opaque string (its tables just miss
+# unknown names), so models outside the trace's 14 register dynamically —
+# capped by the int32 gpu_mask bit width.
+MAX_GPU_MODELS = 31
+GPU_MODELS = [
     "P4",
     "2080",
     "1080",
@@ -58,9 +62,25 @@ GPU_MODELS = (
     "G1",
     "G2",
     "G3",
-)
+]
 GPU_MODEL_IDS = {name: i for i, name in enumerate(GPU_MODELS)}
 NO_GPU = -1  # gpu_type id of CPU-only nodes
+
+
+def register_gpu_model(name: str) -> int:
+    """id of `name`, registering unknown models with zeroed memory/energy
+    tables (matching the reference's missing-map-entry behavior)."""
+    mid = GPU_MODEL_IDS.get(name)
+    if mid is None:
+        if len(GPU_MODELS) >= MAX_GPU_MODELS:
+            raise ValueError(
+                f"too many distinct GPU models (> {MAX_GPU_MODELS}): the "
+                "gpu_spec bitmask is int32"
+            )
+        mid = len(GPU_MODELS)
+        GPU_MODELS.append(name)
+        GPU_MODEL_IDS[name] = mid
+    return mid
 
 GPU_MEMORY_MIB = {
     "P4": 7980711936 // 1024 // 1024,
@@ -117,12 +137,13 @@ _GPU_ENERGY = {
     "G2": (30.0, 150.0),
     "G3": (50.0, 400.0),
 }
-GPU_IDLE_W = np.array(
-    [_GPU_ENERGY.get(m, (0.0, 0.0))[0] for m in GPU_MODELS], np.float32
-)
-GPU_FULL_W = np.array(
-    [_GPU_ENERGY.get(m, (0.0, 0.0))[1] for m in GPU_MODELS], np.float32
-)
+# Fixed MAX_GPU_MODELS width so dynamically registered models (always
+# zero-energy, like every other model missing from the reference's map)
+# index in range without reshaping tables a jit may have captured.
+GPU_IDLE_W = np.zeros(MAX_GPU_MODELS, np.float32)
+GPU_FULL_W = np.zeros(MAX_GPU_MODELS, np.float32)
+for _i, _m in enumerate(GPU_MODELS):
+    GPU_IDLE_W[_i], GPU_FULL_W[_i] = _GPU_ENERGY.get(_m, (0.0, 0.0))
 
 # Pod "GPU affinity" classes used by the GpuClustering policy
 # (ref: open-gpu-share/utils/pod.go:111-123): share-gpu plus "N-gpu" for
@@ -151,7 +172,7 @@ def gpu_spec_to_mask(spec: str) -> int:
         part = part.strip()
         if not part or part == "nan":
             continue
-        mask |= 1 << GPU_MODEL_IDS[part]
+        mask |= 1 << register_gpu_model(part)
     return mask
 
 

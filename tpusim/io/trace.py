@@ -22,7 +22,7 @@ import numpy as np
 
 from tpusim.constants import (
     CPU_MODEL_IDS,
-    GPU_MODEL_IDS,
+    register_gpu_model,
     MAX_GPUS_PER_NODE,
     NO_GPU,
     gpu_spec_to_mask,
@@ -146,7 +146,8 @@ def nodes_to_state(nodes: Sequence[NodeRow]) -> NodeState:
     """NodeRow list → all-idle NodeState (ref: node YAML → corev1.Node →
     NodeResource)."""
     gpu_type = np.array(
-        [GPU_MODEL_IDS[n.model] if n.model else NO_GPU for n in nodes], np.int32
+        [register_gpu_model(n.model) if n.model else NO_GPU for n in nodes],
+        np.int32,
     )
     cpu_type = np.array(
         [CPU_MODEL_IDS.get(n.cpu_model, 0) for n in nodes], np.int32
