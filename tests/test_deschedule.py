@@ -165,3 +165,63 @@ def test_driver_deschedule_end_to_end():
     s = sim.last_result.state
     used_cpu = int((s.cpu_cap - s.cpu_left).sum())
     assert used_cpu == 2000 * after_placed
+
+
+def test_deschedule_reschedule_emits_per_event_reports():
+    """The victim reschedule goes through the reporting loop in the
+    reference (deschedule.go:91 → SchedulePods), so per-event [Report]
+    lines must cover those events too."""
+    from tpusim.io.trace import NodeRow, PodRow
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+
+    # nodes end up CPU-congested (< the cosSim 2000-milli bar) with free
+    # GPU milli, the precondition for cosSim victim selection
+    nodes = [NodeRow("n0", 13000, 262144, 4, "V100M16"),
+             NodeRow("n1", 13000, 262144, 4, "V100M16")]
+    pods = [
+        PodRow(f"p{i}", 4000, 1024, 1, 500, "", creation_time=i)
+        for i in range(6)
+    ]
+    cfg = SimulatorConfig(
+        policies=(("FGDScore", 1000),),
+        gpu_sel_method="FGDScore",
+        deschedule_ratio=0.4,
+        deschedule_policy="cosSim",
+    )
+    sim = Simulator(nodes, cfg)
+    sim.set_workload_pods(pods)
+    res = sim.run()
+    base = sim.log.dump().count("(origin)")
+    assert base == res.events
+
+    sim.deschedule_cluster()
+    text = sim.log.dump()
+    assert "Num of Descheduled Pods: 2" in text  # ceil(0.4 * 6) placed... 2
+    assert text.count("(origin)") == base + 2  # victim reschedule reported
+
+
+def test_inflation_emits_per_event_reports():
+    """Inflation scheduling reports per event and prints the failed-pods
+    detail block (ref: simulator.go:1023-1024 SchedulePods +
+    ReportFailedPods)."""
+    from tpusim.io.trace import NodeRow, PodRow
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+
+    nodes = [NodeRow("n0", 64000, 262144, 8, "V100M16")]
+    pods = [
+        PodRow(f"p{i}", 2000, 1024, 1, 500, "", creation_time=i)
+        for i in range(4)
+    ]
+    cfg = SimulatorConfig(
+        policies=(("BestFitScore", 1000),), inflation_ratio=2.0
+    )
+    sim = Simulator(nodes, cfg)
+    sim.set_workload_pods(pods)
+    res = sim.run()
+    base = sim.log.dump().count("(origin)")
+    assert base == res.events
+
+    sim.run_workload_inflation_evaluation("ScheduleInflation")
+    text = sim.log.dump()
+    assert text.count("(origin)") > base  # inflation events reported
+    assert "Cluster Analysis Results (ScheduleInflation)" in text

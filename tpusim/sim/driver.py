@@ -321,11 +321,7 @@ class Simulator:
             state, specs, jnp.asarray(ev_kind), jnp.asarray(ev_pod), key
         )
         out = device_fetch(out)
-        if self.cfg.report_per_event and out.metrics is not None:
-            self._emit_event_reports(
-                out.metrics, pods, ev_kind, ev_pod,
-                np.asarray(out.ever_failed), out, state,
-            )
+        self._emit_event_reports(out, pods, ev_kind, ev_pod, state)
         skipped = np.array([p.unscheduled for p in pods], bool)
         failed_mask = np.asarray(out.ever_failed) | skipped
         unscheduled = [
@@ -476,18 +472,15 @@ class Simulator:
             return None
         self.log.info(f"(Inflation) Num of Total Pods: {len(extra)}")
         state = jax.tree.map(jnp.asarray, self.last_result.state)
-        specs = pods_to_specs(extra)
-
-        out = device_fetch(
-            self.run_events(
-                state,
-                specs,
-                jnp.zeros(len(extra), jnp.int32),
-                jnp.arange(len(extra), dtype=jnp.int32),
-                jax.random.PRNGKey(self.cfg.inflation_seed),
-            )
+        # same reporting replay as the main workload (the reference's
+        # inflation path reuses SchedulePods + ReportFailedPods,
+        # simulator.go:1023-1024)
+        out, _, unscheduled, _ = self._replay_pods(
+            state, extra, jax.random.PRNGKey(self.cfg.inflation_seed),
+            use_timestamps=False,
         )
-        failed = int(np.asarray(out.placed_node < 0).sum())
+        report_failed_pods(self.log, [u.pod for u in unscheduled])
+        failed = len(unscheduled)
         self.log.info(f"[ReportFailedPods] {failed} unscheduled inflation pods")
         saved = self.last_result.state
         self.last_result.state = jax.tree.map(np.asarray, out.state)
@@ -536,13 +529,19 @@ class Simulator:
             return []
         v = np.asarray(victims, np.int32)
         vspecs = jax.tree.map(lambda a: a[jnp.asarray(v)], specs)
-        ev_kind = jnp.zeros(len(victims), jnp.int32)
-        ev_pod = jnp.arange(len(victims), dtype=jnp.int32)
+        ev_kind = np.zeros(len(victims), np.int32)  # EV_CREATE stream
+        ev_pod = np.arange(len(victims), dtype=np.int32)
 
         out = device_fetch(
             self.run_events(
-                state, vspecs, ev_kind, ev_pod, jax.random.PRNGKey(self.cfg.seed + 1)
+                state, vspecs, jnp.asarray(ev_kind), jnp.asarray(ev_pod),
+                jax.random.PRNGKey(self.cfg.seed + 1),
             )
+        )
+        # the victim reschedule goes through the reporting loop in the
+        # reference too (deschedule.go:91 → SchedulePods)
+        self._emit_event_reports(
+            out, [res.pods[int(i)] for i in v], ev_kind, ev_pod, state
         )
         placed_v = np.asarray(out.placed_node)
         mask_v = np.asarray(out.dev_mask)
@@ -612,33 +611,30 @@ class Simulator:
             pod_gpu[ev_pods],
         )
 
-    def _emit_event_reports(
-        self, m, pods=None, ev_kind=None, ev_pod=None, failed=None,
-        out=None, start_state=None,
-    ):
+    def _emit_event_reports(self, out, pods, ev_kind, ev_pod, start_state):
         """Per-event log block: `[i] attempt to ...` line (simulator.go:410,
         420; failures echo the deletePod rollback line :354), then the
         frag/alloc/power report lines incl. the bellman variant
         (simulator.go:426-427, analysis.go:109-110). Skip events
         (pod-unscheduled annotation) emit nothing (simulator.go:391-399).
-        All line families format vectorized over the event axis
-        (reports.batch_event_report_msgs) and append in one bulk call."""
+        No-op when per-event reporting is off (the replay carries no
+        metrics then). All line families format vectorized over the event
+        axis (reports.batch_event_report_msgs) and append in one bulk
+        call."""
         from tpusim.sim.engine import EV_CREATE, EV_DELETE
         from tpusim.sim.reports import batch_event_report_msgs
 
+        m = out.metrics
+        if not self.cfg.report_per_event or m is None:
+            return
         amounts = np.asarray(m.frag_amounts)
         total_gpus = self.total_gpus
-        kinds = None if ev_kind is None else np.asarray(ev_kind)
-        bellman = None
-        if out is not None and start_state is not None and pods is not None:
-            bellman = self._bellman_series(start_state, pods, ev_kind, ev_pod, out)
-        pod_names = ev_failed = None
-        if kinds is not None and pods is not None:
-            names = np.array([p.name for p in pods])
-            ev_pods = np.asarray(ev_pod)
-            pod_names = names[ev_pods]
-            if failed is not None:
-                ev_failed = np.asarray(failed)[ev_pods]
+        kinds = np.asarray(ev_kind)
+        bellman = self._bellman_series(start_state, pods, ev_kind, ev_pod, out)
+        names = np.array([p.name for p in pods])
+        ev_pods = np.asarray(ev_pod)
+        pod_names = names[ev_pods]
+        ev_failed = np.asarray(out.ever_failed)[ev_pods]
         self.log.info_many(
             batch_event_report_msgs(
                 amounts,
