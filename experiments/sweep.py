@@ -85,7 +85,16 @@ def main(argv=None):
             try:
                 runner.run_experiment(runner.get_args(argv_exp))
                 break
-            except (jax.errors.JaxRuntimeError, ConnectionError, OSError) as e:
+            except (jax.errors.JaxRuntimeError, OSError) as e:
+                # OSError covers the tunnel's transport failures (connection
+                # resets, timeouts, DNS) — but its deterministic filesystem
+                # subclasses must surface immediately, not after 3 retries.
+                if isinstance(
+                    e,
+                    (FileNotFoundError, IsADirectoryError,
+                     NotADirectoryError, PermissionError),
+                ):
+                    raise
                 if attempt == 2:
                     raise
                 print(

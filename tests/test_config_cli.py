@@ -224,6 +224,11 @@ def test_scheduler_config_rejects_extenders_and_pct(tmp_path):
     p.write_text(yaml.dump({**base, "percentageOfNodesToScore": 100}))
     load_scheduler_config(str(p))  # explicit 100 is fine
 
+    # non-numeric YAML must surface as the typed error, not bare ValueError
+    p.write_text(yaml.dump({**base, "percentageOfNodesToScore": "most"}))
+    with pytest.raises(SchedulerConfigError, match="not an integer"):
+        load_scheduler_config(str(p))
+
     p.write_text(
         yaml.dump({**base, "extenders": [{"urlPrefix": "http://x/"}]})
     )
@@ -405,6 +410,45 @@ spec:
     assert "Service" not in {
         o["kind"] for o in chart_objects("rel", str(chart))
     }
+
+
+def test_chart_tpl_and_semver(tmp_path):
+    """`tpl` re-parses its string argument against the given dot, and
+    `semverCompare` evaluates single constraints (raising on range syntax
+    outside the subset) instead of silently passing through."""
+    import pytest
+
+    from tpusim.io.chart import ChartError, chart_objects
+
+    chart = tmp_path / "t"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text("name: t\nversion: 1.0.0\n")
+    (chart / "values.yaml").write_text(
+        'greeting: "hi {{ .Release.Name }}"\n'
+    )
+    (chart / "templates" / "cm.yaml").write_text(
+        """kind: ConfigMap
+metadata:
+  name: cm
+data:
+  msg: {{ tpl .Values.greeting . | quote }}
+  new: {{ if semverCompare ">=1.19" .Capabilities.KubeVersion.Version }}"yes"{{ else }}"no"{{ end }}
+"""
+    )
+    (cm,) = chart_objects("rel", str(chart))
+    assert cm["data"]["msg"] == "hi rel"
+    assert cm["data"]["new"] == "yes"
+
+    (chart / "templates" / "cm.yaml").write_text(
+        """kind: ConfigMap
+metadata:
+  name: cm
+data:
+  bad: {{ semverCompare "^1.19.x" "1.20" }}
+"""
+    )
+    with pytest.raises(ChartError, match="semverCompare"):
+        chart_objects("rel", str(chart))
 
 
 # ---- applier end-to-end on the example cluster ----

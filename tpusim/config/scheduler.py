@@ -102,7 +102,14 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
     # and always scores every node, so reject configs that ask otherwise
     # rather than silently computing something different.
     pct = doc.get("percentageOfNodesToScore")
-    if pct is not None and int(pct) != 100:
+    if pct is not None:
+        try:
+            pct = int(pct)
+        except (TypeError, ValueError):
+            raise SchedulerConfigError(
+                f"percentageOfNodesToScore={pct!r} is not an integer"
+            ) from None
+    if pct is not None and pct != 100:
         raise SchedulerConfigError(
             f"percentageOfNodesToScore={pct} unsupported: this simulator "
             "always scores 100% of nodes (the reference forces the same, "

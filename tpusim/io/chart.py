@@ -383,7 +383,33 @@ def _printf(fmt: str, *args) -> str:
     return "".join(out)
 
 
-def _make_funcs(render_template):
+def _semver_compare(constraint, version):
+    """Minimal semverCompare: one `[op]x.y.z` constraint against a version.
+    Helm's range/caret/tilde/wildcard syntax is outside the subset → ChartError."""
+    m = re.match(
+        r"^\s*(>=|<=|!=|>|<|=)?\s*v?(\d+(?:\.\d+){0,2})(?:-[\w.-]+)?\s*$",
+        str(constraint),
+    )
+    vm = re.match(
+        r"^\s*v?(\d+(?:\.\d+){0,2})(?:[-+][\w.-]+)?\s*$", str(version)
+    )
+    if not m or not vm:
+        raise ChartError(
+            f"semverCompare: unsupported constraint {constraint!r} vs {version!r} "
+            "(only single [>=|<=|>|<|=|!=]x.y.z constraints are in the subset)"
+        )
+    op = m.group(1) or "="
+    want = tuple(int(x) for x in m.group(2).split("."))
+    have = tuple(int(x) for x in vm.group(1).split("."))[: len(want)]
+    have = have + (0,) * (len(want) - len(have))
+    return {
+        "=": have == want, "!=": have != want,
+        ">": have > want, ">=": have >= want,
+        "<": have < want, "<=": have <= want,
+    }[op]
+
+
+def _make_funcs(render_template, render_string):
     def required(msg, v):
         if v is None or v == "":
             raise ChartError(f"required value missing: {msg}")
@@ -426,7 +452,7 @@ def _make_funcs(render_template):
         "required": required,
         "len": lambda v: len(v),
         "include": render_template,
-        "tpl": lambda s, dot: s,  # charts rarely need re-parsing; pass through
+        "tpl": render_string,
         "list": lambda *a: list(a),
         "dict": lambda *a: {a[i]: a[i + 1] for i in range(0, len(a), 2)},
         "add": lambda *a: sum(a),
@@ -441,7 +467,7 @@ def _make_funcs(render_template):
         }.get(kind, False),
         "hasKey": lambda d, k: isinstance(d, dict) and k in d,
         "contains": lambda sub, s: sub in _go_str(s),
-        "semverCompare": lambda *_: True,
+        "semverCompare": _semver_compare,
     }
 
 
@@ -449,13 +475,19 @@ class _Renderer:
     def __init__(self, templates: Dict[str, list], root):
         self.templates = templates
         self.root = root
-        self.funcs = _make_funcs(self._include)
+        self.funcs = _make_funcs(self._include, self._tpl)
 
     # include "name" dot → string
     def _include(self, name, dot=None):
         body = self.templates.get(name)
         if body is None:
             raise ChartError(f"undefined template {name!r}")
+        ctx = _Ctx(self.root, dot if dot is not None else self.root, {"$": self.root}, self.templates)
+        return self._render(body, ctx)
+
+    # tpl "string" dot → re-parse and render the string as a template
+    def _tpl(self, s, dot=None):
+        body, _, _, _ = _parse(_tokenize(_go_str(s)), 0, self.templates, stop=())
         ctx = _Ctx(self.root, dot if dot is not None else self.root, {"$": self.root}, self.templates)
         return self._render(body, ctx)
 
@@ -622,7 +654,7 @@ def render_chart(name: str, path: str, values_override: Optional[dict] = None) -
             "Version": chart_meta.get("version", ""),
             "AppVersion": chart_meta.get("appVersion", ""),
         },
-        "Capabilities": {"KubeVersion": {"Version": "v1.20.5", "Major": "1", "Minor": "20"}},
+        "Capabilities": {"KubeVersion": {"Version": "v1.20.5", "GitVersion": "v1.20.5", "Major": "1", "Minor": "20"}},
         "Template": {"BasePath": os.path.join(name, "templates")},
     }
 
