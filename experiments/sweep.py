@@ -43,36 +43,46 @@ def main(argv=None):
     ap.add_argument("--traces", nargs="*", default=None)
     ap.add_argument("--methods", nargs="*", default=None, help="method ids")
     ap.add_argument("--fast", action="store_true", help="no per-event report")
+    ap.add_argument(
+        "--no-batch", action="store_true",
+        help="run seeds one-by-one instead of one vmapped replay per group",
+    )
     args = ap.parse_args(argv)
 
     traces = args.traces or TRACES
     methods = [m for m in METHODS if args.methods is None or m[0] in args.methods]
-    grid = [
-        (trace, m, seed)
-        for trace in traces
-        for m in methods
-        for seed in range(42, 42 + args.seeds)
-    ]
+    groups = [(trace, m) for trace in traces for m in methods]
+    seeds = list(range(42, 42 + args.seeds))
+    total = len(groups) * len(seeds)
     t_all = time.perf_counter()
-    for i, (trace, (mid, flags, gpusel, dimext, norm), seed) in enumerate(grid):
-        outdir = f"{args.out_root}/{trace}/{mid}/{args.tune}/{seed}"
-        argv_exp = (
-            ["-d", outdir, "-f", trace]
-            + flags.split()
-            + ["-gpusel", gpusel, "-dimext", dimext, "-norm", norm,
-               "-tune", str(args.tune), "-tuneseed", str(seed),
-               "--shuffle-pod", "true"]
-            + (["--no-per-event-report"] if args.fast else [])
-        )
-        # resume marker: written only after a fully-finished experiment,
-        # keyed on the exact argv so --fast and full runs never alias
-        marker = Path(outdir) / ".sweep_done"
-        if marker.exists() and marker.read_text() == " ".join(argv_exp):
-            print(
-                f"[sweep {i + 1}/{len(grid)}] {trace} {mid} seed={seed} "
-                f"cached, skipping",
-                flush=True,
+    done = 0
+    for trace, (mid, flags, gpusel, dimext, norm) in groups:
+        # one group = the same experiment across seeds; uncached seeds run
+        # as ONE vmapped device replay (driver.run_batch) unless --no-batch
+        pending = []
+        for seed in seeds:
+            outdir = f"{args.out_root}/{trace}/{mid}/{args.tune}/{seed}"
+            argv_exp = (
+                ["-d", outdir, "-f", trace]
+                + flags.split()
+                + ["-gpusel", gpusel, "-dimext", dimext, "-norm", norm,
+                   "-tune", str(args.tune), "-tuneseed", str(seed),
+                   "--shuffle-pod", "true"]
+                + (["--no-per-event-report"] if args.fast else [])
             )
+            # resume marker: written only after a fully-finished experiment,
+            # keyed on the exact argv so --fast and full runs never alias
+            marker = Path(outdir) / ".sweep_done"
+            if marker.exists() and marker.read_text() == " ".join(argv_exp):
+                done += 1
+                print(
+                    f"[sweep {done}/{total}] {trace} {mid} seed={seed} "
+                    f"cached, skipping",
+                    flush=True,
+                )
+                continue
+            pending.append((seed, argv_exp, marker))
+        if not pending:
             continue
         t0 = time.perf_counter()
         # the TPU tunnel occasionally drops a remote_compile call mid-sweep;
@@ -83,7 +93,13 @@ def main(argv=None):
 
         for attempt in range(3):
             try:
-                runner.run_experiment(runner.get_args(argv_exp))
+                if len(pending) > 1 and not args.no_batch:
+                    runner.run_experiment_batch(
+                        [runner.get_args(a) for _, a, _ in pending]
+                    )
+                else:
+                    for _, argv_exp, _ in pending:
+                        runner.run_experiment(runner.get_args(argv_exp))
                 break
             except (jax.errors.JaxRuntimeError, OSError) as e:
                 # OSError covers the tunnel's transport failures (connection
@@ -91,26 +107,29 @@ def main(argv=None):
                 # subclasses must surface immediately, not after 3 retries.
                 if isinstance(
                     e,
-                    (FileNotFoundError, IsADirectoryError,
+                    (FileNotFoundError, FileExistsError, IsADirectoryError,
                      NotADirectoryError, PermissionError),
                 ):
                     raise
                 if attempt == 2:
                     raise
                 print(
-                    f"[sweep] {trace} {mid} seed={seed} attempt "
-                    f"{attempt + 1} failed ({e}); retrying",
+                    f"[sweep] {trace} {mid} seeds={[s for s, _, _ in pending]} "
+                    f"attempt {attempt + 1} failed ({e}); retrying",
                     flush=True,
                 )
                 time.sleep(5)
-        marker.write_text(" ".join(argv_exp))
+        for seed, argv_exp, marker in pending:
+            marker.write_text(" ".join(argv_exp))
+            done += 1
         print(
-            f"[sweep {i + 1}/{len(grid)}] {trace} {mid} seed={seed} "
+            f"[sweep {done}/{total}] {trace} {mid} "
+            f"seeds={[s for s, _, _ in pending]} "
             f"{time.perf_counter() - t0:.1f}s "
             f"(total {time.perf_counter() - t_all:.0f}s)",
             flush=True,
         )
-    print(f"[sweep] {len(grid)} experiments in {time.perf_counter() - t_all:.0f}s")
+    print(f"[sweep] {total} experiments in {time.perf_counter() - t_all:.0f}s")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,131 @@
+"""Seed-batched execution (driver.schedule_pods_batch / run_batch) must give
+each seed exactly what a standalone run gives: same placements, device
+masks, final state, unscheduled lists, and reference-format log content
+(metric float rows may differ in last-ulp reduce order, which the log's
+fixed-precision formatting absorbs)."""
+
+import numpy as np
+import pytest
+
+from tpusim.io.trace import NodeRow, PodRow
+from tpusim.sim.driver import Simulator, SimulatorConfig, run_batch
+from tpusim.sim.typical import TypicalPodsConfig
+
+
+def _mk_cluster(rng):
+    return [
+        NodeRow(
+            f"n{i:03d}", 32000, 131072, int(g), "V100M16" if g else ""
+        )
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], 16))
+    ]
+
+
+def _mk_pods(rng, n=40):
+    out = []
+    for i in range(n):
+        gpu = int(rng.choice([0, 1, 2]))
+        milli = 1000 if gpu > 1 else int(rng.choice([0, 300, 500, 1000]))
+        if gpu == 0:
+            milli = 0
+        out.append(
+            PodRow(f"p{i:04d}", int(rng.choice([1000, 2000, 4000])), 2048,
+                   gpu, milli)
+        )
+    return out
+
+
+def _cfg(seed, policies=(("FGDScore", 1000),), gpu_sel="FGDScore",
+         report=True, shuffle=True):
+    return SimulatorConfig(
+        policies=policies,
+        gpu_sel_method=gpu_sel,
+        shuffle_pod=shuffle,
+        tuning_ratio=1.2,
+        tuning_seed=seed,
+        seed=seed,
+        report_per_event=report,
+        typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
+    )
+
+
+@pytest.mark.parametrize(
+    "policies,gpu_sel",
+    [
+        ((("FGDScore", 1000),), "FGDScore"),
+        ((("BestFitScore", 1000),), "best"),
+        ((("RandomScore", 1000),), "random"),  # sequential-engine path
+    ],
+    ids=["fgd", "bestfit", "random"],
+)
+def test_batch_matches_single_runs(policies, gpu_sel):
+    rng = np.random.default_rng(5)
+    nodes = _mk_cluster(rng)
+    pods = _mk_pods(rng)
+    seeds = [42, 43, 44]
+
+    singles = []
+    for s in seeds:
+        sim = Simulator(nodes, _cfg(s, policies, gpu_sel))
+        sim.set_workload_pods(pods)
+        sim.run()
+        sim.finish()
+        singles.append((sim.last_result, sim.log.dump()))
+
+    batch_sims = []
+    for s in seeds:
+        sim = Simulator(nodes, _cfg(s, policies, gpu_sel))
+        sim.set_workload_pods(pods)
+        batch_sims.append(sim)
+    results = run_batch(batch_sims)
+    for sim in batch_sims:
+        sim.finish()
+
+    for (single, slog), sim, res in zip(singles, batch_sims, results):
+        assert np.array_equal(single.placed_node, res.placed_node)
+        assert np.array_equal(single.dev_mask, res.dev_mask)
+        for a, b in zip(single.state, res.state):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert len(single.unscheduled_pods) == len(res.unscheduled_pods)
+        assert [u.pod.name for u in single.unscheduled_pods] == [
+            u.pod.name for u in res.unscheduled_pods
+        ]
+        assert np.array_equal(single.creation_rank, res.creation_rank)
+        # the reference-format logs must match line-for-line: fixed-precision
+        # formatting absorbs last-ulp float differences from vmapped reduces
+        assert slog == sim.log.dump()
+
+
+def test_batch_rejects_mixed_configs():
+    rng = np.random.default_rng(9)
+    nodes = _mk_cluster(rng)
+    pods = _mk_pods(rng, 12)
+    a = Simulator(nodes, _cfg(42))
+    b = Simulator(
+        nodes, _cfg(43, policies=(("BestFitScore", 1000),), gpu_sel="best")
+    )
+    a.set_workload_pods(pods)
+    b.set_workload_pods(pods)
+    with pytest.raises(ValueError, match="same-config"):
+        run_batch([a, b])
+
+
+def test_batch_no_report_mode():
+    rng = np.random.default_rng(11)
+    nodes = _mk_cluster(rng)
+    pods = _mk_pods(rng, 30)
+    seeds = [7, 8]
+    singles = []
+    for s in seeds:
+        sim = Simulator(nodes, _cfg(s, report=False))
+        sim.set_workload_pods(pods)
+        sim.run()
+        singles.append(sim.last_result)
+    sims = []
+    for s in seeds:
+        sim = Simulator(nodes, _cfg(s, report=False))
+        sim.set_workload_pods(pods)
+        sims.append(sim)
+    results = run_batch(sims)
+    for single, res in zip(singles, results):
+        assert np.array_equal(single.placed_node, res.placed_node)

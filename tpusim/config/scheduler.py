@@ -104,8 +104,10 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
     pct = doc.get("percentageOfNodesToScore")
     if pct is not None:
         try:
+            if float(pct) != int(pct):
+                raise ValueError
             pct = int(pct)
-        except (TypeError, ValueError):
+        except (TypeError, ValueError, OverflowError):
             raise SchedulerConfigError(
                 f"percentageOfNodesToScore={pct!r} is not an integer"
             ) from None

@@ -386,17 +386,23 @@ def _printf(fmt: str, *args) -> str:
 def _semver_compare(constraint, version):
     """Minimal semverCompare: one `[op]x.y.z` constraint against a version.
     Helm's range/caret/tilde/wildcard syntax is outside the subset → ChartError."""
+    # only the ubiquitous "-0" prerelease-inclusive idiom (">=1.19.0-0") is
+    # accepted on the constraint side; other prerelease constraints have
+    # ordering semantics the subset doesn't model and raise below
     m = re.match(
-        r"^\s*(>=|<=|!=|>|<|=)?\s*v?(\d+(?:\.\d+){0,2})(?:-[\w.-]+)?\s*$",
+        r"^\s*(>=|<=|!=|>|<|=)?\s*v?(\d+(?:\.\d+){0,2})(?:-0)?\s*$",
         str(constraint),
     )
+    # build metadata (+...) is ignored like Helm does; prerelease versions
+    # (-rc.1) have exclusion semantics the subset doesn't model — raise.
     vm = re.match(
-        r"^\s*v?(\d+(?:\.\d+){0,2})(?:[-+][\w.-]+)?\s*$", str(version)
+        r"^\s*v?(\d+(?:\.\d+){0,2})(?:\+[\w.-]+)?\s*$", str(version)
     )
     if not m or not vm:
         raise ChartError(
             f"semverCompare: unsupported constraint {constraint!r} vs {version!r} "
-            "(only single [>=|<=|>|<|=|!=]x.y.z constraints are in the subset)"
+            "(only single [>=|<=|>|<|=|!=]x.y.z constraints against release "
+            "versions are in the subset)"
         )
     op = m.group(1) or "="
     want = tuple(int(x) for x in m.group(2).split("."))

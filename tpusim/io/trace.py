@@ -164,12 +164,16 @@ def nodes_to_state(nodes: Sequence[NodeRow]) -> NodeState:
     )
 
 
-def pods_to_specs(pods: Sequence[PodRow], node_index: dict = None) -> PodSpec:
+def pods_to_specs(
+    pods: Sequence[PodRow], node_index: dict = None, device: bool = True
+) -> PodSpec:
     """PodRow list → batched PodSpec arrays. node_index maps node names to
     row indices for nodeSelector-pinned pods (snapshot resume, export.go
     hostname pinning); pods pinned to unknown nodes become unschedulable,
     pinned to index len(node_index) which no arange(num_nodes) entry matches
-    (-1 is reserved for "unconstrained")."""
+    (-1 is reserved for "unconstrained"). device=False keeps the arrays on
+    host (numpy) — callers that pad/stack several spec sets before one
+    upload (driver.schedule_pods_batch) avoid per-leaf round-trips."""
     import jax.numpy as jnp
 
     def pin(p: PodRow) -> int:
@@ -177,15 +181,16 @@ def pods_to_specs(pods: Sequence[PodRow], node_index: dict = None) -> PodSpec:
             return -1
         return node_index.get(p.pinned_node, len(node_index))
 
+    conv = jnp.asarray if device else (lambda a: a)
     return PodSpec(
-        cpu=jnp.asarray(np.array([p.cpu_milli for p in pods], np.int32)),
-        mem=jnp.asarray(np.array([p.memory_mib for p in pods], np.int32)),
-        gpu_milli=jnp.asarray(np.array([p.gpu_milli for p in pods], np.int32)),
-        gpu_num=jnp.asarray(np.array([p.num_gpu for p in pods], np.int32)),
-        gpu_mask=jnp.asarray(
+        cpu=conv(np.array([p.cpu_milli for p in pods], np.int32)),
+        mem=conv(np.array([p.memory_mib for p in pods], np.int32)),
+        gpu_milli=conv(np.array([p.gpu_milli for p in pods], np.int32)),
+        gpu_num=conv(np.array([p.num_gpu for p in pods], np.int32)),
+        gpu_mask=conv(
             np.array([gpu_spec_to_mask(p.gpu_spec) for p in pods], np.int32)
         ),
-        pinned=jnp.asarray(np.array([pin(p) for p in pods], np.int32)),
+        pinned=conv(np.array([pin(p) for p in pods], np.int32)),
     )
 
 

@@ -42,22 +42,33 @@ def _sigmoid_score(cur, new):
 
 
 def _share_terms(gpu_left, tp):
-    """fit[T,8], fitcnt[T], fitsum[T] for the current device vector."""
+    """fit[T,8], fitcnt[T], fitsum[T] for the current device vector.
+
+    fitcnt/fitsum come out of ONE stacked [T,8,2] reduction instead of two —
+    on TPU each reduction is a fusion barrier (its own kernel launch inside
+    the replay scan body), so merging reductions is the lever here, not
+    FLOPs. Counts stay exact in f32 (<= 8)."""
     fit = (gpu_left[None, :] >= tp.gpu_milli[:, None]) & (tp.gpu_milli[:, None] > 0)
     g = gpu_left[None, :].astype(jnp.float32)
-    return fit, fit.sum(1), (jnp.where(fit, g, 0.0)).sum(1)
+    both = jnp.stack(
+        [fit.astype(jnp.float32), jnp.where(fit, g, 0.0)], axis=-1
+    ).sum(1)  # [T, 2]
+    return fit, both[:, 0], both[:, 1]
 
 
 def _fgd_share_node(cpu_left, gpu_left, gpu_type, pod: PodSpec, tp):
-    """Share-GPU branch: best per-device hypothetical (fgd_score.go:111-134)."""
+    """Share-GPU branch: best per-device hypothetical (fgd_score.go:111-134).
+
+    The current score and the 8 per-device hypotheticals reduce over T in a
+    single [T, 9] sum (see _share_terms on why reductions are merged)."""
     acc = is_accessible(gpu_type, tp.gpu_mask)  # [T]
     gpu_pod = tp.gpu_milli > 0  # [T]
     fit, fitcnt, fitsum = _share_terms(gpu_left, tp)
     total = gpu_left.sum().astype(jnp.float32)
 
-    # current frag score
+    # current frag score term per typical pod
     isq3 = gpu_pod & acc & (fitcnt >= tp.gpu_num) & (cpu_left >= tp.cpu)
-    cur = (tp.freq * jnp.where(isq3, total - fitsum, total)).sum()
+    cur_t = tp.freq * jnp.where(isq3, total - fitsum, total)  # [T]
 
     # hypothetical on device d: only device d's fit/fitsum terms change
     p = pod.gpu_milli
@@ -73,15 +84,18 @@ def _fgd_share_node(cpu_left, gpu_left, gpu_type, pod: PodSpec, tp):
         gpu_pod[:, None] & acc[:, None] & (fitcnt_h >= tp.gpu_num[:, None])
         & cpu_ok_h[:, None]
     )
-    new_per_dev = (
-        tp.freq[:, None] * jnp.where(isq3_h, total_h - fitsum_h, total_h)
-    ).sum(0)  # f32[8]
+    new_t = tp.freq[:, None] * jnp.where(isq3_h, total_h - fitsum_h, total_h)
+
+    sums = jnp.concatenate([cur_t[:, None], new_t], axis=1).sum(0)  # f32[9]
+    cur, new_per_dev = sums[0], sums[1:]
 
     fits = gpu_left >= p
     dev_scores = jnp.where(fits, _sigmoid_score(cur, new_per_dev), jnp.int32(-1))
     best_dev = jnp.argmax(dev_scores).astype(jnp.int32)  # first max on ties
-    score = jnp.where(fits.any(), dev_scores[best_dev], 0)
-    dev = jnp.where(fits.any(), best_dev, -1).astype(jnp.int32)
+    best_score = dev_scores[best_dev]
+    ok = best_score >= 0  # == fits.any(): fitting devices always score >= 0
+    score = jnp.where(ok, best_score, 0)
+    dev = jnp.where(ok, best_dev, -1).astype(jnp.int32)
     return score, dev
 
 

@@ -105,12 +105,13 @@ def select_and_bind(
 
     selectHost: max weighted score over feasible nodes, smallest tie-break
     rank wins (the reference's lexicographic order over randomly-prefixed
-    node names; generic_scheduler.go:187-212)."""
-    cand = jnp.where(feasible, total, -_INT_MAX)
-    best = jnp.max(cand)
-    winner_rank = jnp.where(feasible & (cand == best), tiebreak_rank, _INT_MAX)
-    node = jnp.argmin(winner_rank).astype(jnp.int32)
-    ok = feasible.any()
+    node names; generic_scheduler.go:187-212). Two reductions: max score,
+    then argmax of -rank among the winners (= min rank); feasibility of the
+    result is read off the winner key instead of a third reduction."""
+    best = jnp.max(jnp.where(feasible, total, -_INT_MAX))
+    wkey = jnp.where(feasible & (total == best), -tiebreak_rank, -_INT_MAX)
+    node = jnp.argmax(wkey).astype(jnp.int32)
+    ok = wkey[node] != -_INT_MAX
 
     # Reserve: concrete device allocation on the chosen node.
     gpu_left = state.gpu_left[node]

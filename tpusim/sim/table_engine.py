@@ -150,21 +150,10 @@ def _row_state(state: NodeState, node) -> NodeState:
 _TABLE_REPLAY_CACHE = {}
 
 
-def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
-    """Build the jitted incremental replayer for a static policy config.
-
-    policies: [(policy_fn, weight)] — all must be table-izable (raw score a
-    pure function of node state + pod spec; RandomScore is not).
-
-    report=True emits the per-event metric rows (frag/alloc/power — the
-    reference recomputes these cluster-wide after every event,
-    simulator.go:426-427, its dominant cost). Here per-node frag/power
-    metric tables are refreshed only for the event's touched node and
-    reduced per event. Placements/devices/state stay bit-identical to the
-    sequential engine; the float metric rows agree within last-ulp
-    tolerance (the same kernels run, but XLA may fuse the single-row
-    refresh differently from the full-cluster sweep).
-    """
+def reject_randomized(policies, gpu_sel: str):
+    """Table-izability guard shared by the table and wave engines: anything
+    drawing per-event randomness would silently break their bit-identical
+    contract with the sequential oracle."""
     for fn, _ in policies:
         if fn.policy_name == "RandomScore":
             raise ValueError(
@@ -172,17 +161,17 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
                 "engine (make_replay) for it"
             )
     if gpu_sel == "random":
-        # the per-event PRNG stream would diverge from the sequential
-        # engine's, silently breaking the bit-identical contract
         raise ValueError(
             "gpu_sel='random' draws per-event randomness; use the "
             "sequential engine (make_replay) for it"
         )
-    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report)
-    if cache_key in _TABLE_REPLAY_CACHE:
-        return _TABLE_REPLAY_CACHE[cache_key]
-    num_pol = len(policies)
-    sel_idx = next(
+
+
+def selector_index(policies, gpu_sel: str) -> int:
+    """Index of the policy whose Reserve-phase device pick the configured
+    gpuSelMethod delegates to (-1 = none; the allocateGpuIdFunc registry,
+    plugin/open_gpu_share.go:39)."""
+    return next(
         (
             i
             for i, (fn, _) in enumerate(policies)
@@ -191,12 +180,25 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
         -1,
     )
 
-    def _group_fn(fn, which: str):
-        """Branch-specialized kernel when the policy provides one (the type
-        partition makes the branch static), else the generic kernel."""
-        return getattr(fn, "branches", {}).get(which, fn)
 
-    def _one_type_fn(state: NodeState, tp, key, which: str):
+def _group_fn(fn, which: str):
+    """Branch-specialized kernel when the policy provides one (the type
+    partition makes the branch static), else the generic kernel."""
+    return getattr(fn, "branches", {}).get(which, fn)
+
+
+def make_table_builders(policies, sel_idx: int):
+    """(columns, init_tables) score-table constructors for a static policy
+    list — single-sourced so the incremental table engine and the wave
+    engine (tpusim.sim.wave_engine) build bit-identical tables.
+
+    columns(state1, types, tp, key): one node's scores for all K pod types
+      -> (scores i32[num_pol, K], sharedev i32[K], feas bool[K]).
+    init_tables(state, types, tp, key): full [*, K, N] tables via a K-serial
+      map (bounds peak memory to one node-sweep's intermediates per type).
+    """
+
+    def one_type_fn(state: NodeState, tp, key, which: str):
         ctx_feas = jnp.ones(state.num_nodes, jnp.bool_)
         ctx = ScoreContext(tp=tp, feasible=ctx_feas, rng=key)
 
@@ -213,29 +215,51 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
 
         return one_type
 
-    def _columns(state1: NodeState, types: PodTypes, tp, key):
-        """Score/feasibility columns of ONE node for all K pod types:
-        -> (scores i32[num_pol, K], sharedev i32[K], feas bool[K])."""
+    def columns(state1: NodeState, types: PodTypes, tp, key):
         outs = []
         for which, specs in (("share", types.share), ("whole", types.whole)):
             if specs.cpu.shape[0]:
-                outs.append(jax.vmap(_one_type_fn(state1, tp, key, which))(specs))
+                outs.append(jax.vmap(one_type_fn(state1, tp, key, which))(specs))
         scores = jnp.concatenate([o[0][:, :, 0] for o in outs], 0)  # [K,π]
         sdev = jnp.concatenate([o[1][:, 0] for o in outs], 0)  # [K]
         feas = jnp.concatenate([o[2][:, 0] for o in outs], 0)  # [K]
         return scores.T, sdev, feas
 
-    def _init_tables(state: NodeState, types: PodTypes, tp, key):
-        """Full [*, K, N] tables via a K-serial map (bounds peak memory to
-        one node-sweep's intermediates per type)."""
+    def init_tables(state: NodeState, types: PodTypes, tp, key):
         outs = []
         for which, specs in (("share", types.share), ("whole", types.whole)):
             if specs.cpu.shape[0]:
-                outs.append(jax.lax.map(_one_type_fn(state, tp, key, which), specs))
+                outs.append(jax.lax.map(one_type_fn(state, tp, key, which), specs))
         scores = jnp.concatenate([o[0] for o in outs], 0)  # [K,π,N]
         sdev = jnp.concatenate([o[1] for o in outs], 0)  # [K,N]
         feas = jnp.concatenate([o[2] for o in outs], 0)  # [K,N]
         return jnp.swapaxes(scores, 0, 1), sdev, feas
+
+    return columns, init_tables
+
+
+def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
+    """Build the jitted incremental replayer for a static policy config.
+
+    policies: [(policy_fn, weight)] — all must be table-izable (raw score a
+    pure function of node state + pod spec; RandomScore is not).
+
+    report=True emits the per-event metric rows (frag/alloc/power — the
+    reference recomputes these cluster-wide after every event,
+    simulator.go:426-427, its dominant cost). Here per-node frag/power
+    metric tables are refreshed only for the event's touched node and
+    reduced per event. Placements/devices/state stay bit-identical to the
+    sequential engine; the float metric rows agree within last-ulp
+    tolerance (the same kernels run, but XLA may fuse the single-row
+    refresh differently from the full-cluster sweep).
+    """
+    reject_randomized(policies, gpu_sel)
+    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report)
+    if cache_key in _TABLE_REPLAY_CACHE:
+        return _TABLE_REPLAY_CACHE[cache_key]
+    num_pol = len(policies)
+    sel_idx = selector_index(policies, gpu_sel)
+    _columns, _init_tables = make_table_builders(policies, sel_idx)
 
     @jax.jit
     def replay(
