@@ -94,12 +94,23 @@ def main(argv=None):
         for attempt in range(3):
             try:
                 if len(pending) > 1 and not args.no_batch:
+                    # one vmapped replay for the whole group; markers land
+                    # only after every seed's outputs are written
                     runner.run_experiment_batch(
                         [runner.get_args(a) for _, a, _ in pending]
                     )
+                    for _, argv_exp, marker in pending:
+                        marker.write_text(" ".join(argv_exp))
                 else:
-                    for _, argv_exp, _ in pending:
+                    # per-seed markers: a failure on a late seed must not
+                    # discard earlier seeds' completion records
+                    for _, argv_exp, marker in pending:
+                        if marker.exists() and marker.read_text() == " ".join(
+                            argv_exp
+                        ):
+                            continue
                         runner.run_experiment(runner.get_args(argv_exp))
+                        marker.write_text(" ".join(argv_exp))
                 break
             except (jax.errors.JaxRuntimeError, OSError) as e:
                 # OSError covers the tunnel's transport failures (connection
@@ -119,9 +130,7 @@ def main(argv=None):
                     flush=True,
                 )
                 time.sleep(5)
-        for seed, argv_exp, marker in pending:
-            marker.write_text(" ".join(argv_exp))
-            done += 1
+        done += len(pending)
         print(
             f"[sweep {done}/{total}] {trace} {mid} "
             f"seeds={[s for s, _, _ in pending]} "

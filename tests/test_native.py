@@ -100,3 +100,102 @@ def test_memo_reuse_matches_python_order_dependence():
         assert ev.eval(*node) == pytest.approx(
             node_frag_bellman(node, t, memo=pymemo), abs=1e-9
         )
+
+
+def test_truncation_counter_fires_on_pathological_distribution():
+    """A distribution that recurses arbitrarily deep (zero-CPU pod at
+    frequency ~1 nibbling 1 milli per step keeps cum_prob high while the
+    state changes) must trip the defensive max_depth cutoff — and the
+    counter must expose it, in both the native and Python paths."""
+    t = [(0, 1, 1, 0, 0.999), (1000, 1000, 1, 0, 0.001)]
+    node = (64000, (1000,) * 8, 1)
+
+    ev = BellmanEvaluator(t, max_depth=16)
+    ev.eval(*node)
+    assert ev.truncations() > 0
+    assert ev.max_depth_seen() >= 16
+
+    stats = {}
+    node_frag_bellman(node, t, max_depth=16, stats=stats)
+    assert stats["truncations"] > 0
+    assert stats["max_depth_seen"] >= 16
+
+    # native and python agree on the truncated value too
+    ev2 = BellmanEvaluator(t, max_depth=16)
+    assert ev2.eval(*node) == pytest.approx(
+        node_frag_bellman(node, t, max_depth=16), abs=1e-9
+    )
+
+    # with enough headroom the same fixture converges without truncating
+    # (cum_prob decays below 1/total eventually) and yields a different value
+    deep = BellmanEvaluator(t, max_depth=100_000)
+    v_deep = deep.eval(*node)
+    assert deep.truncations() == 0
+    assert v_deep != pytest.approx(ev.eval(*node), abs=1e-6)
+
+
+def test_truncation_never_fires_on_full_openb_replay():
+    """The max_depth=64 bound (absent from the Go reference,
+    frag.go:231-283) must be pure headroom on the real workload: replay the
+    full openb default trace (FGD, tune 1.3 — the flagship experiment) and
+    assert zero truncations across the whole per-event bellman series."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpusim.io.trace import (
+        build_events,
+        load_node_csv,
+        load_pod_csv,
+        pods_to_specs,
+    )
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+    from tpusim.sim.engine import EV_CREATE
+    from tpusim.sim.typical import TypicalPodsConfig
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    node_csv = os.path.join(repo, "data/csv/openb_node_list_gpu_node.csv")
+    pod_csv = os.path.join(repo, "data/csv/openb_pod_list_default.csv")
+    if not (os.path.isfile(node_csv) and os.path.isfile(pod_csv)):
+        pytest.skip("openb trace not present")
+
+    cfg = SimulatorConfig(
+        policies=(("FGDScore", 1000),),
+        gpu_sel_method="FGDScore",
+        tuning_ratio=1.3,
+        tuning_seed=42,
+        seed=42,
+        shuffle_pod=True,
+        report_per_event=False,
+        typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
+    )
+    sim = Simulator(load_node_csv(node_csv), cfg)
+    sim.set_workload_pods(load_pod_csv(pod_csv))
+    sim.set_typical_pods()
+    pods = sim.prepare_pods()
+    specs = pods_to_specs(pods, sim.node_index)
+    ev_kind, ev_pod = build_events(pods)
+    out = sim.run_events(
+        sim.init_state, specs, jnp.asarray(ev_kind), jnp.asarray(ev_pod),
+        jax.random.PRNGKey(42), bucket=1,
+    )
+
+    ev = BellmanEvaluator(sim._typical_host_rows())
+    state = jax.tree.map(np.asarray, sim.init_state)
+    pod_cpu = np.fromiter((p.cpu_milli for p in pods), np.int32, len(pods))
+    pod_gpu = np.fromiter((p.gpu_milli for p in pods), np.int32, len(pods))
+    ev_pods = np.asarray(ev_pod)
+    series = ev.eval_series(
+        state.cpu_left, state.gpu_left, state.gpu_type,
+        np.asarray(out.event_node), np.asarray(out.event_dev),
+        np.where(np.asarray(ev_kind) == EV_CREATE, 1, -1).astype(np.int8),
+        pod_cpu[ev_pods], pod_gpu[ev_pods],
+    )
+    assert len(series) == len(ev_pods)
+    assert ev.truncations() == 0, (
+        f"max_depth=64 truncated {ev.truncations()} times on openb"
+    )
+    # observed headroom: the openb distribution's cum_prob cutoff bounds
+    # recursion far below the 64 guard
+    assert 0 < ev.max_depth_seen() < 64

@@ -133,3 +133,78 @@ def test_sharded_table_replay_matches_unsharded():
     n = state.num_nodes
     for a, b in zip(jax.tree.leaves(r0.state), jax.tree.leaves(r1.state)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[:n])
+
+
+@pytest.mark.parametrize(
+    "policy,gpu_sel",
+    [
+        ("FGDScore", "FGDScore"),
+        ("BestFitScore", "best"),
+        ("GpuPackingScore", "worst"),
+        ("PWRScore", "PWRScore"),  # exercises the global pwr normalization
+    ],
+    ids=lambda p: str(p),
+)
+def test_shardmap_replay_matches_unsharded(policy, gpu_sel):
+    """The explicit-collective shard_map engine (parallel.shard_engine) must
+    reproduce the unsharded table engine bit-for-bit on placements/state
+    across mesh sizes, with metric rows within float partial-sum tolerance."""
+    from tests.fixtures import random_cluster, random_pods
+    from tests.test_table_engine import _events_with_deletes
+    from tpusim.parallel.shard_engine import make_shardmap_table_replay
+    from tpusim.sim.table_engine import build_pod_types, make_table_replay
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.default_rng(43)
+    state, tp = random_cluster(rng, num_nodes=21)
+    pods = random_pods(rng, num_pods=48)
+    ev_kind, ev_pod = _events_with_deletes(48, rng)
+    types = build_pod_types(pods)
+    policies = [(make_policy(policy), 1000)]
+    key = jax.random.PRNGKey(7)
+    rank = jnp.asarray(tiebreak_rank(21, seed=3))
+
+    plain = make_table_replay(policies, gpu_sel=gpu_sel, report=True)
+    r0 = plain(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+
+    for n_dev in (2, 8):
+        mesh = make_mesh(n_dev)
+        pstate, prank = pad_nodes(state, rank, n_dev)
+        pstate = shard_state(pstate, mesh)
+        sharded = make_shardmap_table_replay(
+            policies, mesh, gpu_sel=gpu_sel, report=True
+        )
+        r1 = sharded(pstate, pods, types, ev_kind, ev_pod, tp, key, prank)
+        np.testing.assert_array_equal(
+            np.asarray(r0.placed_node), np.asarray(r1.placed_node)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r0.dev_mask), np.asarray(r1.dev_mask)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r0.event_node), np.asarray(r1.event_node)
+        )
+        n = state.num_nodes
+        for a, b in zip(jax.tree.leaves(r0.state), jax.tree.leaves(r1.state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[:n])
+        # int usage counters are exact (psum of int partials); float rows
+        # agree within partial-sum reorder tolerance
+        np.testing.assert_array_equal(
+            np.asarray(r0.metrics.used_nodes), np.asarray(r1.metrics.used_nodes)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r0.metrics.used_gpu_milli),
+            np.asarray(r1.metrics.used_gpu_milli),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r0.metrics.arrived_gpu_milli),
+            np.asarray(r1.metrics.arrived_gpu_milli),
+        )
+        for f in ("frag_amounts", "power_cpu", "power_gpu"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(r0.metrics, f)),
+                np.asarray(getattr(r1.metrics, f)),
+                rtol=3e-5,
+                err_msg=f,
+            )

@@ -390,19 +390,22 @@ def _semver_compare(constraint, version):
     # accepted on the constraint side; other prerelease constraints have
     # ordering semantics the subset doesn't model and raise below
     m = re.match(
-        r"^\s*(>=|<=|!=|>|<|=)?\s*v?(\d+(?:\.\d+){0,2})(?:-0)?\s*$",
+        r"^\s*(>=|<=|!=|>|<|=)?\s*v?(\d+(?:\.\d+){0,2})(-0)?\s*$",
         str(constraint),
     )
-    # build metadata (+...) is ignored like Helm does; prerelease versions
-    # (-rc.1) have exclusion semantics the subset doesn't model — raise.
+    # build metadata (+...) is ignored like Helm does. A prerelease version
+    # (1.27.3-gke.100) compares by its numeric core ONLY when the constraint
+    # opted into prereleases via "-0"; against a plain constraint Helm
+    # EXCLUDES prereleases, which the subset doesn't model — raise.
     vm = re.match(
-        r"^\s*v?(\d+(?:\.\d+){0,2})(?:\+[\w.-]+)?\s*$", str(version)
+        r"^\s*v?(\d+(?:\.\d+){0,2})(-[\w.-]+)?(?:\+[\w.-]+)?\s*$",
+        str(version),
     )
-    if not m or not vm:
+    if not m or not vm or (vm.group(2) and not m.group(3)):
         raise ChartError(
             f"semverCompare: unsupported constraint {constraint!r} vs {version!r} "
-            "(only single [>=|<=|>|<|=|!=]x.y.z constraints against release "
-            "versions are in the subset)"
+            "(the subset models single [>=|<=|>|<|=|!=]x.y.z constraints; "
+            "prerelease versions only against a '-0'-suffixed constraint)"
         )
     op = m.group(1) or "="
     want = tuple(int(x) for x in m.group(2).split("."))

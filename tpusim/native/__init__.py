@@ -75,6 +75,10 @@ def _ensure_lib():
         ]
         lib.bellman_memo_size.restype = ctypes.c_int64
         lib.bellman_memo_size.argtypes = [ctypes.c_void_p]
+        lib.bellman_truncations.restype = ctypes.c_int64
+        lib.bellman_truncations.argtypes = [ctypes.c_void_p]
+        lib.bellman_max_depth_seen.restype = ctypes.c_int32
+        lib.bellman_max_depth_seen.argtypes = [ctypes.c_void_p]
         lib.bellman_free.argtypes = [ctypes.c_void_p]
         _lib = lib
     except (OSError, subprocess.CalledProcessError):
@@ -99,6 +103,7 @@ class BellmanEvaluator:
         ]
         self._handle: Optional[int] = None
         self._pymemo: dict = {}
+        self._pystats: dict = {}
         lib = _ensure_lib()
         if lib is not None:
             t = len(self._typical)
@@ -131,6 +136,7 @@ class BellmanEvaluator:
             self._typical,
             max_depth=self._max_depth,
             memo=self._pymemo,
+            stats=self._pystats,
         )
 
     def eval_series(
@@ -204,6 +210,21 @@ class BellmanEvaluator:
         if self._handle is not None:
             return int(_lib.bellman_memo_size(self._handle))
         return len(self._pymemo)
+
+    def truncations(self) -> int:
+        """How often the defensive max_depth cutoff fired (the Go reference
+        recurses unboundedly, frag.go:231-283 — on real traces this must
+        stay 0; tests/test_native.py asserts it over a full openb replay)."""
+        if self._handle is not None:
+            return int(_lib.bellman_truncations(self._handle))
+        return int(self._pystats.get("truncations", 0))
+
+    def max_depth_seen(self) -> int:
+        """Deepest recursion level reached — the observed headroom under
+        the max_depth bound."""
+        if self._handle is not None:
+            return int(_lib.bellman_max_depth_seen(self._handle))
+        return int(self._pystats.get("max_depth_seen", 0))
 
     def __del__(self):
         if self._handle is not None and _lib is not None:

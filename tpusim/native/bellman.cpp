@@ -163,6 +163,8 @@ struct Evaluator {
     std::vector<TypicalPod> pods;
     std::vector<int32_t> millis;  // distinct positive, ascending
     int max_depth;
+    int64_t truncations = 0;  // times the depth cutoff fired (see below)
+    int max_depth_seen = 0;   // deepest recursion level reached
     FlatMap memo;
 
     double rec(int32_t cpu_left, int16_t* g /* sorted desc */, int32_t type,
@@ -199,7 +201,14 @@ struct Evaluator {
                 fit_of(t.milli) < t.num || cpu_left < t.cpu)
                 ratio_except_q3 += t.freq;
         }
-        if (depth >= max_depth) return static_cast<double>(total);
+        if (depth > max_depth_seen) max_depth_seen = depth;
+        if (depth >= max_depth) {
+            // the Go reference has no depth limit (frag.go:231-283); this
+            // guard exists only for pathological distributions, and the
+            // counter lets callers assert it never fires on real traces
+            ++truncations;
+            return static_cast<double>(total);
+        }
 
         double frag;
         if (ratio_except_q3 < 0.999) {
@@ -318,6 +327,14 @@ int32_t bellman_series(void* handle, int32_t n, const int32_t* cpu_left,
 int64_t bellman_memo_size(void* handle) {
     return static_cast<int64_t>(
         static_cast<Evaluator*>(handle)->memo.size());
+}
+
+int64_t bellman_truncations(void* handle) {
+    return static_cast<Evaluator*>(handle)->truncations;
+}
+
+int32_t bellman_max_depth_seen(void* handle) {
+    return static_cast<Evaluator*>(handle)->max_depth_seen;
 }
 
 void bellman_free(void* handle) { delete static_cast<Evaluator*>(handle); }

@@ -124,7 +124,7 @@ def cluster_frag_report(state: NodeState, tp: TypicalPods):
     return amounts, frag, 100.0 * frag / idle, 100.0 * q124 / idle
 
 
-def node_frag_bellman(node, typical, max_depth: int = 64, memo=None):
+def node_frag_bellman(node, typical, max_depth: int = 64, memo=None, stats=None):
     """Host-side Bellman expected-frag value function
     (ref: frag.go:231-283 NodeGpuFragBellman).
 
@@ -133,7 +133,10 @@ def node_frag_bellman(node, typical, max_depth: int = 64, memo=None):
     `node` is (cpu_left:int, gpu_left:tuple[int,...], gpu_type:int); `typical`
     is a list of (cpu, gpu_milli, gpu_num, gpu_mask, freq) tuples. Pass a
     dict as `memo` to share the flattened-state cache across calls (the
-    reference's cross-event `fragMemo sync.Map`, simulator.go:58).
+    reference's cross-event `fragMemo sync.Map`, simulator.go:58). Pass a
+    dict as `stats` to collect {"truncations", "max_depth_seen"} — the Go
+    code has no depth limit, so callers can assert the defensive cutoff
+    never fires on real traces.
 
     The recursion keeps the device vector canonically sorted DESCENDING
     (value permutation-invariant, like the reference's Flatten dedup key),
@@ -177,10 +180,14 @@ def node_frag_bellman(node, typical, max_depth: int = 64, memo=None):
                 or cpu_left < cpu
             ):
                 ratio_except_q3 += p
+        if stats is not None and depth > stats.get("max_depth_seen", 0):
+            stats["max_depth_seen"] = depth
         if depth >= max_depth:
             # Defensive truncation (the Go code has no depth limit; its
             # cum_prob cutoff bounds recursion in practice). Do NOT memoize:
             # the truncated value would poison shallow-depth revisits.
+            if stats is not None:
+                stats["truncations"] = stats.get("truncations", 0) + 1
             return float(total)
         if ratio_except_q3 < 0.999:
             pv = 0.0

@@ -1,3 +1,4 @@
+from tpusim.parallel.shard_engine import make_shardmap_table_replay
 from tpusim.parallel.sharding import (
     make_mesh,
     make_sharded_replay,
@@ -11,6 +12,7 @@ __all__ = [
     "make_mesh",
     "make_sharded_replay",
     "make_sharded_table_replay",
+    "make_shardmap_table_replay",
     "pad_nodes",
     "shard_state",
     "state_sharding",

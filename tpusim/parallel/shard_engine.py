@@ -1,0 +1,370 @@
+"""Explicit-collective sharded replay (shard_map) — flat per-event cost.
+
+The first sharded engine (tpusim.parallel.sharding) re-jits the table engine
+with node-axis in_shardings and lets XLA's SPMD partitioner insert the
+collectives. That proves equality, but the partitioner turns the per-event
+dynamic gathers/scatters at the winning node's index (state.gpu_left[node],
+.at[node].add, the dirty-column refresh) into whole-array movement, so
+us/event GROWS with mesh size (MULTICHIP round-2 table: 2751 -> 9731 us/event
+from 1 -> 8 virtual devices).
+
+This engine writes the communication by hand with jax.shard_map, the way the
+scaling-book recipe says to when the partitioner's choices matter:
+
+  - Filter/Score/table refresh are LOCAL: each shard owns N/D node rows and
+    the matching [K, N/D] score-table shard; the dirty-node column refresh
+    runs on every shard but only the owner's masked write lands.
+  - selectHost is a local argmax + THREE scalar collectives: pmax of the
+    best local score, pmin of the winning tie-break rank among score-tied
+    shards, psum of the winner's global node id (ranks are a permutation,
+    so exactly one shard contributes). Lexicographically identical to the
+    global (max score, min rank) selection in sim.step.select_and_bind.
+  - Reserve/Bind are OWNER-LOCAL: the owning shard computes the device mask
+    from its local row (sim.step.choose_devices — the same helper the
+    global engine binds with) and applies the row update; one [8]-wide psum
+    publishes the device mask for the replicated bookkeeping arrays.
+  - Per-event metric rows (report=True) never synchronize inside the loop:
+    each shard emits LOCAL partial rows (frag/usage/power sums over its own
+    rows) as scan outputs, and ONE psum over the whole [E, 13] matrix after
+    the scan produces the cluster rows — zero per-event collectives beyond
+    selectHost's, vs the reference recomputing cluster metrics after every
+    event (simulator.go:426-427).
+
+Per-event collective payload: 3 scalars + one 8-lane mask, independent of
+N and D — the us/event curve stays flat as the mesh grows (MULTICHIP.md).
+Placements are bit-identical to the single-device table engine; metric
+float sums differ only in partial-sum order (local-then-psum).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
+from tpusim.ops.frag import cluster_frag_amounts
+from tpusim.policies.base import feasible_min_max, minmax_scale_i32
+from tpusim.sim.engine import EventMetrics, ReplayResult, cluster_usage, power_rows
+from tpusim.sim.step import choose_devices
+from tpusim.sim.table_engine import (
+    PodTypes,
+    _row_state,
+    make_table_builders,
+    reject_randomized,
+    selector_index,
+)
+from tpusim.types import NodeState, PodSpec
+
+from tpusim.parallel.sharding import NODE_AXIS, state_sharding
+
+_INT_MAX = jnp.int32(jnp.iinfo(jnp.int32).max)
+
+_SHARDMAP_CACHE = {}
+
+
+
+
+def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
+                               report: bool = False):
+    """Build the explicit-collective sharded replayer. The node count must
+    already be padded to a multiple of the mesh size (parallel.pad_nodes)
+    and `state`/`tiebreak_rank` sharded over it (parallel.shard_state)."""
+    reject_randomized(policies, gpu_sel)
+    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
+                 tuple(mesh.shape.items()), tuple(d.id for d in mesh.devices.flat))
+    if cache_key in _SHARDMAP_CACHE:
+        return _SHARDMAP_CACHE[cache_key]
+    sel_idx = selector_index(policies, gpu_sel)
+    _columns, _init_tables = make_table_builders(policies, sel_idx)
+    npol = len(policies)
+    n_dev = mesh.shape[NODE_AXIS]
+
+    def shard_fn(state, rank, pods, types, ev_kind, ev_pod, tp, key):
+        """Runs per shard: state/rank are the LOCAL node rows."""
+        nloc = state.num_nodes
+        me = jax.lax.axis_index(NODE_AXIS)
+        offset = (me * nloc).astype(jnp.int32)
+        gids = offset + jnp.arange(nloc, dtype=jnp.int32)
+        num_pods = pods.cpu.shape[0]
+        type_id = types.type_id
+
+        key, k_init = jax.random.split(key)
+        s0, d0, f0 = _init_tables(state, types, tp, k_init)
+        packed_tbl = jnp.concatenate(
+            [jnp.moveaxis(s0, 0, -1), d0[..., None],
+             f0.astype(jnp.int32)[..., None]],
+            axis=-1,
+        )  # [K, nloc, C]
+
+        placed = jnp.full(num_pods, -1, jnp.int32)
+        masks = jnp.zeros((num_pods, MAX_GPUS_PER_NODE), jnp.bool_)
+        failed = jnp.zeros(num_pods, jnp.bool_)
+        if report:
+            frag_tbl = cluster_frag_amounts(state, tp)  # local [nloc, 7]
+            pc0, pg0 = power_rows(state)
+            power_tbl = jnp.stack([pc0, pg0], -1)  # local [nloc, 2]
+        else:
+            frag_tbl = power_tbl = jnp.zeros((0,))
+
+        def body(carry, ev):
+            (state, packed_tbl, dirty, placed, masks, failed,
+             arr_cpu, arr_gpu, frag_tbl, power_tbl, key) = carry
+            kind, idx = ev
+            pod = jax.tree.map(lambda a: a[idx], pods)
+            t_id = type_id[idx]
+            key, k_col, k_sel = jax.random.split(key, 3)
+
+            # dirty-column refresh: ONLY the owning shard computes (a real
+            # lax.cond branch — non-owners skip the K-type scoring sweep
+            # entirely, which also keeps the single-host virtual mesh from
+            # paying D redundant refreshes per event)
+            li = dirty - offset
+            owns_d = (li >= 0) & (li < nloc)
+            lic = jnp.clip(li, 0, nloc - 1)
+
+            # the cond computes only the [K, 1, C] column (non-owners reuse
+            # the old slice); the table write itself stays OUTSIDE the cond
+            # so XLA can alias the dynamic_update_slice in place — a cond
+            # returning the whole table forces a full-buffer copy per event
+            def refresh_col():
+                cs, cd, cf = _columns(_row_state(state, lic), types, tp, k_col)
+                return jnp.concatenate(
+                    [cs.T, cd[:, None], cf.astype(jnp.int32)[:, None]],
+                    axis=-1,
+                )[:, None, :]
+
+            new_col = jax.lax.cond(
+                owns_d,
+                refresh_col,
+                lambda: jax.lax.dynamic_slice_in_dim(packed_tbl, lic, 1, axis=1),
+            )
+            packed_tbl = jax.lax.dynamic_update_slice_in_dim(
+                packed_tbl, new_col, lic, axis=1
+            )
+
+            def do_create():
+                row = packed_tbl[t_id]  # [nloc, C]
+                feasible = (row[:, npol + 1] != 0) & (
+                    (pod.pinned < 0) | (gids == pod.pinned)
+                )
+                total = jnp.zeros(nloc, jnp.int32)
+                for i, (fn, weight) in enumerate(policies):
+                    raw = row[:, i]
+                    if fn.normalize in ("minmax", "pwr"):
+                        # local extrema + pmin/pmax = the global reduction;
+                        # the scaling core is the same code the unsharded
+                        # engines normalize with
+                        lo_l, hi_l = feasible_min_max(raw, feasible)
+                        lo = jax.lax.pmin(lo_l, NODE_AXIS)
+                        hi = jax.lax.pmax(hi_l, NODE_AXIS)
+                        raw = minmax_scale_i32(
+                            raw, feasible, lo, hi,
+                            0 if fn.normalize == "minmax" else MAX_NODE_SCORE,
+                        )
+                    total = total + jnp.int32(weight) * raw
+
+                # selectHost: local argmax + 3 scalar collectives
+                best_l = jnp.max(jnp.where(feasible, total, -_INT_MAX))
+                wkey = jnp.where(
+                    feasible & (total == best_l), -rank, -_INT_MAX
+                )
+                am_l = jnp.argmax(wkey).astype(jnp.int32)
+                rank_l = -wkey[am_l]  # INT_MAX when shard has no candidate
+                g_best = jax.lax.pmax(best_l, NODE_AXIS)
+                g_rank = jax.lax.pmin(
+                    jnp.where(best_l == g_best, rank_l, _INT_MAX), NODE_AXIS
+                )
+                ok = g_best != -_INT_MAX
+                win = ok & (best_l == g_best) & (rank_l == g_rank)
+                gnode = jax.lax.psum(
+                    jnp.where(win, offset + am_l, 0), NODE_AXIS
+                ).astype(jnp.int32)
+
+                # Reserve/Bind: owner-local row update; one [8] psum
+                # publishes the device mask for the replicated bookkeeping
+                ln = jnp.clip(gnode - offset, 0, nloc - 1)
+                owner = (gnode >= offset) & (gnode < offset + nloc)
+                dmask_l = choose_devices(
+                    state.gpu_left[ln], pod, row[ln, npol], gpu_sel, k_sel
+                ) & ok
+                dev_mask = (
+                    jax.lax.psum(
+                        jnp.where(owner, dmask_l, False).astype(jnp.int32),
+                        NODE_AXIS,
+                    )
+                    > 0
+                )
+                apply = owner & ok
+                from tpusim.policies.clustering import pod_affinity_class
+
+                cls = pod_affinity_class(pod)
+                new_state = state._replace(
+                    cpu_left=state.cpu_left.at[ln].add(
+                        jnp.where(apply, -pod.cpu, 0)
+                    ),
+                    mem_left=state.mem_left.at[ln].add(
+                        jnp.where(apply, -pod.mem, 0)
+                    ),
+                    gpu_left=state.gpu_left.at[ln].add(
+                        jnp.where(apply, -dev_mask.astype(jnp.int32) * pod.gpu_milli, 0)
+                    ),
+                    aff_cnt=state.aff_cnt.at[ln, jnp.maximum(cls, 0)].add(
+                        jnp.where(apply & (cls >= 0), 1, 0)
+                    ),
+                )
+                node_out = jnp.where(ok, gnode, -1)
+                return (
+                    new_state,
+                    placed.at[idx].set(node_out),
+                    masks.at[idx].set(dev_mask),
+                    failed.at[idx].set(~ok),
+                    node_out,
+                    arr_cpu + pod.cpu,
+                    arr_gpu + pod.total_gpu_milli(),
+                    node_out,
+                    dev_mask,
+                )
+
+            def do_delete():
+                gnode = placed[idx]
+                dmask = masks[idx]
+                ln = jnp.clip(gnode - offset, 0, nloc - 1)
+                apply = (gnode >= offset) & (gnode < offset + nloc)
+                from tpusim.policies.clustering import pod_affinity_class
+
+                cls = pod_affinity_class(pod)
+                new_state = state._replace(
+                    cpu_left=state.cpu_left.at[ln].add(
+                        jnp.where(apply, pod.cpu, 0)
+                    ),
+                    mem_left=state.mem_left.at[ln].add(
+                        jnp.where(apply, pod.mem, 0)
+                    ),
+                    gpu_left=state.gpu_left.at[ln].add(
+                        jnp.where(apply, dmask.astype(jnp.int32) * pod.gpu_milli, 0)
+                    ),
+                    aff_cnt=state.aff_cnt.at[ln, jnp.maximum(cls, 0)].add(
+                        jnp.where(apply & (cls >= 0), -1, 0)
+                    ),
+                )
+                return (
+                    new_state,
+                    placed.at[idx].set(-1),
+                    masks.at[idx].set(False),
+                    failed,
+                    gnode,
+                    arr_cpu,
+                    arr_gpu,
+                    gnode,
+                    dmask,
+                )
+
+            def do_skip():
+                return (
+                    state, placed, masks, failed, dirty, arr_cpu, arr_gpu,
+                    jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
+                )
+
+            (state2, placed2, masks2, failed2, dirty2, arr_cpu2, arr_gpu2,
+             node, dev) = jax.lax.switch(
+                jnp.clip(kind, 0, 2), [do_create, do_delete, do_skip]
+            )
+            if report:
+                # refresh the touched node's LOCAL metric rows (same kernels
+                # as the table engine's report path), emit local partials —
+                # the cross-shard sum happens ONCE after the scan
+                li2 = dirty2 - offset
+                owns2 = (li2 >= 0) & (li2 < nloc)
+                lic2 = jnp.clip(li2, 0, nloc - 1)
+
+                def refresh_metrics():
+                    row_state = _row_state(state2, lic2)
+                    fr = cluster_frag_amounts(row_state, tp)  # [1, 7]
+                    pc, pg = power_rows(row_state)
+                    return fr, jnp.stack([pc[0], pg[0]])[None, :]
+
+                fr, prow = jax.lax.cond(
+                    owns2,
+                    refresh_metrics,
+                    lambda: (
+                        jax.lax.dynamic_slice_in_dim(frag_tbl, lic2, 1, 0),
+                        jax.lax.dynamic_slice_in_dim(power_tbl, lic2, 1, 0),
+                    ),
+                )
+                frag_tbl2 = jax.lax.dynamic_update_slice_in_dim(
+                    frag_tbl, fr, lic2, 0
+                )
+                power_tbl2 = jax.lax.dynamic_update_slice_in_dim(
+                    power_tbl, prow, lic2, 0
+                )
+                un, ug, ugm, ucm = cluster_usage(state2)  # local partials
+                # float partials (frag amounts + power) and int partials
+                # (usage counters) ride separate streams: packing the int
+                # counters into f32 would lose exactness past 2^24
+                pf = jnp.concatenate([frag_tbl2.sum(0), power_tbl2.sum(0)])
+                pi = jnp.stack([un, ug, ugm, ucm])
+            else:
+                frag_tbl2, power_tbl2 = frag_tbl, power_tbl
+                pf = jnp.zeros(0, jnp.float32)
+                pi = jnp.zeros(0, jnp.int32)
+            return (
+                state2, packed_tbl, dirty2, placed2, masks2, failed2,
+                arr_cpu2, arr_gpu2, frag_tbl2, power_tbl2, key,
+            ), (pf, pi, node, dev, arr_cpu2, arr_gpu2)
+
+        init = (state, packed_tbl, jnp.int32(0), placed, masks, failed,
+                jnp.int32(0), jnp.int32(0), frag_tbl, power_tbl, key)
+        (state, _, _, placed, masks, failed, _, _, _, _, _), (
+            pf, pi, nodes, devs, arr_cpus, arr_gpus
+        ) = jax.lax.scan(body, init, (ev_kind, ev_pod))
+
+        if report:
+            # the ONE cross-shard metric reduction for the whole replay
+            # (well, two: exact-int usage counters and float frag/power)
+            rows_f = jax.lax.psum(pf, NODE_AXIS)  # [E, 9]
+            rows_i = jax.lax.psum(pi, NODE_AXIS)  # [E, 4]
+            metrics = EventMetrics(
+                frag_amounts=rows_f[:, :7],
+                used_nodes=rows_i[:, 0],
+                used_gpus=rows_i[:, 1],
+                used_gpu_milli=rows_i[:, 2],
+                used_cpu_milli=rows_i[:, 3],
+                arrived_gpu_milli=arr_gpus,
+                arrived_cpu_milli=arr_cpus,
+                power_cpu=rows_f[:, 7],
+                power_gpu=rows_f[:, 8],
+            )
+        else:
+            metrics = None
+        return state, placed, masks, failed, metrics, nodes, devs
+
+    state_specs = NodeState(*([P(NODE_AXIS)] * len(NodeState._fields)))
+    spec_r = PodSpec(*([P()] * 6))
+    types_specs = PodTypes(spec_r, spec_r, P())
+    from tpusim.types import TypicalPods
+
+    tp_specs = TypicalPods(*([P()] * len(TypicalPods._fields)))
+    metrics_specs = (
+        EventMetrics(*([P()] * len(EventMetrics._fields))) if report else None
+    )
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(state_specs, P(NODE_AXIS), spec_r, types_specs,
+                  P(), P(), tp_specs, P()),
+        out_specs=(state_specs, P(), P(), P(), metrics_specs, P(), P()),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def replay(state, pods, types, ev_kind, ev_pod, tp, key,
+               tiebreak_rank) -> ReplayResult:
+        out = mapped(state, tiebreak_rank, pods, types, ev_kind, ev_pod,
+                     tp, key)
+        return ReplayResult(*out)
+
+    _SHARDMAP_CACHE[cache_key] = replay
+    return replay

@@ -52,28 +52,39 @@ class PolicyResult(NamedTuple):
 PolicyFn = Callable[[NodeState, PodSpec, ScoreContext], PolicyResult]
 
 
-def minmax_normalize_i32(scores, feasible):
-    """Integer min-max rescale to [0, 100] over feasible nodes
-    (ref: plugin_utils.go:48-74). oldRange == 0 → all MinNodeScore(0).
-    Infeasible rows are passed through untouched (the reference never sees
-    them); callers mask them out before use.
-    """
+def feasible_min_max(scores, feasible):
+    """(lo, hi) over feasible entries — the reduction half of the min-max
+    normalizations, split out so sharded callers can feed pmin/pmax-combined
+    global extrema into the same scaling core."""
     big = jnp.int32(jnp.iinfo(jnp.int32).max)
     lo = jnp.min(jnp.where(feasible, scores, big))
     hi = jnp.max(jnp.where(feasible, scores, -big))
+    return lo, hi
+
+
+def minmax_scale_i32(scores, feasible, lo, hi, degenerate):
+    """The scaling core of the reference's integer NormalizeScore
+    (plugin_utils.go:48-74): rescale to [0, MAX_NODE_SCORE] against the
+    supplied extrema; a zero range maps everything to `degenerate`.
+    Infeasible rows pass through untouched (the reference never sees them);
+    callers mask them out before use."""
     rng = hi - lo
-    scaled = jnp.where(rng == 0, 0, (scores - lo) * MAX_NODE_SCORE // jnp.maximum(rng, 1))
+    scaled = jnp.where(
+        rng == 0, degenerate,
+        (scores - lo) * MAX_NODE_SCORE // jnp.maximum(rng, 1),
+    )
     return jnp.where(feasible, scaled, scores)
+
+
+def minmax_normalize_i32(scores, feasible):
+    """Integer min-max rescale to [0, 100] over feasible nodes
+    (ref: plugin_utils.go:48-74). oldRange == 0 → all MinNodeScore(0)."""
+    lo, hi = feasible_min_max(scores, feasible)
+    return minmax_scale_i32(scores, feasible, lo, hi, 0)
 
 
 def pwr_normalize_i32(scores, feasible):
     """PWR's own NormalizeScore (pwr_score.go:104-139): min-max to [0,100]
     but the degenerate all-equal case maps to 100, not 0."""
-    big = jnp.int32(jnp.iinfo(jnp.int32).max)
-    lo = jnp.min(jnp.where(feasible, scores, big))
-    hi = jnp.max(jnp.where(feasible, scores, -big))
-    rng = hi - lo
-    scaled = jnp.where(
-        rng == 0, MAX_NODE_SCORE, (scores - lo) * MAX_NODE_SCORE // jnp.maximum(rng, 1)
-    )
-    return jnp.where(feasible, scaled, scores)
+    lo, hi = feasible_min_max(scores, feasible)
+    return minmax_scale_i32(scores, feasible, lo, hi, MAX_NODE_SCORE)

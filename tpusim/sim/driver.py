@@ -536,6 +536,23 @@ class Simulator:
 
     # ---- reporting (analysis.go) ----
 
+    def _typical_host_rows(self):
+        """Typical-pod distribution as host tuples
+        [(cpu, gpu_milli, gpu_num, gpu_mask, freq)] — the BellmanEvaluator's
+        constructor format."""
+        t = getattr(self, "_typical_host", None)
+        if t is None:
+            t = self._typical_host = device_fetch(self.typical)
+        return list(
+            zip(
+                np.asarray(t.cpu).tolist(),
+                np.asarray(t.gpu_milli).tolist(),
+                np.asarray(t.gpu_num).tolist(),
+                np.asarray(t.gpu_mask).tolist(),
+                np.asarray(t.freq).tolist(),
+            )
+        )
+
     def _bellman_series(self, start_state, pods, ev_kind, ev_pod, out):
         """Per-event cluster Bellman frag (ref: the `(bellman)` [Report]
         variant, analysis.go:110): reconstruct each event's touched node
@@ -550,21 +567,7 @@ class Simulator:
         if self._bellman_eval is None:
             from tpusim.native import BellmanEvaluator
 
-            t = getattr(self, "_typical_host", None)
-            if t is None:
-
-                t = self._typical_host = device_fetch(self.typical)
-            self._bellman_eval = BellmanEvaluator(
-                list(
-                    zip(
-                        np.asarray(t.cpu).tolist(),
-                        np.asarray(t.gpu_milli).tolist(),
-                        np.asarray(t.gpu_num).tolist(),
-                        np.asarray(t.gpu_mask).tolist(),
-                        np.asarray(t.freq).tolist(),
-                    )
-                )
-            )
+            self._bellman_eval = BellmanEvaluator(self._typical_host_rows())
         kinds = np.asarray(ev_kind)
         ev_pods = np.asarray(ev_pod)
         pod_cpu = np.fromiter(
@@ -794,12 +797,17 @@ def schedule_pods_batch(
             and s.cfg.use_timestamps == lead.cfg.use_timestamps
             and s.cfg.typical_pods == lead.cfg.typical_pods
             and s.nodes == lead.nodes
+            # the batched replay scores every seed against lead's typical
+            # pods (vmap in_axes None), which is only sound when the seeds
+            # share the workload the distribution derives from
+            and s.workload_pods == lead.workload_pods
         )
         if not same:
             raise ValueError(
                 "schedule_pods_batch requires same-config sims (policies, "
-                "gpu/dim/norm methods, report flag, typical-pod knobs, and "
-                "an identical node cluster may not differ across the batch)"
+                "gpu/dim/norm methods, report flag, typical-pod knobs, the "
+                "node cluster, and the workload may not differ across the "
+                "batch)"
             )
     t0 = time.perf_counter()
     specs_list, ev_list = [], []

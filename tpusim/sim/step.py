@@ -89,6 +89,23 @@ def _choose_share_device(gpu_left, pod, policy_dev, gpu_sel: str, key):
     )
 
 
+def choose_devices(gpu_left, pod, policy_dev_scalar, gpu_sel: str, key):
+    """Reserve-phase device mask for one node row: share-GPU pods go through
+    the gpuSelMethod machinery (_choose_share_device), whole/multi-GPU pods
+    through the two-pointer pack in device-index order (gpunodeinfo.go:
+    182-201; == first fully-free devices when milli == 1000). Shared by the
+    global select_and_bind and the shard_map engine's owner-local bind."""
+    share_dev = _choose_share_device(gpu_left, pod, policy_dev_scalar, gpu_sel, key)
+    share_mask = jax.nn.one_hot(share_dev, MAX_GPUS_PER_NODE, dtype=jnp.bool_) & (
+        share_dev >= 0
+    )
+    units, _ = allocate_two_pointer(gpu_left, pod.gpu_milli, pod.gpu_num)
+    whole_mask = units > 0
+    is_share = pod.is_gpu_share()
+    has_gpu = pod.total_gpu_milli() > 0
+    return jnp.where(has_gpu, jnp.where(is_share, share_mask, whole_mask), False)
+
+
 def select_and_bind(
     state: NodeState,
     pod: PodSpec,
@@ -114,18 +131,7 @@ def select_and_bind(
     ok = wkey[node] != -_INT_MAX
 
     # Reserve: concrete device allocation on the chosen node.
-    gpu_left = state.gpu_left[node]
-    share_dev = _choose_share_device(gpu_left, pod, policy_dev[node], gpu_sel, key)
-    share_mask = jax.nn.one_hot(share_dev, MAX_GPUS_PER_NODE, dtype=jnp.bool_) & (
-        share_dev >= 0
-    )
-    # Whole-GPU / multi-GPU pods: two-pointer pack in device-index order
-    # (gpunodeinfo.go:182-201; == first fully-free devices when milli == 1000).
-    units, _ = allocate_two_pointer(gpu_left, pod.gpu_milli, pod.gpu_num)
-    whole_mask = units > 0
-    is_share = pod.is_gpu_share()
-    has_gpu = pod.total_gpu_milli() > 0
-    dev_mask = jnp.where(has_gpu, jnp.where(is_share, share_mask, whole_mask), False)
+    dev_mask = choose_devices(state.gpu_left[node], pod, policy_dev[node], gpu_sel, key)
     dev_mask = dev_mask & ok
 
     # Bind: scatter-commit the placement.
