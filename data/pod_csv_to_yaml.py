@@ -1,0 +1,23 @@
+#!/usr/bin/env python3
+"""CSV pod trace → YAML manifests (drop-in for the reference's
+data/pod_csv_to_yaml.py CLI: same argv, same <stem>/<stem>.yaml output
+layout). Implementation in tpusim.io.data_prep.
+
+Usage:
+    python3 data/pod_csv_to_yaml.py data/csv/openb_pod_list_gpuspec10.csv
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from tpusim.io.data_prep import pod_csv_to_yaml
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        sys.exit(__doc__)
+    src = Path(sys.argv[1])
+    if not src.exists():
+        sys.exit(f"CSV File: {src} does not exist")
+    pod_csv_to_yaml(src, sys.argv[2] if len(sys.argv) > 2 else None)

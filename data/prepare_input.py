@@ -1,0 +1,24 @@
+#!/usr/bin/env python3
+"""Generate per-trace cluster-config input folders from the CSV traces
+(drop-in for the reference's data/prepare_input.sh): every
+openb_pod_list*.csv becomes <out>/<trace>/ holding its pod YAML plus the
+shared node YAML, ready for `python -m tpusim apply` (or the reference's
+`simon apply`). Implementation in tpusim.io.data_prep.
+
+Usage:
+    python3 data/prepare_input.py [csv_dir] [out_dir]
+    python3 data/prepare_input.py data/csv data/input
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from tpusim.io.data_prep import prepare_input
+
+if __name__ == "__main__":
+    csv_dir = sys.argv[1] if len(sys.argv) > 1 else "data/csv"
+    out_dir = sys.argv[2] if len(sys.argv) > 2 else "data/input"
+    made = prepare_input(csv_dir, out_dir)
+    print(f"prepared {len(made)} trace folders under {out_dir}")

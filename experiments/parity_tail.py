@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""frag@90 parity-tail analysis: seed-distribution comparison for the worst
+cells of the 102-cell matrix (PARITY.md).
+
+The 10-seed-mean frag@90 deltas peak at ~3 pt on a handful of
+(cpu050/multigpu20/gpushare40) x (GpuClustering/GpuPacking) cells. This tool
+re-runs those cells at many seeds on this framework and compares the
+resulting distribution against the reference's 10 per-seed values
+(experiments/analysis/expected_results/analysis_frag_ratio_discrete.csv),
+reporting mean +/- std, ranges, and the two-sample overlap — the evidence
+PARITY.md's "seed noise" attribution rests on.
+
+    python experiments/sweep.py --out-root /tmp/parity30 \
+        --traces openb_pod_list_cpu050 openb_pod_list_multigpu20 \
+        --methods 03-GpuClustering 04-GpuPacking --seeds 30
+    python experiments/sweep.py --out-root /tmp/parity30 \
+        --traces openb_pod_list_gpushare40 --methods 04-GpuPacking --seeds 30
+    python experiments/merge.py --data-root /tmp/parity30 --out /tmp/parity30_merged
+    python experiments/parity_tail.py --merged /tmp/parity30_merged
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import math
+import statistics
+from pathlib import Path
+
+REF = Path("/root/reference/experiments/analysis/expected_results")
+
+CELLS = [
+    ("openb_pod_list_cpu050", "04-GpuPacking"),
+    ("openb_pod_list_multigpu20", "04-GpuPacking"),
+    ("openb_pod_list_cpu050", "03-GpuClustering"),
+    ("openb_pod_list_multigpu20", "03-GpuClustering"),
+    ("openb_pod_list_gpushare40", "04-GpuPacking"),
+]
+
+
+def per_seed(path: Path, load_col: str = "90"):
+    out = {}
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            key = (row["workload"], row["sc_policy"])
+            out.setdefault(key, []).append(float(row[load_col]))
+    return out
+
+
+def fmt(vals):
+    m = statistics.mean(vals)
+    s = statistics.stdev(vals) if len(vals) > 1 else 0.0
+    return m, s, min(vals), max(vals)
+
+
+def welch_t(a, b):
+    """Welch's t statistic + approximate dof (no scipy in the image; |t|<2
+    at these dofs means the means are statistically indistinguishable)."""
+    ma, mb = statistics.mean(a), statistics.mean(b)
+    va, vb = statistics.variance(a), statistics.variance(b)
+    na, nb = len(a), len(b)
+    se2 = va / na + vb / nb
+    t = (ma - mb) / math.sqrt(se2) if se2 else 0.0
+    dof = se2**2 / (
+        (va / na) ** 2 / (na - 1) + (vb / nb) ** 2 / (nb - 1)
+    ) if se2 else 1.0
+    return t, dof
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--merged", default="/tmp/parity30_merged")
+    ap.add_argument("--load", default="90", help="arrived-load percent column")
+    ap.add_argument("--ref", default=str(REF / "analysis_frag_ratio_discrete.csv"))
+    args = ap.parse_args(argv)
+
+    ours = per_seed(Path(args.merged) / "analysis_frag_ratio_discrete.csv", args.load)
+    ref = per_seed(Path(args.ref), args.load)
+
+    print(
+        f"frag ratio @ {args.load}% arrived load — per-seed distributions "
+        "(ref 10 seeds vs ours)\n"
+    )
+    print(
+        f"{'cell':45s} {'ref mean±std [min,max]':28s} "
+        f"{'ours mean±std [min,max]':28s} {'Δmean':>6s} {'|t|':>5s}"
+    )
+    for cell in CELLS:
+        r = ref.get(cell)
+        o = ours.get(cell)
+        if not r or not o:
+            print(f"{cell}: missing data (ref={bool(r)}, ours={bool(o)})")
+            continue
+        rm, rs, rlo, rhi = fmt(r)
+        om, os_, olo, ohi = fmt(o)
+        t, dof = welch_t(r, o)
+        print(
+            f"{cell[0][15:] + ' × ' + cell[1]:45s} "
+            f"{rm:6.2f}±{rs:5.2f} [{rlo:5.1f},{rhi:5.1f}]   "
+            f"{om:6.2f}±{os_:5.2f} [{olo:5.1f},{ohi:5.1f}]   "
+            f"{om - rm:+6.2f} {abs(t):5.2f}  (n={len(o)}, dof≈{dof:.0f})"
+        )
+
+
+if __name__ == "__main__":
+    main()

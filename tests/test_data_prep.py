@@ -1,0 +1,124 @@
+"""Data-prep converters (tpusim.io.data_prep): CSV → YAML → ingest must
+reproduce the scheduling-relevant PodRow/NodeRow fields of direct CSV
+ingestion (ref tools being re-created: data/pod_csv_to_yaml.py,
+data/prepare_input.sh, node_yaml/)."""
+
+import csv
+import os
+
+import pytest
+
+from tpusim.io.data_prep import node_csv_to_yaml, pod_csv_to_yaml, prepare_input
+from tpusim.io.k8s_yaml import load_objects, node_from_k8s, pod_from_k8s
+from tpusim.io.trace import load_node_csv, load_pod_csv
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+POD_CSV = os.path.join(REPO, "data/csv/openb_pod_list_gpuspec10.csv")
+NODE_CSV = os.path.join(REPO, "data/csv/openb_node_list_gpu_node.csv")
+
+needs_traces = pytest.mark.skipif(
+    not (os.path.isfile(POD_CSV) and os.path.isfile(NODE_CSV)),
+    reason="openb traces not present",
+)
+
+
+@needs_traces
+def test_pod_csv_yaml_roundtrip(tmp_path):
+    """CSV → YAML → pod_from_k8s equals load_pod_csv on every
+    scheduling-relevant field, including the creation/deletion times the
+    reference converter drops (pod_csv_to_yaml.py:117-118)."""
+    out = pod_csv_to_yaml(POD_CSV, tmp_path / "pods.yaml")
+    via_yaml = [pod_from_k8s(o) for o in load_objects([str(out)])]
+    direct = load_pod_csv(POD_CSV)
+    assert len(via_yaml) == len(direct)
+    for y, d in zip(via_yaml, direct):
+        assert y.name == f"paib-gpu/{d.name}"
+        assert (y.cpu_milli, y.memory_mib) == (d.cpu_milli, d.memory_mib)
+        assert (y.num_gpu, y.gpu_milli, y.gpu_spec) == (
+            d.num_gpu, d.gpu_milli, d.gpu_spec,
+        )
+        assert (y.creation_time, y.deletion_time) == (
+            d.creation_time, d.deletion_time,
+        )
+
+
+@needs_traces
+def test_node_csv_yaml_roundtrip(tmp_path):
+    out = node_csv_to_yaml(NODE_CSV, tmp_path / "nodes.yaml")
+    via_yaml = [node_from_k8s(o) for o in load_objects([str(out)])]
+    direct = load_node_csv(NODE_CSV)
+    assert len(via_yaml) == len(direct)
+    for y, d in zip(via_yaml, direct):
+        assert (y.name, y.cpu_milli, y.memory_mib, y.gpu, y.model) == (
+            d.name, d.cpu_milli, d.memory_mib, d.gpu, d.model,
+        )
+
+
+def test_prepare_input_layout(tmp_path):
+    """prepare_input builds one folder per pod trace, each holding the
+    trace's pod YAML + the shared node YAML (prepare_input.sh layout)."""
+    csv_dir = tmp_path / "csv"
+    csv_dir.mkdir()
+    with open(csv_dir / "openb_node_list_gpu_node.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["sn", "cpu_milli", "memory_mib", "gpu", "model"])
+        w.writerow(["n0", 32000, 131072, 2, "V100M16"])
+    for trace in ("openb_pod_list_a", "openb_pod_list_b"):
+        with open(csv_dir / f"{trace}.csv", "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(
+                ["name", "cpu_milli", "memory_mib", "num_gpu", "gpu_milli",
+                 "gpu_spec", "qos", "pod_phase", "creation_time",
+                 "deletion_time", "scheduled_time"]
+            )
+            w.writerow(["p0", 4000, 8192, 1, 500, "", "LS", "Running", 0, 0, 0])
+
+    made = prepare_input(csv_dir, tmp_path / "input")
+    assert [m.name for m in made] == ["openb_pod_list_a", "openb_pod_list_b"]
+    for m in made:
+        assert (m / f"{m.name}.yaml").is_file()
+        assert (m / "openb_node_list_gpu_node.yaml").is_file()
+        objs = load_objects(
+            [str(m / f"{m.name}.yaml"),
+             str(m / "openb_node_list_gpu_node.yaml")]
+        )
+        kinds = sorted(o["kind"] for o in objs)
+        assert kinds == ["Node", "Pod"]
+
+
+@needs_traces
+def test_prepared_input_drives_apply(tmp_path):
+    """The generated cluster-config directory must run end-to-end through
+    the Applier (the consumer the reference's prepare_input.sh feeds)."""
+    import io as _io
+
+    import yaml as _yaml
+
+    from tpusim.apply import Applier, ApplyOptions
+
+    csv_dir = tmp_path / "csv"
+    csv_dir.mkdir()
+    # a tiny slice of the real traces keeps the end-to-end run fast
+    with open(NODE_CSV) as f:
+        rows = f.readlines()
+    (csv_dir / "openb_node_list_gpu_node.csv").write_text(
+        "".join(rows[:9])
+    )
+    with open(POD_CSV) as f:
+        rows = f.readlines()
+    (csv_dir / "openb_pod_list_tiny.csv").write_text("".join(rows[:13]))
+
+    made = prepare_input(csv_dir, tmp_path / "input")
+    cr = {
+        "apiVersion": "simon/v1alpha1",
+        "kind": "Config",
+        "metadata": {"name": "prep"},
+        "spec": {"cluster": {"customConfig": str(made[0])}},
+    }
+    cr_path = tmp_path / "cc.yaml"
+    cr_path.write_text(_yaml.dump(cr))
+    out = _io.StringIO()
+    Applier(
+        ApplyOptions(simon_config=str(cr_path), extended_resources=["gpu"])
+    ).run(out=out)
+    assert "unscheduled pods" in out.getvalue()

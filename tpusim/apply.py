@@ -57,10 +57,10 @@ class Applier:
         )
         # kubeConfig mode: the reference connects a kube-client and lists
         # the cluster's objects (CreateClusterResourceFromClient,
-        # simulator.go:746-830). This build preserves the capability via a
-        # `kubectl get ... -o yaml` dump at the kubeConfig path — a
-        # credential file pointing at a live API server is rejected inside
-        # load_cluster_from_dump with guidance.
+        # simulator.go:746-830). Here the kubeConfig path accepts BOTH a
+        # kubeconfig credential file (live API server, thin HTTP client in
+        # tpusim.io.kube_client) and a `kubectl get ... -o yaml` dump
+        # (offline fallback); run() routes on the file's shape.
 
     def _simulator_config(self) -> SimulatorConfig:
         cc = self.cr.custom_config
@@ -110,11 +110,18 @@ class Applier:
     def run(self, out=sys.stdout) -> SimulateResult:
         if self.cr.kube_config:
             from tpusim.io.k8s_yaml import load_cluster_from_dump
+            from tpusim.io.kube_client import (
+                is_kubeconfig_file,
+                load_cluster_from_client,
+            )
 
-            cluster = load_cluster_from_dump(self.cr.kube_config)
+            if is_kubeconfig_file(self.cr.kube_config):
+                cluster = load_cluster_from_client(self.cr.kube_config)
+            else:
+                cluster = load_cluster_from_dump(self.cr.kube_config)
             if not cluster.nodes:
                 raise ValueError(
-                    f"no Node objects in cluster dump {self.cr.kube_config}"
+                    f"no Node objects from kubeConfig {self.cr.kube_config}"
                 )
         else:
             cluster = load_cluster_from_dir(self.cr.custom_cluster)

@@ -408,6 +408,15 @@ def _semver_compare(constraint, version):
             "prerelease versions only against a '-0'-suffixed constraint)"
         )
     op = m.group(1) or "="
+    # the numeric-core comparison is only sound for a '-0' (minimal
+    # prerelease) constraint under >= and < — under =, !=, > and <= the
+    # version's own prerelease ordering would decide, which the subset
+    # doesn't model
+    if m.group(3) and op not in (">=", "<"):
+        raise ChartError(
+            f"semverCompare: unsupported constraint {constraint!r} "
+            "('-0' prerelease constraints are only modeled under >= and <)"
+        )
     want = tuple(int(x) for x in m.group(2).split("."))
     have = tuple(int(x) for x in vm.group(1).split("."))[: len(want)]
     have = have + (0,) * (len(want) - len(have))

@@ -343,15 +343,42 @@ def load_cluster_from_dump(path: str) -> ClusterResource:
             )
         elif obj.get("kind") == "Config" and "clusters" in obj:
             raise ValueError(
-                f"{path} is a kubeconfig credential file; this build cannot "
-                "reach a live API server. Ingest a cluster dump instead: "
-                "kubectl get nodes,pods,deployments,statefulsets,daemonsets "
-                "-A -o yaml > dump.yaml"
+                f"{path} is a kubeconfig credential file, not a dump; use "
+                "tpusim.io.kube_client.load_cluster_from_client (the "
+                "applier's kubeConfig path routes there automatically), or "
+                "ingest a dump: kubectl get nodes,pods,deployments,"
+                "statefulsets,daemonsets -A -o yaml > dump.yaml"
             )
         else:
             objs.append(obj)
-    objs = [o for o in objs if o.get("kind") != "Pod" or is_static_pod(o)]
+    objs = _filter_cluster_objects(objs)
     return load_cluster_from_objects(objs)
+
+
+def _filter_cluster_objects(objs: Sequence[dict]) -> List[dict]:
+    """CreateClusterResourceFromClient's object-filtering rules applied to
+    an already-listed object set (simulator.go:759-771, 830-836, 881-891):
+    raw Pods only when static, no Deployment-owned ReplicaSets, no
+    CronJob-owned Jobs — a full `kubectl get -A` dump contains both owners
+    and their children, which would otherwise double-expand workload pods."""
+
+    def owned_by(obj, kind):
+        return any(
+            ref.get("kind") == kind
+            for ref in (obj.get("metadata") or {}).get("ownerReferences") or []
+        )
+
+    out = []
+    for o in objs:
+        kind = o.get("kind")
+        if kind == "Pod" and not is_static_pod(o):
+            continue
+        if kind == "ReplicaSet" and owned_by(o, "Deployment"):
+            continue
+        if kind == "Job" and owned_by(o, "CronJob"):
+            continue
+        out.append(o)
+    return out
 
 
 def load_cluster_from_objects(objs: Sequence[dict]) -> ClusterResource:

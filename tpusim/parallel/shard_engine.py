@@ -38,11 +38,9 @@ float sums differ only in partial-sum order (local-then-psum).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
 from tpusim.ops.frag import cluster_frag_amounts
@@ -58,11 +56,9 @@ from tpusim.sim.table_engine import (
 )
 from tpusim.types import NodeState, PodSpec
 
-from tpusim.parallel.sharding import NODE_AXIS, state_sharding
+from tpusim.parallel.sharding import NODE_AXIS
 
 _INT_MAX = jnp.int32(jnp.iinfo(jnp.int32).max)
-
-_SHARDMAP_CACHE = {}
 
 
 
@@ -73,10 +69,6 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     already be padded to a multiple of the mesh size (parallel.pad_nodes)
     and `state`/`tiebreak_rank` sharded over it (parallel.shard_state)."""
     reject_randomized(policies, gpu_sel)
-    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
-                 tuple(mesh.shape.items()), tuple(d.id for d in mesh.devices.flat))
-    if cache_key in _SHARDMAP_CACHE:
-        return _SHARDMAP_CACHE[cache_key]
     sel_idx = selector_index(policies, gpu_sel)
     _columns, _init_tables = make_table_builders(policies, sel_idx)
     npol = len(policies)
@@ -366,5 +358,4 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                      tp, key)
         return ReplayResult(*out)
 
-    _SHARDMAP_CACHE[cache_key] = replay
     return replay
