@@ -10,8 +10,14 @@ event loop is one compiled lax.scan on the TPU.
 Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "placements/sec", "vs_baseline": N}
 plus auxiliary quality numbers (GPU allocation ratio) on stderr.
+
+`--all` additionally measures every sweep policy (the 6 reference-cached
+methods + PWR), pinning the sequential path's throughput (RandomScore /
+gpu_sel=random cannot use the table engine) and the 16-seed batched
+aggregate, writing the rows to BENCH_DETAILS.json (stderr shows them too).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -24,6 +30,18 @@ sys.path.insert(0, REPO)
 # (BASELINE.md "Implied placement throughput").
 BASELINE_PLACEMENTS_PER_SEC = 13.59
 
+# (name, policies, gpu_sel, dim_ext, norm) — the sweep's method configs
+# (experiments/generate_run_scripts.py METHODS)
+POLICY_ROWS = [
+    ("Random", (("RandomScore", 1000),), "random", "merge", "max"),
+    ("DotProd", (("DotProductScore", 1000),), "best", "merge", "max"),
+    ("GpuClustering", (("GpuClusteringScore", 1000),), "best", "share", "max"),
+    ("GpuPacking", (("GpuPackingScore", 1000),), "best", "share", "max"),
+    ("BestFit", (("BestFitScore", 1000),), "best", "share", "max"),
+    ("FGD", (("FGDScore", 1000),), "FGDScore", "share", "max"),
+    ("PWR", (("PWRScore", 1000),), "PWRScore", "share", "max"),
+]
+
 
 def load_trace():
     from tpusim.io.trace import load_node_csv, load_pod_csv
@@ -33,23 +51,32 @@ def load_trace():
     return load_node_csv(node_csv), load_pod_csv(pod_csv)
 
 
-def main():
+def gpu_alloc_pct(state) -> float:
+    import numpy as np
+
+    from tpusim.constants import MILLI
+
+    slot = np.arange(state.gpu_left.shape[1])[None, :] < state.gpu_cnt[:, None]
+    milli_used = int(np.where(slot, MILLI - state.gpu_left, 0).sum())
+    return 100.0 * milli_used / (int(state.gpu_cnt.sum()) * MILLI)
+
+
+def measure_policy(nodes, pods, name, policies, gpu_sel, dim_ext, norm):
+    """One policy's replay throughput + end-state quality (both engines
+    where the config allows; the table engine rejects per-event randomness)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from tpusim.constants import MILLI
     from tpusim.io.trace import build_events, pods_to_specs
     from tpusim.sim.driver import Simulator, SimulatorConfig
     from tpusim.sim.typical import TypicalPodsConfig
 
-    nodes, pods = load_trace()
-    # exact flags of the reference's 1020-experiment protocol (FGD row):
-    # -FGD 1000 -gpusel FGD -dimext share -norm max -tune 1.3 -tuneseed 42
-    # --shuffle-pod=true (experiments/run_scripts/generate_run_scripts.py)
     cfg = SimulatorConfig(
-        policies=(("FGDScore", 1000),),
-        gpu_sel_method="FGDScore",
+        policies=policies,
+        gpu_sel_method=gpu_sel,
+        dim_ext_method=dim_ext,
+        norm_method=norm,
         tuning_ratio=1.3,
         tuning_seed=42,
         seed=42,
@@ -61,56 +88,149 @@ def main():
     sim.set_workload_pods(pods)
     sim.set_typical_pods()
     trace = sim.prepare_pods()
-
     specs = pods_to_specs(trace)
     ev_kind, ev_pod = build_events(trace)
     ev_kind, ev_pod = jnp.asarray(ev_kind), jnp.asarray(ev_pod)
     key = jax.random.PRNGKey(cfg.seed)
 
     def run():
-        # auto-selects the incremental score-table engine (exact-equivalent
-        # to the sequential oracle; tests/test_table_engine.py). bucket=1:
-        # a single-config benchmark needs no sweep shape-bucketing padding.
         res = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key, bucket=1)
         jax.block_until_ready(res.state)
         return res
 
     t0 = time.perf_counter()
-    result = run()  # compile + first replay
+    result = run()
     compile_and_first = time.perf_counter() - t0
-
     t0 = time.perf_counter()
-    result = run()  # steady-state
+    result = run()
     wall = time.perf_counter() - t0
 
     events = int(ev_kind.shape[0])
     unscheduled = int(np.asarray(result.ever_failed).sum())
-    # successful placements only — at tune 1.3 the cluster saturates and a
-    # chunk of the tuned events are (correctly) rejected
     placements = events - unscheduled
-    throughput = placements / wall
-
-    # Quality cross-check: end-state GPU allocation ratio (the reference's
-    # headline metric; FGD @ tune 1.3 reaches ~95.3% MilliGpu, BASELINE.md).
     state = jax.tree.map(np.asarray, result.state)
-    slot = np.arange(state.gpu_left.shape[1])[None, :] < state.gpu_cnt[:, None]
-    milli_used = int(np.where(slot, MILLI - state.gpu_left, 0).sum())
-    milli_cap = int(state.gpu_cnt.sum()) * MILLI
+    return {
+        "policy": name,
+        "engine": "table" if sim._table_ok else "sequential",
+        "events": events,
+        "placements": placements,
+        "wall_s": round(wall, 3),
+        "placements_per_sec": round(placements / wall, 1),
+        "gpu_alloc_pct": round(gpu_alloc_pct(state), 2),
+        "compile_first_s": round(compile_and_first, 1),
+    }
+
+
+def measure_batched(nodes, pods, seeds=16):
+    """Aggregate throughput of the seed-batched vmapped replay (FGD config;
+    see ENGINES.md) — the sweep's execution mode."""
+    import jax
+    import numpy as np
+
+    from tpusim.sim.driver import (
+        Simulator,
+        SimulatorConfig,
+        schedule_pods_batch,
+    )
+    from tpusim.sim.typical import TypicalPodsConfig
+
+    def mk(seed):
+        cfg = SimulatorConfig(
+            policies=(("FGDScore", 1000),),
+            gpu_sel_method="FGDScore",
+            tuning_ratio=1.3,
+            tuning_seed=seed,
+            seed=seed,
+            shuffle_pod=True,
+            report_per_event=False,
+            typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
+        )
+        sim = Simulator(nodes, cfg)
+        sim.set_workload_pods(pods)
+        return sim
+
+    sims = [mk(42 + s) for s in range(seeds)]
+    pods_lists = [s.prepare_pods() for s in sims]
+    schedule_pods_batch(sims, pods_lists)  # compile + first
+    t0 = time.perf_counter()
+    results = schedule_pods_batch(sims, pods_lists)
+    wall = time.perf_counter() - t0
+    placements = sum(
+        r.events - len(r.unscheduled_pods) for r in results
+    )
+    return {
+        "policy": "FGD",
+        "engine": f"table, {seeds}-seed vmap batch",
+        "events": sum(r.events for r in results),
+        "placements": placements,
+        "wall_s": round(wall, 3),
+        "placements_per_sec": round(placements / wall, 1),
+        "gpu_alloc_pct": round(
+            float(np.mean([gpu_alloc_pct(r.state) for r in results])), 2
+        ),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--all", action="store_true",
+        help="per-policy + batched rows -> BENCH_DETAILS.json",
+    )
+    args = ap.parse_args()
+    nodes, pods = load_trace()
+
+    # headline: exact flags of the reference's 1020-experiment protocol
+    # (FGD row): -FGD 1000 -gpusel FGD -dimext share -norm max -tune 1.3
+    # -tuneseed 42 --shuffle-pod=true
+    head = measure_policy(
+        nodes, pods, *next(r for r in POLICY_ROWS if r[0] == "FGD")
+    )
     print(
-        f"[bench] events={events} placed={placements} wall={wall:.2f}s "
-        f"(first incl. compile {compile_and_first:.1f}s) "
-        f"gpu_alloc={100.0 * milli_used / milli_cap:.2f}% "
-        f"unscheduled={unscheduled}",
+        f"[bench] events={head['events']} placed={head['placements']} "
+        f"wall={head['wall_s']:.2f}s "
+        f"(first incl. compile {head['compile_first_s']:.1f}s) "
+        f"gpu_alloc={head['gpu_alloc_pct']:.2f}% ",
         file=sys.stderr,
     )
+
+    if args.all:
+        rows = []
+        for name, policies, gpu_sel, dim_ext, norm in POLICY_ROWS:
+            row = (
+                head
+                if name == "FGD"
+                else measure_policy(
+                    nodes, pods, name, policies, gpu_sel, dim_ext, norm
+                )
+            )
+            rows.append(row)
+            print(f"[bench-all] {json.dumps(row)}", file=sys.stderr)
+        rows.append(measure_batched(nodes, pods))
+        print(f"[bench-all] {json.dumps(rows[-1])}", file=sys.stderr)
+        out = os.path.join(REPO, "BENCH_DETAILS.json")
+        with open(out, "w") as f:
+            json.dump(
+                {
+                    "config": "openb_pod_list_default, tune 1.3, seed 42, "
+                    "warm steady-state on one TPU chip",
+                    "baseline_placements_per_sec": BASELINE_PLACEMENTS_PER_SEC,
+                    "rows": rows,
+                },
+                f,
+                indent=1,
+            )
+        print(f"[bench-all] wrote {out}", file=sys.stderr)
 
     print(
         json.dumps(
             {
                 "metric": "openb default-trace FGD replay throughput (tune 1.3)",
-                "value": round(throughput, 1),
+                "value": head["placements_per_sec"],
                 "unit": "placements/sec",
-                "vs_baseline": round(throughput / BASELINE_PLACEMENTS_PER_SEC, 1),
+                "vs_baseline": round(
+                    head["placements_per_sec"] / BASELINE_PLACEMENTS_PER_SEC, 1
+                ),
             }
         )
     )

@@ -8,7 +8,8 @@ layout experiments/run.py + generate_run_scripts.py produce) and emits:
 
   analysis_allo_discrete.csv        GPU allocation ratio (%) sampled at each
                                     integer arrived-load percent 0..130
-  analysis_frag_discrete.csv        frag amount (milli-GPU) at same samples
+  analysis_frag_discrete.csv        frag amount (% of cluster GPU capacity,
+                                    the reference's unit) at same samples
   analysis_frag_ratio_discrete.csv  frag ratio (%) at same samples
   analysis_fail_pods.csv            unscheduled-pod count per experiment
 
@@ -89,7 +90,13 @@ def merge(data_root: Path, out_dir: Path):
         if frag_file.is_file():
             frag = read_csv_dict(frag_file)
             n = min(len(frag), len(arrive))
-            fmilli = [float(r["origin_milli"]) / 1000 for r in frag[:n]]
+            # frag amount as PERCENT of cluster GPU capacity — the
+            # reference's unit (merge_frag_discrete.py:88:
+            # 100 * frag_milli / 1000 / total_gpu_num), so its plot scripts
+            # read these files unchanged
+            fmilli = [
+                float(r["origin_milli"]) / total_gpus / 10 for r in frag[:n]
+            ]
             fratio = [float(r["origin_ratio"]) for r in frag[:n]]
             row = dict(key, total_gpus=total_gpus)
             row.update(discretize(arrive[:n], fmilli))

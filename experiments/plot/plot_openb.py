@@ -1,17 +1,33 @@
 #!/usr/bin/env python
-"""Paper-figure plots from the merged discrete CSVs
-(ref: experiments/plot/plot_openb_{alloc,frag_amount,frag_ratio}.py and the
-*_alloc_bar.py family → Fig 7, 9, 11-14 of the FGD paper).
+"""Paper-figure plots from the merged discrete CSVs — figure-for-figure
+with the reference's plot family (experiments/plot/plot_openb_alloc.py,
+plot_openb_frag_{amount,ratio}.py, plot_openb_{gpushare,gpuspec,multigpu,
+nongpu}_alloc_bar.py → the FGD paper's Fig 7, 9, 11-14).
 
-Input: experiments/analysis_results/analysis_{allo,frag,frag_ratio}_discrete.csv
-(from experiments/merge.py). Output: PNGs under --out-dir.
+Content semantics match the reference scripts exactly:
+  - openb_alloc: UNALLOCATED GPU % (100 − alloc ratio) vs arrived load,
+    median over seeds + 25-75 percentile band, the 6 cached policies, the
+    'Ideal' diagonal, x ∈ [75, 120], y ∈ [0, 25] (plot_openb_alloc.py:83-103)
+  - openb_frag_amount / openb_frag_ratio: median + band, x ∈ [0, 120]
+    (plot_openb_frag_amount.py:76-97; note the reference's frag_amount
+    y-label is a copy-paste of the ratio label — ours says what the axis is)
+  - the 4 alloc-bar families: unallocated GPU % AT 100% ARRIVED LOAD,
+    sd error bars, the reference's trace subsets with its percent x-labels
+    (plot_openb_*_alloc_bar.py:16-21, 75-110)
 
-Design notes (dataviz method): line charts for the load-sweep curves
-(change-over-time job), grouped bars for per-variant allocation (magnitude
-across categories). Policies take a fixed categorical palette slot —
-validated 8-hue set, assigned by policy id order, never cycled — with a
-legend always present and direct terminal labels on ≤4-series figures.
-Static matplotlib renders: the hover layer is N/A.
+Input: analysis_{allo,frag,frag_ratio}_discrete.csv (experiments/merge.py —
+same schema as the reference's expected_results). Output: PNGs.
+
+--compare-with <dir> additionally loads a second results dir (e.g. the
+reference's expected_results) through the SAME pipeline and prints the
+numeric differences of every plotted series (medians per x, bar heights) —
+the figure-level validation story in experiments/plot/README.md (this image
+has no PDF rasterizer, so figures are compared at plotted-series level, one
+abstraction below pixels).
+
+Design notes (dataviz method): line charts for the load-sweep curves,
+grouped bars for per-variant allocation; policies take fixed categorical
+palette slots; percentile bands at 18% opacity fills.
 """
 
 from __future__ import annotations
@@ -20,6 +36,7 @@ import argparse
 import csv
 from collections import defaultdict
 from pathlib import Path
+from statistics import median, pstdev
 
 import matplotlib
 
@@ -45,6 +62,42 @@ GRID = "#e4e3df"
 
 LOAD_COLS = [str(x) for x in range(0, 131)]
 
+# the 6 reference-cached policies, legend order of the reference curves
+# (plot_openb_alloc.py:66 policy_keep)
+POLICY_KEEP = [
+    "01-Random", "02-DotProd", "03-GpuClustering",
+    "04-GpuPacking", "05-BestFit", "06-FGD",
+]
+
+# the bar families (plot_openb_*_alloc_bar.py:16-21 + label maps :75-84)
+BAR_FAMILIES = {
+    "nongpu": (
+        "Proportion of non-GPU workloads in terms of task number",
+        [("openb_pod_list_cpu050", "5%"), ("openb_pod_list_cpu100", "10%"),
+         ("openb_pod_list_cpu200", "20%"), ("openb_pod_list_cpu250", "25%")],
+    ),
+    "gpushare": (
+        "Proportion of GPU-sharing workloads in terms of GPU requests",
+        [("openb_pod_list_gpushare20", "20%"),
+         ("openb_pod_list_gpushare40", "40%"),
+         ("openb_pod_list_gpushare60", "60%"),
+         ("openb_pod_list_gpushare80", "80%"),
+         ("openb_pod_list_gpushare100", "100%")],
+    ),
+    "gpuspec": (
+        "Proportion of workloads with GPU type constraints in terms of GPU requests",
+        [("openb_pod_list_gpuspec10", "10%"), ("openb_pod_list_gpuspec20", "20%"),
+         ("openb_pod_list_gpuspec25", "25%"), ("openb_pod_list_gpuspec33", "33%")],
+    ),
+    "multigpu": (
+        "Proportion of multi-GPU workloads in terms of GPU requests",
+        [("openb_pod_list_multigpu20", "20%"),
+         ("openb_pod_list_multigpu30", "30%"),
+         ("openb_pod_list_multigpu40", "40%"),
+         ("openb_pod_list_multigpu50", "50%")],
+    ),
+}
+
 
 def _style(ax, xlabel, ylabel, title):
     ax.set_facecolor(SURFACE)
@@ -60,7 +113,7 @@ def _style(ax, xlabel, ylabel, title):
 
 
 def load_discrete(path: Path):
-    """→ {(workload, policy): [(load%, mean value over seeds)]}"""
+    """→ {(workload, policy): {load%: [per-seed values]}}"""
     acc = defaultdict(lambda: defaultdict(list))
     with open(path, newline="") as f:
         for r in csv.DictReader(f):
@@ -69,77 +122,154 @@ def load_discrete(path: Path):
                 v = r.get(col)
                 if v not in (None, ""):
                     acc[key][int(col)].append(float(v))
-    return {
-        key: sorted((x, sum(vs) / len(vs)) for x, vs in series.items())
-        for key, series in acc.items()
-    }
+    return acc
 
 
-def plot_curves(data, workload, ylabel, title, out_png):
+def curve_series(data, workload, policy, transform=lambda v: v):
+    """Plotted line content: per-x (median, p25, p75) over seeds."""
+    series = data.get((workload, policy))
+    if not series:
+        return []
+    out = []
+    for x in sorted(series):
+        vs = sorted(transform(v) for v in series[x])
+        n = len(vs)
+        out.append(
+            (x, median(vs), vs[max(0, n // 4)], vs[min(n - 1, (3 * n) // 4)])
+        )
+    return out
+
+
+def plot_curves(data, workload, ylabel, title, out_png, transform=lambda v: v,
+                xlim=(0, 120), ylim=None, ideal=False):
     fig, ax = plt.subplots(figsize=(6.4, 4.2), dpi=150)
     fig.patch.set_facecolor(SURFACE)
-    policies = sorted({p for w, p in data if w == workload})
-    for policy in policies:
-        series = data[(workload, policy)]
-        xs = [x for x, _ in series]
-        ys = [y for _, y in series]
+    for policy in POLICY_KEEP:
+        series = curve_series(data, workload, policy, transform)
+        if not series:
+            continue
+        xs = [s[0] for s in series]
+        color = PALETTE.get(policy, TEXT_SECONDARY)
+        ax.fill_between(
+            xs, [s[2] for s in series], [s[3] for s in series],
+            color=color, alpha=0.18, linewidth=0, zorder=2,
+        )
         ax.plot(
-            xs,
-            ys,
-            color=PALETTE.get(policy, TEXT_SECONDARY),
-            linewidth=2,
-            label=policy,
-            zorder=3,
+            xs, [s[1] for s in series], color=color, linewidth=2,
+            label=policy, zorder=3,
+        )
+    if ideal:
+        ax.plot(
+            [0, 100], [100, 0], linestyle=":", color="grey", alpha=0.8,
+            label="Ideal", zorder=3,
         )
     _style(ax, "Arrived workload (% of cluster GPU capacity)", ylabel, title)
-    ax.legend(
-        frameon=False, fontsize=8, labelcolor=TEXT_PRIMARY, loc="upper left"
-    )
+    if xlim:
+        ax.set_xlim(*xlim)
+    if ylim:
+        ax.set_ylim(*ylim)
+    ax.legend(frameon=False, fontsize=8, labelcolor=TEXT_PRIMARY, loc="best")
     fig.tight_layout()
     fig.savefig(out_png, facecolor=SURFACE)
     plt.close(fig)
     print(f"[plot] {out_png}")
 
 
-def plot_variant_bars(data, variant_prefix, at_load, ylabel, title, out_png):
-    """Grouped bars: x = trace variants of one family, group = policy
-    (ref: plot_openb_{gpushare,gpuspec,multigpu,nongpu}_alloc_bar.py)."""
-    workloads = sorted({w for w, _ in data if variant_prefix in w})
-    policies = sorted({p for _, p in data})
-    if not workloads:
-        print(f"[plot] no workloads matching {variant_prefix}, skipping")
+def bar_heights(data, family, at_load=100):
+    """Plotted bar content: {(trace label, policy): (mean unalloc, sd)} at
+    the reference's 100%-arrived-load sample."""
+    _, traces = BAR_FAMILIES[family]
+    out = {}
+    for workload, label in traces:
+        for policy in POLICY_KEEP:
+            vals = data.get((workload, policy), {}).get(at_load)
+            if vals:
+                un = [100.0 - v for v in vals]
+                out[(label, policy)] = (
+                    sum(un) / len(un),
+                    pstdev(un) if len(un) > 1 else 0.0,
+                )
+    return out
+
+
+def plot_variant_bars(data, family, title, out_png):
+    xlabel, traces = BAR_FAMILIES[family]
+    heights = bar_heights(data, family)
+    labels = [lab for _, lab in traces if any(k[0] == lab for k in heights)]
+    if not labels:
+        print(f"[plot] no workloads for {family}, skipping")
         return
-    fig, ax = plt.subplots(figsize=(7.2, 4.2), dpi=150)
+    fig, ax = plt.subplots(figsize=(7.2, 4.0), dpi=150)
     fig.patch.set_facecolor(SURFACE)
-    n = len(policies)
+    n = len(POLICY_KEEP)
     width = 0.8 / n
-    for j, policy in enumerate(policies):
-        xs, ys = [], []
-        for i, w in enumerate(workloads):
-            series = dict(data.get((w, policy), []))
-            if at_load in series:
+    # reference bar order: FGD first (plot_openb_*_alloc_bar.py policy_keep)
+    for j, policy in enumerate(reversed(POLICY_KEEP)):
+        xs, ys, errs = [], [], []
+        for i, lab in enumerate(labels):
+            if (lab, policy) in heights:
+                m, sd = heights[(lab, policy)]
                 xs.append(i + (j - n / 2 + 0.5) * width)
-                ys.append(series[at_load])
+                ys.append(m)
+                errs.append(sd)
         ax.bar(
-            xs,
-            ys,
-            width=width * 0.92,  # 2px-equivalent gap between adjacent bars
-            color=PALETTE.get(policy, TEXT_SECONDARY),
-            label=policy,
-            zorder=3,
+            xs, ys, width=width * 0.92, yerr=errs, capsize=2,
+            error_kw={"ecolor": TEXT_SECONDARY, "elinewidth": 0.8},
+            color=PALETTE.get(policy, TEXT_SECONDARY), label=policy, zorder=3,
         )
-    ax.set_xticks(range(len(workloads)))
-    ax.set_xticklabels(
-        [w.replace("openb_pod_list_", "") for w in workloads],
-        rotation=20,
-        ha="right",
-    )
-    _style(ax, "Trace variant", ylabel, title)
-    ax.legend(frameon=False, fontsize=8, labelcolor=TEXT_PRIMARY, ncol=2)
+    ax.set_xticks(range(len(labels)))
+    ax.set_xticklabels(labels)
+    _style(ax, xlabel, "Unallocated GPU (%) @ 100% arrived load", title)
+    ax.set_ylim(0, 22)
+    ax.legend(frameon=False, fontsize=8, labelcolor=TEXT_PRIMARY, ncol=3)
     fig.tight_layout()
     fig.savefig(out_png, facecolor=SURFACE)
     plt.close(fig)
     print(f"[plot] {out_png}")
+
+
+def compare_results(ours_dir: Path, ref_dir: Path, workload: str):
+    """Numeric diff of every plotted series between two results dirs run
+    through the identical pipeline (see module docstring)."""
+    print(f"\n[compare] {ours_dir} vs {ref_dir}")
+    for fname, transform, what in (
+        ("analysis_allo_discrete.csv", lambda v: 100.0 - v, "unalloc curve"),
+        ("analysis_frag_ratio_discrete.csv", lambda v: v, "frag-ratio curve"),
+        ("analysis_frag_discrete.csv", lambda v: v, "frag-amount curve"),
+    ):
+        a, b = ours_dir / fname, ref_dir / fname
+        if not (a.is_file() and b.is_file()):
+            print(f"  {what}: missing file, skipped")
+            continue
+        da, db = load_discrete(a), load_discrete(b)
+        worst = (0.0, "")
+        for policy in POLICY_KEEP:
+            sa = dict(
+                (x, m) for x, m, _, _ in curve_series(da, workload, policy, transform)
+            )
+            sb = dict(
+                (x, m) for x, m, _, _ in curve_series(db, workload, policy, transform)
+            )
+            for x in sorted(set(sa) & set(sb)):
+                d = abs(sa[x] - sb[x])
+                if d > worst[0]:
+                    worst = (d, f"{policy}@{x}%")
+        print(f"  {what} ({workload}): max |Δ median| = {worst[0]:.2f} at {worst[1]}")
+    a = ours_dir / "analysis_allo_discrete.csv"
+    b = ref_dir / "analysis_allo_discrete.csv"
+    if a.is_file() and b.is_file():
+        da, db = load_discrete(a), load_discrete(b)
+        for family in BAR_FAMILIES:
+            ha, hb = bar_heights(da, family), bar_heights(db, family)
+            common = set(ha) & set(hb)
+            if not common:
+                print(f"  {family} bars: no common cells, skipped")
+                continue
+            worst = max(common, key=lambda k: abs(ha[k][0] - hb[k][0]))
+            print(
+                f"  {family} bars: max |Δ mean height| = "
+                f"{abs(ha[worst][0] - hb[worst][0]):.2f} at {worst}"
+            )
 
 
 def main():
@@ -147,7 +277,11 @@ def main():
     ap.add_argument("--results", default="experiments/analysis_results")
     ap.add_argument("--out-dir", default="experiments/plot/figures")
     ap.add_argument("--workload", default="openb_pod_list_default")
-    ap.add_argument("--at-load", type=int, default=130)
+    ap.add_argument(
+        "--compare-with", default=None,
+        help="second results dir (e.g. the reference's expected_results); "
+        "print numeric diffs of every plotted series",
+    )
     args = ap.parse_args()
     results = Path(args.results)
     out = Path(args.out_dir)
@@ -157,44 +291,38 @@ def main():
     if allo.is_file():
         data = load_discrete(allo)
         plot_curves(
-            data,
-            args.workload,
-            "GPU allocation ratio (%)",
-            f"GPU allocation vs arrived load — {args.workload}",
+            data, args.workload, "Unallocated GPU (%)",
+            f"Unallocated GPU vs arrived load — {args.workload}",
             out / "openb_alloc.png",
+            transform=lambda v: 100.0 - v,
+            xlim=(75, 120), ylim=(0, 25), ideal=True,
         )
-        for fam, label in (
-            ("gpushare", "GPU-sharing"),
-            ("gpuspec", "GPU-type-constrained"),
-            ("multigpu", "multi-GPU"),
-            ("cpu", "non-GPU"),
-        ):
+        for family in BAR_FAMILIES:
             plot_variant_bars(
-                data,
-                fam,
-                args.at_load,
-                f"GPU allocation ratio @ {args.at_load}% (%)",
-                f"Allocation across {label} trace variants",
-                out / f"openb_{fam}_alloc_bar.png",
+                data, family,
+                f"Unallocated GPU across {family} trace variants",
+                out / f"openb_{family}_alloc_bar.png",
             )
     frag = results / "analysis_frag_discrete.csv"
     if frag.is_file():
         plot_curves(
-            load_discrete(frag),
-            args.workload,
-            "Fragmented GPU milli (×10³)",
+            load_discrete(frag), args.workload,
+            "Fragmented GPU (% of cluster capacity)",
             f"Fragmentation amount vs arrived load — {args.workload}",
             out / "openb_frag_amount.png",
+            xlim=(0, 120),
         )
     fratio = results / "analysis_frag_ratio_discrete.csv"
     if fratio.is_file():
         plot_curves(
-            load_discrete(fratio),
-            args.workload,
-            "Fragmentation ratio (%)",
+            load_discrete(fratio), args.workload,
+            "Frag / Total (%)",
             f"Fragmentation ratio vs arrived load — {args.workload}",
             out / "openb_frag_ratio.png",
+            xlim=(0, 120),
         )
+    if args.compare_with:
+        compare_results(results, Path(args.compare_with), args.workload)
 
 
 if __name__ == "__main__":

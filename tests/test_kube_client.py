@@ -286,3 +286,74 @@ def test_applier_routes_kubeconfig_to_client(tmp_path, api_server):
         ApplyOptions(simon_config=str(cc), extended_resources=["gpu"])
     ).run(out=out)
     assert "unscheduled pods" in out.getvalue()
+
+
+def test_client_rejects_exec_plugin_kubeconfig(tmp_path):
+    """GKE/EKS-style exec credential plugins must fail with guidance, not
+    an opaque unauthenticated 401."""
+    p = tmp_path / "kubeconfig"
+    p.write_text(
+        yaml.dump(
+            {
+                "apiVersion": "v1",
+                "kind": "Config",
+                "current-context": "c",
+                "clusters": [
+                    {"name": "c", "cluster": {"server": "http://x"}}
+                ],
+                "users": [
+                    {
+                        "name": "u",
+                        "user": {
+                            "exec": {"command": "gke-gcloud-auth-plugin"}
+                        },
+                    }
+                ],
+                "contexts": [
+                    {"name": "c", "context": {"cluster": "c", "user": "u"}}
+                ],
+            }
+        )
+    )
+    with pytest.raises(KubeClientError, match="credential plugin"):
+        KubeClient(str(p))
+
+
+def test_client_cleans_up_credential_material(tmp_path, api_server):
+    """Inline CA/key material decoded to temp files must not outlive the
+    client on disk."""
+    import base64
+    import gc
+    import os
+
+    p = tmp_path / "kubeconfig"
+    p.write_text(
+        yaml.dump(
+            {
+                "apiVersion": "v1",
+                "kind": "Config",
+                "current-context": "c",
+                "clusters": [
+                    {
+                        "name": "c",
+                        "cluster": {
+                            "server": api_server,
+                            # http server: CA never loaded, but the https
+                            # branch materializer is what we exercise below
+                        },
+                    }
+                ],
+                "users": [{"name": "u", "user": {"token": "t"}}],
+                "contexts": [
+                    {"name": "c", "context": {"cluster": "c", "user": "u"}}
+                ],
+            }
+        )
+    )
+    client = KubeClient(str(p))
+    fake = base64.b64encode(b"not-a-real-key").decode()
+    path = client._materialize(fake, None)
+    assert os.path.isfile(path)
+    del client
+    gc.collect()
+    assert not os.path.exists(path)

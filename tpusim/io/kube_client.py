@@ -71,6 +71,7 @@ class KubeClient:
 
     def __init__(self, kubeconfig_path: str, timeout: float = 30.0):
         self.timeout = timeout
+        self._tmp_files: List[str] = []
         with open(kubeconfig_path) as f:
             cfg = yaml.safe_load(f) or {}
         if "clusters" not in cfg:
@@ -117,11 +118,23 @@ class KubeClient:
             token = open(user["tokenFile"]).read().strip()
         if token:
             self._headers["Authorization"] = f"Bearer {token}"
+        elif user.get("exec") or user.get("auth-provider"):
+            # GKE/EKS/AKS-style credential plugins run an external binary
+            # per request — outside this thin client's scope; fail with
+            # guidance instead of an opaque 401 from the server
+            raise KubeClientError(
+                f"kubeconfig {kubeconfig_path} authenticates via a "
+                "credential plugin (exec/auth-provider), which this client "
+                "does not run. Mint a static token (e.g. `kubectl create "
+                "token <sa>`) into the user's `token:` field, or ingest an "
+                "offline dump instead."
+            )
         self._ssl_ctx = self._make_ssl_context(cluster, user)
 
-    @staticmethod
-    def _materialize(data_b64: Optional[str], path: Optional[str]) -> Optional[str]:
-        """Inline base64 material → temp file path (ssl wants files)."""
+    def _materialize(self, data_b64: Optional[str], path: Optional[str]) -> Optional[str]:
+        """Inline base64 material → temp file path (ssl wants files; 0600
+        perms via NamedTemporaryFile). Tracked and removed in __del__ so
+        decoded keys don't outlive the client on disk."""
         if path:
             return path
         if not data_b64:
@@ -129,7 +142,15 @@ class KubeClient:
         f = tempfile.NamedTemporaryFile("wb", delete=False, suffix=".pem")
         f.write(base64.b64decode(data_b64))
         f.close()
+        self._tmp_files.append(f.name)
         return f.name
+
+    def __del__(self):
+        for p in getattr(self, "_tmp_files", []):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
     def _make_ssl_context(self, cluster: dict, user: dict):
         if self.server.startswith("http://"):
