@@ -125,6 +125,17 @@ def load_discrete(path: Path):
     return acc
 
 
+def _percentile(vs, q):
+    """Linear-interpolation percentile (numpy's default method) over a
+    sorted list — the estimator seaborn's ("pi", 50) band uses."""
+    if len(vs) == 1:
+        return vs[0]
+    pos = q / 100.0 * (len(vs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(vs) - 1)
+    return vs[lo] + (pos - lo) * (vs[hi] - vs[lo])
+
+
 def curve_series(data, workload, policy, transform=lambda v: v):
     """Plotted line content: per-x (median, p25, p75) over seeds."""
     series = data.get((workload, policy))
@@ -133,9 +144,8 @@ def curve_series(data, workload, policy, transform=lambda v: v):
     out = []
     for x in sorted(series):
         vs = sorted(transform(v) for v in series[x])
-        n = len(vs)
         out.append(
-            (x, median(vs), vs[max(0, n // 4)], vs[min(n - 1, (3 * n) // 4)])
+            (x, median(vs), _percentile(vs, 25), _percentile(vs, 75))
         )
     return out
 

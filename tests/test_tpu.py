@@ -108,3 +108,64 @@ def test_driver_small_run_on_tpu(accel):
     assert not res.unscheduled_pods
     assert (np.asarray(res.placed_node[:4]) == 1).all()
     assert "Cluster Analysis Results" in sim.log.dump()
+
+
+def test_wave_engine_on_tpu(accel):
+    """The wave engine's intra-wave exact repair must hold on TPU numerics
+    (float kernel values patched into stale rows must equal the table
+    engine's refreshed columns bit-for-bit)."""
+    from tests.fixtures import random_cluster, random_pods
+    from tpusim.policies import make_policy
+    from tpusim.sim.engine import EV_CREATE, make_replay
+    from tpusim.sim.table_engine import build_pod_types
+    from tpusim.sim.wave_engine import make_wave_replay
+
+    rng = np.random.default_rng(17)
+    state, tp = random_cluster(rng, num_nodes=32)
+    pods = random_pods(rng, num_pods=48)
+    ev_kind = jnp.full(48, EV_CREATE, jnp.int32)
+    ev_pod = jnp.arange(48, dtype=jnp.int32)
+    policies = [(make_policy("FGDScore"), 1000)]
+    key = jax.random.PRNGKey(3)
+    rank = jnp.asarray(rng.permutation(32).astype(np.int32))
+
+    seq = make_replay(policies, "FGDScore", report=False)(
+        state, pods, ev_kind, ev_pod, tp, key, rank
+    )
+    wav = make_wave_replay(policies, "FGDScore", wave=8)(
+        state, pods, build_pod_types(pods), ev_kind, ev_pod, tp, key, rank
+    )
+    assert np.array_equal(np.asarray(seq.placed_node), np.asarray(wav.placed_node))
+    assert np.array_equal(np.asarray(seq.event_node), np.asarray(wav.event_node))
+
+
+def test_seed_batched_replay_on_tpu(accel):
+    """Per-seed bit-identity of the vmapped batch on the real chip (the
+    device where the sweep actually runs it)."""
+    from tests.fixtures import random_cluster, random_pods
+    from tpusim.io.trace import tiebreak_rank
+    from tpusim.policies import make_policy
+    from tpusim.sim.engine import EV_CREATE
+    from tpusim.sim.table_engine import build_pod_types, make_table_replay
+
+    rng = np.random.default_rng(23)
+    state, tp = random_cluster(rng, num_nodes=24)
+    pods = random_pods(rng, num_pods=40)
+    ev_kind = jnp.full(40, EV_CREATE, jnp.int32)
+    ev_pod = jnp.arange(40, dtype=jnp.int32)
+    policies = [(make_policy("FGDScore"), 1000)]
+    tab = make_table_replay(policies, "FGDScore", report=False)
+
+    ranks = jnp.stack(
+        [jnp.asarray(tiebreak_rank(24, s)) for s in range(4)]
+    )
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(4))
+    types = build_pod_types(pods)
+    batched = jax.jit(
+        jax.vmap(lambda k, r: tab(state, pods, types, ev_kind, ev_pod, tp, k, r))
+    )(keys, ranks)
+    for s in range(4):
+        single = tab(state, pods, types, ev_kind, ev_pod, tp, keys[s], ranks[s])
+        assert np.array_equal(
+            np.asarray(single.placed_node), np.asarray(batched.placed_node[s])
+        ), f"seed {s}"

@@ -238,7 +238,9 @@ def is_kubeconfig_file(path: str) -> bool:
     try:
         with open(path) as f:
             doc = yaml.safe_load(f)
-    except yaml.YAMLError:
+    except (yaml.YAMLError, OSError, UnicodeDecodeError):
+        # unreadable / binary / non-UTF8 → not a kubeconfig; let the dump
+        # loader produce its own typed error
         return False
     return isinstance(doc, dict) and doc.get("kind") == "Config" and "clusters" in doc
 
