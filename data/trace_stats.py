@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""Trace statistics — the reference's two stats notebooks as a CLI
+(`data/0 - Workloads stats.ipynb`, `data/1 - Nodes stats.ipynb`):
+pod-category population + GPU-request shares per class (incl. within the
+multi-GPU class), and the per-GPU-model node inventory. stdlib only.
+
+Usage:
+    python3 data/trace_stats.py data/csv/openb_pod_list_gpushare60.csv
+    python3 data/trace_stats.py data/csv/openb_node_list_all_node.csv
+    python3 data/trace_stats.py          # both defaults
+"""
+
+from __future__ import annotations
+
+import csv
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def pod_category(num_gpu: int, gpu_milli: int) -> str:
+    """The notebook's category conditions (Workloads stats, cell 3)."""
+    if num_gpu == 0:
+        return "NO-GPU"
+    if num_gpu == 1 and gpu_milli < 1000:
+        return "Share-GPU"
+    if gpu_milli == 1000:
+        return f"{num_gpu}-GPU"
+    return f"{num_gpu}x{gpu_milli}m"  # not present in openb traces
+
+
+def workload_stats(path):
+    rows = list(csv.DictReader(open(path)))
+    cats = defaultdict(int)
+    req = defaultdict(int)
+    for r in rows:
+        c = pod_category(int(r["num_gpu"]), int(r["gpu_milli"] or 0))
+        cats[c] += 1
+        req[c] += int(r["num_gpu"]) * int(r["gpu_milli"] or 0)
+    total_req = sum(req.values()) or 1
+
+    def order(c):
+        return (c != "NO-GPU", c != "Share-GPU", c)
+
+    print(f"\n== workload stats: {path} ({len(rows)} pods)")
+    print(f"{'category':>10s} {'task pop %':>11s} {'GPU-req %':>10s}")
+    for c in sorted(cats, key=order):
+        print(
+            f"{c:>10s} {100.0 * cats[c] / len(rows):10.2f}% "
+            f"{100.0 * req[c] / total_req:9.2f}%"
+        )
+    multi = {c: v for c, v in req.items() if c not in ("NO-GPU", "Share-GPU", "1-GPU")}
+    mt = sum(multi.values())
+    if mt:
+        print("GPU-req % within the multi-GPU class:")
+        for c in sorted(multi, key=order):
+            print(f"{c:>10s} {100.0 * multi[c] / mt:10.2f}%")
+
+
+def node_stats(path):
+    rows = list(csv.DictReader(open(path)))
+    by_model = defaultdict(list)
+    for r in rows:
+        by_model[r.get("model") or "<no GPU>"].append(r)
+    print(f"\n== node stats: {path} ({len(rows)} nodes)")
+    print(
+        f"{'model':>10s} {'nodes':>6s} {'gpus':>6s} {'gpu/node':>9s} "
+        f"{'cpu_milli/node':>15s} {'memory_mib/node':>16s}"
+    )
+    for model in sorted(by_model):
+        ns = by_model[model]
+        gpus = sum(int(n["gpu"]) for n in ns)
+        print(
+            f"{model:>10s} {len(ns):6d} {gpus:6d} {gpus / len(ns):9.2f} "
+            f"{sum(int(n['cpu_milli']) for n in ns) / len(ns):15.1f} "
+            f"{sum(int(n['memory_mib']) for n in ns) / len(ns):16.1f}"
+        )
+
+
+def main(argv):
+    paths = argv or [
+        str(REPO / "data/csv/openb_pod_list_gpushare60.csv"),
+        str(REPO / "data/csv/openb_node_list_all_node.csv"),
+    ]
+    for p in paths:
+        with open(p, newline="") as f:
+            header = f.readline()
+        if "num_gpu" in header:
+            workload_stats(p)
+        else:
+            node_stats(p)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -36,7 +36,7 @@ import argparse
 import csv
 from collections import defaultdict
 from pathlib import Path
-from statistics import median, pstdev
+from statistics import median, pstdev, quantiles
 
 import matplotlib
 
@@ -125,28 +125,22 @@ def load_discrete(path: Path):
     return acc
 
 
-def _percentile(vs, q):
-    """Linear-interpolation percentile (numpy's default method) over a
-    sorted list — the estimator seaborn's ("pi", 50) band uses."""
-    if len(vs) == 1:
-        return vs[0]
-    pos = q / 100.0 * (len(vs) - 1)
-    lo = int(pos)
-    hi = min(lo + 1, len(vs) - 1)
-    return vs[lo] + (pos - lo) * (vs[hi] - vs[lo])
-
-
 def curve_series(data, workload, policy, transform=lambda v: v):
-    """Plotted line content: per-x (median, p25, p75) over seeds."""
+    """Plotted line content: per-x (median, p25, p75) over seeds —
+    linear-interpolation percentiles (statistics.quantiles "inclusive" ==
+    numpy's default method, the estimator seaborn's ("pi", 50) band uses)."""
     series = data.get((workload, policy))
     if not series:
         return []
     out = []
     for x in sorted(series):
         vs = sorted(transform(v) for v in series[x])
-        out.append(
-            (x, median(vs), _percentile(vs, 25), _percentile(vs, 75))
-        )
+        if len(vs) == 1:
+            p25 = p75 = vs[0]
+        else:
+            qs = quantiles(vs, n=4, method="inclusive")
+            p25, p75 = qs[0], qs[2]
+        out.append((x, median(vs), p25, p75))
     return out
 
 

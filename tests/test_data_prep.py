@@ -122,3 +122,24 @@ def test_prepared_input_drives_apply(tmp_path):
         ApplyOptions(simon_config=str(cr_path), extended_resources=["gpu"])
     ).run(out=out)
     assert "unscheduled pods" in out.getvalue()
+
+
+@needs_traces
+def test_trace_stats_cli(capsys):
+    """data/trace_stats.py (the reference's two stats notebooks as a CLI)
+    must reproduce the notebook's headline numbers on the trace it uses:
+    gpushare60's GPU-sharing request share is ~60% by construction."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_stats", os.path.join(REPO, "data/trace_stats.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main([os.path.join(REPO, "data/csv/openb_pod_list_gpushare60.csv"),
+              NODE_CSV])
+    out = capsys.readouterr().out
+    assert "Share-GPU" in out and "60.01%" in out
+    assert "8152 pods" in out
+    # node side: 1213 GPU nodes, G2 is the 8-GPU workhorse
+    assert "1213 nodes" in out and "G2" in out
