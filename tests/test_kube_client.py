@@ -167,9 +167,18 @@ FIXTURE = {
 }
 
 
+# paths the handler answers with 403 (RBAC denial) instead of the fixture
+FORBIDDEN: set = set()
+
+
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
-        body = FIXTURE.get(self.path.split("?")[0])
+        path = self.path.split("?")[0]
+        if path in FORBIDDEN:
+            self.send_response(403)
+            self.end_headers()
+            return
+        body = FIXTURE.get(path)
         if body is None:
             self.send_response(404)
             self.end_headers()
@@ -219,6 +228,58 @@ def test_is_kubeconfig_file(tmp_path, api_server):
     dump = tmp_path / "dump.yaml"
     dump.write_text(yaml.dump({"kind": "List", "items": []}))
     assert not is_kubeconfig_file(str(dump))
+
+
+def test_is_kubeconfig_file_large_files(tmp_path, api_server):
+    """Size alone must not route a file: a multi-MB multi-cluster
+    kubeconfig still goes to the client path, while a multi-MB dump skips
+    the full parse via the head-of-file marker scan."""
+    big_kc = tmp_path / "big-kubeconfig"
+    doc = yaml.safe_load(open(_kubeconfig(tmp_path, api_server)))
+    doc["clusters"] += [
+        {"name": f"c{i}", "cluster": {"server": f"https://h{i}:6443",
+                                      "certificate-authority-data": "x" * 4096}}
+        for i in range(400)
+    ]
+    big_kc.write_text(yaml.dump(doc))
+    assert big_kc.stat().st_size > 1 << 20
+    assert is_kubeconfig_file(str(big_kc))
+
+    big_dump = tmp_path / "big-dump.yaml"
+    big_dump.write_text(
+        yaml.dump({"kind": "List", "items": [_node(f"n{i}") for i in range(8000)]})
+    )
+    assert big_dump.stat().st_size > 1 << 20
+    assert not is_kubeconfig_file(str(big_dump))
+
+
+def test_client_403_falls_through_to_next_candidate(tmp_path, api_server):
+    """An RBAC-denied deprecated group-version must not abort ingestion
+    when the current group-version is listable (ADVICE r3)."""
+    kc = _kubeconfig(tmp_path, api_server)
+    FORBIDDEN.add("/apis/policy/v1beta1/poddisruptionbudgets")
+    try:
+        cluster = load_cluster_from_client(kc)
+        assert [n.name for n in cluster.nodes] == ["node-a", "node-b"]
+    finally:
+        FORBIDDEN.clear()
+
+
+def test_client_all_candidates_denied_raises(tmp_path, api_server):
+    """If every candidate endpoint is RBAC-denied the client must raise —
+    even for optional groups, silence would drop real objects."""
+    kc = _kubeconfig(tmp_path, api_server)
+    FORBIDDEN.update(
+        {
+            "/apis/policy/v1beta1/poddisruptionbudgets",
+            "/apis/policy/v1/poddisruptionbudgets",
+        }
+    )
+    try:
+        with pytest.raises(KubeClientError, match="PodDisruptionBudget"):
+            load_cluster_from_client(kc)
+    finally:
+        FORBIDDEN.clear()
 
 
 def test_client_lists_and_filters(tmp_path, api_server):

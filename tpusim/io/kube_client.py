@@ -25,6 +25,7 @@ from __future__ import annotations
 import base64
 import json
 import os
+import re
 import ssl
 import tempfile
 import urllib.error
@@ -185,6 +186,13 @@ class KubeClient:
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 raise FileNotFoundError(path) from e
+            if e.code == 403:
+                # RBAC denial; list_all treats this like 404 so a denied
+                # deprecated group-version (e.g. policy/v1beta1) can fall
+                # through to a listable candidate (e.g. policy/v1)
+                raise PermissionError(
+                    f"GET {path}: HTTP 403 {e.reason}"
+                ) from e
             raise KubeClientError(
                 f"GET {path} failed: HTTP {e.code} {e.reason}"
             ) from e
@@ -194,14 +202,21 @@ class KubeClient:
             ) from e
 
     def list_all(self, paths: Sequence[str], kind: str) -> List[dict]:
-        """First non-404 list endpoint → items with kind/apiVersion
-        injected (k8s list responses carry the kind only on the envelope)."""
+        """First listable endpoint → items with kind/apiVersion injected
+        (k8s list responses carry the kind only on the envelope). 404 and
+        403 both fall through to the next group-version candidate — a
+        deprecated path may be RBAC-denied while the current one is
+        listable; only all-candidates-failed aborts."""
         last: Optional[Exception] = None
+        denied = False
         for path in paths:
             try:
                 body = self.get(path)
             except FileNotFoundError as e:
                 last = e
+                continue
+            except PermissionError as e:
+                last, denied = e, True
                 continue
             api_version = body.get("apiVersion") or "v1"
             items = []
@@ -211,7 +226,7 @@ class KubeClient:
                 item.setdefault("apiVersion", api_version)
                 items.append(item)
             return items
-        if kind in ("PodDisruptionBudget", "CronJob"):
+        if kind in ("PodDisruptionBudget", "CronJob") and not denied:
             return []  # optional API groups may be absent entirely
         raise KubeClientError(f"unable to list {kind}: {last}")
 
@@ -230,11 +245,23 @@ class KubeClient:
 
 def is_kubeconfig_file(path: str) -> bool:
     """Heuristic the applier uses to pick client vs dump ingestion: a
-    kubeconfig is `kind: Config` with a clusters list. Credential files are
-    tiny; a multi-MB file is certainly a cluster dump, so skip the parse
-    (re-parsing a large dump here would double ingestion startup)."""
-    if not os.path.isfile(path) or os.path.getsize(path) > 1 << 20:
+    kubeconfig is `kind: Config` with a clusters list. Large files get a
+    cheap head-of-file marker scan before the full parse, so a multi-MB
+    cluster dump skips the double parse while a large multi-cluster
+    kubeconfig still routes to the client path."""
+    if not os.path.isfile(path):
         return False
+    if os.path.getsize(path) > 1 << 20:
+        try:
+            with open(path, errors="replace") as f:
+                head = f.read(64 << 10)
+        except OSError:
+            return False
+        # kubeconfig top-level keys at column 0 (either may sit beyond the
+        # head in a large file — key order varies); dumps are object
+        # lists/streams whose kinds/fields all sit indented or differ
+        if not re.search(r"^(kind: Config\b|clusters:)", head, re.M):
+            return False
     try:
         with open(path) as f:
             doc = yaml.safe_load(f)

@@ -143,6 +143,10 @@ class Simulator:
         self._table_ok = self.cfg.gpu_sel_method != "random" and all(
             fn.policy_name != "RandomScore" for fn, _ in self._policy_fns
         )
+        # device-phase wall of the last schedule_pods_batch call this sim
+        # led (dispatch + fetch, excluding host spec prep/result slicing);
+        # read by bench.py's batched row for like-for-like throughput
+        self._last_batch_device_s = None
         if self._table_ok:
             from tpusim.sim.table_engine import make_table_replay
 
@@ -874,17 +878,23 @@ def schedule_pods_batch(
         # type_id remap works elementwise on the stacked [S, P] ids)
         types_b = pad_pod_types(types_b)
         fn = _batched_engine(lead._table_fn, table=True)
+        t_dev = time.perf_counter()
         out = fn(
             lead.init_state, specs_b, types_b, ev_kind_b, ev_pod_b,
             lead.typical, keys, ranks,
         )
     else:
         fn = _batched_engine(lead.replay_fn, table=False)
+        t_dev = time.perf_counter()
         out = fn(
             lead.init_state, specs_b, ev_kind_b, ev_pod_b,
             lead.typical, keys, ranks,
         )
     out = device_fetch(out)
+    # device-phase wall (replay dispatch + fetch), excluding the host-side
+    # spec padding above and result slicing below — the like-for-like
+    # number against a single run_events call (bench.py batched row)
+    lead._last_batch_device_s = time.perf_counter() - t_dev
     wall = time.perf_counter() - t0
 
     results = []
