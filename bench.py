@@ -111,7 +111,7 @@ def measure_policy(nodes, pods, name, policies, gpu_sel, dim_ext, norm):
     state = jax.tree.map(np.asarray, result.state)
     return {
         "policy": name,
-        "engine": "table" if sim._table_ok else "sequential",
+        "engine": sim._last_engine,
         "events": events,
         "placements": placements,
         "wall_s": round(wall, 3),

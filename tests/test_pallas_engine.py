@@ -1,0 +1,144 @@
+"""Fused whole-replay Pallas engine (tpusim.sim.pallas_engine) vs the
+incremental table engine: identical placements, device masks, failure flags
+and final state on randomized create/delete mixes.
+
+The CPU lane runs the kernel in Pallas interpreter mode (the Mosaic path
+needs real TPU hardware — tests/test_tpu.py pins the on-chip equality on the
+full openb trace). Interpreter steps are slow, so traces here are small; the
+semantics exercised (share + whole + CPU-only pods, deletions, infeasible
+pods, pinned pods, tie-breaking) are the same."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.fixtures import random_cluster, random_pods
+from tests.test_table_engine import _assert_equal, _events_with_deletes
+from tpusim.policies import make_policy
+from tpusim.sim.engine import EV_CREATE
+from tpusim.sim.pallas_engine import make_pallas_replay, supports
+from tpusim.sim.table_engine import build_pod_types, make_table_replay
+from tpusim.types import PodSpec
+
+
+def _run_both(policy, gpu_sel, state, tp, pods, ev_kind, ev_pod, rank):
+    policies = [(make_policy(policy), 1000)]
+    key = jax.random.PRNGKey(3)
+    types = build_pod_types(pods)
+    tab = make_table_replay(policies, gpu_sel=gpu_sel)
+    r0 = tab(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+    pal = make_pallas_replay(policies, gpu_sel=gpu_sel, interpret=True)
+    r1 = pal(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+    return r0, r1
+
+
+def test_pallas_fgd_matches_table_engine():
+    rng = np.random.default_rng(11)
+    state, tp = random_cluster(rng, num_nodes=24)
+    pods = random_pods(rng, num_pods=40)
+    ev_kind, ev_pod = _events_with_deletes(40, rng)
+    rank = jnp.asarray(rng.permutation(24).astype(np.int32))
+    r0, r1 = _run_both("FGDScore", "FGDScore", state, tp, pods, ev_kind, ev_pod, rank)
+    _assert_equal(r0, r1)
+    assert np.array_equal(np.asarray(r0.event_node), np.asarray(r1.event_node))
+    assert np.array_equal(np.asarray(r0.event_dev), np.asarray(r1.event_dev))
+
+
+def test_pallas_fgd_gpu_sel_best():
+    """gpuSelMethod=best routes Reserve through the best-fit device pick
+    instead of FGD's own (open_gpu_share.go:285-304)."""
+    rng = np.random.default_rng(13)
+    state, tp = random_cluster(rng, num_nodes=16)
+    pods = random_pods(rng, num_pods=30)
+    ev_kind, ev_pod = _events_with_deletes(30, rng)
+    rank = jnp.asarray(rng.permutation(16).astype(np.int32))
+    r0, r1 = _run_both("FGDScore", "best", state, tp, pods, ev_kind, ev_pod, rank)
+    _assert_equal(r0, r1)
+
+
+def test_pallas_pinned_and_infeasible():
+    """Pinned pods (snapshot re-bind) bind only to their node; pods no node
+    can host are recorded failed — identically to the table engine."""
+    rng = np.random.default_rng(17)
+    state, tp = random_cluster(rng, num_nodes=12)
+    pods = random_pods(rng, num_pods=20)
+    # pin pod 0 to node 3; make pod 1 infeasible everywhere
+    pods = PodSpec(
+        cpu=pods.cpu.at[1].set(2**28),
+        mem=pods.mem,
+        gpu_milli=pods.gpu_milli,
+        gpu_num=pods.gpu_num,
+        gpu_mask=pods.gpu_mask,
+        pinned=pods.pinned.at[0].set(3),
+    )
+    ev_kind = jnp.full(20, EV_CREATE, jnp.int32)
+    ev_pod = jnp.arange(20, dtype=jnp.int32)
+    rank = jnp.asarray(rng.permutation(12).astype(np.int32))
+    r0, r1 = _run_both("FGDScore", "FGDScore", state, tp, pods, ev_kind, ev_pod, rank)
+    _assert_equal(r0, r1)
+    assert bool(np.asarray(r1.ever_failed)[1])
+
+
+def test_driver_engine_knob():
+    """SimulatorConfig.engine routes run_events: forced `pallas` (CPU ->
+    interpreter mode) must reproduce forced `table` exactly through the
+    full driver path; bad/unsupported knobs raise at construction."""
+    from tests.test_batch import _mk_cluster, _mk_pods
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+    from tpusim.sim.typical import TypicalPodsConfig
+
+    rng = np.random.default_rng(23)
+    nodes = _mk_cluster(rng)
+    pods = _mk_pods(rng, n=24)
+
+    def run(engine):
+        cfg = SimulatorConfig(
+            policies=(("FGDScore", 1000),),
+            gpu_sel_method="FGDScore",
+            shuffle_pod=True,
+            seed=42,
+            report_per_event=False,
+            engine=engine,
+            typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
+        )
+        sim = Simulator(nodes, cfg)
+        sim.set_workload_pods(pods)
+        return sim.run()
+
+    r_tab = run("table")
+    r_pal = run("pallas")
+    assert np.array_equal(r_tab.placed_node, r_pal.placed_node)
+    assert np.array_equal(r_tab.dev_mask, r_pal.dev_mask)
+    assert [u.pod.name for u in r_tab.unscheduled_pods] == [
+        u.pod.name for u in r_pal.unscheduled_pods
+    ]
+
+    from tpusim.sim.driver import Simulator as S, SimulatorConfig as C
+
+    with pytest.raises(ValueError, match="unknown engine"):
+        S(nodes, C(engine="warp"))
+    with pytest.raises(ValueError, match="pallas"):
+        S(nodes, C(policies=(("RandomScore", 1000),), gpu_sel_method="random",
+                   engine="pallas", report_per_event=False))
+    with pytest.raises(ValueError, match="table"):
+        S(nodes, C(policies=(("RandomScore", 1000),), gpu_sel_method="random",
+                   engine="table", report_per_event=False))
+    with pytest.raises(ValueError, match="pallas"):
+        # report mode has no pallas path
+        S(nodes, C(engine="pallas", report_per_event=True))
+
+
+def test_supports_gating():
+    fgd = make_policy("FGDScore")
+    rand = make_policy("RandomScore")
+    bestfit = make_policy("BestFitScore")
+    assert supports([(fgd, 1000)], "FGDScore", report=False)
+    assert supports([(fgd, 1000)], "best", report=False)
+    assert not supports([(fgd, 1000)], "FGDScore", report=True)
+    assert not supports([(fgd, 1000)], "random", report=False)
+    assert not supports([(fgd, 1000), (bestfit, 1)], "best", report=False)
+    assert not supports([(bestfit, 1000)], "best", report=False)  # no column yet
+    assert not supports([(fgd, 1000)], "PWRScore", report=False)
+    with pytest.raises(ValueError):
+        make_pallas_replay([(rand, 1000)], gpu_sel="best")

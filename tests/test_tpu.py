@@ -86,6 +86,53 @@ def test_engine_vs_table_engine_on_tpu(accel):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_pallas_engine_full_openb_on_tpu(accel):
+    """The fused whole-replay Pallas kernel must reproduce the table
+    engine's placements/devices/state bit-for-bit on the FULL openb default
+    trace at tune 1.3 — the headline-bench configuration. This is the
+    pallas engine's exactness gate on real Mosaic numerics (the CPU suite
+    only covers interpreter mode)."""
+    import os
+
+    from tpusim.io.trace import build_events, load_node_csv, load_pod_csv, pods_to_specs
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+    from tpusim.sim.pallas_engine import make_pallas_replay
+    from tpusim.sim.table_engine import build_pod_types
+    from tpusim.sim.typical import TypicalPodsConfig
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    nodes = load_node_csv(os.path.join(repo, "data/csv/openb_node_list_gpu_node.csv"))
+    pods = load_pod_csv(os.path.join(repo, "data/csv/openb_pod_list_default.csv"))
+    cfg = SimulatorConfig(
+        policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+        tuning_ratio=1.3, tuning_seed=42, seed=42, shuffle_pod=True,
+        report_per_event=False,
+        typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
+    )
+    sim = Simulator(nodes, cfg)
+    sim.set_workload_pods(pods)
+    sim.set_typical_pods()
+    trace = sim.prepare_pods()
+    specs = pods_to_specs(trace)
+    ev_kind, ev_pod = build_events(trace)
+    ev_kind, ev_pod = jnp.asarray(ev_kind), jnp.asarray(ev_pod)
+    key = jax.random.PRNGKey(42)
+    types = build_pod_types(specs)
+
+    tab = sim._table_fn(
+        sim.init_state, specs, types, ev_kind, ev_pod, sim.typical, key, sim.rank
+    )
+    pal = make_pallas_replay(list(sim._policy_fns), gpu_sel="FGDScore")(
+        sim.init_state, specs, types, ev_kind, ev_pod, sim.typical, key, sim.rank
+    )
+    assert np.array_equal(np.asarray(tab.placed_node), np.asarray(pal.placed_node))
+    assert np.array_equal(np.asarray(tab.dev_mask), np.asarray(pal.dev_mask))
+    assert np.array_equal(np.asarray(tab.ever_failed), np.asarray(pal.ever_failed))
+    assert np.array_equal(np.asarray(tab.event_node), np.asarray(pal.event_node))
+    for a, b in zip(jax.tree.leaves(tab.state), jax.tree.leaves(pal.state)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_driver_small_run_on_tpu(accel):
     """A tiny end-to-end driver run on the accelerator: placements land,
     reports emit, no unscheduled pods."""

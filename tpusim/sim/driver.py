@@ -66,6 +66,17 @@ class SimulatorConfig:
     seed: int = 42  # node tie-break permutation + jax PRNG
     report_per_event: bool = True
     use_timestamps: bool = False
+    # replay engine: auto (fastest supported), or force one of
+    # sequential | table | pallas (ENGINES.md). `auto` picks the fused
+    # Pallas engine on TPU backends for supported configs, else the
+    # incremental table engine, else the sequential oracle. Degenerate
+    # workloads (zero distinct pod types / fewer events than types) always
+    # run the sequential path — the table init would cost more than it
+    # saves; a forced table/pallas engine still applies whenever at least
+    # one pod type exists. The seed-batched sweep (schedule_pods_batch)
+    # honors `sequential`; `pallas` has no batched form and batches run
+    # the (bit-identical) table engine instead.
+    engine: str = "auto"
 
 
 @dataclass
@@ -147,6 +158,9 @@ class Simulator:
         # led (dispatch + fetch, excluding host spec prep/result slicing);
         # read by bench.py's batched row for like-for-like throughput
         self._last_batch_device_s = None
+        # which engine the last run_events call dispatched to
+        # (pallas | table | sequential) — bench/log labeling
+        self._last_engine = None
         if self._table_ok:
             from tpusim.sim.table_engine import make_table_replay
 
@@ -154,6 +168,43 @@ class Simulator:
                 self._policy_fns,
                 gpu_sel=self.cfg.gpu_sel_method,
                 report=self.cfg.report_per_event,
+            )
+        # fused whole-replay Pallas engine (tpusim.sim.pallas_engine): one
+        # kernel for the entire event loop, ~4x the table engine on chip;
+        # single-policy no-report configs only. On CPU backends it runs in
+        # interpreter mode — only sensible when forced (engine: pallas).
+        if self.cfg.engine not in ("auto", "sequential", "table", "pallas"):
+            raise ValueError(
+                f"unknown engine {self.cfg.engine!r}: expected auto | "
+                "sequential | table | pallas"
+            )
+        from tpusim.sim import pallas_engine
+
+        self._pallas_ok = self._table_ok and pallas_engine.supports(
+            self._policy_fns, self.cfg.gpu_sel_method, self.cfg.report_per_event
+        )
+        if self.cfg.engine == "pallas" and not self._pallas_ok:
+            raise ValueError(
+                "engine: pallas requires a single-policy, no-report config "
+                "with a registered Pallas column kernel (see "
+                "tpusim.sim.pallas_engine.supports)"
+            )
+        if self.cfg.engine == "table" and not self._table_ok:
+            raise ValueError(
+                "engine: table cannot run per-event-random configs "
+                "(RandomScore / gpuSelMethod random); use sequential"
+            )
+        self._pallas_fn = None
+        if self._pallas_ok and self.cfg.engine in ("auto", "pallas"):
+            # Mosaic lowers on TPU backends only; anywhere else (cpu, gpu)
+            # a forced `engine: pallas` runs the interpreter — correct but
+            # slow, the CPU test lane's harness. `auto` never picks it off
+            # TPU (run_events gates on the same predicate).
+            self._pallas_fn = pallas_engine.make_pallas_replay(
+                self._policy_fns,
+                gpu_sel=self.cfg.gpu_sel_method,
+                report=self.cfg.report_per_event,
+                interpret=jax.default_backend() != "tpu",
             )
 
     def run_events(
@@ -178,7 +229,7 @@ class Simulator:
         # dedup types from the UNPADDED specs (no spurious zero type); the
         # type_id axis is padded alongside the pod axis (padded events only
         # ever reference pod 0)
-        if not self._table_ok:
+        if not self._table_ok or self.cfg.engine == "sequential":
             types = None
         elif types is None:
             types = build_pod_types(specs)
@@ -192,14 +243,27 @@ class Simulator:
         out = None
         if types is not None:
             k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
-            if k > 0 and e >= 2 * k:
+            big = k > 0 and e >= 2 * k
+            if big or (self.cfg.engine in ("table", "pallas") and k > 0):
                 if p2 != p or e2 != e:  # bucketed run: stabilize K too
                     types = pad_pod_types(types)
-                out = self._table_fn(
+                # the fused Pallas engine wins whenever it applies; its
+                # Mosaic path needs a real accelerator (auto never picks
+                # the CPU interpreter — that is only for a forced
+                # `engine: pallas` under the test lane)
+                use_pallas = self._pallas_fn is not None and (
+                    self.cfg.engine == "pallas"
+                    or (self.cfg.engine == "auto" and big
+                        and jax.default_backend() == "tpu")
+                )
+                fn = self._pallas_fn if use_pallas else self._table_fn
+                self._last_engine = "pallas" if use_pallas else "table"
+                out = fn(
                     state, specs, types, ev_kind, ev_pod, self.typical, key,
                     self.rank,
                 )
         if out is None:
+            self._last_engine = "sequential"
             out = self.replay_fn(
                 state, specs, ev_kind, ev_pod, self.typical, key, self.rank
             )
@@ -799,6 +863,7 @@ def schedule_pods_batch(
             and s.cfg.norm_method == lead.cfg.norm_method
             and s.cfg.report_per_event == lead.cfg.report_per_event
             and s.cfg.use_timestamps == lead.cfg.use_timestamps
+            and s.cfg.engine == lead.cfg.engine
             and s.cfg.typical_pods == lead.cfg.typical_pods
             and s.nodes == lead.nodes
             # the batched replay scores every seed against lead's typical
@@ -825,7 +890,10 @@ def schedule_pods_batch(
     e = max(len(k) for k, _ in ev_list)
     p2, e2 = _bucket_sizes(p, e, bucket)
 
-    use_table = lead._table_ok
+    # engine knob: `sequential` is honored; `pallas` has no batched form
+    # (vmap over the fused kernel is untested), so batches run the
+    # bit-identical table engine (SimulatorConfig.engine docstring)
+    use_table = lead._table_ok and lead.cfg.engine != "sequential"
     tids = [None] * len(sims)
     if use_table:
         # one shared type table across the batch: dedup over the

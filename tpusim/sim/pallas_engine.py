@@ -1,0 +1,645 @@
+"""Fused whole-replay Pallas engine — one kernel for the entire event loop.
+
+Round-3 profiling (ENGINES.md) showed the incremental table replay is
+KERNEL-LAUNCH-BOUND: ~40 small fused kernels per event plus a ~15 us/iteration
+`lax.scan` floor put a hard ceiling of ~16.6k events/s on one chip, while the
+per-event math itself is only ~1-2 us of VPU work. This engine removes both
+overheads at once: the WHOLE replay is a single `pl.pallas_call` with
+`grid=(E,)` and sequential ("arbitrary") dimension semantics. The score /
+feasibility / device tables, the cluster state, and the placement bookkeeping
+all live in VMEM across grid steps (~6 MB total); one grid step = one event =
+the same filter -> score-column refresh -> selectHost -> Reserve -> Bind cycle
+the table engine runs (mirroring the reference's per-pod cycle,
+vendor .../scheduler/scheduler.go:441 scheduleOne + the simon plugin set),
+executed as straight-line VPU code with zero kernel launches per event.
+
+Mosaic constraints shape the implementation (probed on the target chip):
+scalars cannot be stored to VMEM and dynamic lane-dim slicing is not
+lowerable, so every "pointer chase" is a masked vector op instead --
+  row gather  score_tbl[t_id]      -> sum(where(sublane_iota == t_id, tbl, 0))
+  col update  tbl[:, node] = col   -> where(lane_iota == node, col, tbl)
+  scalar read placed[idx]          -> sum(where(lane_iota == idx, placed, 0))
+Each masked rewrite touches the full [K, N] table (~0.7 us of i32 VPU work),
+noise next to the launch overhead it replaces.
+
+Exactness: the kernel computes the same integer scores from the same integer
+state as the table engine; the only divergence channel is f32 reduction order
+inside the FGD frag sums (floor(sigmoid(.)*100) can flip an integer score when
+a sum lands exactly on a truncation boundary). Placements are asserted
+identical to the table engine on the full openb trace in the TPU lane
+(tests/test_tpu.py); the CPU lane pins interpreter-mode equality on
+randomized small traces (tests/test_pallas_engine.py).
+
+Scope: single-policy configurations (the reference's own experiment protocol
+enables one Score plugin at weight 1000, SURVEY.md §5.6) whose policy has a
+column kernel in PALLAS_COLUMNS, gpu_sel in {best, worst, policy self-select},
+report_per_event=False. driver.run_events picks this engine automatically on
+TPU backends and falls back to the table/sequential engines otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
+from tpusim.sim.engine import ReplayResult
+from tpusim.sim.step import SELF_SELECT_POLICIES
+from tpusim.sim.table_engine import PodTypes, reject_randomized
+from tpusim.types import NodeState, PodSpec
+
+_INT_MAX = np.int32(np.iinfo(np.int32).max)
+
+_EV_FIELDS = 12  # packed per-event row size (see _pack_events)
+
+
+def _iota(shape, dim):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, dim)
+
+
+def _node_bit(gtyp):
+    """GPU-model bit of a node's gpu_type id (-1 = no GPU -> no bit).
+    ref: utils.go:957-1005 IsNodeAccessibleToPod."""
+    return jnp.where(gtyp >= 0, jax.lax.shift_left(1, jnp.maximum(gtyp, 0)), 0)
+
+
+def _sigmoid_score_f32(cur, new):
+    """trunc(sigmoid((cur-new)/1000) * MaxNodeScore) — fgd_score.go:124."""
+    s = jax.nn.sigmoid((cur - new) / 1000.0)
+    return jnp.floor(s * MAX_NODE_SCORE).astype(jnp.int32)
+
+
+def _cumsum8_lanes(u):
+    """Inclusive prefix sum of a (1,8) lane vector (no cumsum in Mosaic)."""
+    sub = _iota((8, 8), 0)
+    lane = _iota((8, 8), 1)
+    a = jnp.where(lane <= sub, u, 0)  # (8,8): row d = prefix of u
+    return a.sum(axis=1, keepdims=True).T  # (1,8)
+
+
+# ---------------------------------------------------------------------------
+# Policy column kernels: score ONE node (scalars + (8,1) device vector)
+# against every pod type at once. Signature:
+#   col_fn(node: _NodeScalars, types: _TypeCols, tp: _TpRows)
+#     -> (score_col i32[K,1], sdev_col i32[K,1])
+# Registered per policy name; policies without an entry fall back to the
+# table engine.
+# ---------------------------------------------------------------------------
+
+
+class _NodeScalars(NamedTuple):
+    cpu: jnp.ndarray  # scalar i32 cpu_left
+    mem: jnp.ndarray  # scalar i32 mem_left
+    gcnt: jnp.ndarray  # scalar i32 gpu count
+    gtyp: jnp.ndarray  # scalar i32 gpu model id (-1 none)
+    g8: jnp.ndarray  # (8,1) i32 per-device milli left
+
+
+class _TypeCols(NamedTuple):
+    """Pod-type spec columns, share-group rows [0,Ks) then whole [Ks,K)."""
+
+    cpu: jnp.ndarray  # (K,1) i32
+    mem: jnp.ndarray  # (K,1) i32
+    milli: jnp.ndarray  # (K,1) i32
+    num: jnp.ndarray  # (K,1) i32
+    mask: jnp.ndarray  # (K,1) i32
+    ks: int  # static share-group size
+
+
+class _TpRows(NamedTuple):
+    """Typical-pod distribution as (1,T) rows (ref: frag.go:285-380)."""
+
+    cpu: jnp.ndarray  # (1,T) i32
+    milli: jnp.ndarray  # (1,T) i32
+    numf: jnp.ndarray  # (1,T) f32
+    mask: jnp.ndarray  # (1,T) i32
+    freq: jnp.ndarray  # (1,T) f32
+
+
+def _frag_terms(node: _NodeScalars, tp: _TpRows):
+    """Shared frag ingredients for one node: the fit/fitcnt/fitsum
+    decomposition of NodeGpuShareFragAmountScore (frag.go:148-203) that
+    policies/fgd.py uses, here against (8,T)-shaped broadcasts."""
+    gf = node.g8.astype(jnp.float32)  # (8,1)
+    fit = (node.g8 >= tp.milli) & (tp.milli > 0)  # (8,T)
+    fitf = fit.astype(jnp.float32)
+    fitcnt = fitf.sum(axis=0, keepdims=True)  # (1,T)
+    fitsum = jnp.where(fit, gf, 0.0).sum(axis=0, keepdims=True)  # (1,T)
+    total = gf.sum()
+    acc = (tp.mask == 0) | ((tp.mask & _node_bit(node.gtyp)) != 0)  # (1,T)
+    gpu_pod = tp.milli > 0
+    return fit, fitf, fitcnt, fitsum, total, acc, gpu_pod
+
+
+def _fgd_column(node: _NodeScalars, types: _TypeCols, tp: _TpRows):
+    """FGD score + Reserve-device column for one node across all pod types
+    (ref: plugin/fgd_score.go:99-156; the same fit/fitsum decomposition as
+    policies/fgd.py, vectorized over the type axis)."""
+    ks = types.ks
+    k = types.cpu.shape[0]
+    kw = k - ks
+    fit, fitf, fitcnt, fitsum, total, acc, gpu_pod = _frag_terms(node, tp)
+    isq3 = gpu_pod & acc & (fitcnt >= tp.numf) & (node.cpu >= tp.cpu)
+    cur = (tp.freq * jnp.where(isq3, total - fitsum, total)).sum()
+    gf = node.g8.astype(jnp.float32)  # (8,1)
+    gT = node.g8.T  # (1,8)
+    t = tp.cpu.shape[1]
+
+    outs = []
+    # --- share branch: best per-device hypothetical (fgd_score.go:111-134)
+    if ks:
+        p = types.milli[:ks]  # (Ks,1)
+        p3 = p.astype(jnp.float32).reshape(ks, 1, 1)
+        g3 = gf.reshape(1, 8, 1)
+        m3i = tp.milli.reshape(1, 1, t)
+        fitp = ((g3 - p3) >= m3i.astype(jnp.float32)) & (m3i > 0)  # (Ks,8,T)
+        fit3 = fitf.reshape(1, 8, t)
+        fitcnt_h = fitcnt.reshape(1, 1, t) - fit3 + fitp.astype(jnp.float32)
+        fitsum_h = (
+            fitsum.reshape(1, 1, t)
+            - jnp.where(fit.reshape(1, 8, t), g3, 0.0)
+            + jnp.where(fitp, g3 - p3, 0.0)
+        )
+        cpu_ok = (node.cpu - types.cpu[:ks]) >= tp.cpu  # (Ks,T)
+        isq3_h = (
+            gpu_pod.reshape(1, 1, t)
+            & acc.reshape(1, 1, t)
+            & (fitcnt_h >= tp.numf.reshape(1, 1, t))
+            & cpu_ok.reshape(ks, 1, t)
+        )
+        total_h = total - p3  # (Ks,1,1)
+        new = (
+            tp.freq.reshape(1, 1, t)
+            * jnp.where(isq3_h, total_h - fitsum_h, total_h)
+        ).sum(axis=2)  # (Ks,8)
+        fits = gT >= p  # (Ks,8)
+        dev_scores = jnp.where(fits, _sigmoid_score_f32(cur, new), -1)
+        best_score = jnp.max(dev_scores, axis=1, keepdims=True)  # (Ks,1)
+        lane8 = _iota((ks, 8), 1)
+        best_dev = jnp.min(
+            jnp.where(dev_scores == best_score, lane8, 8), axis=1, keepdims=True
+        )
+        ok = best_score >= 0  # == fits.any(): fitting devices score >= 0
+        outs.append((jnp.where(ok, best_score, 0), jnp.where(ok, best_dev, -1)))
+
+    # --- whole/CPU branch: Sub hypothetical (fgd_score.go:137-148)
+    if kw:
+        wm = types.milli[ks:]  # (Kw,1)
+        wn = types.num[ks:]
+        wc = types.cpu[ks:]
+        # select_devices_packed (resource.go:454-480): stable ascending
+        # rank of each device by milli-left, ties by device index
+        sub8 = _iota((8, 8), 0)  # d
+        lane8b = _iota((8, 8), 1)  # e
+        lt = (gT < node.g8) | ((gT == node.g8) & (lane8b < sub8))  # [d,e]
+        rank8 = lt.astype(jnp.int32).sum(axis=1, keepdims=True)  # (8,1)
+        fit_w = (gT >= wm) & (wm > 0)  # (Kw,8)
+        # devices taken = fitting, with < num fitting devices ahead in order
+        earlier = fit_w.reshape(kw, 1, 8) & (
+            rank8.T.reshape(1, 1, 8) < rank8.reshape(1, 8, 1)
+        )  # [k,d,e]
+        cnt = earlier.astype(jnp.int32).sum(axis=2)  # (Kw,8)
+        take = fit_w & (cnt < wn)
+        g2 = jnp.where(wn > 0, gT - take * wm, gT)  # (Kw,8)
+        g2f = g2.astype(jnp.float32)
+        m3i = tp.milli.reshape(1, 1, t)
+        fit2 = (g2.reshape(kw, 8, 1) >= m3i) & (m3i > 0)  # (Kw,8,T)
+        fitcnt2 = fit2.astype(jnp.float32).sum(axis=1)  # (Kw,T)
+        fitsum2 = jnp.where(fit2, g2f.reshape(kw, 8, 1), 0.0).sum(axis=1)
+        total2 = g2f.sum(axis=1, keepdims=True)  # (Kw,1)
+        isq3_2 = gpu_pod & acc & (fitcnt2 >= tp.numf) & ((node.cpu - wc) >= tp.cpu)
+        new_w = (tp.freq * jnp.where(isq3_2, total2 - fitsum2, total2)).sum(
+            axis=1, keepdims=True
+        )
+        outs.append(
+            (_sigmoid_score_f32(cur, new_w), jnp.full((kw, 1), -1, jnp.int32))
+        )
+
+    if len(outs) == 2:
+        return (
+            jnp.concatenate([outs[0][0], outs[1][0]], axis=0),
+            jnp.concatenate([outs[0][1], outs[1][1]], axis=0),
+        )
+    return outs[0]
+
+
+PALLAS_COLUMNS = {"FGDScore": _fgd_column}
+
+_SUPPORTED_GPU_SEL = {"best", "worst"} | SELF_SELECT_POLICIES
+
+
+def supports(policies, gpu_sel: str, report: bool) -> bool:
+    """Whether make_pallas_replay can run this configuration."""
+    if report or len(policies) != 1:
+        return False
+    fn, _ = policies[0]
+    if fn.policy_name not in PALLAS_COLUMNS:
+        return False
+    if gpu_sel not in _SUPPORTED_GPU_SEL:
+        return False
+    # a self-select gpuSelMethod must name the enabled policy (otherwise
+    # there is no sdev source; the reference would fail plugin lookup too)
+    if gpu_sel in SELF_SELECT_POLICIES and gpu_sel != fn.policy_name:
+        return False
+    return True
+
+
+def _feas_column(node: _NodeScalars, types: _TypeCols):
+    """Filter-phase feasibility for one node x all types (mirrors
+    step.filter_nodes minus the per-event pinned-node mask)."""
+    gT = node.g8.T  # (1,8)
+    fit = (node.cpu >= types.cpu) & (node.mem >= types.mem)  # (K,1)
+    units = jnp.where(types.milli > 0, gT // jnp.maximum(types.milli, 1), 0)
+    can_alloc = units.sum(axis=1, keepdims=True) >= types.num
+    acc = (types.mask == 0) | ((types.mask & _node_bit(node.gtyp)) != 0)
+    gpu_ok = (node.gcnt > 0) & acc & can_alloc
+    needs_gpu = (types.milli * types.num) > 0
+    return (fit & (~needs_gpu | gpu_ok)).astype(jnp.int32)
+
+
+def _pack_events(specs: PodSpec, type_id, ev_kind, ev_pod):
+    """[_EV_FIELDS, E] i32 per-event rows: every pod scalar the kernel
+    needs, pre-gathered host/XLA-side so the kernel only does masked lane
+    extraction (Mosaic cannot dynamically index the pod axis)."""
+    from tpusim.policies.clustering import pod_affinity_class
+
+    pod = jax.tree.map(lambda a: a[ev_pod], specs)
+    return jnp.stack(
+        [
+            ev_kind.astype(jnp.int32),
+            ev_pod.astype(jnp.int32),
+            type_id[ev_pod].astype(jnp.int32),
+            pod.cpu,
+            pod.mem,
+            pod.gpu_milli,
+            pod.gpu_num,
+            pod.gpu_mask,
+            pod.pinned,
+            pod_affinity_class(pod),
+            pod.is_gpu_share().astype(jnp.int32),
+            pod.total_gpu_milli(),
+        ]
+    )
+
+
+def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
+    """The fused replay kernel for a static (column_fn, Ks, normalize,
+    gpu_sel, weight) configuration. See module docstring for the masked-op
+    calculus; every step mirrors a line of sim/step.py or table_engine.py."""
+    self_select = gpu_sel in SELF_SELECT_POLICIES
+
+    def kernel(
+        ev_ref,  # [F, E] i32
+        tcpu_ref, tmem_ref, tmilli_ref, tnum_ref, tmask_ref,  # [K,1] i32
+        tpcpu_ref, tpmilli_ref, tpnumf_ref, tpmask_ref, tpfreq_ref,  # [1,T]
+        gcnt_ref, gtyp_ref, rank_ref,  # [1,N] i32 (read-only)
+        cpu0_ref, mem0_ref, gpu0_ref, aff0_ref,  # initial state
+        score_ref, sdev_ref, feas_ref,  # [K,N] i32
+        cpu_ref, mem_ref,  # [1,N] i32
+        gpul_ref,  # [8,N] i32
+        aff_ref,  # [9,N] i32
+        placed_ref, maskb_ref, failed_ref,  # [1,P] i32
+        evnode_ref, evdevb_ref,  # [1,E] i32
+        dirty,  # SMEM (1,) i32
+    ):
+        i = pl.program_id(0)
+        kdim, n = score_ref.shape
+        e = evnode_ref.shape[1]
+        p = placed_ref.shape[1]
+
+        lane_n = _iota((1, n), 1)
+        lane_e = _iota((1, e), 1)
+        lane_p = _iota((1, p), 1)
+        lane_kn = _iota((kdim, n), 1)
+        sub_kn = _iota((kdim, n), 0)
+
+        types = _TypeCols(
+            tcpu_ref[:, :], tmem_ref[:, :], tmilli_ref[:, :],
+            tnum_ref[:, :], tmask_ref[:, :], ks,
+        )
+        tp = _TpRows(
+            tpcpu_ref[:, :], tpmilli_ref[:, :], tpnumf_ref[:, :],
+            tpmask_ref[:, :], tpfreq_ref[:, :],
+        )
+
+        def node_scalars(d):
+            seln = lane_n == d
+            return _NodeScalars(
+                cpu=jnp.sum(jnp.where(seln, cpu_ref[:, :], 0)),
+                mem=jnp.sum(jnp.where(seln, mem_ref[:, :], 0)),
+                gcnt=jnp.sum(jnp.where(seln, gcnt_ref[:, :], 0)),
+                gtyp=jnp.sum(jnp.where(seln, gtyp_ref[:, :], 0)),
+                g8=jnp.sum(
+                    jnp.where(seln, gpul_ref[:, :], 0), axis=1, keepdims=True
+                ),
+            )
+
+        def refresh_column(d):
+            node = node_scalars(d)
+            col_score, col_sdev = column_fn(node, types, tp)
+            col_feas = _feas_column(node, types)
+            hit = lane_kn == d
+            score_ref[:, :] = jnp.where(hit, col_score, score_ref[:, :])
+            sdev_ref[:, :] = jnp.where(hit, col_sdev, sdev_ref[:, :])
+            feas_ref[:, :] = jnp.where(hit, col_feas, feas_ref[:, :])
+
+        @pl.when(i == 0)
+        def _():
+            cpu_ref[:, :] = cpu0_ref[:, :]
+            mem_ref[:, :] = mem0_ref[:, :]
+            gpul_ref[:, :] = gpu0_ref[:, :]
+            aff_ref[:, :] = aff0_ref[:, :]
+            placed_ref[:, :] = jnp.full((1, p), -1, jnp.int32)
+            maskb_ref[:, :] = jnp.zeros((1, p), jnp.int32)
+            failed_ref[:, :] = jnp.zeros((1, p), jnp.int32)
+            evnode_ref[:, :] = jnp.full((1, e), -1, jnp.int32)
+            evdevb_ref[:, :] = jnp.zeros((1, e), jnp.int32)
+            dirty[0] = 0
+
+            # build the score/sdev/feas tables column by column from the
+            # initial state — the table engine's init_tables, but through
+            # the SAME column code path the per-event refresh uses
+            def body(d, _):
+                refresh_column(d)
+                return 0
+
+            jax.lax.fori_loop(0, n, body, 0)
+
+        # refresh the one column whose node changed last event
+        # (table_engine.py's per-event column refresh; at i == 0 the tables
+        # were just built, so the refresh is subsumed by the init loop)
+        @pl.when(i != 0)
+        def _():
+            refresh_column(dirty[0])
+
+        # ---- this event's packed scalars (masked lane extraction)
+        ev = ev_ref[:, :]
+
+        def f(j):
+            return jnp.sum(jnp.where(lane_e == i, ev[j : j + 1, :], 0))
+
+        kind = f(0)
+        idx = f(1)
+        tid = f(2)
+        pcpu, pmem, pmilli, pnum = f(3), f(4), f(5), f(6)
+        ppin, pcls, pshare, ptgm = f(8), f(9), f(10), f(11)
+        sel_p = lane_p == idx
+        sel_e = lane_e == i
+        sub8c = _iota((8, 1), 0)
+        sub9c = _iota((9, 1), 0)
+
+        # ---- creation: Filter -> Score row -> selectHost -> Reserve -> Bind
+        @pl.when(kind == 0)
+        def _():
+            hit_t = sub_kn == tid
+            raw = jnp.sum(
+                jnp.where(hit_t, score_ref[:, :], 0), axis=0, keepdims=True
+            )  # (1,N)
+            feas_row = (
+                jnp.sum(jnp.where(hit_t, feas_ref[:, :], 0), axis=0, keepdims=True)
+                != 0
+            )
+            # nodeSelector pinning is a per-event mask, not a table column
+            feasible = feas_row & ((ppin < 0) | (lane_n == ppin))
+            if normalize in ("minmax", "pwr"):
+                lo = jnp.min(jnp.where(feasible, raw, _INT_MAX))
+                hi = jnp.max(jnp.where(feasible, raw, -_INT_MAX))
+                rngv = hi - lo
+                degen = 0 if normalize == "minmax" else MAX_NODE_SCORE
+                scaled = jnp.where(
+                    rngv == 0,
+                    degen,
+                    (raw - lo) * MAX_NODE_SCORE // jnp.maximum(rngv, 1),
+                )
+                raw = jnp.where(feasible, scaled, raw)
+            total = weight * raw
+            # selectHost: max weighted score, smallest tie-break rank wins
+            best = jnp.max(jnp.where(feasible, total, -_INT_MAX))
+            wkey = jnp.where(
+                feasible & (total == best), -rank_ref[:, :], -_INT_MAX
+            )
+            m = jnp.max(wkey)
+            ok = m != -_INT_MAX
+            node = jnp.where(ok, jnp.min(jnp.where(wkey == m, lane_n, n)), 0)
+
+            # Reserve: device pick on the winner (step.choose_devices)
+            seln = lane_n == node
+            g8w = jnp.sum(
+                jnp.where(seln, gpul_ref[:, :], 0), axis=1, keepdims=True
+            )  # (8,1)
+            gT = g8w.T  # (1,8)
+            lane8 = _iota((1, 8), 1)
+            fits = gT >= pmilli
+            any_fit = jnp.sum(fits.astype(jnp.int32)) > 0
+            # allocate_share_best: min milli-left among fitting, first index
+            bkey = jnp.where(fits, gT, _INT_MAX)
+            bdev = jnp.min(jnp.where(bkey == jnp.min(bkey), lane8, 8))
+            bdev = jnp.where(any_fit, bdev, -1)
+            if gpu_sel == "worst":
+                wkey8 = jnp.where(fits, gT, -_INT_MAX)
+                wdev = jnp.min(jnp.where(wkey8 == jnp.max(wkey8), lane8, 8))
+                share_dev = jnp.where(any_fit, wdev, -1)
+            elif self_select:
+                sdev = jnp.sum(jnp.where(hit_t & seln, sdev_ref[:, :], 0))
+                share_dev = jnp.where(sdev >= 0, sdev, bdev)
+            else:  # "best"
+                share_dev = bdev
+            share_bits = jnp.where(
+                share_dev >= 0,
+                jax.lax.shift_left(1, jnp.maximum(share_dev, 0)),
+                0,
+            )
+            # allocate_two_pointer for whole/multi-GPU pods
+            units = jnp.where(pmilli > 0, gT // jnp.maximum(pmilli, 1), 0)
+            prev = _cumsum8_lanes(units) - units
+            take_units = jnp.clip(pnum - prev, 0, units)
+            whole_bits = jnp.sum(
+                jnp.where(take_units > 0, jax.lax.shift_left(1, lane8), 0)
+            )
+            bits = jnp.where(
+                ptgm > 0, jnp.where(pshare != 0, share_bits, whole_bits), 0
+            )
+            bits = jnp.where(ok, bits, 0)
+
+            # Bind: masked scatter-commit (step.select_and_bind)
+            okn = seln & ok
+            cpu_ref[:, :] = jnp.where(okn, cpu_ref[:, :] - pcpu, cpu_ref[:, :])
+            mem_ref[:, :] = jnp.where(okn, mem_ref[:, :] - pmem, mem_ref[:, :])
+            mask8 = (jax.lax.shift_right_logical(bits, sub8c) & 1) != 0  # (8,1)
+            gpul_ref[:, :] = jnp.where(
+                okn & mask8, gpul_ref[:, :] - pmilli, gpul_ref[:, :]
+            )
+            aff_hit = okn & (sub9c == jnp.maximum(pcls, 0)) & (pcls >= 0)
+            aff_ref[:, :] = jnp.where(aff_hit, aff_ref[:, :] + 1, aff_ref[:, :])
+
+            placed_ref[:, :] = jnp.where(
+                sel_p, jnp.where(ok, node, -1), placed_ref[:, :]
+            )
+            maskb_ref[:, :] = jnp.where(sel_p, bits, maskb_ref[:, :])
+            failed_ref[:, :] = jnp.where(
+                sel_p, jnp.where(ok, 0, 1), failed_ref[:, :]
+            )
+            evnode_ref[:, :] = jnp.where(
+                sel_e, jnp.where(ok, node, -1), evnode_ref[:, :]
+            )
+            evdevb_ref[:, :] = jnp.where(sel_e, bits, evdevb_ref[:, :])
+            dirty[0] = jnp.where(ok, node, 0)
+
+        # ---- deletion: return resources to the recorded devices
+        # (step.unschedule; simulator.go:334-357)
+        @pl.when(kind == 1)
+        def _():
+            node = jnp.sum(jnp.where(sel_p, placed_ref[:, :], 0))
+            bits = jnp.sum(jnp.where(sel_p, maskb_ref[:, :], 0))
+            was = node >= 0
+            nodee = jnp.maximum(node, 0)
+            seln = (lane_n == nodee) & was
+            cpu_ref[:, :] = jnp.where(seln, cpu_ref[:, :] + pcpu, cpu_ref[:, :])
+            mem_ref[:, :] = jnp.where(seln, mem_ref[:, :] + pmem, mem_ref[:, :])
+            mask8 = (jax.lax.shift_right_logical(bits, sub8c) & 1) != 0
+            gpul_ref[:, :] = jnp.where(
+                seln & mask8, gpul_ref[:, :] + pmilli, gpul_ref[:, :]
+            )
+            aff_hit = seln & (sub9c == jnp.maximum(pcls, 0)) & (pcls >= 0)
+            aff_ref[:, :] = jnp.where(aff_hit, aff_ref[:, :] - 1, aff_ref[:, :])
+            placed_ref[:, :] = jnp.where(sel_p, -1, placed_ref[:, :])
+            maskb_ref[:, :] = jnp.where(sel_p, 0, maskb_ref[:, :])
+            evnode_ref[:, :] = jnp.where(sel_e, node, evnode_ref[:, :])
+            evdevb_ref[:, :] = jnp.where(sel_e, bits, evdevb_ref[:, :])
+            dirty[0] = nodee
+
+        # kind == 2 (EV_SKIP / padding): dirty, outputs unchanged
+
+    return kernel
+
+
+_PALLAS_REPLAY_CACHE = {}
+
+
+def make_pallas_replay(
+    policies, gpu_sel: str = "best", report: bool = False, interpret: bool = False
+):
+    """Build the fused single-kernel replayer. Same call signature as the
+    table engine's replay (state, pods, types, ev_kind, ev_pod, tp, key,
+    tiebreak_rank); raises for configurations supports() rejects. `key` is
+    accepted but unused — every supported configuration is deterministic
+    (reject_randomized guarantees it)."""
+    reject_randomized(policies, gpu_sel)
+    if not supports(policies, gpu_sel, report):
+        raise ValueError(
+            "pallas engine supports single-policy no-report configs with a "
+            f"registered column kernel; got {[f.policy_name for f, _ in policies]}"
+            f" / gpu_sel={gpu_sel} / report={report}"
+        )
+    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, interpret)
+    if cache_key in _PALLAS_REPLAY_CACHE:
+        return _PALLAS_REPLAY_CACHE[cache_key]
+
+    fn, weight = policies[0]
+    column_fn = PALLAS_COLUMNS[fn.policy_name]
+    normalize = fn.normalize
+    weight = int(weight)
+
+    @jax.jit
+    def replay(
+        state: NodeState,
+        pods: PodSpec,
+        types: PodTypes,
+        ev_kind,
+        ev_pod,
+        tp,
+        key,
+        tiebreak_rank=None,
+    ) -> ReplayResult:
+        from tpusim.parallel.sharding import pad_nodes
+
+        n0 = state.num_nodes
+        if tiebreak_rank is None:
+            tiebreak_rank = jnp.arange(n0, dtype=jnp.int32)
+        state_p, rank_p = pad_nodes(state, tiebreak_rank, 128)
+        n = state_p.num_nodes
+
+        ks = int(types.share.cpu.shape[0])
+        kw = int(types.whole.cpu.shape[0])
+        kdim = ks + kw
+
+        def col(field):
+            return jnp.concatenate(
+                [getattr(types.share, field), getattr(types.whole, field)]
+            ).reshape(kdim, 1)
+
+        tcols = [col(f) for f in ("cpu", "mem", "gpu_milli", "gpu_num", "gpu_mask")]
+        t = int(tp.cpu.shape[0])
+        tprows = [
+            tp.cpu.reshape(1, t),
+            tp.gpu_milli.reshape(1, t),
+            tp.gpu_num.astype(jnp.float32).reshape(1, t),
+            tp.gpu_mask.reshape(1, t),
+            tp.freq.reshape(1, t),
+        ]
+        ev = _pack_events(pods, types.type_id, ev_kind, ev_pod)
+        e = int(ev.shape[1])
+        p = int(pods.cpu.shape[0])
+
+        kernel = _make_kernel(column_fn, ks, normalize, gpu_sel, weight)
+        out_shape = (
+            jax.ShapeDtypeStruct((kdim, n), jnp.int32),  # score
+            jax.ShapeDtypeStruct((kdim, n), jnp.int32),  # sdev
+            jax.ShapeDtypeStruct((kdim, n), jnp.int32),  # feas
+            jax.ShapeDtypeStruct((1, n), jnp.int32),  # cpu_left
+            jax.ShapeDtypeStruct((1, n), jnp.int32),  # mem_left
+            jax.ShapeDtypeStruct((8, n), jnp.int32),  # gpu_left (dev-major)
+            jax.ShapeDtypeStruct((9, n), jnp.int32),  # aff_cnt (class-major)
+            jax.ShapeDtypeStruct((1, p), jnp.int32),  # placed
+            jax.ShapeDtypeStruct((1, p), jnp.int32),  # device mask bits
+            jax.ShapeDtypeStruct((1, p), jnp.int32),  # failed
+            jax.ShapeDtypeStruct((1, e), jnp.int32),  # event node
+            jax.ShapeDtypeStruct((1, e), jnp.int32),  # event dev bits
+        )
+        (
+            _score, _sdev, _feas, cpu_l, mem_l, gpul, aff,
+            placed, maskb, failed, evnode, evdevb,
+        ) = pl.pallas_call(
+            kernel,
+            grid=(e,),
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 18,
+            out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 12),
+            scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",),
+            ),
+            interpret=interpret,
+        )(
+            ev,
+            *tcols,
+            *tprows,
+            state_p.gpu_cnt.reshape(1, n),
+            state_p.gpu_type.reshape(1, n),
+            rank_p.reshape(1, n),
+            state_p.cpu_left.reshape(1, n),
+            state_p.mem_left.reshape(1, n),
+            state_p.gpu_left.T,
+            state_p.aff_cnt.T,
+        )
+
+        bit8 = jnp.arange(MAX_GPUS_PER_NODE, dtype=jnp.int32)
+        new_state = state._replace(
+            cpu_left=cpu_l[0, :n0],
+            mem_left=mem_l[0, :n0],
+            gpu_left=gpul[:, :n0].T,
+            aff_cnt=aff[:, :n0].T,
+        )
+        masks = ((maskb[0, :, None] >> bit8) & 1) != 0  # [P,8] bool
+        devs = ((evdevb[0, :, None] >> bit8) & 1) != 0  # [E,8] bool
+        return ReplayResult(
+            new_state, placed[0], masks, failed[0] != 0, None, evnode[0], devs
+        )
+
+    _PALLAS_REPLAY_CACHE[cache_key] = replay
+    return replay
