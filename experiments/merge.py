@@ -12,6 +12,14 @@ layout experiments/run.py + generate_run_scripts.py produce) and emits:
                                     the reference's unit) at same samples
   analysis_frag_ratio_discrete.csv  frag ratio (%) at same samples
   analysis_fail_pods.csv            unscheduled-pod count per experiment
+  analysis_pwr_discrete.csv         cluster/cpu/gpu watts at same samples
+                                    (one row per experiment per series —
+                                    the fork's power deliverable, notebook
+                                    "1 - Parse results" cells 2/4)
+  analysis_usage_discrete.csv       used/arrived GPU milli ratio (GRAR /
+                                    usage_efficiency, notebook cell 8)
+  analysis_failed_discrete.csv      cumulative failed-pod count at same
+                                    samples (notebook cell 2 sched df)
 
 Row key: (workload, sc_policy, tune, seed) — the schema of
 experiments/analysis/expected_results/*.csv in the reference, so its
@@ -62,6 +70,9 @@ def discretize(series_x, series_y, lo=0, hi=130):
 def merge(data_root: Path, out_dir: Path):
     allo_rows, frag_rows, fratio_rows, fail_rows = [], [], [], []
     fail_detail_rows = []  # ref: merge_fail_pods.py → analysis_fail.csv
+    pwr_rows = []  # power series (fork notebook "1 - Parse results" cell 2)
+    usage_rows = []  # used/arrived GPU ratio (notebook cell 8 usage_efficiency)
+    failed_rows = []  # cumulative failed pods (notebook cell 2 sched df)
     for allo_file in sorted(data_root.glob("*/*/*/*/analysis_allo.csv")):
         exp_dir = allo_file.parent
         seed = exp_dir.name
@@ -105,6 +116,47 @@ def merge(data_root: Path, out_dir: Path):
             row.update(discretize(arrive[:n], fratio))
             fratio_rows.append(row)
 
+        # merged power curves (the fork's distinguishing deliverable: its
+        # "1 - Parse results" notebook builds per-seed power/efficiency/
+        # failure curves on a cumulative-workload axis and averages them;
+        # here the same series are sampled at integer arrived-load percent
+        # like every other *_discrete table, one row per (experiment, series))
+        pwr_file = exp_dir / "analysis_pwr.csv"
+        if pwr_file.is_file():
+            pwr = read_csv_dict(pwr_file)
+            n = min(len(pwr), len(arrive))
+            for series, col in (
+                ("cluster", "power_cluster"),
+                ("cpu", "power_cluster_CPU"),
+                ("gpu", "power_cluster_GPU"),
+            ):
+                vals = [float(r[col]) for r in pwr[:n]]
+                row = dict(key, total_gpus=total_gpus, series=series)
+                row.update(discretize(arrive[:n], vals))
+                pwr_rows.append(row)
+
+        # GPU usage efficiency = used / arrived milli (GRAR; guard the
+        # pre-arrival zero rows the notebook's interpolation papers over)
+        usage = [
+            float(r["used_gpu_milli"]) / max(float(r["arrived_gpu_milli"]), 1.0)
+            for r in allo
+        ]
+        row = dict(key, total_gpus=total_gpus)
+        row.update(discretize(arrive, usage))
+        usage_rows.append(row)
+
+        cdol_file = exp_dir / "analysis_cdol.csv"
+        if cdol_file.is_file():
+            cdol = read_csv_dict(cdol_file)
+            n = min(len(cdol), len(arrive))
+            cum, curve = 0, []
+            for r in cdol[:n]:
+                cum += 1 if r["event"] == "failed" else 0
+                curve.append(float(cum))
+            row = dict(key, total_gpus=total_gpus)
+            row.update(discretize(arrive[:n], curve))
+            failed_rows.append(row)
+
         summary_file = exp_dir / "analysis.csv"
         if summary_file.is_file():
             summary = read_csv_dict(summary_file)
@@ -137,10 +189,13 @@ def merge(data_root: Path, out_dir: Path):
         ("analysis_frag_discrete.csv", frag_rows),
         ("analysis_frag_ratio_discrete.csv", fratio_rows),
         ("analysis_fail_pods.csv", fail_rows),
+        ("analysis_pwr_discrete.csv", pwr_rows),
+        ("analysis_usage_discrete.csv", usage_rows),
+        ("analysis_failed_discrete.csv", failed_rows),
     ):
         if not rows:
             continue
-        cols = ["workload", "sc_policy", "tune", "seed", "total_gpus"]
+        cols = ["workload", "sc_policy", "tune", "seed", "total_gpus", "series"]
         extra = sorted(
             {k for r in rows for k in r if k not in cols},
             key=lambda k: (isinstance(k, str), k),

@@ -35,6 +35,9 @@ CELLS = [
     ("openb_pod_list_cpu050", "03-GpuClustering"),
     ("openb_pod_list_multigpu20", "03-GpuClustering"),
     ("openb_pod_list_gpushare40", "04-GpuPacking"),
+    # round 4: the one >1pt plotted-series delta outside the round-3
+    # analysis — the default trace's DotProd frag@90 curve (VERDICT r3 §6)
+    ("openb_pod_list_default", "02-DotProd"),
 ]
 
 

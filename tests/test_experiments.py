@@ -8,6 +8,8 @@ import os
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parents[1]
 EXP = REPO / "experiments"
 
@@ -74,6 +76,16 @@ def test_run_analysis_merge_plot(tmp_path):
     result = run.run_experiment(args)
     assert result["summary"]["unscheduled"] == 0
     assert (outdir / "simon.log").is_file()
+
+    # a second policy for the cross-policy power deliverable below
+    outdir2 = tmp_path / "data" / "tiny_trace" / "05-BestFit" / "1.0" / "42"
+    args2 = run.get_args(
+        [
+            "-d", str(outdir2), "-f", str(pod_csv),
+            "--node-trace", str(node_csv), "-BestFit", "1000",
+        ]
+    )
+    run.run_experiment(args2)
     # per-event series parsed back out of the log
     assert len(result["allo"]["used_gpu_milli"]) == 8
     assert result["allo"]["used_gpu_milli"][-1] == 6000  # 4×1000 + 4×500
@@ -91,8 +103,51 @@ def test_run_analysis_merge_plot(tmp_path):
     with open(results_dir / "analysis_allo_discrete.csv", newline="") as f:
         rows = list(csv.DictReader(f))
     assert rows[0]["workload"] == "tiny_trace"
-    assert rows[0]["sc_policy"] == "06-FGD"
+    assert rows[0]["sc_policy"] == "05-BestFit"
     assert float(rows[0]["100"]) == 100.0  # fully allocated at 100% load
+
+    # power/usage/failed merges (the fork's notebook-1 parse, round 4)
+    with open(results_dir / "analysis_pwr_discrete.csv", newline="") as f:
+        pwr_rows = list(csv.DictReader(f))
+    # one row per experiment per series, cluster = cpu + gpu at each sample
+    by_series = {
+        r["series"]: r for r in pwr_rows if r["sc_policy"] == "06-FGD"
+    }
+    assert set(by_series) == {"cluster", "cpu", "gpu"}
+    assert float(by_series["cluster"]["100"]) == pytest.approx(
+        float(by_series["cpu"]["100"]) + float(by_series["gpu"]["100"]), abs=0.05
+    )
+    with open(results_dir / "analysis_usage_discrete.csv", newline="") as f:
+        usage_rows = list(csv.DictReader(f))
+    # all 8 tiny pods schedule -> used == arrived at 100% load
+    assert float(usage_rows[0]["100"]) == pytest.approx(1.0, abs=0.01)
+    assert (results_dir / "analysis_failed_discrete.csv").is_file()
+
+    # power deliverable: figures + tables from the merged artifact alone
+    power = _load("exp_power", EXP / "power.py")
+    power_dir = tmp_path / "power"
+    sys.argv = [
+        "power.py", "--merged", str(results_dir), "--out", str(power_dir)
+    ]
+    power.main()
+    assert (power_dir / "power_savings_tiny_trace.png").is_file()
+    assert (power_dir / "usage_efficiency_tiny_trace.png").is_file()
+    assert (power_dir / "failed_relative_tiny_trace.png").is_file()
+    md = (power_dir / "power_tables.md").read_text()
+    assert "GRAR" in md and "06-FGD" in md and "05-BestFit" in md
+    tex = (power_dir / "power_tables.tex").read_text()
+    assert "\\begin{tabular}" in tex and "Savings" in tex
+
+    # trace families with percentage suffixes must emit LaTeX-safe headers
+    # (a raw % would comment out the rest of the header row)
+    power.emit_tables(
+        {"openb_pod_list_cpu": {"06-FGD": {"050": 0.95, "100": 0.97}}},
+        {},
+        power_dir,
+    )
+    tex2 = (power_dir / "power_tables.tex").read_text()
+    assert "GRAR (050\\%)" in tex2
+    assert "(050%)" not in tex2
 
     # plots render from the merged tables
     plot = _load("exp_plot", EXP / "plot" / "plot_openb.py")
