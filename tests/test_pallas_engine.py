@@ -33,16 +33,55 @@ def _run_both(policy, gpu_sel, state, tp, pods, ev_kind, ev_pod, rank):
     return r0, r1
 
 
-def test_pallas_fgd_matches_table_engine():
+@pytest.mark.parametrize(
+    "policy,gpu_sel",
+    [
+        ("FGDScore", "FGDScore"),
+        ("BestFitScore", "best"),
+        ("GpuPackingScore", "worst"),
+        ("GpuClusteringScore", "best"),
+        ("PWRScore", "PWRScore"),
+        ("DotProductScore", "DotProductScore"),
+    ],
+    ids=lambda p: str(p),
+)
+def test_pallas_matches_table_engine(policy, gpu_sel):
     rng = np.random.default_rng(11)
     state, tp = random_cluster(rng, num_nodes=24)
     pods = random_pods(rng, num_pods=40)
     ev_kind, ev_pod = _events_with_deletes(40, rng)
     rank = jnp.asarray(rng.permutation(24).astype(np.int32))
-    r0, r1 = _run_both("FGDScore", "FGDScore", state, tp, pods, ev_kind, ev_pod, rank)
+    r0, r1 = _run_both(policy, gpu_sel, state, tp, pods, ev_kind, ev_pod, rank)
     _assert_equal(r0, r1)
     assert np.array_equal(np.asarray(r0.event_node), np.asarray(r1.event_node))
     assert np.array_equal(np.asarray(r0.event_dev), np.asarray(r1.event_dev))
+
+
+@pytest.mark.parametrize("norm", ["max", "node", "pod"])
+@pytest.mark.parametrize("dim_ext", ["merge", "share", "divide", "extend"])
+def test_pallas_dotprod_dim_ext(dim_ext, norm):
+    """Every DotProduct (dim-extension × norm) config has a Pallas column
+    (the reference's 4 virtual-expansion modes, resource.go:246-381, and
+    3 norm methods, dot_product_score.go:76-83)."""
+    from tpusim.policies import make_policy as mk
+
+    rng = np.random.default_rng(31)
+    state, tp = random_cluster(rng, num_nodes=16)
+    pods = random_pods(rng, num_pods=30)
+    ev_kind, ev_pod = _events_with_deletes(30, rng)
+    rank = jnp.asarray(rng.permutation(16).astype(np.int32))
+    policies = [
+        (mk("DotProductScore", dim_ext_method=dim_ext, norm_method=norm), 1000)
+    ]
+    key = jax.random.PRNGKey(3)
+    types = build_pod_types(pods)
+    r0 = make_table_replay(policies, gpu_sel="DotProductScore")(
+        state, pods, types, ev_kind, ev_pod, tp, key, rank
+    )
+    r1 = make_pallas_replay(
+        policies, gpu_sel="DotProductScore", interpret=True
+    )(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+    _assert_equal(r0, r1)
 
 
 def test_pallas_fgd_gpu_sel_best():
@@ -133,12 +172,14 @@ def test_supports_gating():
     fgd = make_policy("FGDScore")
     rand = make_policy("RandomScore")
     bestfit = make_policy("BestFitScore")
+    simon = make_policy("Simon")
     assert supports([(fgd, 1000)], "FGDScore", report=False)
     assert supports([(fgd, 1000)], "best", report=False)
+    assert supports([(bestfit, 1000)], "best", report=False)
     assert not supports([(fgd, 1000)], "FGDScore", report=True)
     assert not supports([(fgd, 1000)], "random", report=False)
     assert not supports([(fgd, 1000), (bestfit, 1)], "best", report=False)
-    assert not supports([(bestfit, 1000)], "best", report=False)  # no column yet
+    assert not supports([(simon, 1000)], "best", report=False)  # no column
     assert not supports([(fgd, 1000)], "PWRScore", report=False)
     with pytest.raises(ValueError):
         make_pallas_replay([(rand, 1000)], gpu_sel="best")

@@ -86,12 +86,18 @@ def test_engine_vs_table_engine_on_tpu(accel):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_pallas_engine_full_openb_on_tpu(accel):
+@pytest.mark.parametrize(
+    "policy,gpu_sel",
+    [("FGDScore", "FGDScore"), ("PWRScore", "PWRScore")],
+    ids=["fgd", "pwr"],
+)
+def test_pallas_engine_full_openb_on_tpu(accel, policy, gpu_sel):
     """The fused whole-replay Pallas kernel must reproduce the table
     engine's placements/devices/state bit-for-bit on the FULL openb default
     trace at tune 1.3 — the headline-bench configuration. This is the
     pallas engine's exactness gate on real Mosaic numerics (the CPU suite
-    only covers interpreter mode)."""
+    only covers interpreter mode). FGD covers the frag f32 sums; PWR covers
+    the energy-table lookups and its own normalize mode."""
     import os
 
     from tpusim.io.trace import build_events, load_node_csv, load_pod_csv, pods_to_specs
@@ -104,7 +110,7 @@ def test_pallas_engine_full_openb_on_tpu(accel):
     nodes = load_node_csv(os.path.join(repo, "data/csv/openb_node_list_gpu_node.csv"))
     pods = load_pod_csv(os.path.join(repo, "data/csv/openb_pod_list_default.csv"))
     cfg = SimulatorConfig(
-        policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+        policies=((policy, 1000),), gpu_sel_method=gpu_sel,
         tuning_ratio=1.3, tuning_seed=42, seed=42, shuffle_pod=True,
         report_per_event=False,
         typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
@@ -122,7 +128,7 @@ def test_pallas_engine_full_openb_on_tpu(accel):
     tab = sim._table_fn(
         sim.init_state, specs, types, ev_kind, ev_pod, sim.typical, key, sim.rank
     )
-    pal = make_pallas_replay(list(sim._policy_fns), gpu_sel="FGDScore")(
+    pal = make_pallas_replay(list(sim._policy_fns), gpu_sel=gpu_sel)(
         sim.init_state, specs, types, ev_kind, ev_pod, sim.typical, key, sim.rank
     )
     assert np.array_equal(np.asarray(tab.placed_node), np.asarray(pal.placed_node))

@@ -38,6 +38,11 @@ def jit_policy(fn):
         j = jax.jit(fn)
         j.normalize = fn.normalize
         j.policy_name = fn.policy_name
+        # config attrs (DotProduct carries dim_ext/norm; the pallas-engine
+        # column resolver reads them)
+        for attr in ("dim_ext", "norm"):
+            if hasattr(fn, attr):
+                setattr(j, attr, getattr(fn, attr))
         _JIT_CACHE[fn] = j
     return _JIT_CACHE[fn]
 

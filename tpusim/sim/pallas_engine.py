@@ -32,9 +32,11 @@ randomized small traces (tests/test_pallas_engine.py).
 
 Scope: single-policy configurations (the reference's own experiment protocol
 enables one Score plugin at weight 1000, SURVEY.md §5.6) whose policy has a
-column kernel in PALLAS_COLUMNS, gpu_sel in {best, worst, policy self-select},
-report_per_event=False. driver.run_events picks this engine automatically on
-TPU backends and falls back to the table/sequential engines otherwise.
+column kernel in PALLAS_COLUMNS — FGD, BestFit, GpuPacking, GpuClustering,
+PWR, and DotProduct (all 4 dim-extension methods) — with gpu_sel in {best,
+worst, policy self-select} and report_per_event=False. driver.run_events
+picks this engine automatically on TPU backends and falls back to the
+table/sequential engines otherwise.
 """
 
 from __future__ import annotations
@@ -47,7 +49,18 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
+from tpusim.constants import (
+    CPU_FULL_W,
+    CPU_IDLE_W,
+    CPU_NCORES,
+    GPU_FULL_W,
+    GPU_IDLE_W,
+    MAX_GPUS_PER_NODE,
+    MAX_NODE_SCORE,
+    MAX_SPEC_CPU,
+    MAX_SPEC_GPU,
+    MILLI,
+)
 from tpusim.sim.engine import ReplayResult
 from tpusim.sim.step import SELF_SELECT_POLICIES
 from tpusim.sim.table_engine import PodTypes, reject_randomized
@@ -95,9 +108,23 @@ def _cumsum8_lanes(u):
 class _NodeScalars(NamedTuple):
     cpu: jnp.ndarray  # scalar i32 cpu_left
     mem: jnp.ndarray  # scalar i32 mem_left
+    cap: jnp.ndarray  # scalar i32 cpu_cap
     gcnt: jnp.ndarray  # scalar i32 gpu count
     gtyp: jnp.ndarray  # scalar i32 gpu model id (-1 none)
+    ctyp: jnp.ndarray  # scalar i32 cpu model id
     g8: jnp.ndarray  # (8,1) i32 per-device milli left
+    aff9: jnp.ndarray  # (9,1) i32 pods per GPU-affinity class
+
+
+class _EnergyRows(NamedTuple):
+    """Energy model tables as (1,M) rows (ref: open-gpu-share/utils/
+    const.go:48-121; tpusim.constants CPU_*/GPU_* arrays)."""
+
+    gidle: jnp.ndarray  # (1,Mg) f32 idle watts per GPU model
+    gfull: jnp.ndarray  # (1,Mg) f32 full watts per GPU model
+    cidle: jnp.ndarray  # (1,Mc) f32 idle watts per CPU package
+    cfull: jnp.ndarray  # (1,Mc) f32 full watts per CPU package
+    ncores: jnp.ndarray  # (1,Mc) f32 physical cores per CPU package
 
 
 class _TypeCols(NamedTuple):
@@ -121,6 +148,27 @@ class _TpRows(NamedTuple):
     freq: jnp.ndarray  # (1,T) f32
 
 
+def _packed_take(node: _NodeScalars, milli, num):
+    """select_devices_packed for (K,1) type columns on one node: fitting
+    devices taken least-free-first, stable by index, until `num` are found
+    (ref: resource.go:454-480). Returns (take (K,8) bool, ok (K,1) bool)."""
+    gT = node.g8.T  # (1,8)
+    kdim = milli.shape[0]
+    sub8 = _iota((8, 8), 0)  # d
+    lane8b = _iota((8, 8), 1)  # e
+    lt = (gT < node.g8) | ((gT == node.g8) & (lane8b < sub8))  # [d,e]
+    rank8 = lt.astype(jnp.int32).sum(axis=1, keepdims=True)  # (8,1)
+    fit = (gT >= milli) & (milli > 0)  # (K,8)
+    # taken = fitting, with < num fitting devices ahead in sorted order
+    earlier = fit.reshape(kdim, 1, 8) & (
+        rank8.T.reshape(1, 1, 8) < rank8.reshape(1, 8, 1)
+    )  # [k,d,e]
+    cnt = earlier.astype(jnp.int32).sum(axis=2)  # (K,8)
+    take = fit & (cnt < num)
+    ok = take.astype(jnp.int32).sum(axis=1, keepdims=True) >= num
+    return take, ok
+
+
 def _frag_terms(node: _NodeScalars, tp: _TpRows):
     """Shared frag ingredients for one node: the fit/fitcnt/fitsum
     decomposition of NodeGpuShareFragAmountScore (frag.go:148-203) that
@@ -136,7 +184,7 @@ def _frag_terms(node: _NodeScalars, tp: _TpRows):
     return fit, fitf, fitcnt, fitsum, total, acc, gpu_pod
 
 
-def _fgd_column(node: _NodeScalars, types: _TypeCols, tp: _TpRows):
+def _fgd_column(node: _NodeScalars, types: _TypeCols, tp: _TpRows, aux):
     """FGD score + Reserve-device column for one node across all pod types
     (ref: plugin/fgd_score.go:99-156; the same fit/fitsum decomposition as
     policies/fgd.py, vectorized over the type axis)."""
@@ -192,19 +240,7 @@ def _fgd_column(node: _NodeScalars, types: _TypeCols, tp: _TpRows):
         wm = types.milli[ks:]  # (Kw,1)
         wn = types.num[ks:]
         wc = types.cpu[ks:]
-        # select_devices_packed (resource.go:454-480): stable ascending
-        # rank of each device by milli-left, ties by device index
-        sub8 = _iota((8, 8), 0)  # d
-        lane8b = _iota((8, 8), 1)  # e
-        lt = (gT < node.g8) | ((gT == node.g8) & (lane8b < sub8))  # [d,e]
-        rank8 = lt.astype(jnp.int32).sum(axis=1, keepdims=True)  # (8,1)
-        fit_w = (gT >= wm) & (wm > 0)  # (Kw,8)
-        # devices taken = fitting, with < num fitting devices ahead in order
-        earlier = fit_w.reshape(kw, 1, 8) & (
-            rank8.T.reshape(1, 1, 8) < rank8.reshape(1, 8, 1)
-        )  # [k,d,e]
-        cnt = earlier.astype(jnp.int32).sum(axis=2)  # (Kw,8)
-        take = fit_w & (cnt < wn)
+        take, _ = _packed_take(node, wm, wn)  # (Kw,8)
         g2 = jnp.where(wn > 0, gT - take * wm, gT)  # (Kw,8)
         g2f = g2.astype(jnp.float32)
         m3i = tp.milli.reshape(1, 1, t)
@@ -228,7 +264,278 @@ def _fgd_column(node: _NodeScalars, types: _TypeCols, tp: _TpRows):
     return outs[0]
 
 
-PALLAS_COLUMNS = {"FGDScore": _fgd_column}
+def _first_max_dev(scores, neg):
+    """(value, device) of the first maximum over the device lane axis —
+    jnp.argmax's first-on-ties semantics via max + min-index."""
+    kdim = scores.shape[0]
+    best = jnp.max(scores, axis=1, keepdims=True)  # (K,1)
+    lane8 = _iota((kdim, 8), 1)
+    dev = jnp.min(jnp.where(scores == best, lane8, 8), axis=1, keepdims=True)
+    ok = best > neg
+    return jnp.where(ok, best, neg), jnp.where(ok, dev, -1)
+
+
+def _bestfit_column(node: _NodeScalars, types: _TypeCols, tp, aux):
+    """BestFit (ref: best_fit_score.go:66-97): weighted free-minus-request
+    over {cpu, gpu} dims against max machine specs."""
+    gtot = node.g8.sum().astype(jnp.float32)
+    s = (
+        (node.cpu - types.cpu).astype(jnp.float32) / MAX_SPEC_CPU * 0.5
+        + (gtot - (types.milli * types.num).astype(jnp.float32))
+        / MAX_SPEC_GPU * 0.5
+    )
+    score = jnp.floor((1.0 - s) * MAX_NODE_SCORE).astype(jnp.int32)
+    return score, jnp.full_like(score, -1)
+
+
+def _packing_column(node: _NodeScalars, types: _TypeCols, tp, aux):
+    """GpuPacking 3-tier scoring (ref: gpu_packing_score.go:67-117;
+    mirrors policies/packing.py over the type axis)."""
+    gT = node.g8.T  # (1,8)
+    fully_free = (node.g8 == MILLI).astype(jnp.int32).sum()
+    t3, t2 = MAX_NODE_SCORE // 3, MAX_NODE_SCORE // 2
+    case3 = jnp.maximum(t3 - fully_free, fully_free)
+    take, ok = _packed_take(node, types.milli, types.num)  # (K,8)
+    free_used = (take & (gT == MILLI)).astype(jnp.int32).sum(
+        axis=1, keepdims=True
+    )
+    ratio = jnp.where(take, gT * 100 // MILLI, 0).sum(axis=1, keepdims=True)
+    case1 = jnp.maximum(MAX_NODE_SCORE - ratio // 10, t2)
+    case2 = jnp.maximum(t2 - free_used, t3)
+    score = jnp.where(
+        fully_free == node.gcnt,
+        case3,
+        jnp.where(~ok, 0, jnp.where(free_used > 0, case2, case1)),
+    )
+    score = jnp.where((types.milli * types.num) > 0, score, 0)
+    return score.astype(jnp.int32), jnp.full_like(score, -1)
+
+
+def _type_affinity_class(types: _TypeCols):
+    """pod_affinity_class per type column (ref: pod.go:111-123)."""
+    share = (types.num == 1) & (types.milli < MILLI)
+    cls = jnp.where(share, 0, types.num)
+    return jnp.where(types.num == 0, -1, cls)
+
+
+def _clustering_column(node: _NodeScalars, types: _TypeCols, tp, aux):
+    """GpuClustering quartile scoring (ref: gpu_clustering_score.go:32-56;
+    mirrors policies/clustering.py)."""
+    q = MAX_NODE_SCORE // 4  # 25
+    counts = node.aff9.T  # (1,9)
+    n_classes = (counts > 0).astype(jnp.int32).sum()
+    cls = _type_affinity_class(types)  # (K,1)
+    kdim = cls.shape[0]
+    lane9 = _iota((kdim, 9), 1)
+    has_cls = jnp.sum(
+        jnp.where(lane9 == jnp.maximum(cls, 0), counts, 0),
+        axis=1, keepdims=True,
+    ) > 0
+    gtot = node.g8.sum()
+    pack = q * (MAX_SPEC_GPU - gtot) // MAX_SPEC_GPU
+    base = jnp.where(
+        has_cls,
+        jnp.where(n_classes == 1, 3 * q, 2 * q),
+        jnp.where(n_classes == 0, q, 0),
+    )
+    score = jnp.where(cls < 0, 0, base + pack).astype(jnp.int32)
+    return score, jnp.full_like(score, -1)
+
+
+_PWR_NEG = np.int32(-(2**31) + 1)  # policies/pwr.py _NEG_INF
+
+
+def _pwr_column(node: _NodeScalars, types: _TypeCols, tp, aux: _EnergyRows):
+    """PWR watts-delta scoring (ref: pwr_score.go:150-218; mirrors
+    policies/pwr.py's two-channel decomposition: the CPU package count and
+    devices flipping idle->working)."""
+    ks = types.ks
+    kdim = types.cpu.shape[0]
+
+    def look(row, idx):
+        lane = _iota((1, row.shape[1]), 1)
+        return jnp.sum(jnp.where(lane == idx, row, 0.0))
+
+    gidle = jnp.where(node.gtyp >= 0, look(aux.gidle, jnp.maximum(node.gtyp, 0)), 0.0)
+    gfull = jnp.where(node.gtyp >= 0, look(aux.gfull, jnp.maximum(node.gtyp, 0)), 0.0)
+    busy_delta = gfull - gidle
+    cidle = look(aux.cidle, node.ctyp)
+    cfull = look(aux.cfull, node.ctyp)
+    ncores = look(aux.ncores, node.ctyp)
+
+    real_cores = jnp.ceil(node.cap.astype(jnp.float32) / MILLI / 2)
+    num_cpus = jnp.ceil(real_cores / ncores)
+
+    def cpu_watts(cpu_left):
+        idle_cores = jnp.floor(cpu_left.astype(jnp.float32) / MILLI / 2)
+        active = jnp.ceil((real_cores - idle_cores) / ncores)
+        return cidle * (num_cpus - active) + cfull * active
+
+    was_idle = node.g8.T == MILLI  # (1,8)
+    n_idle = was_idle.astype(jnp.float32).sum()
+    gpu_old = gidle * n_idle + gfull * (node.gcnt.astype(jnp.float32) - n_idle)
+    old = cpu_watts(node.cpu) + gpu_old
+    cpu_new = cpu_watts(node.cpu - types.cpu)  # (K,1)
+
+    score = jnp.zeros((kdim, 1), jnp.int32)
+    sdev = jnp.full((kdim, 1), -1, jnp.int32)
+    sub_k = _iota((kdim, 1), 0)
+    if ks:
+        # share branch: device flips iff fully idle and the pod takes milli
+        new_dev = cpu_new + gpu_old + jnp.where(
+            was_idle & (types.milli > 0), busy_delta, 0.0
+        )  # (K,8)
+        fits = node.g8.T >= types.milli
+        dev_scores = jnp.where(fits, (old - new_dev).astype(jnp.int32), _PWR_NEG)
+        s_val, s_dev = _first_max_dev(dev_scores, _PWR_NEG)
+        in_share = sub_k < ks
+        score = jnp.where(in_share, s_val, score)
+        sdev = jnp.where(in_share, s_dev, sdev)
+    if kdim - ks:
+        # whole/CPU branch: Sub's taken devices flip iff previously idle
+        take, _ = _packed_take(node, types.milli, types.num)  # (K,8)
+        flips = (take & was_idle).astype(jnp.float32).sum(axis=1, keepdims=True)
+        w_val = (old - (cpu_new + gpu_old + flips * busy_delta)).astype(jnp.int32)
+        in_whole = sub_k >= ks
+        score = jnp.where(in_whole, w_val, score)
+        sdev = jnp.where(in_whole, -1, sdev)
+    return score, sdev
+
+
+def _make_dotprod_column(dim_ext: str, norm: str):
+    """DotProduct column for a (dimExtMethod, normMethod) config (ref:
+    dot_product_score.go + the virtual expansion resource.go:246-381;
+    mirrors policies/dotprod.py's fixed-slot masked kernels)."""
+
+    def safe_div(v, n):
+        return jnp.where(n > 0, v / jnp.where(n > 0, n, 1.0), 0.0)
+
+    def column(node: _NodeScalars, types: _TypeCols, tp, aux):
+        kdim = types.cpu.shape[0]
+        gT = node.g8.T.astype(jnp.float32)  # (1,8)
+        gtot = node.g8.sum().astype(jnp.float32)
+        idle_cnt = (node.g8 == MILLI).astype(jnp.int32).sum()
+        cpu_f = node.cpu.astype(jnp.float32)
+        treq = (types.milli * types.num).astype(jnp.float32)  # (K,1)
+        tcpu = types.cpu.astype(jnp.float32)
+        cap_f = node.cap.astype(jnp.float32)
+        gcap = (node.gcnt * MILLI).astype(jnp.float32)
+        neg = jnp.float32(-(2.0**30))
+
+        if norm == "node":
+            div_cpu, div_gpu = cap_f, gcap
+        elif norm == "pod":
+            div_cpu, div_gpu = tcpu, treq
+        else:  # max
+            div_cpu = jnp.float32(MAX_SPEC_CPU)
+            div_gpu = jnp.float32(MAX_SPEC_GPU)
+
+        if dim_ext == "merge":
+            dot = (
+                safe_div(cpu_f, div_cpu) * safe_div(tcpu, div_cpu)
+                + safe_div(gtot, div_gpu) * safe_div(treq, div_gpu)
+            ) / 2.0
+            if norm == "pod":
+                dot = jnp.tanh(dot / 10.0)
+            s = jnp.where(node.cpu >= types.cpu, 1.0 - dot, neg)  # (K,1)
+            best = s
+            dev = jnp.full((kdim, 1), -1, jnp.int32)
+        else:
+            slot_real = _iota((1, 8), 1) < node.gcnt
+            pool_gpu = (idle_cnt * MILLI).astype(jnp.float32)
+            first_free = jnp.min(
+                jnp.where((node.g8.T == MILLI), _iota((1, 8), 1), 8)
+            )
+            first_free = jnp.where(idle_cnt > 0, first_free, -1)
+            if dim_ext in ("share", "divide"):
+                # 8 per-device slots (partially-used fitting devices, share
+                # pods only) + the idle pool (resource.go:315-365)
+                dev_active = (
+                    (treq < MILLI) & slot_real & (gT < MILLI) & (gT >= treq)
+                )  # (K,8)
+                pool_active = treq <= (idle_cnt * MILLI).astype(jnp.float32)
+                slot_gpu9 = jnp.concatenate(
+                    [jnp.broadcast_to(gT, (kdim, 8)),
+                     jnp.broadcast_to(pool_gpu, (kdim, 1))], axis=1
+                )  # (K,9)
+                active9 = jnp.concatenate([dev_active, pool_active], axis=1)
+                if dim_ext == "divide":
+                    slot_cpu9 = safe_div(cpu_f * slot_gpu9, gtot)
+                else:
+                    slot_cpu9 = jnp.broadcast_to(cpu_f, (kdim, 9))
+                dots = (
+                    safe_div(slot_cpu9, div_cpu) * safe_div(tcpu, div_cpu)
+                    + safe_div(slot_gpu9, div_gpu) * safe_div(treq, div_gpu)
+                ) / 2.0
+            else:  # extend: formalized groups (resource.go:217-287)
+                dev_group = slot_real & (gT > 0) & (gT < MILLI)  # (1,8)
+                pool_group = idle_cnt > 0
+                group9 = jnp.concatenate(
+                    [jnp.broadcast_to(dev_group, (kdim, 8)),
+                     jnp.broadcast_to(pool_group, (kdim, 1))], axis=1
+                )
+                left9 = jnp.concatenate(
+                    [jnp.broadcast_to(gT, (kdim, 8)),
+                     jnp.broadcast_to(pool_gpu, (kdim, 1))], axis=1
+                )
+                n_groups = dev_group.astype(jnp.float32).sum() + jnp.where(
+                    pool_group, 1.0, 0.0
+                )
+                active9 = group9 & (left9 >= treq)
+                slot_gpu9 = left9
+                cpu_term = safe_div(cpu_f, div_cpu) * safe_div(tcpu, div_cpu)
+                gpu_terms = safe_div(left9, div_gpu) * safe_div(treq, div_gpu)
+                dots = (cpu_term + gpu_terms) / jnp.maximum(1.0 + n_groups, 1.0)
+            if norm == "pod":
+                dots = jnp.tanh(dots / 10.0)
+            s9 = jnp.where((node.cpu >= types.cpu) & active9, 1.0 - dots, neg)
+            best = jnp.max(s9, axis=1, keepdims=True)  # (K,1)
+            lane9 = _iota((kdim, 9), 1)
+            slot = jnp.min(
+                jnp.where(s9 == best, lane9, 9), axis=1, keepdims=True
+            )
+            dev = jnp.where(slot < 8, slot, first_free).astype(jnp.int32)
+            dev = jnp.where(best == neg, -1, dev)
+        raw = jnp.where(
+            best == neg, 0, (MAX_NODE_SCORE * best).astype(jnp.int32)
+        )
+        return raw, dev
+
+    return column
+
+
+def _resolve_column(fn):
+    """Column kernel for a policy fn, or None if this policy/config has no
+    Pallas form (the driver then falls back to the table engine)."""
+    name = fn.policy_name
+    if name == "FGDScore":
+        return _fgd_column
+    if name == "BestFitScore":
+        return _bestfit_column
+    if name == "GpuPackingScore":
+        return _packing_column
+    if name == "GpuClusteringScore":
+        return _clustering_column
+    if name == "PWRScore":
+        return _pwr_column
+    if name == "DotProductScore":
+        dim_ext = getattr(fn, "dim_ext", None)
+        norm = getattr(fn, "norm", None)
+        # a wrapped policy object (e.g. jit_policy) may not carry the
+        # config attrs — answer the predicate with "no column" rather
+        # than crash
+        if dim_ext is None or norm is None:
+            return None
+        return _make_dotprod_column(dim_ext, norm)
+    return None
+
+
+# policy names with a Pallas column implementation (config resolved by
+# _resolve_column; kept as a set for quick membership tests/docs)
+PALLAS_COLUMNS = {
+    "FGDScore", "BestFitScore", "GpuPackingScore", "GpuClusteringScore",
+    "PWRScore", "DotProductScore",
+}
 
 _SUPPORTED_GPU_SEL = {"best", "worst"} | SELF_SELECT_POLICIES
 
@@ -238,7 +545,7 @@ def supports(policies, gpu_sel: str, report: bool) -> bool:
     if report or len(policies) != 1:
         return False
     fn, _ = policies[0]
-    if fn.policy_name not in PALLAS_COLUMNS:
+    if _resolve_column(fn) is None:
         return False
     if gpu_sel not in _SUPPORTED_GPU_SEL:
         return False
@@ -298,6 +605,8 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
         tcpu_ref, tmem_ref, tmilli_ref, tnum_ref, tmask_ref,  # [K,1] i32
         tpcpu_ref, tpmilli_ref, tpnumf_ref, tpmask_ref, tpfreq_ref,  # [1,T]
         gcnt_ref, gtyp_ref, rank_ref,  # [1,N] i32 (read-only)
+        cpucap_ref, ctyp_ref,  # [1,N] i32 (read-only; PWR/Simon dims)
+        gidle_ref, gfull_ref, cidle_ref, cfull_ref, ncores_ref,  # (1,M) f32
         cpu0_ref, mem0_ref, gpu0_ref, aff0_ref,  # initial state
         score_ref, sdev_ref, feas_ref,  # [K,N] i32
         cpu_ref, mem_ref,  # [1,N] i32
@@ -326,22 +635,31 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
             tpcpu_ref[:, :], tpmilli_ref[:, :], tpnumf_ref[:, :],
             tpmask_ref[:, :], tpfreq_ref[:, :],
         )
+        aux = _EnergyRows(
+            gidle_ref[:, :], gfull_ref[:, :], cidle_ref[:, :],
+            cfull_ref[:, :], ncores_ref[:, :],
+        )
 
         def node_scalars(d):
             seln = lane_n == d
             return _NodeScalars(
                 cpu=jnp.sum(jnp.where(seln, cpu_ref[:, :], 0)),
                 mem=jnp.sum(jnp.where(seln, mem_ref[:, :], 0)),
+                cap=jnp.sum(jnp.where(seln, cpucap_ref[:, :], 0)),
                 gcnt=jnp.sum(jnp.where(seln, gcnt_ref[:, :], 0)),
                 gtyp=jnp.sum(jnp.where(seln, gtyp_ref[:, :], 0)),
+                ctyp=jnp.sum(jnp.where(seln, ctyp_ref[:, :], 0)),
                 g8=jnp.sum(
                     jnp.where(seln, gpul_ref[:, :], 0), axis=1, keepdims=True
+                ),
+                aff9=jnp.sum(
+                    jnp.where(seln, aff_ref[:, :], 0), axis=1, keepdims=True
                 ),
             )
 
         def refresh_column(d):
             node = node_scalars(d)
-            col_score, col_sdev = column_fn(node, types, tp)
+            col_score, col_sdev = column_fn(node, types, tp, aux)
             col_feas = _feas_column(node, types)
             hit = lane_kn == d
             score_ref[:, :] = jnp.where(hit, col_score, score_ref[:, :])
@@ -541,7 +859,7 @@ def make_pallas_replay(
         return _PALLAS_REPLAY_CACHE[cache_key]
 
     fn, weight = policies[0]
-    column_fn = PALLAS_COLUMNS[fn.policy_name]
+    column_fn = _resolve_column(fn)
     normalize = fn.normalize
     weight = int(weight)
 
@@ -601,6 +919,13 @@ def make_pallas_replay(
             jax.ShapeDtypeStruct((1, e), jnp.int32),  # event node
             jax.ShapeDtypeStruct((1, e), jnp.int32),  # event dev bits
         )
+        energy_rows = [
+            jnp.asarray(GPU_IDLE_W).reshape(1, -1),
+            jnp.asarray(GPU_FULL_W).reshape(1, -1),
+            jnp.asarray(CPU_IDLE_W).reshape(1, -1),
+            jnp.asarray(CPU_FULL_W).reshape(1, -1),
+            jnp.asarray(CPU_NCORES).reshape(1, -1),
+        ]
         (
             _score, _sdev, _feas, cpu_l, mem_l, gpul, aff,
             placed, maskb, failed, evnode, evdevb,
@@ -608,7 +933,7 @@ def make_pallas_replay(
             kernel,
             grid=(e,),
             out_shape=out_shape,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 18,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 25,
             out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 12),
             scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
             compiler_params=pltpu.CompilerParams(
@@ -622,6 +947,9 @@ def make_pallas_replay(
             state_p.gpu_cnt.reshape(1, n),
             state_p.gpu_type.reshape(1, n),
             rank_p.reshape(1, n),
+            state_p.cpu_cap.reshape(1, n),
+            state_p.cpu_type.reshape(1, n),
+            *energy_rows,
             state_p.cpu_left.reshape(1, n),
             state_p.mem_left.reshape(1, n),
             state_p.gpu_left.T,
