@@ -43,10 +43,58 @@ QUAD_KEYS = [
 ]
 INFOMSG = "level=info msg="
 
+# PodResource.Repr spec extractor (reference merge_fail_pods.py applies the
+# same shape to its analysis_fail.out) — shared by the log parser and the
+# direct path (which applies it to the reprs it generates itself)
+FAIL_SPEC_RE = re.compile(
+    r"<CPU:\s*([\d.]+), GPU: (\d+) x \{(\d+)\s*\}m "
+    r"\(CPUREQ: [^)]*\) \(GPUREQ: ([^)]*)\)>"
+)
+
+
+def fail_spec_key(line: str):
+    """(cpu_milli, num_gpu, gpu_milli, gpu_type) from a Repr line, or None."""
+    m = FAIL_SPEC_RE.search(line)
+    if not m:
+        return None
+    return (
+        round(float(m.group(1)) * 1000),
+        int(m.group(2)),
+        int(m.group(3)),
+        m.group(4),
+    )
+
+
+def fail_table(fail_specs: Dict[tuple, int]) -> Dict[str, list]:
+    """Reference merged schema (merge_fail_pods.py): one row per distinct
+    failed request spec, ordered by frequency, gpu_type "" → "<none>"."""
+    fail = {
+        "order": [],
+        "num_pod": [],
+        "cpu_milli": [],
+        "num_gpu": [],
+        "gpu_milli": [],
+        "gpu_type_req": [],
+    }
+    ranked = sorted(fail_specs.items(), key=lambda kv: (-kv[1], kv[0]))
+    for order, ((cpu, ngpu, milli, gtype), count) in enumerate(ranked):
+        fail["order"].append(order)
+        fail["num_pod"].append(count)
+        fail["cpu_milli"].append(cpu)
+        fail["num_gpu"].append(ngpu)
+        fail["gpu_milli"].append(milli)
+        fail["gpu_type_req"].append(
+            "<none>" if gtype in ("", "ANY", "NONE") else gtype
+        )
+    return fail
+
 
 def camel_to_snake(name: str) -> str:
-    name = re.sub("(.)([A-Z][a-z]+)", r"\1_\2", name)
-    return re.sub("([a-z0-9])([A-Z])", r"\1_\2", name).lower()
+    """Single-sourced from the report emitter so the direct and log-parse
+    lanes can never disagree on summary key names."""
+    from tpusim.sim.reports import camel_to_snake as _c2s
+
+    return _c2s(name)
 
 
 def parse_log(path: str, meta: Dict[str, str] = None) -> Dict[str, dict]:
@@ -91,18 +139,8 @@ def parse_log(path: str, meta: Dict[str, str] = None) -> Dict[str, dict]:
                 in_fail_block = True
                 continue
             if in_fail_block:
-                m = re.search(
-                    r"<CPU:\s*([\d.]+), GPU: (\d+) x \{(\d+)\s*\}m "
-                    r"\(CPUREQ: [^)]*\) \(GPUREQ: ([^)]*)\)>",
-                    line,
-                )
-                if m:
-                    key = (
-                        round(float(m.group(1)) * 1000),
-                        int(m.group(2)),
-                        int(m.group(3)),
-                        m.group(4),
-                    )
+                key = fail_spec_key(line)
+                if key is not None:
                     fail_specs[key] = fail_specs.get(key, 0) + 1
                     continue
                 in_fail_block = False
@@ -202,33 +240,13 @@ def parse_log(path: str, meta: Dict[str, str] = None) -> Dict[str, dict]:
                 cdol["pod_name"].append(pod_name)
                 cdol["cum_pod"].append(cum)
 
-    # reference merged schema (merge_fail_pods.py): one row per distinct
-    # failed request spec, ordered by frequency, gpu_type "" → "<none>"
-    fail = {
-        "order": [],
-        "num_pod": [],
-        "cpu_milli": [],
-        "num_gpu": [],
-        "gpu_milli": [],
-        "gpu_type_req": [],
-    }
-    ranked = sorted(fail_specs.items(), key=lambda kv: (-kv[1], kv[0]))
-    for order, ((cpu, ngpu, milli, gtype), count) in enumerate(ranked):
-        fail["order"].append(order)
-        fail["num_pod"].append(count)
-        fail["cpu_milli"].append(cpu)
-        fail["num_gpu"].append(ngpu)
-        fail["gpu_milli"].append(milli)
-        fail["gpu_type_req"].append(
-            "<none>" if gtype in ("", "ANY", "NONE") else gtype
-        )
     return {
         "summary": summary,
         "frag": frag,
         "allo": allo,
         "cdol": cdol,
         "pwr": pwr,
-        "fail": fail,
+        "fail": fail_table(fail_specs),
     }
 
 
@@ -243,18 +261,10 @@ def _write_series_csv(path: Path, series: Dict[str, list]):
             w.writerow([v[i] if i < len(v) else "" for v in series.values()])
 
 
-def analyze_dir(exp_dir: str, meta: Dict[str, str] = None) -> dict:
-    """Parse every *.log under exp_dir, write analysis{,_frag,_allo,_cdol,
-    _pwr}.csv beside them (one experiment per directory in this harness)."""
-    exp = Path(exp_dir)
-    logs = sorted(exp.glob("*.log"))
-    if not logs:
-        raise FileNotFoundError(f"no *.log under {exp_dir}")
-    rows = []
-    result = None
-    for log in logs:
-        result = parse_log(str(log), meta)
-        rows.append(result["summary"])
+def _write_experiment_csvs(exp: Path, rows: List[dict], result: dict):
+    """The per-experiment CSV family from a parse_log/build_result_from_sim
+    result dict — shared by both analysis lanes so file layout and cell
+    conversion can never drift."""
     cols: List[str] = []
     for r in rows:
         cols.extend(k for k in r if k not in cols)
@@ -262,7 +272,6 @@ def analyze_dir(exp_dir: str, meta: Dict[str, str] = None) -> dict:
         w = csv.DictWriter(f, fieldnames=cols)
         w.writeheader()
         w.writerows(rows)
-    # series CSVs reflect the last log (harness runs one log per dir)
     _write_series_csv(exp / "analysis_frag.csv", result["frag"])
     _write_series_csv(exp / "analysis_allo.csv", result["allo"])
     _write_series_csv(exp / "analysis_cdol.csv", result["cdol"])
@@ -274,6 +283,134 @@ def analyze_dir(exp_dir: str, meta: Dict[str, str] = None) -> dict:
         _write_series_csv(fail_csv, result["fail"])
     elif fail_csv.exists():
         fail_csv.unlink()
+
+
+def analyze_dir(exp_dir: str, meta: Dict[str, str] = None) -> dict:
+    """Parse every *.log under exp_dir, write analysis{,_frag,_allo,_cdol,
+    _pwr}.csv beside them (one experiment per directory in this harness).
+    This is the log-compat lane; the sweep's default is analyze_sim."""
+    exp = Path(exp_dir)
+    logs = sorted(exp.glob("*.log"))
+    if not logs:
+        raise FileNotFoundError(f"no *.log under {exp_dir}")
+    rows = []
+    result = None
+    for log in logs:
+        result = parse_log(str(log), meta)
+        rows.append(result["summary"])
+    # series CSVs reflect the last log (harness runs one log per dir)
+    _write_experiment_csvs(exp, rows, result)
+    return result
+
+
+def build_result_from_sim(sim, meta: Dict[str, str] = None) -> dict:
+    """parse_log's result dict built directly from the driver's structured
+    stashes — no log round trip. Byte-identical to parsing the log this
+    run wrote: every float passes through the SAME formatted string the
+    log line carries (tpusim.sim.reports.event_report_series /
+    cluster_analysis_block), every ordering mirrors the parser's insertion
+    order, and the stop-marker semantics (only events logged before
+    `finish()` count) hold because the driver stashes exactly what it
+    logged."""
+    import numpy as np
+
+    from tpusim.sim.engine import EV_CREATE, EV_DELETE
+    from tpusim.sim.reports import pod_resource_repr
+
+    summary: Dict[str, object] = dict(meta or {})
+    summary["unscheduled"] = 0
+    summary["origin_pods"] = len(sim.workload_pods)
+    summary.update(sim.analysis_summary)
+    summary["unscheduled"] = len(sim.last_result.unscheduled_pods)
+
+    frag: Dict[str, list] = {}
+    allo: Dict[str, list] = {}
+    pwr: Dict[str, list] = {}
+    cdol = {"id": [], "event": [], "pod_name": [], "cum_pod": []}
+    cum = 0
+    live = set()
+    for rep in sim.event_reports:
+        kinds = rep["kinds"]
+        active = (kinds == EV_CREATE) | (kinds == EV_DELETE)
+        s = rep["series"]
+        # [Report] families: float() of the same formatted strings the log
+        # lines embed (event_report_series)
+        for key in ("origin_milli", "origin_ratio", "origin_q124"):
+            frag.setdefault(key, []).extend(
+                s[key][active].astype(np.float64).tolist()
+            )
+        if "bellman_milli" in s:
+            for key in ("bellman_milli", "bellman_ratio"):
+                frag.setdefault(key, []).extend(
+                    s[key][active].astype(np.float64).tolist()
+                )
+        for key in (
+            "used_nodes", "used_gpus", "used_gpu_milli",
+        ):
+            allo.setdefault(key, []).extend(rep[key][active].tolist())
+        allo.setdefault("total_gpus", []).extend(
+            [int(rep["total_gpus"])] * int(active.sum())
+        )
+        for key in ("arrived_gpu_milli", "used_cpu_milli", "arrived_cpu_milli"):
+            allo.setdefault(key, []).extend(rep[key][active].tolist())
+        for key in ("power_cluster", "power_cluster_CPU", "power_cluster_GPU"):
+            pwr.setdefault(key, []).extend(
+                s[key][active].astype(np.float64).tolist()
+            )
+        # cdol timeline (the parser's create/delete/failed/skipped calculus
+        # over the attempt + rollback lines)
+        names = rep["pod_names"]
+        failed = rep["failed"]
+        for idx in np.flatnonzero(active):
+            name = str(names[idx])
+            if kinds[idx] == EV_CREATE:
+                if failed[idx]:
+                    verb = "failed"  # rollback line follows the attempt
+                else:
+                    verb = "create"
+                    cum += 1
+                    live.add(name)
+            else:
+                if name in live:
+                    verb = "delete"
+                    cum -= 1
+                    live.discard(name)
+                else:
+                    verb = "skipped"
+            cdol["id"].append(int(idx))
+            cdol["event"].append(verb)
+            cdol["pod_name"].append(name)
+            cdol["cum_pod"].append(cum)
+
+    # fail block: the same Repr -> regex -> grouping the parser applies,
+    # run over the reprs this run logged (sim.report_failed stash)
+    fail_specs: Dict[tuple, int] = {}
+    for pods in sim.failed_pod_lists:
+        for p in pods:
+            key = fail_spec_key(
+                pod_resource_repr(p.cpu_milli, p.num_gpu, p.gpu_milli, p.gpu_spec)
+            )
+            if key is not None:
+                fail_specs[key] = fail_specs.get(key, 0) + 1
+
+    return {
+        "summary": summary,
+        "frag": frag,
+        "allo": allo,
+        "cdol": cdol,
+        "pwr": pwr,
+        "fail": fail_table(fail_specs),
+    }
+
+
+def analyze_sim(sim, exp_dir: str, meta: Dict[str, str] = None) -> dict:
+    """Direct analysis lane: the same CSV family analyze_dir writes, built
+    from the driver's arrays instead of re-parsing the log (the log-line →
+    regex → CSV round trip was ~1/3 of sweep wall clock; the log itself is
+    still written for the reference-format contract)."""
+    exp = Path(exp_dir)
+    result = build_result_from_sim(sim, meta)
+    _write_experiment_csvs(exp, [result["summary"]], result)
     return result
 
 

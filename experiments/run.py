@@ -90,6 +90,18 @@ def get_args(argv=None):
         action="store_true",
         help="also write the equivalent cluster/scheduler YAML configs",
     )
+    p.add_argument(
+        "--engine", type=str, default="auto",
+        help="replay engine: auto | sequential | table | pallas (ENGINES.md)",
+    )
+    p.add_argument(
+        "--analysis-from-log",
+        action="store_true",
+        help="build the analysis CSVs by re-parsing simon.log (the "
+        "reference's log_to_csv lane) instead of directly from the "
+        "driver's arrays; outputs are byte-identical either way "
+        "(tests/test_experiments.py pins it)",
+    )
     return p.parse_args(argv)
 
 
@@ -211,6 +223,7 @@ def _build_sim(args):
         seed=args.workload_tuning_seed,
         report_per_event=not args.no_per_event_report,
         use_timestamps=args.use_timestamps,
+        engine=args.engine,
         typical_pods=TypicalPodsConfig(
             is_involved_cpu_pods=args.is_involved_cpu_pods.lower() == "true",
             pod_popularity_threshold=args.pod_popularity_threshold,
@@ -250,7 +263,7 @@ def _post_run(sim, args, outdir, pod_csv, policies, t0) -> dict:
     print(f"[run] {log_path} ({wall:.1f}s, {sim.last_result.events} events)")
 
     sys.path.insert(0, str(Path(__file__).parent))
-    from analysis import analyze_dir
+    from analysis import analyze_dir, analyze_sim
 
     meta = {
         "workload": Path(pod_csv).stem,
@@ -262,7 +275,9 @@ def _post_run(sim, args, outdir, pod_csv, policies, t0) -> dict:
         "dr": args.deschedule_ratio,
         "dp": args.deschedule_policy,
     }
-    return analyze_dir(str(outdir), meta)
+    if args.analysis_from_log:
+        return analyze_dir(str(outdir), meta)
+    return analyze_sim(sim, str(outdir), meta)
 
 
 def run_experiment(args) -> dict:

@@ -126,6 +126,22 @@ def test_simon_cr_parse_and_validate(tmp_path):
     assert cr.custom_config.typical_pods.pod_popularity_threshold == 95
     assert cr.custom_config.tuning.ratio == 0.0
 
+    assert cr.custom_config.engine == "auto"  # default
+
+    # the engine knob flows customConfig.engine -> SimulatorConfig.engine
+    doc = {
+        "apiVersion": "simon/v1alpha1",
+        "kind": "Config",
+        "spec": {
+            "cluster": {"customConfig": "example/test-cluster"},
+            "customConfig": {"engine": "table"},
+        },
+    }
+    p = tmp_path / "engine.yaml"
+    p.write_text(yaml.dump(doc))
+    cr2 = load_simon_cr(str(p), REPO)
+    assert cr2.custom_config.engine == "table"
+
     bad = {
         "apiVersion": "simon/v1alpha1",
         "kind": "Config",

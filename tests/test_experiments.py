@@ -181,6 +181,49 @@ def test_run_analysis_merge_plot(tmp_path):
     assert "no overlapping reference cells" in out
 
 
+def test_analysis_lanes_byte_identical(tmp_path):
+    """The direct array->CSV lane (default) and the log-reparse lane
+    (--analysis-from-log) must write byte-identical CSV families — on a
+    trace exercising failures (an unfittable pod), deletions
+    (deletion_time + --use-timestamps), and the failed-create rollback
+    calculus (the --engine knob also gets a forced-table pass here)."""
+    run = _load("exp_run2", EXP / "run.py")
+    node_csv, _ = _write_tiny_trace(tmp_path)
+    pod_csv = tmp_path / "mix_trace.csv"
+    with open(pod_csv, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(
+            ["name", "cpu_milli", "memory_mib", "num_gpu", "gpu_milli",
+             "gpu_spec", "qos", "pod_phase", "creation_time",
+             "deletion_time", "scheduled_time"]
+        )
+        for i in range(10):
+            w.writerow([f"pod-{i}", 2000, 4096, 1, 500, "", "LS",
+                        "Running", i, i + 20 if i % 3 == 0 else 0, 0])
+        # unfittable: more CPU than any node has
+        w.writerow(["pod-big", 99000000, 4096, 0, 0, "", "LS", "Running",
+                    5, 0, 0])
+
+    outs = {}
+    for lane, extra in (("direct", ()), ("log", ("--analysis-from-log",))):
+        outdir = tmp_path / lane
+        run.run_experiment(run.get_args(
+            ["-d", str(outdir), "-f", str(pod_csv), "--node-trace",
+             str(node_csv), "-FGD", "1000", "-gpusel", "FGDScore",
+             "--use-timestamps", "--engine", "table", *extra]
+        ))
+        outs[lane] = outdir
+    files = sorted(
+        p.name for p in outs["direct"].iterdir()
+        if p.name.startswith("analysis")
+    )
+    assert "analysis_fail.csv" in files  # the unfittable pod failed
+    for name in files:
+        a = (outs["direct"] / name).read_bytes()
+        b = (outs["log"] / name).read_bytes()
+        assert a == b, f"{name} differs between analysis lanes"
+
+
 def test_generate_run_scripts(capsys):
     gen = _load("exp_gen", EXP / "generate_run_scripts.py")
     sys.argv = [

@@ -163,33 +163,45 @@ def test_driver_small_run_on_tpu(accel):
     assert "Cluster Analysis Results" in sim.log.dump()
 
 
-def test_wave_engine_on_tpu(accel):
-    """The wave engine's intra-wave exact repair must hold on TPU numerics
-    (float kernel values patched into stale rows must equal the table
-    engine's refreshed columns bit-for-bit)."""
+def test_shardmap_engine_compiles_on_tpu(accel):
+    """The explicit-collective shard_map engine must compile and run its
+    collective path (psum/pmax lanes) on the real chip — the CPU suite only
+    exercises it on the virtual host mesh (VERDICT r3 §6: 'the TPU test
+    lane never compiles the collective path on real hardware'). One device
+    suffices: the collectives still lower and execute, just degenerate."""
     from tests.fixtures import random_cluster, random_pods
+    from tpusim.parallel.shard_engine import make_shardmap_table_replay
+    from tpusim.parallel.sharding import make_mesh, pad_nodes, shard_state
     from tpusim.policies import make_policy
-    from tpusim.sim.engine import EV_CREATE, make_replay
-    from tpusim.sim.table_engine import build_pod_types
-    from tpusim.sim.wave_engine import make_wave_replay
+    from tpusim.sim.engine import EV_CREATE
+    from tpusim.sim.table_engine import build_pod_types, make_table_replay
 
     rng = np.random.default_rng(17)
-    state, tp = random_cluster(rng, num_nodes=32)
-    pods = random_pods(rng, num_pods=48)
-    ev_kind = jnp.full(48, EV_CREATE, jnp.int32)
-    ev_pod = jnp.arange(48, dtype=jnp.int32)
+    state, tp = random_cluster(rng, num_nodes=24)
+    pods = random_pods(rng, num_pods=40)
+    ev_kind = jnp.full(40, EV_CREATE, jnp.int32)
+    ev_pod = jnp.arange(40, dtype=jnp.int32)
     policies = [(make_policy("FGDScore"), 1000)]
     key = jax.random.PRNGKey(3)
-    rank = jnp.asarray(rng.permutation(32).astype(np.int32))
+    rank = jnp.asarray(rng.permutation(24).astype(np.int32))
 
-    seq = make_replay(policies, "FGDScore", report=False)(
-        state, pods, ev_kind, ev_pod, tp, key, rank
-    )
-    wav = make_wave_replay(policies, "FGDScore", wave=8)(
+    plain = make_table_replay(policies, "FGDScore", report=False)(
         state, pods, build_pod_types(pods), ev_kind, ev_pod, tp, key, rank
     )
-    assert np.array_equal(np.asarray(seq.placed_node), np.asarray(wav.placed_node))
-    assert np.array_equal(np.asarray(seq.event_node), np.asarray(wav.event_node))
+    mesh = make_mesh(1)
+    pstate, prank = pad_nodes(state, rank, 1)
+    pstate = shard_state(pstate, mesh)
+    sharded = make_shardmap_table_replay(policies, mesh, gpu_sel="FGDScore")(
+        pstate, pods, build_pod_types(pods), ev_kind, ev_pod, tp, key, prank
+    )
+    assert np.array_equal(
+        np.asarray(plain.placed_node), np.asarray(sharded.placed_node)
+    )
+    assert np.array_equal(
+        np.asarray(plain.dev_mask), np.asarray(sharded.dev_mask)
+    )
+    for a, b in zip(jax.tree.leaves(plain.state), jax.tree.leaves(sharded.state)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_seed_batched_replay_on_tpu(accel):

@@ -78,6 +78,7 @@ class Applier:
             deschedule_ratio=cc.deschedule.ratio,
             deschedule_policy=cc.deschedule.policy,
             use_timestamps=cc.use_timestamps,
+            engine=cc.engine,
         )
 
     def _load_apps(self, node_names: Sequence[str]) -> List[tuple]:

@@ -72,6 +72,10 @@ class CustomConfig:
     # build ingests traces that always carry timestamps, the switch is
     # explicit.
     use_timestamps: bool = False
+    # Replay engine selection (no reference counterpart — the engines are
+    # this build's execution strategies, ENGINES.md):
+    # auto | sequential | table | pallas. Validated by Simulator.__init__.
+    engine: str = "auto"
 
 
 @dataclass
@@ -192,6 +196,7 @@ def parse_simon_cr(doc: dict, base_dir: str = ".") -> SimonCR:
         ),
         typical_pods=_typical(cc_raw.get("typicalPodsConfig") or {}),
         use_timestamps=bool(cc_raw.get("useTimestamps", False)),
+        engine=str(cc_raw.get("engine") or "auto"),
     )
 
     apps = []

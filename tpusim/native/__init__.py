@@ -35,7 +35,7 @@ def _ensure_lib():
             or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
         ):
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB + ".tmp", _SRC],
+                ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB + ".tmp", _SRC],
                 check=True,
                 capture_output=True,
             )

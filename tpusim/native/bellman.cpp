@@ -157,6 +157,9 @@ struct TypicalPod {
     int32_t num;
     int64_t mask;
     double freq;
+    int32_t mi;  // index into Evaluator::millis (-1: milli == 0) — avoids
+                 // the per-row linear milli lookup in the recursion's two
+                 // hottest loops
 };
 
 struct Evaluator {
@@ -187,18 +190,15 @@ struct Evaluator {
                 nfit[mi] = i;
             }
         }
-        auto fit_of = [&](int32_t milli) {
-            // millis is tiny (<= ~16); linear lookup
-            for (size_t mi = 0; mi < millis.size(); ++mi)
-                if (millis[mi] == milli) return nfit[mi];
-            return 0;
+        auto fit_of = [&](const TypicalPod& t) {
+            return t.mi >= 0 ? nfit[t.mi] : 0;
         };
         int64_t node_bit = type >= 0 ? (1ll << type) : 0;
 
         double ratio_except_q3 = 0.0;
         for (const auto& t : pods) {
             if (t.milli == 0 || (t.mask != 0 && !(t.mask & node_bit)) ||
-                fit_of(t.milli) < t.num || cpu_left < t.cpu)
+                fit_of(t) < t.num || cpu_left < t.cpu)
                 ratio_except_q3 += t.freq;
         }
         if (depth > max_depth_seen) max_depth_seen = depth;
@@ -224,7 +224,7 @@ struct Evaluator {
                                        cum_prob * t.freq, depth + 1);
                     continue;
                 }
-                int j = fit_of(t.milli);
+                int j = fit_of(t);
                 if (j < t.num) {
                     pv += static_cast<double>(total) * t.freq;
                     continue;
@@ -258,15 +258,24 @@ void* bellman_new(const int32_t* cpu, const int32_t* milli,
     auto* ev = new Evaluator();
     ev->max_depth = max_depth;
     ev->pods.reserve(t);
+    // zero-frequency rows (typical-axis padding) contribute exactly 0.0 to
+    // every freq-weighted sum, so dropping them here is bit-identical and
+    // shrinks the recursion's per-miss loops
     for (int i = 0; i < t; ++i)
-        ev->pods.push_back({cpu[i], milli[i], num[i], mask[i], freq[i]});
+        if (freq[i] != 0.0)
+            ev->pods.push_back({cpu[i], milli[i], num[i], mask[i], freq[i], -1});
     std::vector<int32_t> ms;
-    for (int i = 0; i < t; ++i)
-        if (milli[i] > 0) ms.push_back(milli[i]);
+    for (const auto& p : ev->pods)
+        if (p.milli > 0) ms.push_back(p.milli);
     std::sort(ms.begin(), ms.end());
     ms.erase(std::unique(ms.begin(), ms.end()), ms.end());
     if (ms.size() > 64) { delete ev; return nullptr; }
     ev->millis = std::move(ms);
+    for (auto& p : ev->pods)
+        if (p.milli > 0)
+            p.mi = static_cast<int32_t>(
+                std::lower_bound(ev->millis.begin(), ev->millis.end(), p.milli)
+                - ev->millis.begin());
     return ev;
 }
 

@@ -161,6 +161,13 @@ class Simulator:
         # which engine the last run_events call dispatched to
         # (pallas | table | sequential) — bench/log labeling
         self._last_engine = None
+        # direct-CSV-path stashes (experiments/analysis.py analyze_sim):
+        # per-event structured report data (one entry per reporting replay,
+        # main schedule + inflation/deschedule stages, in log order) + the
+        # accumulated cluster-analysis summary key/values across stages
+        self.event_reports = []
+        self.analysis_summary = {}
+        self.failed_pod_lists = []
         if self._table_ok:
             from tpusim.sim.table_engine import make_table_replay
 
@@ -455,9 +462,16 @@ class Simulator:
         self.log.info(f"Number of original workload pods: {len(self.workload_pods)}")
         res = self.schedule_pods(pods)
         # failed-pods detail block (core.go:156 ReportFailedPods)
-        report_failed_pods(self.log, [u.pod for u in res.unscheduled_pods])
+        self.report_failed([u.pod for u in res.unscheduled_pods])
         self.cluster_analysis("InitSchedule")
         return res
+
+    def report_failed(self, pods) -> None:
+        """Failed-pods detail block + the direct-CSV path's stash (every
+        block the log carries contributes to the fail-spec grouping, like
+        the parser's in_fail_block accumulation)."""
+        report_failed_pods(self.log, pods)
+        self.failed_pod_lists.append(list(pods))
 
     def finish(self):
         """Emit the unscheduled-count line (apply.go:228). It is the
@@ -522,7 +536,7 @@ class Simulator:
             state, extra, jax.random.PRNGKey(self.cfg.inflation_seed),
             use_timestamps=False,
         )
-        report_failed_pods(self.log, [u.pod for u in unscheduled])
+        self.report_failed([u.pod for u in unscheduled])
         failed = len(unscheduled)
         self.log.info(f"[ReportFailedPods] {failed} unscheduled inflation pods")
         saved = self.last_result.state
@@ -673,6 +687,8 @@ class Simulator:
         m = out.metrics
         if not self.cfg.report_per_event or m is None:
             return
+        from tpusim.sim.reports import event_report_series
+
         amounts = np.asarray(m.frag_amounts)
         total_gpus = self.total_gpus
         kinds = np.asarray(ev_kind)
@@ -681,6 +697,26 @@ class Simulator:
         ev_pods = np.asarray(ev_pod)
         pod_names = names[ev_pods]
         ev_failed = np.asarray(out.ever_failed)[ev_pods]
+        series = event_report_series(
+            amounts, np.asarray(m.power_cpu), np.asarray(m.power_gpu), bellman
+        )
+        # stash the structured per-event data for the direct CSV path
+        # (experiments/analysis.py analyze_sim — the formatted `series`
+        # strings are the SAME objects the log lines embed, so both lanes
+        # are byte-identical by construction)
+        self.event_reports.append({
+            "series": series,
+            "kinds": kinds,
+            "pod_names": pod_names,
+            "failed": ev_failed,
+            "used_nodes": np.asarray(m.used_nodes),
+            "used_gpus": np.asarray(m.used_gpus),
+            "used_gpu_milli": np.asarray(m.used_gpu_milli),
+            "arrived_gpu_milli": np.asarray(m.arrived_gpu_milli),
+            "used_cpu_milli": np.asarray(m.used_cpu_milli),
+            "arrived_cpu_milli": np.asarray(m.arrived_cpu_milli),
+            "total_gpus": total_gpus,
+        })
         self.log.info_many(
             batch_event_report_msgs(
                 amounts,
@@ -699,6 +735,7 @@ class Simulator:
                 ev_delete=EV_DELETE,
                 pod_names=pod_names,
                 failed=ev_failed,
+                series=series,
             )
         )
 
@@ -722,17 +759,29 @@ class Simulator:
         }
         return requested, allocatable
 
-    def cluster_analysis(self, tag: str = "InitSchedule"):
-        """The end-of-stage 16-line analysis block (analysis.go:145-199)."""
+    def cluster_analysis(self, tag: str = "InitSchedule", _amounts=None):
+        """The end-of-stage 16-line analysis block (analysis.go:145-199).
+
+        `_amounts` lets run_batch supply precomputed cluster frag amounts
+        (one vmapped device call + one fetch for the whole seed group,
+        instead of a ~100 ms tunnel round trip per sim)."""
         from tpusim.ops.frag import cluster_frag_report
 
         state = (
             self.last_result.state if hasattr(self, "last_result") else self.init_state
         )
-        state_j = jax.tree.map(jnp.asarray, state)
-        amounts = np.asarray(cluster_frag_report(state_j, self.typical)[0])
+        if _amounts is not None:
+            amounts = np.asarray(_amounts)
+        else:
+            state_j = jax.tree.map(jnp.asarray, state)
+            amounts = np.asarray(cluster_frag_report(state_j, self.typical)[0])
         requested, allocatable = self.alloc_maps(state)
-        cluster_analysis_block(self.log, tag, amounts, requested, allocatable)
+        kv = cluster_analysis_block(
+            self.log, tag, amounts, requested, allocatable
+        )
+        # running summary across stages, in emission order (the direct CSV
+        # path's stand-in for re-parsing the blocks out of the log)
+        self.analysis_summary.update(kv)
         return amounts, requested, allocatable
 
 
@@ -982,6 +1031,29 @@ def schedule_pods_batch(
     return results
 
 
+_FRAG_BATCH_FN = None
+
+
+def _batched_frag_amounts(sims) -> np.ndarray:
+    """Cluster frag amounts for every sim's final state in ONE vmapped
+    device call + ONE fetch (the per-sim cluster_analysis round trip costs
+    ~100 ms of tunnel latency each)."""
+    global _FRAG_BATCH_FN
+    from tpusim.ops.frag import cluster_frag_amounts
+
+    if _FRAG_BATCH_FN is None:
+        _FRAG_BATCH_FN = jax.jit(
+            jax.vmap(lambda s, tp: cluster_frag_amounts(s, tp).sum(0), (0, None))
+        )
+    states = jax.tree.map(
+        lambda *xs: jnp.asarray(np.stack([np.asarray(x) for x in xs])),
+        *[s.last_result.state for s in sims],
+    )
+    return np.asarray(
+        device_fetch(_FRAG_BATCH_FN(states, sims[0].typical))
+    )
+
+
 def run_batch(sims: Sequence["Simulator"]) -> List[SimulateResult]:
     """run() for a seed batch: per-sim host prep and reporting, one
     batched device replay (see schedule_pods_batch)."""
@@ -994,7 +1066,8 @@ def run_batch(sims: Sequence["Simulator"]) -> List[SimulateResult]:
             f"Number of original workload pods: {len(sim.workload_pods)}"
         )
     results = schedule_pods_batch(sims, pods_list)
-    for sim, res in zip(sims, results):
-        report_failed_pods(sim.log, [u.pod for u in res.unscheduled_pods])
-        sim.cluster_analysis("InitSchedule")
+    amounts = _batched_frag_amounts(sims)
+    for i, (sim, res) in enumerate(zip(sims, results)):
+        sim.report_failed([u.pod for u in res.unscheduled_pods])
+        sim.cluster_analysis("InitSchedule", _amounts=amounts[i])
     return results

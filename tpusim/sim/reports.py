@@ -10,7 +10,8 @@ escape before the closing quote, as logrus renders embedded newlines.
 from __future__ import annotations
 
 import math
-from typing import IO, List, Optional, Sequence
+import re
+from typing import IO, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -133,6 +134,49 @@ def report_failed_pods(log: LogSink, pods) -> None:
     log.infoln()
 
 
+def event_report_series(
+    amounts: np.ndarray,  # f32[E, 7]
+    power_cpu: np.ndarray,
+    power_gpu: np.ndarray,
+    bellman: Optional[np.ndarray] = None,  # f64[E]
+) -> Dict[str, np.ndarray]:
+    """The per-event float series of the report block, as FORMATTED string
+    arrays — the single source both the log emitter
+    (batch_event_report_msgs) and the direct CSV path
+    (experiments/analysis.py analyze_sim) consume, so the two lanes are
+    byte-identical by construction.
+
+    Intermediate sums/ratios reproduce the scalar emitters' float32-sum →
+    float64-divide sequencing exactly (report_frag_line/report_power_line).
+    """
+
+    def f2(a):
+        return np.char.mod("%.2f", a)
+
+    idle32 = amounts.sum(axis=1, dtype=np.float32)
+    idle = idle32.astype(np.float64)
+    frag = idle - amounts[:, Q3_SATISFIED].astype(np.float64)
+    q124 = (amounts[:, 0] + amounts[:, 1] + amounts[:, 3]).astype(np.float64)
+    safe = np.where(idle != 0, idle, 1.0)
+    fr = np.where(idle != 0, 100.0 * frag / safe, 0.0)
+    qr = np.where(idle != 0, 100.0 * q124 / safe, 0.0)
+    pc = power_cpu.astype(np.float64)
+    pg = power_gpu.astype(np.float64)
+    series = {
+        "origin_milli": f2(frag),
+        "origin_ratio": f2(fr),
+        "origin_q124": f2(qr),
+        "power_cluster": np.char.mod("%.1f", pc + pg),
+        "power_cluster_CPU": np.char.mod("%.1f", pc),
+        "power_cluster_GPU": np.char.mod("%.1f", pg),
+    }
+    if bellman is not None:
+        br = np.where(idle != 0, 100.0 * bellman / safe, 0.0)
+        series["bellman_milli"] = f2(bellman)
+        series["bellman_ratio"] = f2(br)
+    return series
+
+
 def batch_event_report_msgs(
     amounts: np.ndarray,  # f32[E, 7]
     total_gpus: int,
@@ -150,6 +194,7 @@ def batch_event_report_msgs(
     ev_delete: int = 1,
     pod_names: Optional[np.ndarray] = None,  # str[E] name of event's pod
     failed: Optional[np.ndarray] = None,  # bool[E] creation was rejected
+    series: Optional[Dict[str, np.ndarray]] = None,  # event_report_series
 ) -> List[str]:
     """The whole per-event report block, vectorized: every line family is
     formatted as one numpy string op over the event axis, then interleaved
@@ -157,9 +202,8 @@ def batch_event_report_msgs(
     alloccpu → power; simulator.go:410-427, analysis.go:109-118). Skip
     events (pod-unscheduled annotation) emit nothing (simulator.go:391-399).
 
-    Intermediate sums/ratios reproduce the scalar emitters' float32-sum →
-    float64-divide sequencing exactly, so printed values are bit-identical
-    to the per-event path this replaces.
+    Number formatting comes from event_report_series (pass a prebuilt one
+    to share it with the direct CSV path).
     """
     e_count = amounts.shape[0]
     if e_count == 0:
@@ -169,9 +213,8 @@ def batch_event_report_msgs(
         if kinds is None
         else (kinds == ev_create) | (kinds == ev_delete)
     )
-
-    def f2(a):
-        return np.char.mod("%.2f", a)
+    if series is None:
+        series = event_report_series(amounts, power_cpu, power_gpu, bellman)
 
     def cat(*parts):
         out = parts[0]
@@ -179,17 +222,10 @@ def batch_event_report_msgs(
             out = np.char.add(out, p)
         return out
 
-    # [Report] (origin): float32 row-sums, float64 ratios (report_frag_line)
-    idle32 = amounts.sum(axis=1, dtype=np.float32)
-    idle = idle32.astype(np.float64)
-    frag = idle - amounts[:, Q3_SATISFIED].astype(np.float64)
-    q124 = (amounts[:, 0] + amounts[:, 1] + amounts[:, 3]).astype(np.float64)
-    safe = np.where(idle != 0, idle, 1.0)
-    fr = np.where(idle != 0, 100.0 * frag / safe, 0.0)
-    qr = np.where(idle != 0, 100.0 * q124 / safe, 0.0)
     frag_l = cat(
-        "[Report]; Frag amount: ", f2(frag), "; Frag ratio: ", f2(fr),
-        "%; Q124 ratio: ", f2(qr), "%; (origin)",
+        "[Report]; Frag amount: ", series["origin_milli"],
+        "; Frag ratio: ", series["origin_ratio"],
+        "%; Q124 ratio: ", series["origin_q124"], "%; (origin)",
     )
 
     rows = []  # (mask, msgs) in per-event emission order
@@ -211,14 +247,14 @@ def batch_event_report_msgs(
                 )
             )
     rows.append((active, frag_l))
-    if bellman is not None:
-        br = np.where(idle != 0, 100.0 * bellman / safe, 0.0)
+    if "bellman_milli" in series:
         rows.append(
             (
                 active,
                 cat(
-                    "[Report]; Frag amount: ", f2(bellman),
-                    "; Frag ratio: ", f2(br), "%; (bellman)",
+                    "[Report]; Frag amount: ", series["bellman_milli"],
+                    "; Frag ratio: ", series["bellman_ratio"],
+                    "%; (bellman)",
                 ),
             )
         )
@@ -244,15 +280,13 @@ def batch_event_report_msgs(
             ),
         )
     )
-    pc = power_cpu.astype(np.float64)
-    pg = power_gpu.astype(np.float64)
     rows.append(
         (
             active,
             cat(
-                "[Power]; cluster: ", np.char.mod("%.1f", pc + pg),
-                "; ClusterCPU: ", np.char.mod("%.1f", pc),
-                "; ClusterGPU: ", np.char.mod("%.1f", pg),
+                "[Power]; cluster: ", series["power_cluster"],
+                "; ClusterCPU: ", series["power_cluster_CPU"],
+                "; ClusterGPU: ", series["power_cluster_GPU"],
             ),
         )
     )
@@ -265,15 +299,28 @@ def batch_event_report_msgs(
     return grid.T.ravel()[mask.T.ravel()].tolist()
 
 
+def camel_to_snake(name: str) -> str:
+    """scripts/analysis.py's key normalization (shared with the direct CSV
+    path so summary keys match the log-parse lane exactly)."""
+    name = re.sub("(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub("([a-z0-9])([A-Z])", r"\1_\2", name).lower()
+
+
 def cluster_analysis_block(
     log: LogSink,
     tag: str,
     frag_amounts: np.ndarray,  # f32[7]
     alloc_requested: dict,
     alloc_allocatable: dict,
-):
+) -> Dict[str, float]:
     """The 16-line `Cluster Analysis Results` block
-    (analysis.go:177-199 + alloc.go:65-88)."""
+    (analysis.go:177-199 + alloc.go:65-88).
+
+    Returns the summary key/values scripts/analysis.py's parser would
+    extract from this block (each value round-tripped through the SAME
+    formatted string the log line carries), in the parser's insertion
+    order — the direct CSV path consumes this instead of re-parsing."""
+    summary: Dict[str, float] = {}
     log.infoln()
     log.info(f"========== Cluster Analysis Results ({tag}) ==========")
     log.info("Allocation Ratio:")
@@ -282,16 +329,24 @@ def cluster_analysis_block(
         aval = alloc_allocatable[k]
         ratio = 100.0 * rval / aval if aval else 0.0
         log.info(f"    {k:<8}: {ratio:4.1f}% ({rval}/{aval})")
+        summary[camel_to_snake(k + tag)] = float(f"{ratio:4.1f}")
+        summary[camel_to_snake(k + "Amount" + tag)] = float(rval)
+        summary[camel_to_snake(k + "Total")] = float(aval)
     total = float(frag_amounts.sum())
     denom = total if total else 1.0
     for v, name in enumerate(FRAG_CLASS_NAMES):
         val = float(frag_amounts[v])
-        log.info(f"{name:<13}: {val / 1000:6.2f} x 10^3 ({100 * val / denom:5.2f}%)")
+        pct = 100 * val / denom
+        log.info(f"{name:<13}: {val / 1000:6.2f} x 10^3 ({pct:5.2f}%)")
+        summary[camel_to_snake(name + tag)] = float(f"{pct:5.2f}")
     log.info("--------------------")
     log.info(f"{'idle_gpu_milli':<13}: {total / 1000:6.2f} x 10^3 (100.0%)")
     frag = total - float(frag_amounts[Q3_SATISFIED])
+    fpct = 100 * frag / denom
     log.info(
-        f"{'frag_gpu_milli':<13}: {frag / 1000:6.2f} x 10^3 ({100 * frag / denom:5.2f}%)"
+        f"{'frag_gpu_milli':<13}: {frag / 1000:6.2f} x 10^3 ({fpct:5.2f}%)"
     )
+    summary[camel_to_snake("frag_gpu_milli" + tag)] = float(f"{fpct:5.2f}")
     log.info("==============================================")
     log.infoln()
+    return summary

@@ -151,9 +151,9 @@ _TABLE_REPLAY_CACHE = {}
 
 
 def reject_randomized(policies, gpu_sel: str):
-    """Table-izability guard shared by the table and wave engines: anything
-    drawing per-event randomness would silently break their bit-identical
-    contract with the sequential oracle."""
+    """Table-izability guard shared by the table and pallas engines:
+    anything drawing per-event randomness would silently break their
+    bit-identical contract with the sequential oracle."""
     for fn, _ in policies:
         if fn.policy_name == "RandomScore":
             raise ValueError(
@@ -189,8 +189,7 @@ def _group_fn(fn, which: str):
 
 def make_table_builders(policies, sel_idx: int):
     """(columns, init_tables) score-table constructors for a static policy
-    list — single-sourced so the incremental table engine and the wave
-    engine (tpusim.sim.wave_engine) build bit-identical tables.
+    list — single-sourced table builders for the incremental engine.
 
     columns(state1, types, tp, key): one node's scores for all K pod types
       -> (scores i32[num_pol, K], sharedev i32[K], feas bool[K]).
