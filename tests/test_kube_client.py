@@ -302,6 +302,135 @@ def test_client_lists_and_filters(tmp_path, api_server):
     assert {(p.gpu_milli, p.num_gpu) for p in gpu_pods} == {(500, 1)}
 
 
+def _exec_kubeconfig(tmp_path, server, plugin_body: str, exec_extra=None):
+    """kubeconfig whose user authenticates via an exec credential plugin
+    (a stub shell script standing in for gke-gcloud-auth-plugin & co)."""
+    plugin = tmp_path / "stub-credential-plugin"
+    plugin.write_text("#!/bin/sh\n" + plugin_body)
+    plugin.chmod(0o755)
+    p = tmp_path / "exec-kubeconfig"
+    p.write_text(
+        yaml.dump(
+            {
+                "apiVersion": "v1",
+                "kind": "Config",
+                "current-context": "sim",
+                "clusters": [{"name": "c", "cluster": {"server": server}}],
+                "users": [
+                    {
+                        "name": "u",
+                        "user": {
+                            "exec": dict(
+                                {
+                                    "apiVersion": (
+                                        "client.authentication.k8s.io/v1"
+                                    ),
+                                    "command": str(plugin),
+                                    "args": ["get-token"],
+                                    "env": [
+                                        {"name": "STUB_TOKEN_SUFFIX",
+                                         "value": "-from-env"}
+                                    ],
+                                },
+                                **(exec_extra or {}),
+                            )
+                        },
+                    }
+                ],
+                "contexts": [
+                    {"name": "sim", "context": {"cluster": "c", "user": "u"}}
+                ],
+            }
+        )
+    )
+    return str(p)
+
+
+_TOKEN_PLUGIN = """
+[ "$1" = "get-token" ] || exit 2
+# the client must supply the ExecCredential handshake env
+echo "$KUBERNETES_EXEC_INFO" | grep -q ExecCredential || exit 3
+cat <<EOF
+{"apiVersion": "client.authentication.k8s.io/v1", "kind": "ExecCredential",
+ "status": {"token": "exec-minted$STUB_TOKEN_SUFFIX"}}
+EOF
+"""
+
+
+def test_exec_plugin_token(tmp_path, api_server):
+    """client-go ExecCredential contract: the plugin subprocess runs with
+    the configured args/env + KUBERNETES_EXEC_INFO, and its status.token
+    becomes the bearer token (ref: client-go behavior behind
+    utils.go:843-882)."""
+    kc = _exec_kubeconfig(tmp_path, api_server, _TOKEN_PLUGIN)
+    seen = {}
+    orig = _Handler.do_GET
+
+    def spy(self):
+        seen["auth"] = self.headers.get("Authorization")
+        return orig(self)
+
+    _Handler.do_GET = spy
+    try:
+        cluster = load_cluster_from_client(kc)
+    finally:
+        _Handler.do_GET = orig
+    assert seen["auth"] == "Bearer exec-minted-from-env"
+    assert [n.name for n in cluster.nodes] == ["node-a", "node-b"]
+
+
+def test_exec_plugin_failures(tmp_path, api_server):
+    """Plugin failure modes surface as typed errors naming the plugin:
+    non-zero exit, invalid JSON, wrong kind, and a missing binary."""
+    cases = [
+        ("exit 7\n", "exit 7"),
+        ("echo not-json\n", "invalid JSON"),
+        ('echo \'{"kind": "Secret", "status": {"token": "x"}}\'\n',
+         "expected ExecCredential"),
+        ('echo \'{"kind": "ExecCredential", "status": {}}\'\n',
+         "neither a token"),
+    ]
+    for body, match in cases:
+        kc = _exec_kubeconfig(tmp_path, api_server, '[ "$1" = get-token ]\n' + body)
+        with pytest.raises(KubeClientError, match=match):
+            KubeClient(kc)
+    kc = _exec_kubeconfig(tmp_path, api_server, "exit 0\n")
+    import os
+
+    # missing exec bit -> typed error, not a raw PermissionError
+    (tmp_path / "stub-credential-plugin").chmod(0o644)
+    with pytest.raises(KubeClientError, match="not runnable"):
+        KubeClient(kc)
+    os.unlink(tmp_path / "stub-credential-plugin")
+    with pytest.raises(KubeClientError, match="not runnable"):
+        KubeClient(kc)
+
+
+def test_auth_provider_still_guided(tmp_path, api_server):
+    """Legacy auth-provider users (no external contract) still get the
+    guidance error rather than an opaque 401."""
+    p = tmp_path / "ap-kubeconfig"
+    p.write_text(
+        yaml.dump(
+            {
+                "apiVersion": "v1",
+                "kind": "Config",
+                "current-context": "sim",
+                "clusters": [{"name": "c", "cluster": {"server": api_server}}],
+                "users": [
+                    {"name": "u",
+                     "user": {"auth-provider": {"name": "gcp"}}}
+                ],
+                "contexts": [
+                    {"name": "sim", "context": {"cluster": "c", "user": "u"}}
+                ],
+            }
+        )
+    )
+    with pytest.raises(KubeClientError, match="auth-provider"):
+        KubeClient(str(p))
+
+
 def test_client_auth_header(tmp_path, api_server):
     """The bearer token from the kubeconfig must reach the wire."""
     seen = {}
