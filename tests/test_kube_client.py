@@ -406,6 +406,39 @@ def test_exec_plugin_failures(tmp_path, api_server):
         KubeClient(kc)
 
 
+def test_exec_plugin_cluster_info_and_env_edges(tmp_path, api_server):
+    """provideClusterInfo puts spec.cluster in the handshake; falsy env
+    values (0/false) pass through as strings, only null means empty; a
+    cert without its key is a typed error."""
+    body = """
+echo "$KUBERNETES_EXEC_INFO" | grep -q '"server"' || exit 4
+[ "$ZERO_VAL" = "0" ] || exit 5
+[ "$NULL_VAL" = "" ] || exit 6
+cat <<EOF
+{"apiVersion": "client.authentication.k8s.io/v1", "kind": "ExecCredential",
+ "status": {"token": "cluster-info-token"}}
+EOF
+"""
+    kc = _exec_kubeconfig(
+        tmp_path, api_server, body,
+        exec_extra={
+            "provideClusterInfo": True,
+            "env": [{"name": "ZERO_VAL", "value": 0},
+                    {"name": "NULL_VAL", "value": None}],
+        },
+    )
+    client = KubeClient(kc)
+    assert client._headers["Authorization"] == "Bearer cluster-info-token"
+
+    half = (
+        'echo \'{"kind": "ExecCredential", "status": '
+        '{"token": "t", "clientCertificateData": "PEM"}}\'\n'
+    )
+    kc = _exec_kubeconfig(tmp_path, api_server, half)
+    with pytest.raises(KubeClientError, match="one half"):
+        KubeClient(kc)
+
+
 def test_auth_provider_still_guided(tmp_path, api_server):
     """Legacy auth-provider users (no external contract) still get the
     guidance error rather than an opaque 401."""

@@ -69,7 +69,7 @@ LIST_ENDPOINTS = [
 ]
 
 
-def _run_exec_plugin(spec: dict, kubeconfig_path: str):
+def _run_exec_plugin(spec: dict, kubeconfig_path: str, cluster: dict = None):
     """Run a kubeconfig exec credential plugin per the client-go
     ExecCredential contract (client.authentication.k8s.io): invoke
     `command args...` with the configured env plus KUBERNETES_EXEC_INFO,
@@ -88,13 +88,28 @@ def _run_exec_plugin(spec: dict, kubeconfig_path: str):
     env = dict(os.environ)
     for e in spec.get("env") or []:
         if e.get("name"):
-            # an explicit null value means empty, like kubectl
-            env[e["name"]] = str(e.get("value") or "")
+            v = e.get("value")
+            # only an explicit null means empty (0/false pass as "0"/"False")
+            env[e["name"]] = "" if v is None else str(v)
+    exec_spec: dict = {"interactive": False}
+    if spec.get("provideClusterInfo") and cluster is not None:
+        # client-go passes the target cluster to the plugin when asked
+        # (ExecConfig.ProvideClusterInfo -> spec.cluster in the handshake)
+        info = {"server": cluster.get("server", "")}
+        if cluster.get("certificate-authority-data") is not None:
+            info["certificate-authority-data"] = cluster[
+                "certificate-authority-data"
+            ]
+        if cluster.get("insecure-skip-tls-verify") is not None:
+            info["insecure-skip-tls-verify"] = cluster[
+                "insecure-skip-tls-verify"
+            ]
+        exec_spec["cluster"] = info
     env["KUBERNETES_EXEC_INFO"] = json.dumps(
         {
             "apiVersion": api_version,
             "kind": "ExecCredential",
-            "spec": {"interactive": False},
+            "spec": exec_spec,
         }
     )
     argv = [command] + [str(a) for a in spec.get("args") or []]
@@ -134,7 +149,12 @@ def _run_exec_plugin(spec: dict, kubeconfig_path: str):
     token = status.get("token")
     cert = status.get("clientCertificateData")
     key = status.get("clientKeyData")
-    if not token and not (cert and key):
+    if bool(cert) != bool(key):
+        raise KubeClientError(
+            f"exec credential plugin {command!r} returned only one half of "
+            "the clientCertificateData/clientKeyData pair"
+        )
+    if not token and not cert:
         raise KubeClientError(
             f"exec credential plugin {command!r} returned neither a token "
             "nor a client certificate/key pair"
@@ -198,7 +218,7 @@ class KubeClient:
             # reference's client runs these transparently through
             # clientcmd.BuildConfigFromFlags, utils.go:843-882)
             token, cert_data, key_data = _run_exec_plugin(
-                user["exec"], kubeconfig_path
+                user["exec"], kubeconfig_path, cluster
             )
             if cert_data:
                 # re-encode the plugin's PEM as -data kubeconfig keys so

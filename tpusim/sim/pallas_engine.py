@@ -15,12 +15,16 @@ executed as straight-line VPU code with zero kernel launches per event.
 
 Mosaic constraints shape the implementation (probed on the target chip):
 scalars cannot be stored to VMEM and dynamic lane-dim slicing is not
-lowerable, so every "pointer chase" is a masked vector op instead --
-  row gather  score_tbl[t_id]      -> sum(where(sublane_iota == t_id, tbl, 0))
-  col update  tbl[:, node] = col   -> where(lane_iota == node, col, tbl)
-  scalar read placed[idx]          -> sum(where(lane_iota == idx, placed, 0))
-Each masked rewrite touches the full [K, N] table (~0.7 us of i32 VPU work),
-noise next to the launch overhead it replaces.
+lowerable — but dynamic slicing on LEADING and SUBLANE dims is. So the node
+and event axes are chunked as (C, 128) and the tables as [K, C, 128]:
+  row gather   score_tbl[t_id]     -> score[pl.ds(tid,1), :, :]   (free)
+  col update   tbl[:, node] = col  -> rmw of tbl[:, pl.ds(c,1), :]
+                                      masked on lane == node % 128
+  scalar read  placed[idx]         -> sum(where(lane_iota == idx, placed, 0))
+                                      (pod-axis arrays stay flat [1, P] —
+                                      the masked full-row op is ~45 KB)
+Each update touches one (.., 1, 128) chunk instead of a whole [K, N] table
+(~12x less masked-write traffic than the round-4 v1 flat layout).
 
 Exactness: the kernel computes the same integer scores from the same integer
 state as the table engine; the only divergence channel is f32 reduction order
@@ -594,38 +598,47 @@ def _pack_events(specs: PodSpec, type_id, ev_kind, ev_pod):
     )
 
 
+_CH = 128  # lane-chunk width: the node/event axes are laid out [*, C, 128]
+
+
 def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
     """The fused replay kernel for a static (column_fn, Ks, normalize,
     gpu_sel, weight) configuration. See module docstring for the masked-op
-    calculus; every step mirrors a line of sim/step.py or table_engine.py."""
+    calculus; every step mirrors a line of sim/step.py or table_engine.py.
+
+    Layout (round-4 v2): the node axis is chunked as (C, 128) and the
+    tables as [K, C, 128], because Mosaic supports dynamic slicing on
+    leading and sublane dims (probed) but not the lane dim. Row gathers
+    become free leading-dim slices, and column/state updates touch one
+    (.., 1, 128) chunk instead of rewriting whole [K, N] tables — ~12x
+    less masked-write traffic per event than the v1 flat layout."""
     self_select = gpu_sel in SELF_SELECT_POLICIES
 
     def kernel(
-        ev_ref,  # [F, E] i32
+        ev_ref,  # [F, Ec, 128] i32
         tcpu_ref, tmem_ref, tmilli_ref, tnum_ref, tmask_ref,  # [K,1] i32
         tpcpu_ref, tpmilli_ref, tpnumf_ref, tpmask_ref, tpfreq_ref,  # [1,T]
-        gcnt_ref, gtyp_ref, rank_ref,  # [1,N] i32 (read-only)
-        cpucap_ref, ctyp_ref,  # [1,N] i32 (read-only; PWR/Simon dims)
+        gcnt_ref, gtyp_ref, rank_ref,  # (C,128) i32 (read-only)
+        cpucap_ref, ctyp_ref,  # (C,128) i32 (read-only; PWR dims)
         gidle_ref, gfull_ref, cidle_ref, cfull_ref, ncores_ref,  # (1,M) f32
-        cpu0_ref, mem0_ref, gpu0_ref, aff0_ref,  # initial state
-        score_ref, sdev_ref, feas_ref,  # [K,N] i32
-        cpu_ref, mem_ref,  # [1,N] i32
-        gpul_ref,  # [8,N] i32
-        aff_ref,  # [9,N] i32
+        cpu0_ref, mem0_ref, gpu0_ref, aff0_ref,  # initial state (chunked)
+        score_ref, sdev_ref, feas_ref,  # [K, C, 128] i32
+        cpu_ref, mem_ref,  # (C,128) i32
+        gpul_ref,  # [8, C, 128] i32
+        aff_ref,  # [9, C, 128] i32
         placed_ref, maskb_ref, failed_ref,  # [1,P] i32
-        evnode_ref, evdevb_ref,  # [1,E] i32
+        evnode_ref, evdevb_ref,  # [Ec, 128] i32
         dirty,  # SMEM (1,) i32
     ):
         i = pl.program_id(0)
-        kdim, n = score_ref.shape
-        e = evnode_ref.shape[1]
+        kdim, nc, _ = score_ref.shape
+        n = nc * _CH
         p = placed_ref.shape[1]
 
-        lane_n = _iota((1, n), 1)
-        lane_e = _iota((1, e), 1)
         lane_p = _iota((1, p), 1)
-        lane_kn = _iota((kdim, n), 1)
-        sub_kn = _iota((kdim, n), 0)
+        # node id grid over the chunked layout
+        nid = _iota((nc, _CH), 0) * _CH + _iota((nc, _CH), 1)
+        lane1 = _iota((1, _CH), 1)
 
         types = _TypeCols(
             tcpu_ref[:, :], tmem_ref[:, :], tmilli_ref[:, :],
@@ -640,43 +653,57 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
             cfull_ref[:, :], ncores_ref[:, :],
         )
 
+        def chunk_scalar(ref, c, sel):
+            """ref (C,128): ref[c, l] via a one-chunk masked reduce."""
+            return jnp.sum(jnp.where(sel, ref[pl.ds(c, 1), :], 0))
+
         def node_scalars(d):
-            seln = lane_n == d
+            c, l = d // _CH, d % _CH
+            sel = lane1 == l
+            # 3D chunk slices reshape to 2D before reducing — Mosaic's
+            # reduction lowering rejects the layout a 3D-sliced operand
+            # carries (observed on-chip), while the 2D pattern is the one
+            # the v1 layout already proved out
+            g8c = gpul_ref[:, pl.ds(c, 1), :].reshape(8, _CH)
+            a9c = aff_ref[:, pl.ds(c, 1), :].reshape(9, _CH)
             return _NodeScalars(
-                cpu=jnp.sum(jnp.where(seln, cpu_ref[:, :], 0)),
-                mem=jnp.sum(jnp.where(seln, mem_ref[:, :], 0)),
-                cap=jnp.sum(jnp.where(seln, cpucap_ref[:, :], 0)),
-                gcnt=jnp.sum(jnp.where(seln, gcnt_ref[:, :], 0)),
-                gtyp=jnp.sum(jnp.where(seln, gtyp_ref[:, :], 0)),
-                ctyp=jnp.sum(jnp.where(seln, ctyp_ref[:, :], 0)),
-                g8=jnp.sum(
-                    jnp.where(seln, gpul_ref[:, :], 0), axis=1, keepdims=True
-                ),
-                aff9=jnp.sum(
-                    jnp.where(seln, aff_ref[:, :], 0), axis=1, keepdims=True
-                ),
+                cpu=chunk_scalar(cpu_ref, c, sel),
+                mem=chunk_scalar(mem_ref, c, sel),
+                cap=chunk_scalar(cpucap_ref, c, sel),
+                gcnt=chunk_scalar(gcnt_ref, c, sel),
+                gtyp=chunk_scalar(gtyp_ref, c, sel),
+                ctyp=chunk_scalar(ctyp_ref, c, sel),
+                g8=jnp.sum(jnp.where(sel, g8c, 0), axis=1, keepdims=True),
+                aff9=jnp.sum(jnp.where(sel, a9c, 0), axis=1, keepdims=True),
             )
 
         def refresh_column(d):
             node = node_scalars(d)
             col_score, col_sdev = column_fn(node, types, tp, aux)
             col_feas = _feas_column(node, types)
-            hit = lane_kn == d
-            score_ref[:, :] = jnp.where(hit, col_score, score_ref[:, :])
-            sdev_ref[:, :] = jnp.where(hit, col_sdev, sdev_ref[:, :])
-            feas_ref[:, :] = jnp.where(hit, col_feas, feas_ref[:, :])
+            c, l = d // _CH, d % _CH
+            hit = (lane1 == l).reshape(1, 1, _CH)
+            for ref, col in (
+                (score_ref, col_score),
+                (sdev_ref, col_sdev),
+                (feas_ref, col_feas),
+            ):
+                blk = ref[:, pl.ds(c, 1), :]  # (K,1,128)
+                ref[:, pl.ds(c, 1), :] = jnp.where(
+                    hit, col.reshape(kdim, 1, 1), blk
+                )
 
         @pl.when(i == 0)
         def _():
             cpu_ref[:, :] = cpu0_ref[:, :]
             mem_ref[:, :] = mem0_ref[:, :]
-            gpul_ref[:, :] = gpu0_ref[:, :]
-            aff_ref[:, :] = aff0_ref[:, :]
-            placed_ref[:, :] = jnp.full((1, p), -1, jnp.int32)
-            maskb_ref[:, :] = jnp.zeros((1, p), jnp.int32)
-            failed_ref[:, :] = jnp.zeros((1, p), jnp.int32)
-            evnode_ref[:, :] = jnp.full((1, e), -1, jnp.int32)
-            evdevb_ref[:, :] = jnp.zeros((1, e), jnp.int32)
+            gpul_ref[:, :, :] = gpu0_ref[:, :, :]
+            aff_ref[:, :, :] = aff0_ref[:, :, :]
+            placed_ref[:, :] = jnp.full(placed_ref.shape, -1, jnp.int32)
+            maskb_ref[:, :] = jnp.zeros(placed_ref.shape, jnp.int32)
+            failed_ref[:, :] = jnp.zeros(placed_ref.shape, jnp.int32)
+            evnode_ref[:, :] = jnp.full(evnode_ref.shape, -1, jnp.int32)
+            evdevb_ref[:, :] = jnp.zeros(evnode_ref.shape, jnp.int32)
             dirty[0] = 0
 
             # build the score/sdev/feas tables column by column from the
@@ -695,11 +722,13 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
         def _():
             refresh_column(dirty[0])
 
-        # ---- this event's packed scalars (masked lane extraction)
-        ev = ev_ref[:, :]
+        # ---- this event's packed scalars (one-chunk masked extraction)
+        ec, el = i // _CH, i % _CH
+        evblk = ev_ref[:, pl.ds(ec, 1), :]  # (F,1,128)
+        sel_ev = (lane1 == el).reshape(1, 1, _CH)
 
         def f(j):
-            return jnp.sum(jnp.where(lane_e == i, ev[j : j + 1, :], 0))
+            return jnp.sum(jnp.where(sel_ev, evblk[j : j + 1, :, :], 0))
 
         kind = f(0)
         idx = f(1)
@@ -707,23 +736,28 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
         pcpu, pmem, pmilli, pnum = f(3), f(4), f(5), f(6)
         ppin, pcls, pshare, ptgm = f(8), f(9), f(10), f(11)
         sel_p = lane_p == idx
-        sel_e = lane_e == i
+        sel_e1 = lane1 == el
         sub8c = _iota((8, 1), 0)
-        sub9c = _iota((9, 1), 0)
+
+        def state_update(c, delta_fns):
+            """Apply masked one-chunk updates: [(ref, hit_mask, delta)] —
+            (C,128) refs take a (1,128) mask; [R,C,128] refs take an
+            (R,1,128)-broadcastable mask; delta is scalar (or (R,1,1))."""
+            for ref, hit, delta in delta_fns:
+                if ref.ndim == 2:
+                    blk = ref[pl.ds(c, 1), :]
+                    ref[pl.ds(c, 1), :] = jnp.where(hit, blk + delta, blk)
+                else:
+                    blk = ref[:, pl.ds(c, 1), :]
+                    ref[:, pl.ds(c, 1), :] = jnp.where(hit, blk + delta, blk)
 
         # ---- creation: Filter -> Score row -> selectHost -> Reserve -> Bind
         @pl.when(kind == 0)
         def _():
-            hit_t = sub_kn == tid
-            raw = jnp.sum(
-                jnp.where(hit_t, score_ref[:, :], 0), axis=0, keepdims=True
-            )  # (1,N)
-            feas_row = (
-                jnp.sum(jnp.where(hit_t, feas_ref[:, :], 0), axis=0, keepdims=True)
-                != 0
-            )
+            raw = score_ref[pl.ds(tid, 1), :, :].reshape(nc, _CH)
+            feas_row = feas_ref[pl.ds(tid, 1), :, :].reshape(nc, _CH) != 0
             # nodeSelector pinning is a per-event mask, not a table column
-            feasible = feas_row & ((ppin < 0) | (lane_n == ppin))
+            feasible = feas_row & ((ppin < 0) | (nid == ppin))
             if normalize in ("minmax", "pwr"):
                 lo = jnp.min(jnp.where(feasible, raw, _INT_MAX))
                 hi = jnp.max(jnp.where(feasible, raw, -_INT_MAX))
@@ -743,12 +777,14 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
             )
             m = jnp.max(wkey)
             ok = m != -_INT_MAX
-            node = jnp.where(ok, jnp.min(jnp.where(wkey == m, lane_n, n)), 0)
+            node = jnp.where(ok, jnp.min(jnp.where(wkey == m, nid, n)), 0)
+            c, l = node // _CH, node % _CH
+            sel_l = lane1 == l
 
             # Reserve: device pick on the winner (step.choose_devices)
-            seln = lane_n == node
             g8w = jnp.sum(
-                jnp.where(seln, gpul_ref[:, :], 0), axis=1, keepdims=True
+                jnp.where(sel_l, gpul_ref[:, pl.ds(c, 1), :].reshape(8, _CH), 0),
+                axis=1, keepdims=True,
             )  # (8,1)
             gT = g8w.T  # (1,8)
             lane8 = _iota((1, 8), 1)
@@ -763,7 +799,13 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
                 wdev = jnp.min(jnp.where(wkey8 == jnp.max(wkey8), lane8, 8))
                 share_dev = jnp.where(any_fit, wdev, -1)
             elif self_select:
-                sdev = jnp.sum(jnp.where(hit_t & seln, sdev_ref[:, :], 0))
+                sdev = jnp.sum(
+                    jnp.where(
+                        sel_l,
+                        sdev_ref[pl.ds(tid, 1), pl.ds(c, 1), :].reshape(1, _CH),
+                        0,
+                    )
+                )
                 share_dev = jnp.where(sdev >= 0, sdev, bdev)
             else:  # "best"
                 share_dev = bdev
@@ -784,16 +826,29 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
             )
             bits = jnp.where(ok, bits, 0)
 
-            # Bind: masked scatter-commit (step.select_and_bind)
-            okn = seln & ok
-            cpu_ref[:, :] = jnp.where(okn, cpu_ref[:, :] - pcpu, cpu_ref[:, :])
-            mem_ref[:, :] = jnp.where(okn, mem_ref[:, :] - pmem, mem_ref[:, :])
-            mask8 = (jax.lax.shift_right_logical(bits, sub8c) & 1) != 0  # (8,1)
-            gpul_ref[:, :] = jnp.where(
-                okn & mask8, gpul_ref[:, :] - pmilli, gpul_ref[:, :]
+            # Bind: masked one-chunk scatter-commit (step.select_and_bind)
+            okl = sel_l & ok
+            mask8 = (jax.lax.shift_right_logical(bits, sub8c) & 1) != 0
+            aff_sub = _iota((9, 1), 0) == jnp.maximum(pcls, 0)
+            state_update(
+                c,
+                [
+                    (cpu_ref, okl, -pcpu),
+                    (mem_ref, okl, -pmem),
+                    (
+                        gpul_ref,
+                        okl.reshape(1, 1, _CH) & mask8.reshape(8, 1, 1),
+                        -pmilli,
+                    ),
+                    (
+                        aff_ref,
+                        okl.reshape(1, 1, _CH)
+                        & aff_sub.reshape(9, 1, 1)
+                        & (pcls >= 0),
+                        1,
+                    ),
+                ],
             )
-            aff_hit = okn & (sub9c == jnp.maximum(pcls, 0)) & (pcls >= 0)
-            aff_ref[:, :] = jnp.where(aff_hit, aff_ref[:, :] + 1, aff_ref[:, :])
 
             placed_ref[:, :] = jnp.where(
                 sel_p, jnp.where(ok, node, -1), placed_ref[:, :]
@@ -802,10 +857,12 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
             failed_ref[:, :] = jnp.where(
                 sel_p, jnp.where(ok, 0, 1), failed_ref[:, :]
             )
-            evnode_ref[:, :] = jnp.where(
-                sel_e, jnp.where(ok, node, -1), evnode_ref[:, :]
+            eblk = evnode_ref[pl.ds(ec, 1), :]
+            evnode_ref[pl.ds(ec, 1), :] = jnp.where(
+                sel_e1, jnp.where(ok, node, -1), eblk
             )
-            evdevb_ref[:, :] = jnp.where(sel_e, bits, evdevb_ref[:, :])
+            dblk = evdevb_ref[pl.ds(ec, 1), :]
+            evdevb_ref[pl.ds(ec, 1), :] = jnp.where(sel_e1, bits, dblk)
             dirty[0] = jnp.where(ok, node, 0)
 
         # ---- deletion: return resources to the recorded devices
@@ -816,19 +873,35 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
             bits = jnp.sum(jnp.where(sel_p, maskb_ref[:, :], 0))
             was = node >= 0
             nodee = jnp.maximum(node, 0)
-            seln = (lane_n == nodee) & was
-            cpu_ref[:, :] = jnp.where(seln, cpu_ref[:, :] + pcpu, cpu_ref[:, :])
-            mem_ref[:, :] = jnp.where(seln, mem_ref[:, :] + pmem, mem_ref[:, :])
+            c, l = nodee // _CH, nodee % _CH
+            sel_l = (lane1 == l) & was
             mask8 = (jax.lax.shift_right_logical(bits, sub8c) & 1) != 0
-            gpul_ref[:, :] = jnp.where(
-                seln & mask8, gpul_ref[:, :] + pmilli, gpul_ref[:, :]
+            aff_sub = _iota((9, 1), 0) == jnp.maximum(pcls, 0)
+            state_update(
+                c,
+                [
+                    (cpu_ref, sel_l, pcpu),
+                    (mem_ref, sel_l, pmem),
+                    (
+                        gpul_ref,
+                        sel_l.reshape(1, 1, _CH) & mask8.reshape(8, 1, 1),
+                        pmilli,
+                    ),
+                    (
+                        aff_ref,
+                        sel_l.reshape(1, 1, _CH)
+                        & aff_sub.reshape(9, 1, 1)
+                        & (pcls >= 0),
+                        -1,
+                    ),
+                ],
             )
-            aff_hit = seln & (sub9c == jnp.maximum(pcls, 0)) & (pcls >= 0)
-            aff_ref[:, :] = jnp.where(aff_hit, aff_ref[:, :] - 1, aff_ref[:, :])
             placed_ref[:, :] = jnp.where(sel_p, -1, placed_ref[:, :])
             maskb_ref[:, :] = jnp.where(sel_p, 0, maskb_ref[:, :])
-            evnode_ref[:, :] = jnp.where(sel_e, node, evnode_ref[:, :])
-            evdevb_ref[:, :] = jnp.where(sel_e, bits, evdevb_ref[:, :])
+            eblk = evnode_ref[pl.ds(ec, 1), :]
+            evnode_ref[pl.ds(ec, 1), :] = jnp.where(sel_e1, node, eblk)
+            dblk = evdevb_ref[pl.ds(ec, 1), :]
+            evdevb_ref[pl.ds(ec, 1), :] = jnp.where(sel_e1, bits, dblk)
             dirty[0] = nodee
 
         # kind == 2 (EV_SKIP / padding): dirty, outputs unchanged
@@ -903,21 +976,33 @@ def make_pallas_replay(
         ev = _pack_events(pods, types.type_id, ev_kind, ev_pod)
         e = int(ev.shape[1])
         p = int(pods.cpu.shape[0])
+        nc = n // _CH
+        # event axis chunked like the node axis; pad with EV_SKIP rows the
+        # grid (over the TRUE e) never reads
+        epad = (-e) % _CH
+        if epad:
+            ev = jnp.concatenate(
+                [ev, jnp.zeros((ev.shape[0], epad), jnp.int32)
+                 .at[0, :].set(2)],
+                axis=1,
+            )
+        ec = (e + epad) // _CH
+        ev3 = ev.reshape(ev.shape[0], ec, _CH)
 
         kernel = _make_kernel(column_fn, ks, normalize, gpu_sel, weight)
         out_shape = (
-            jax.ShapeDtypeStruct((kdim, n), jnp.int32),  # score
-            jax.ShapeDtypeStruct((kdim, n), jnp.int32),  # sdev
-            jax.ShapeDtypeStruct((kdim, n), jnp.int32),  # feas
-            jax.ShapeDtypeStruct((1, n), jnp.int32),  # cpu_left
-            jax.ShapeDtypeStruct((1, n), jnp.int32),  # mem_left
-            jax.ShapeDtypeStruct((8, n), jnp.int32),  # gpu_left (dev-major)
-            jax.ShapeDtypeStruct((9, n), jnp.int32),  # aff_cnt (class-major)
+            jax.ShapeDtypeStruct((kdim, nc, _CH), jnp.int32),  # score
+            jax.ShapeDtypeStruct((kdim, nc, _CH), jnp.int32),  # sdev
+            jax.ShapeDtypeStruct((kdim, nc, _CH), jnp.int32),  # feas
+            jax.ShapeDtypeStruct((nc, _CH), jnp.int32),  # cpu_left
+            jax.ShapeDtypeStruct((nc, _CH), jnp.int32),  # mem_left
+            jax.ShapeDtypeStruct((8, nc, _CH), jnp.int32),  # gpu_left
+            jax.ShapeDtypeStruct((9, nc, _CH), jnp.int32),  # aff_cnt
             jax.ShapeDtypeStruct((1, p), jnp.int32),  # placed
             jax.ShapeDtypeStruct((1, p), jnp.int32),  # device mask bits
             jax.ShapeDtypeStruct((1, p), jnp.int32),  # failed
-            jax.ShapeDtypeStruct((1, e), jnp.int32),  # event node
-            jax.ShapeDtypeStruct((1, e), jnp.int32),  # event dev bits
+            jax.ShapeDtypeStruct((ec, _CH), jnp.int32),  # event node
+            jax.ShapeDtypeStruct((ec, _CH), jnp.int32),  # event dev bits
         )
         energy_rows = [
             jnp.asarray(GPU_IDLE_W).reshape(1, -1),
@@ -926,6 +1011,10 @@ def make_pallas_replay(
             jnp.asarray(CPU_FULL_W).reshape(1, -1),
             jnp.asarray(CPU_NCORES).reshape(1, -1),
         ]
+
+        def chunk(a):
+            return a.reshape(nc, _CH)
+
         (
             _score, _sdev, _feas, cpu_l, mem_l, gpul, aff,
             placed, maskb, failed, evnode, evdevb,
@@ -941,32 +1030,34 @@ def make_pallas_replay(
             ),
             interpret=interpret,
         )(
-            ev,
+            ev3,
             *tcols,
             *tprows,
-            state_p.gpu_cnt.reshape(1, n),
-            state_p.gpu_type.reshape(1, n),
-            rank_p.reshape(1, n),
-            state_p.cpu_cap.reshape(1, n),
-            state_p.cpu_type.reshape(1, n),
+            chunk(state_p.gpu_cnt),
+            chunk(state_p.gpu_type),
+            chunk(rank_p),
+            chunk(state_p.cpu_cap),
+            chunk(state_p.cpu_type),
             *energy_rows,
-            state_p.cpu_left.reshape(1, n),
-            state_p.mem_left.reshape(1, n),
-            state_p.gpu_left.T,
-            state_p.aff_cnt.T,
+            chunk(state_p.cpu_left),
+            chunk(state_p.mem_left),
+            state_p.gpu_left.T.reshape(8, nc, _CH),
+            state_p.aff_cnt.T.reshape(9, nc, _CH),
         )
 
         bit8 = jnp.arange(MAX_GPUS_PER_NODE, dtype=jnp.int32)
         new_state = state._replace(
-            cpu_left=cpu_l[0, :n0],
-            mem_left=mem_l[0, :n0],
-            gpu_left=gpul[:, :n0].T,
-            aff_cnt=aff[:, :n0].T,
+            cpu_left=cpu_l.reshape(n)[:n0],
+            mem_left=mem_l.reshape(n)[:n0],
+            gpu_left=gpul.reshape(8, n)[:, :n0].T,
+            aff_cnt=aff.reshape(9, n)[:, :n0].T,
         )
         masks = ((maskb[0, :, None] >> bit8) & 1) != 0  # [P,8] bool
-        devs = ((evdevb[0, :, None] >> bit8) & 1) != 0  # [E,8] bool
+        evnode_f = evnode.reshape(ec * _CH)[:e]
+        evdevb_f = evdevb.reshape(ec * _CH)[:e]
+        devs = ((evdevb_f[:, None] >> bit8) & 1) != 0  # [E,8] bool
         return ReplayResult(
-            new_state, placed[0], masks, failed[0] != 0, None, evnode[0], devs
+            new_state, placed[0], masks, failed[0] != 0, None, evnode_f, devs
         )
 
     _PALLAS_REPLAY_CACHE[cache_key] = replay
