@@ -224,6 +224,38 @@ def test_analysis_lanes_byte_identical(tmp_path):
         assert a == b, f"{name} differs between analysis lanes"
 
 
+def test_reused_simulator_lanes_stay_identical(tmp_path):
+    """Calling run() twice on one Simulator must not double-count the
+    direct-CSV stashes vs the log lane (ADVICE r4): both lanes reflect the
+    LAST run only, byte-identically."""
+    import sys
+
+    sys.path.insert(0, str(EXP))
+    from analysis import build_result_from_sim, parse_log
+
+    from tpusim.io.trace import load_node_csv, load_pod_csv
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+
+    node_csv, pod_csv = _write_tiny_trace(tmp_path)
+    sim = Simulator(
+        load_node_csv(str(node_csv)),
+        SimulatorConfig(policies=(("FGDScore", 1000),), seed=1),
+    )
+    sim.set_workload_pods(load_pod_csv(str(pod_csv)))
+    sim.run()
+    sim.finish()
+    sim.run()  # reuse: stashes and log must reset
+    sim.finish()
+    assert len(sim.event_reports) == 1
+    log_path = tmp_path / "simon.log"
+    log_path.write_text(sim.log.dump())
+    direct = build_result_from_sim(sim)
+    parsed = parse_log(str(log_path))
+    assert direct["frag"] == parsed["frag"]
+    assert direct["allo"] == parsed["allo"]
+    assert direct["summary"]["unscheduled"] == parsed["summary"]["unscheduled"]
+
+
 def test_generate_run_scripts(capsys):
     gen = _load("exp_gen", EXP / "generate_run_scripts.py")
     sys.argv = [

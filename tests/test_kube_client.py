@@ -252,6 +252,28 @@ def test_is_kubeconfig_file_large_files(tmp_path, api_server):
     assert big_dump.stat().st_size > 1 << 20
     assert not is_kubeconfig_file(str(big_dump))
 
+    # inconclusive head: a >1MB kubeconfig whose huge `users:` block
+    # (embedded certs) precedes both positive markers must fall back to the
+    # full parse, not be misrouted to dump ingestion (ADVICE r4)
+    tail_kc = tmp_path / "markers-past-head"
+    doc = yaml.safe_load(open(_kubeconfig(tmp_path, api_server)))
+    users_first = {
+        "apiVersion": "v1",
+        "users": [
+            {"name": f"u{i}", "user": {"client-certificate-data": "x" * 4096}}
+            for i in range(400)
+        ],
+        "contexts": doc["contexts"],
+        "current-context": doc["current-context"],
+        "clusters": doc["clusters"],
+        "kind": "Config",
+    }
+    tail_kc.write_text(yaml.dump(users_first, sort_keys=False))
+    assert tail_kc.stat().st_size > 1 << 20
+    head = tail_kc.read_text()[: 64 << 10]
+    assert "kind: Config" not in head and "\nclusters:" not in head
+    assert is_kubeconfig_file(str(tail_kc))
+
 
 def test_client_403_falls_through_to_next_candidate(tmp_path, api_server):
     """An RBAC-denied deprecated group-version must not abort ingestion
@@ -389,6 +411,19 @@ def test_exec_plugin_failures(tmp_path, api_server):
          "expected ExecCredential"),
         ('echo \'{"kind": "ExecCredential", "status": {}}\'\n',
          "neither a token"),
+        # client-go rejects a response apiVersion that differs from the
+        # configured exec.apiVersion (ADVICE r4)
+        ('echo \'{"apiVersion": "client.authentication.k8s.io/v1beta1", '
+         '"kind": "ExecCredential", "status": {"token": "x"}}\'\n',
+         "apiVersion"),
+        # an already-expired credential fails loudly instead of surfacing
+        # later as an opaque 401 (ADVICE r4)
+        ('echo \'{"kind": "ExecCredential", "status": {"token": "x", '
+         '"expirationTimestamp": "2001-01-01T00:00:00Z"}}\'\n',
+         "expired"),
+        ('echo \'{"kind": "ExecCredential", "status": {"token": "x", '
+         '"expirationTimestamp": "not-a-time"}}\'\n',
+         "unparseable"),
     ]
     for body, match in cases:
         kc = _exec_kubeconfig(tmp_path, api_server, '[ "$1" = get-token ]\n' + body)

@@ -145,7 +145,39 @@ def _run_exec_plugin(spec: dict, kubeconfig_path: str, cluster: dict = None):
             f"exec credential plugin {command!r} returned kind "
             f"{cred.get('kind')!r}, expected ExecCredential"
         )
+    # client-go's exec authenticator rejects a response whose apiVersion
+    # differs from the configured exec.apiVersion (exec.go newAuthenticator
+    # response validation); mirror that instead of silently accepting
+    if cred.get("apiVersion") and cred["apiVersion"] != api_version:
+        raise KubeClientError(
+            f"exec credential plugin {command!r} returned apiVersion "
+            f"{cred['apiVersion']!r}, expected the configured {api_version!r}"
+        )
     status = cred.get("status") or {}
+    exp = status.get("expirationTimestamp")
+    if exp:
+        import datetime
+
+        try:
+            exp_dt = datetime.datetime.fromisoformat(
+                str(exp).replace("Z", "+00:00")
+            )
+        except ValueError as e:
+            raise KubeClientError(
+                f"exec credential plugin {command!r} returned an unparseable "
+                f"expirationTimestamp {exp!r}"
+            ) from e
+        if exp_dt.tzinfo is None:
+            # RFC3339 always carries an offset; be lenient and read a naive
+            # stamp as UTC rather than crash comparing naive vs aware
+            exp_dt = exp_dt.replace(tzinfo=datetime.timezone.utc)
+        if exp_dt <= datetime.datetime.now(datetime.timezone.utc):
+            # an already-expired credential would only surface later as an
+            # opaque 401; fail with the actual cause instead
+            raise KubeClientError(
+                f"exec credential plugin {command!r} returned an expired "
+                f"credential (expirationTimestamp {exp})"
+            )
     token = status.get("token")
     cert = status.get("clientCertificateData")
     key = status.get("clientKeyData")
@@ -364,9 +396,11 @@ class KubeClient:
 def is_kubeconfig_file(path: str) -> bool:
     """Heuristic the applier uses to pick client vs dump ingestion: a
     kubeconfig is `kind: Config` with a clusters list. Large files get a
-    cheap head-of-file marker scan before the full parse, so a multi-MB
-    cluster dump skips the double parse while a large multi-cluster
-    kubeconfig still routes to the client path."""
+    cheap head-of-file marker scan before the full parse: a positive marker
+    (kind: Config / clusters:) routes to the kubeconfig parse, a dump
+    marker (items: / any other top-level kind) skips the double parse, and
+    only a head with neither — e.g. a kubeconfig whose huge users: block
+    precedes both markers — pays the full parse to decide."""
     if not os.path.isfile(path):
         return False
     if os.path.getsize(path) > 1 << 20:
@@ -377,9 +411,18 @@ def is_kubeconfig_file(path: str) -> bool:
             return False
         # kubeconfig top-level keys at column 0 (either may sit beyond the
         # head in a large file — key order varies); dumps are object
-        # lists/streams whose kinds/fields all sit indented or differ
+        # lists/streams whose top-level markers differ. A positive marker
+        # routes to the kubeconfig parse, a dump marker (`items:` list /
+        # `kind: List`/typed kinds) short-circuits to dump ingestion, and an
+        # inconclusive head falls through to the full parse — so a >1MB
+        # kubeconfig whose markers sit past the head (e.g. a huge `users:`
+        # block with embedded certs first) is never misrouted.
         if not re.search(r"^(kind: Config\b|clusters:)", head, re.M):
-            return False
+            # any other top-level kind (List, Node, Pod, ... — incl. typed
+            # YAML streams) or an items: list marks a dump without paying
+            # the full multi-MB parse
+            if re.search(r"^(items:|kind: \w+)", head, re.M):
+                return False
     try:
         with open(path) as f:
             doc = yaml.safe_load(f)

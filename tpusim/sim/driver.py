@@ -274,6 +274,11 @@ class Simulator:
             out = self.replay_fn(
                 state, specs, ev_kind, ev_pod, self.typical, key, self.rank
             )
+        # name the engine in the log: the fused engine's documented f32
+        # divergence channel means TPU-vs-CPU result diffs must be
+        # diagnosable from simon.log alone (the analysis parser ignores
+        # unknown line families, so the CSV lanes are unaffected)
+        self.log.info(f"[Engine] replay of {e} events ran on: {self._last_engine}")
         return _slice_result(out, p, e)
 
     # ---- workload prep (core.go:103-142) ----
@@ -453,9 +458,27 @@ class Simulator:
         self.log.info(f"Scheduling app {name}: {len(ordered)} pods")
         return self.schedule_additional(ordered)
 
+    def _reset_run_state(self):
+        """A reused Simulator must not double-count a previous run's series:
+        the direct-CSV stashes accumulate per schedule/report call, and the
+        log-reparse lane reads whatever log the caller kept — reset both
+        lanes' inputs so they stay byte-identical for any call pattern
+        (ADVICE r4). A seekable log stream (the apply path's file) is
+        truncated too; an unseekable one keeps the old lines upstream,
+        which no reset here can unwrite."""
+        self.event_reports = []
+        self.analysis_summary = {}
+        self.failed_pod_lists = []
+        self.log.lines = []
+        s = self.log.stream
+        if s is not None and s.seekable():
+            s.seek(0)
+            s.truncate()
+
     def run(self) -> SimulateResult:
         """Full experiment (core.go:86-268 minus deschedule/inflation, which
         the CLI layers on)."""
+        self._reset_run_state()
         self.set_typical_pods()
         self.set_skyline_pods()
         pods = self.prepare_pods()
@@ -1014,11 +1037,20 @@ def schedule_pods_batch(
     lead._last_batch_device_s = time.perf_counter() - t_dev
     wall = time.perf_counter() - t0
 
+    # the logged name is the engine SEMANTICS (what a cross-backend result
+    # diff needs) and must match a single run's line exactly — the batch
+    # tests pin line-for-line log equality across execution modes; the
+    # batched-execution detail stays in _last_engine for bench labeling
+    engine_name = "table" if use_table else "sequential"
     results = []
     for i, (sim, pods) in enumerate(zip(sims, pods_list)):
         ev_kind_i, ev_pod_i = ev_list[i]
         o = _slice_result(
             jax.tree.map(lambda a: a[i], out), len(pods), len(ev_kind_i)
+        )
+        sim._last_engine = f"{engine_name} ({len(sims)}-seed vmap batch)"
+        sim.log.info(
+            f"[Engine] replay of {len(ev_kind_i)} events ran on: {engine_name}"
         )
         res, events, unscheduled, rank = sim._finish_replay(
             o, pods, ev_kind_i, ev_pod_i, sim.init_state
@@ -1059,6 +1091,7 @@ def run_batch(sims: Sequence["Simulator"]) -> List[SimulateResult]:
     batched device replay (see schedule_pods_batch)."""
     pods_list = []
     for sim in sims:
+        sim._reset_run_state()
         sim.set_typical_pods()
         sim.set_skyline_pods()
         pods_list.append(sim.prepare_pods())
