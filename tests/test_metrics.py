@@ -1,0 +1,100 @@
+"""The vectorized metrics post-pass (tpusim.sim.metrics) must reproduce the
+sequential oracle's in-scan per-event report rows: integer series exactly,
+float series to f32 tolerance (the post-pass accumulates cumulative row
+deltas where the oracle re-reduces the cluster each event — same kernels,
+different summation order). Engine-cross identity (table/pallas/batched all
+byte-identical) follows from the telemetry equality the engine tests pin."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.fixtures import random_cluster, random_pods
+from tests.test_table_engine import _events_with_deletes
+from tpusim.policies import make_policy
+from tpusim.sim.engine import EV_SKIP, make_replay
+from tpusim.sim.metrics import compute_event_metrics
+
+INT_FIELDS = (
+    "used_nodes", "used_gpus", "used_gpu_milli", "used_cpu_milli",
+    "arrived_gpu_milli", "arrived_cpu_milli",
+)
+FLOAT_FIELDS = ("frag_amounts", "power_cpu", "power_gpu")
+
+
+@pytest.mark.parametrize(
+    "policy,gpu_sel",
+    [
+        ("FGDScore", "FGDScore"),
+        ("BestFitScore", "best"),
+        ("PWRScore", "PWRScore"),
+        ("RandomScore", "random"),
+    ],
+    ids=lambda p: str(p),
+)
+def test_postpass_matches_sequential_inscan(policy, gpu_sel):
+    rng = np.random.default_rng(7)
+    state, tp = random_cluster(rng, num_nodes=14)
+    pods = random_pods(rng, num_pods=40)
+    ev_kind, ev_pod = _events_with_deletes(40, rng)
+    # inject a skip event and an unfittable pod (failed create) to exercise
+    # the telemetry's -1 rows
+    ev_kind = jnp.concatenate([ev_kind, jnp.asarray([EV_SKIP], jnp.int32)])
+    ev_pod = jnp.concatenate([ev_pod, jnp.asarray([0], jnp.int32)])
+    policies = [(make_policy(policy), 1000)]
+    key = jax.random.PRNGKey(3)
+    rank = jnp.asarray(rng.permutation(14).astype(np.int32))
+
+    seq = make_replay(policies, gpu_sel=gpu_sel, report=True)
+    oracle = seq(state, pods, ev_kind, ev_pod, tp, key, rank)
+    post = compute_event_metrics(
+        state, pods, ev_kind, ev_pod, oracle.event_node, oracle.event_dev, tp
+    )
+    for f in INT_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(post, f)), np.asarray(getattr(oracle.metrics, f)),
+            err_msg=f,
+        )
+    for f in FLOAT_FIELDS:
+        np.testing.assert_allclose(
+            np.asarray(getattr(post, f)),
+            np.asarray(getattr(oracle.metrics, f)),
+            rtol=2e-5, atol=1e-2, err_msg=f,
+        )
+
+
+def test_postpass_padding_invariance():
+    """EV_SKIP padding rows (the bucketing contract) must not perturb the
+    series of the true prefix."""
+    rng = np.random.default_rng(11)
+    state, tp = random_cluster(rng, num_nodes=10)
+    pods = random_pods(rng, num_pods=20)
+    ev_kind, ev_pod = _events_with_deletes(20, rng)
+    policies = [(make_policy("FGDScore"), 1000)]
+    key = jax.random.PRNGKey(5)
+    seq = make_replay(policies, gpu_sel="FGDScore", report=False)
+    out = seq(state, pods, ev_kind, ev_pod, tp, key, None)
+
+    e = int(ev_kind.shape[0])
+    pad = 17
+    ev_kind_p = jnp.concatenate([ev_kind, jnp.full(pad, EV_SKIP, jnp.int32)])
+    ev_pod_p = jnp.concatenate([ev_pod, jnp.zeros(pad, jnp.int32)])
+    en_p = jnp.concatenate([out.event_node, jnp.full(pad, -1, jnp.int32)])
+    ed_p = jnp.concatenate(
+        [out.event_dev, jnp.zeros((pad, 8), out.event_dev.dtype)]
+    )
+    m0 = compute_event_metrics(
+        state, pods, ev_kind, ev_pod, out.event_node, out.event_dev, tp
+    )
+    m1 = compute_event_metrics(state, pods, ev_kind_p, ev_pod_p, en_p, ed_p, tp)
+    for f in INT_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(m1, f))[:e], np.asarray(getattr(m0, f)),
+            err_msg=f,
+        )
+    for f in FLOAT_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(m1, f))[:e], np.asarray(getattr(m0, f)),
+            err_msg=f,
+        )

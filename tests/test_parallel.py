@@ -147,11 +147,13 @@ def test_sharded_table_replay_matches_unsharded():
 )
 def test_shardmap_replay_matches_unsharded(policy, gpu_sel):
     """The explicit-collective shard_map engine (parallel.shard_engine) must
-    reproduce the unsharded table engine bit-for-bit on placements/state
-    across mesh sizes, with metric rows within float partial-sum tolerance."""
+    reproduce the unsharded table engine bit-for-bit on placements/state/
+    telemetry across mesh sizes — and therefore (shared post-pass) produce
+    byte-identical per-event report series."""
     from tests.fixtures import random_cluster, random_pods
     from tests.test_table_engine import _events_with_deletes
     from tpusim.parallel.shard_engine import make_shardmap_table_replay
+    from tpusim.sim.metrics import compute_event_metrics
     from tpusim.sim.table_engine import build_pod_types, make_table_replay
 
     if len(jax.devices()) < 8:
@@ -165,16 +167,17 @@ def test_shardmap_replay_matches_unsharded(policy, gpu_sel):
     key = jax.random.PRNGKey(7)
     rank = jnp.asarray(tiebreak_rank(21, seed=3))
 
-    plain = make_table_replay(policies, gpu_sel=gpu_sel, report=True)
+    plain = make_table_replay(policies, gpu_sel=gpu_sel)
     r0 = plain(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+    m0 = compute_event_metrics(
+        state, pods, ev_kind, ev_pod, r0.event_node, r0.event_dev, tp
+    )
 
     for n_dev in (2, 8):
         mesh = make_mesh(n_dev)
         pstate, prank = pad_nodes(state, rank, n_dev)
         pstate = shard_state(pstate, mesh)
-        sharded = make_shardmap_table_replay(
-            policies, mesh, gpu_sel=gpu_sel, report=True
-        )
+        sharded = make_shardmap_table_replay(policies, mesh, gpu_sel=gpu_sel)
         r1 = sharded(pstate, pods, types, ev_kind, ev_pod, tp, key, prank)
         np.testing.assert_array_equal(
             np.asarray(r0.placed_node), np.asarray(r1.placed_node)
@@ -185,26 +188,25 @@ def test_shardmap_replay_matches_unsharded(policy, gpu_sel):
         np.testing.assert_array_equal(
             np.asarray(r0.event_node), np.asarray(r1.event_node)
         )
+        np.testing.assert_array_equal(
+            np.asarray(r0.event_dev), np.asarray(r1.event_dev)
+        )
         n = state.num_nodes
         for a, b in zip(jax.tree.leaves(r0.state), jax.tree.leaves(r1.state)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[:n])
-        # int usage counters are exact (psum of int partials); float rows
-        # agree within partial-sum reorder tolerance
-        np.testing.assert_array_equal(
-            np.asarray(r0.metrics.used_nodes), np.asarray(r1.metrics.used_nodes)
+        # identical telemetry + metric-inert pad rows -> the shared
+        # post-pass reconstructs the same report series: integer fields
+        # exactly; the f32 init totals may rebracket with the extra zero
+        # rows (within-configuration lanes stay byte-identical — the
+        # driver always post-passes the state it replayed)
+        m1 = compute_event_metrics(
+            pstate, pods, ev_kind, ev_pod, r1.event_node, r1.event_dev, tp
         )
-        np.testing.assert_array_equal(
-            np.asarray(r0.metrics.used_gpu_milli),
-            np.asarray(r1.metrics.used_gpu_milli),
-        )
-        np.testing.assert_array_equal(
-            np.asarray(r0.metrics.arrived_gpu_milli),
-            np.asarray(r1.metrics.arrived_gpu_milli),
-        )
-        for f in ("frag_amounts", "power_cpu", "power_gpu"):
-            np.testing.assert_allclose(
-                np.asarray(getattr(r0.metrics, f)),
-                np.asarray(getattr(r1.metrics, f)),
-                rtol=3e-5,
-                err_msg=f,
-            )
+        for f, a0 in zip(m0._fields, m0):
+            b0 = np.asarray(getattr(m1, f))
+            if np.asarray(a0).dtype.kind == "f":
+                np.testing.assert_allclose(
+                    np.asarray(a0), b0, rtol=2e-5, atol=1e-2, err_msg=f
+                )
+            else:
+                np.testing.assert_array_equal(np.asarray(a0), b0, err_msg=f)

@@ -23,17 +23,16 @@ scaling-book recipe says to when the partitioner's choices matter:
     from its local row (sim.step.choose_devices — the same helper the
     global engine binds with) and applies the row update; one [8]-wide psum
     publishes the device mask for the replicated bookkeeping arrays.
-  - Per-event metric rows (report=True) never synchronize inside the loop:
-    each shard emits LOCAL partial rows (frag/usage/power sums over its own
-    rows) as scan outputs, and ONE psum over the whole [E, 13] matrix after
-    the scan produces the cluster rows — zero per-event collectives beyond
-    selectHost's, vs the reference recomputing cluster metrics after every
-    event (simulator.go:426-427).
+  - Per-event metrics never touch the loop at all: like every engine since
+    round 5, the replay is metric-free and the report series is
+    reconstructed from the replicated (event_node, event_dev) telemetry by
+    the shared post-pass (tpusim.sim.metrics) — byte-identical to the
+    single-device engines by construction, vs the reference recomputing
+    cluster metrics after every event (simulator.go:426-427).
 
 Per-event collective payload: 3 scalars + one 8-lane mask, independent of
 N and D — the us/event curve stays flat as the mesh grows (MULTICHIP.md).
-Placements are bit-identical to the single-device table engine; metric
-float sums differ only in partial-sum order (local-then-psum).
+Placements are bit-identical to the single-device table engine.
 """
 
 from __future__ import annotations
@@ -43,9 +42,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
-from tpusim.ops.frag import cluster_frag_amounts
 from tpusim.policies.base import feasible_min_max, minmax_scale_i32
-from tpusim.sim.engine import EventMetrics, ReplayResult, cluster_usage, power_rows
+from tpusim.sim.engine import ReplayResult
 from tpusim.sim.step import choose_devices
 from tpusim.sim.table_engine import (
     PodTypes,
@@ -67,7 +65,15 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                                report: bool = False):
     """Build the explicit-collective sharded replayer. The node count must
     already be padded to a multiple of the mesh size (parallel.pad_nodes)
-    and `state`/`tiebreak_rank` sharded over it (parallel.shard_state)."""
+    and `state`/`tiebreak_rank` sharded over it (parallel.shard_state).
+    Metric-free like every engine; build the report series with
+    tpusim.sim.metrics.compute_event_metrics over the replicated
+    telemetry."""
+    if report:
+        raise ValueError(
+            "the shard_map engine replays metric-free; build the report "
+            "series with tpusim.sim.metrics.compute_event_metrics"
+        )
     reject_randomized(policies, gpu_sel)
     sel_idx = selector_index(policies, gpu_sel)
     _columns, _init_tables = make_table_builders(policies, sel_idx)
@@ -94,16 +100,10 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         placed = jnp.full(num_pods, -1, jnp.int32)
         masks = jnp.zeros((num_pods, MAX_GPUS_PER_NODE), jnp.bool_)
         failed = jnp.zeros(num_pods, jnp.bool_)
-        if report:
-            frag_tbl = cluster_frag_amounts(state, tp)  # local [nloc, 7]
-            pc0, pg0 = power_rows(state)
-            power_tbl = jnp.stack([pc0, pg0], -1)  # local [nloc, 2]
-        else:
-            frag_tbl = power_tbl = jnp.zeros((0,))
 
         def body(carry, ev):
             (state, packed_tbl, dirty, placed, masks, failed,
-             arr_cpu, arr_gpu, frag_tbl, power_tbl, key) = carry
+             arr_cpu, arr_gpu, key) = carry
             kind, idx = ev
             pod = jax.tree.map(lambda a: a[idx], pods)
             t_id = type_id[idx]
@@ -264,74 +264,17 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
              node, dev) = jax.lax.switch(
                 jnp.clip(kind, 0, 2), [do_create, do_delete, do_skip]
             )
-            if report:
-                # refresh the touched node's LOCAL metric rows (same kernels
-                # as the table engine's report path), emit local partials —
-                # the cross-shard sum happens ONCE after the scan
-                li2 = dirty2 - offset
-                owns2 = (li2 >= 0) & (li2 < nloc)
-                lic2 = jnp.clip(li2, 0, nloc - 1)
-
-                def refresh_metrics():
-                    row_state = _row_state(state2, lic2)
-                    fr = cluster_frag_amounts(row_state, tp)  # [1, 7]
-                    pc, pg = power_rows(row_state)
-                    return fr, jnp.stack([pc[0], pg[0]])[None, :]
-
-                fr, prow = jax.lax.cond(
-                    owns2,
-                    refresh_metrics,
-                    lambda: (
-                        jax.lax.dynamic_slice_in_dim(frag_tbl, lic2, 1, 0),
-                        jax.lax.dynamic_slice_in_dim(power_tbl, lic2, 1, 0),
-                    ),
-                )
-                frag_tbl2 = jax.lax.dynamic_update_slice_in_dim(
-                    frag_tbl, fr, lic2, 0
-                )
-                power_tbl2 = jax.lax.dynamic_update_slice_in_dim(
-                    power_tbl, prow, lic2, 0
-                )
-                un, ug, ugm, ucm = cluster_usage(state2)  # local partials
-                # float partials (frag amounts + power) and int partials
-                # (usage counters) ride separate streams: packing the int
-                # counters into f32 would lose exactness past 2^24
-                pf = jnp.concatenate([frag_tbl2.sum(0), power_tbl2.sum(0)])
-                pi = jnp.stack([un, ug, ugm, ucm])
-            else:
-                frag_tbl2, power_tbl2 = frag_tbl, power_tbl
-                pf = jnp.zeros(0, jnp.float32)
-                pi = jnp.zeros(0, jnp.int32)
             return (
                 state2, packed_tbl, dirty2, placed2, masks2, failed2,
-                arr_cpu2, arr_gpu2, frag_tbl2, power_tbl2, key,
-            ), (pf, pi, node, dev, arr_cpu2, arr_gpu2)
+                arr_cpu2, arr_gpu2, key,
+            ), (node, dev)
 
         init = (state, packed_tbl, jnp.int32(0), placed, masks, failed,
-                jnp.int32(0), jnp.int32(0), frag_tbl, power_tbl, key)
-        (state, _, _, placed, masks, failed, _, _, _, _, _), (
-            pf, pi, nodes, devs, arr_cpus, arr_gpus
+                jnp.int32(0), jnp.int32(0), key)
+        (state, _, _, placed, masks, failed, _, _, _), (
+            nodes, devs
         ) = jax.lax.scan(body, init, (ev_kind, ev_pod))
-
-        if report:
-            # the ONE cross-shard metric reduction for the whole replay
-            # (well, two: exact-int usage counters and float frag/power)
-            rows_f = jax.lax.psum(pf, NODE_AXIS)  # [E, 9]
-            rows_i = jax.lax.psum(pi, NODE_AXIS)  # [E, 4]
-            metrics = EventMetrics(
-                frag_amounts=rows_f[:, :7],
-                used_nodes=rows_i[:, 0],
-                used_gpus=rows_i[:, 1],
-                used_gpu_milli=rows_i[:, 2],
-                used_cpu_milli=rows_i[:, 3],
-                arrived_gpu_milli=arr_gpus,
-                arrived_cpu_milli=arr_cpus,
-                power_cpu=rows_f[:, 7],
-                power_gpu=rows_f[:, 8],
-            )
-        else:
-            metrics = None
-        return state, placed, masks, failed, metrics, nodes, devs
+        return state, placed, masks, failed, None, nodes, devs
 
     state_specs = NodeState(*([P(NODE_AXIS)] * len(NodeState._fields)))
     spec_r = PodSpec(*([P()] * 6))
@@ -339,15 +282,12 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     from tpusim.types import TypicalPods
 
     tp_specs = TypicalPods(*([P()] * len(TypicalPods._fields)))
-    metrics_specs = (
-        EventMetrics(*([P()] * len(EventMetrics._fields))) if report else None
-    )
     mapped = jax.shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(state_specs, P(NODE_AXIS), spec_r, types_specs,
                   P(), P(), tp_specs, P()),
-        out_specs=(state_specs, P(), P(), P(), metrics_specs, P(), P()),
+        out_specs=(state_specs, P(), P(), P(), None, P(), P()),
         check_vma=False,
     )
 

@@ -129,15 +129,15 @@ def make_sharded_table_replay(
     policies: Sequence[Tuple[object, int]],
     mesh: Mesh,
     gpu_sel: str = "best",
-    report: bool = False,
 ):
     """Sharded twin of tpusim.sim.table_engine.make_table_replay: the
-    [policy, K, N] score/feasibility/device tables (and, with report=True,
-    the per-node metric tables) inherit the node-axis sharding from the
-    cluster state, so per-event work is the one-column refresh local to the
-    owning chip plus the selectHost all-reduce."""
+    [policy, K, N] score/feasibility/device tables inherit the node-axis
+    sharding from the cluster state, so per-event work is the one-column
+    refresh local to the owning chip plus the selectHost all-reduce.
+    Metric-free like the engine it wraps — report series come from the
+    shared post-pass (tpusim.sim.metrics) over the replicated telemetry."""
     from tpusim.sim.table_engine import make_table_replay
 
     return _shard_replay_fn(
-        make_table_replay(policies, gpu_sel=gpu_sel, report=report), mesh, 1
+        make_table_replay(policies, gpu_sel=gpu_sel), mesh, 1
     )

@@ -139,20 +139,14 @@ class Simulator:
             for name, weight in self.cfg.policies
         ]
         # the sequential oracle replay; run_events() below picks between it
-        # and the incremental table engine per call
+        # and the incremental table engine per call. Engines always run
+        # metric-free: the per-event report series is reconstructed from
+        # replay telemetry by the shared post-pass (tpusim.sim.metrics) —
+        # identical across engines by construction
         self.replay_fn = make_replay(
             self._policy_fns,
             gpu_sel=self.cfg.gpu_sel_method,
-            report=self.cfg.report_per_event,
-        )
-        # incremental score-table engine (tpusim.sim.table_engine): exact
-        # same placements/state (report rows agree within float tolerance),
-        # ~4x faster —
-        # usable whenever nothing in the cycle draws per-event randomness
-        # (neither a RandomScore plugin nor a `random` Reserve gpuSelMethod,
-        # whose PRNG stream would differ between the engines)
-        self._table_ok = self.cfg.gpu_sel_method != "random" and all(
-            fn.policy_name != "RandomScore" for fn, _ in self._policy_fns
+            report=False,
         )
         # device-phase wall of the last schedule_pods_batch call this sim
         # led (dispatch + fetch, excluding host spec prep/result slicing);
@@ -168,17 +162,21 @@ class Simulator:
         self.event_reports = []
         self.analysis_summary = {}
         self.failed_pod_lists = []
-        if self._table_ok:
-            from tpusim.sim.table_engine import make_table_replay
+        from tpusim.sim.table_engine import make_table_replay
 
-            self._table_fn = make_table_replay(
-                self._policy_fns,
-                gpu_sel=self.cfg.gpu_sel_method,
-                report=self.cfg.report_per_event,
-            )
+        # incremental score-table engine (tpusim.sim.table_engine): exact
+        # same placements/state, ~4x faster. Since round 5 it also replays
+        # per-event-random configs (RandomScore / gpuSelMethod random)
+        # bit-identically — it follows the oracle's key-split discipline
+        # and recomputes the draw per event instead of reading a table row
+        self._table_fn = make_table_replay(
+            self._policy_fns,
+            gpu_sel=self.cfg.gpu_sel_method,
+            report=False,
+        )
         # fused whole-replay Pallas engine (tpusim.sim.pallas_engine): one
         # kernel for the entire event loop, ~4x the table engine on chip;
-        # single-policy no-report configs only. On CPU backends it runs in
+        # single-policy configs only. On CPU backends it runs in
         # interpreter mode — only sensible when forced (engine: pallas).
         if self.cfg.engine not in ("auto", "sequential", "table", "pallas"):
             raise ValueError(
@@ -187,19 +185,16 @@ class Simulator:
             )
         from tpusim.sim import pallas_engine
 
-        self._pallas_ok = self._table_ok and pallas_engine.supports(
-            self._policy_fns, self.cfg.gpu_sel_method, self.cfg.report_per_event
+        # report configs are no longer a pallas blocker: the engine replays
+        # metric-free and the shared post-pass reconstructs the series
+        self._pallas_ok = pallas_engine.supports(
+            self._policy_fns, self.cfg.gpu_sel_method
         )
         if self.cfg.engine == "pallas" and not self._pallas_ok:
             raise ValueError(
-                "engine: pallas requires a single-policy, no-report config "
-                "with a registered Pallas column kernel (see "
+                "engine: pallas requires a single-policy config with a "
+                "registered Pallas column kernel (see "
                 "tpusim.sim.pallas_engine.supports)"
-            )
-        if self.cfg.engine == "table" and not self._table_ok:
-            raise ValueError(
-                "engine: table cannot run per-event-random configs "
-                "(RandomScore / gpuSelMethod random); use sequential"
             )
         self._pallas_fn = None
         if self._pallas_ok and self.cfg.engine in ("auto", "pallas"):
@@ -210,7 +205,6 @@ class Simulator:
             self._pallas_fn = pallas_engine.make_pallas_replay(
                 self._policy_fns,
                 gpu_sel=self.cfg.gpu_sel_method,
-                report=self.cfg.report_per_event,
                 interpret=jax.default_backend() != "tpu",
             )
 
@@ -236,7 +230,7 @@ class Simulator:
         # dedup types from the UNPADDED specs (no spurious zero type); the
         # type_id axis is padded alongside the pod axis (padded events only
         # ever reference pod 0)
-        if not self._table_ok or self.cfg.engine == "sequential":
+        if self.cfg.engine == "sequential":
             types = None
         elif types is None:
             types = build_pod_types(specs)
@@ -273,6 +267,19 @@ class Simulator:
             self._last_engine = "sequential"
             out = self.replay_fn(
                 state, specs, ev_kind, ev_pod, self.typical, key, self.rank
+            )
+        if self.cfg.report_per_event:
+            # the per-event report series, reconstructed from the replay's
+            # telemetry by the shared vectorized post-pass (still on
+            # device: the caller's device_fetch moves everything in one
+            # transfer)
+            from tpusim.sim.metrics import compute_event_metrics
+
+            out = out._replace(
+                metrics=compute_event_metrics(
+                    state, specs, ev_kind, ev_pod, out.event_node,
+                    out.event_dev, self.typical,
+                )
             )
         # name the engine in the log: the fused engine's documented f32
         # divergence channel means TPU-vs-CPU result diffs must be
@@ -463,17 +470,14 @@ class Simulator:
         the direct-CSV stashes accumulate per schedule/report call, and the
         log-reparse lane reads whatever log the caller kept — reset both
         lanes' inputs so they stay byte-identical for any call pattern
-        (ADVICE r4). A seekable log stream (the apply path's file) is
-        truncated too; an unseekable one keeps the old lines upstream,
-        which no reset here can unwrite."""
+        (ADVICE r4). An attached log stream is NOT rewound — the apply path
+        wires sys.stdout there, possibly shell-redirected into a file we
+        must not clobber; callers re-dumping sim.log after the last run
+        (the run.py flow) always get the consistent single-run log."""
         self.event_reports = []
         self.analysis_summary = {}
         self.failed_pod_lists = []
         self.log.lines = []
-        s = self.log.stream
-        if s is not None and s.seekable():
-            s.seek(0)
-            s.truncate()
 
     def run(self) -> SimulateResult:
         """Full experiment (core.go:86-268 minus deschedule/inflation, which
@@ -894,6 +898,24 @@ def _slice_result(out, p: int, e: int):
 # reductions may order f32 partial sums differently).
 
 _BATCH_WRAP_CACHE = {}
+_BATCHED_METRICS_FN = None
+
+
+def _batched_metrics_fn():
+    """compute_event_metrics vmapped over the seed axis (shared cluster +
+    typical pods, per-seed specs/events/telemetry)."""
+    global _BATCHED_METRICS_FN
+    if _BATCHED_METRICS_FN is None:
+        from tpusim.sim.metrics import compute_event_metrics
+        from tpusim.types import PodSpec
+
+        _BATCHED_METRICS_FN = jax.jit(
+            jax.vmap(
+                compute_event_metrics,
+                in_axes=(None, PodSpec(0, 0, 0, 0, 0, 0), 0, 0, 0, 0, None),
+            )
+        )
+    return _BATCHED_METRICS_FN
 
 
 def _batched_engine(fn, table: bool):
@@ -965,7 +987,7 @@ def schedule_pods_batch(
     # engine knob: `sequential` is honored; `pallas` has no batched form
     # (vmap over the fused kernel is untested), so batches run the
     # bit-identical table engine (SimulatorConfig.engine docstring)
-    use_table = lead._table_ok and lead.cfg.engine != "sequential"
+    use_table = lead.cfg.engine != "sequential"
     tids = [None] * len(sims)
     if use_table:
         # one shared type table across the batch: dedup over the
@@ -979,7 +1001,20 @@ def schedule_pods_batch(
         )
         types = build_pod_types(cat)
         k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
-        if k == 0 or e < 2 * k:
+        # auto: same amortization heuristic run_events applies, per seed
+        # (table init costs K node-sweeps; only worth it with enough
+        # events); a forced engine='table' is honored whenever any type
+        # exists, exactly like the single-run path — so the [Engine] log
+        # lines cannot diverge between batched and standalone execution
+        from tpusim.sim.table_engine import num_pod_types
+
+        if k == 0 or (
+            lead.cfg.engine != "table"
+            and any(
+                len(kinds) < 2 * num_pod_types(s)
+                for s, (kinds, _) in zip(specs_list, ev_list)
+            )
+        ):
             use_table = False
         else:
             offs = np.cumsum([0] + [int(s.cpu.shape[0]) for s in specs_list])
@@ -1029,6 +1064,13 @@ def schedule_pods_batch(
         out = fn(
             lead.init_state, specs_b, ev_kind_b, ev_pod_b,
             lead.typical, keys, ranks,
+        )
+    if lead.cfg.report_per_event:
+        out = out._replace(
+            metrics=_batched_metrics_fn()(
+                lead.init_state, specs_b, ev_kind_b, ev_pod_b,
+                out.event_node, out.event_dev, lead.typical,
+            )
         )
     out = device_fetch(out)
     # device-phase wall (replay dispatch + fetch), excluding the host-side

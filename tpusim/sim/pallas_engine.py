@@ -38,9 +38,12 @@ Scope: single-policy configurations (the reference's own experiment protocol
 enables one Score plugin at weight 1000, SURVEY.md §5.6) whose policy has a
 column kernel in PALLAS_COLUMNS — FGD, BestFit, GpuPacking, GpuClustering,
 PWR, and DotProduct (all 4 dim-extension methods) — with gpu_sel in {best,
-worst, policy self-select} and report_per_event=False. driver.run_events
-picks this engine automatically on TPU backends and falls back to the
-table/sequential engines otherwise.
+worst, policy self-select}. Per-event reporting configs run here too since
+round 5: the kernel replays metric-free and the shared post-pass
+(tpusim.sim.metrics) reconstructs the report series from the emitted
+(event_node, event_dev) telemetry. driver.run_events picks this engine
+automatically on TPU backends and falls back to the table/sequential
+engines otherwise.
 """
 
 from __future__ import annotations
@@ -544,9 +547,12 @@ PALLAS_COLUMNS = {
 _SUPPORTED_GPU_SEL = {"best", "worst"} | SELF_SELECT_POLICIES
 
 
-def supports(policies, gpu_sel: str, report: bool) -> bool:
-    """Whether make_pallas_replay can run this configuration."""
-    if report or len(policies) != 1:
+def supports(policies, gpu_sel: str) -> bool:
+    """Whether make_pallas_replay can run this configuration. Per-event
+    reporting is no longer gated here: engines replay metric-free and the
+    shared post-pass (tpusim.sim.metrics) reconstructs the report series
+    from the telemetry this kernel already emits."""
+    if len(policies) != 1:
         return False
     fn, _ = policies[0]
     if _resolve_column(fn) is None:
@@ -913,7 +919,7 @@ _PALLAS_REPLAY_CACHE = {}
 
 
 def make_pallas_replay(
-    policies, gpu_sel: str = "best", report: bool = False, interpret: bool = False
+    policies, gpu_sel: str = "best", interpret: bool = False
 ):
     """Build the fused single-kernel replayer. Same call signature as the
     table engine's replay (state, pods, types, ev_kind, ev_pod, tp, key,
@@ -921,11 +927,11 @@ def make_pallas_replay(
     accepted but unused — every supported configuration is deterministic
     (reject_randomized guarantees it)."""
     reject_randomized(policies, gpu_sel)
-    if not supports(policies, gpu_sel, report):
+    if not supports(policies, gpu_sel):
         raise ValueError(
-            "pallas engine supports single-policy no-report configs with a "
+            "pallas engine supports single-policy configs with a "
             f"registered column kernel; got {[f.policy_name for f, _ in policies]}"
-            f" / gpu_sel={gpu_sel} / report={report}"
+            f" / gpu_sel={gpu_sel}"
         )
     cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, interpret)
     if cache_key in _PALLAS_REPLAY_CACHE:

@@ -18,9 +18,15 @@ device masks, final state) are bit-identical to the sequential engine — the
 same kernels run, just at different times; tests/test_table_engine.py pins
 equality on the full openb trace prefix and randomized create/delete mixes.
 
-Not table-izable: RandomScore (its score is a per-event PRNG draw over the
-feasible mask, plugin/random_score.go:42-68). make_table_replay rejects it;
-the driver falls back to the sequential engine.
+RandomScore (a per-event PRNG draw over the feasible mask,
+plugin/random_score.go:42-68) is NOT table-izable — its score row changes
+every event — but since round 5 it runs here anyway: the replay body
+follows the sequential engine's key-split discipline exactly (one split
+per event, then (k_rand, k_sel) off the sub-key), so the per-event draw is
+recomputed in do_create from the same key and the same feasible mask the
+oracle sees, bit-identically. The same holds for gpu_sel='random' (the
+Reserve-phase draw consumes k_sel in both engines). Only the fused Pallas
+engine still rejects per-event randomness (reject_randomized).
 """
 
 from __future__ import annotations
@@ -32,14 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpusim.constants import MAX_GPUS_PER_NODE
-from tpusim.ops.frag import cluster_frag_amounts
 from tpusim.policies import ScoreContext, minmax_normalize_i32, pwr_normalize_i32
-from tpusim.sim.engine import (
-    EventMetrics,
-    ReplayResult,
-    assemble_metrics_row,
-    power_rows,
-)
+from tpusim.sim.engine import ReplayResult
 from tpusim.sim.step import (
     SELF_SELECT_POLICIES,
     Placement,
@@ -77,11 +77,11 @@ def _to_specs(uniq: np.ndarray) -> PodSpec:
     )
 
 
-def build_pod_types(specs: PodSpec) -> PodTypes:
-    """Host-side dedup of pod resource specs. `pinned` is deliberately not
-    part of the type key — node pinning is a per-event feasibility mask, not
-    a property the score tables see."""
-    cols = np.stack(
+def _type_cols(specs: PodSpec) -> np.ndarray:
+    """The [P, 5] dedup key matrix (pinned is deliberately not part of the
+    type key — node pinning is a per-event feasibility mask, not a property
+    the score tables see)."""
+    return np.stack(
         [
             np.asarray(specs.cpu),
             np.asarray(specs.mem),
@@ -91,6 +91,17 @@ def build_pod_types(specs: PodSpec) -> PodTypes:
         ],
         axis=1,
     )
+
+
+def num_pod_types(specs: PodSpec) -> int:
+    """Distinct pod resource types in a spec set (the K the table engine's
+    amortization heuristic weighs against the event count)."""
+    return int(np.unique(_type_cols(specs), axis=0).shape[0])
+
+
+def build_pod_types(specs: PodSpec) -> PodTypes:
+    """Host-side dedup of pod resource specs."""
+    cols = _type_cols(specs)
     uniq, inv = np.unique(cols, axis=0, return_inverse=True)
     # is_gpu_share (types.py): exactly one GPU, fractional milli
     is_share = (uniq[:, 3] == 1) & (uniq[:, 2] > 0) & (uniq[:, 2] < 1000)
@@ -151,19 +162,20 @@ _TABLE_REPLAY_CACHE = {}
 
 
 def reject_randomized(policies, gpu_sel: str):
-    """Table-izability guard shared by the table and pallas engines:
-    anything drawing per-event randomness would silently break their
-    bit-identical contract with the sequential oracle."""
+    """Guard for the fused Pallas engine: per-event PRNG draws cannot run
+    inside the fused kernel (no jax.random there), so randomized configs
+    stay on the table/sequential engines (which replay them
+    bit-identically to each other since round 5)."""
     for fn, _ in policies:
         if fn.policy_name == "RandomScore":
             raise ValueError(
-                "RandomScore draws per-event randomness; use the sequential "
-                "engine (make_replay) for it"
+                "RandomScore draws per-event randomness; use the table or "
+                "sequential engine for it"
             )
     if gpu_sel == "random":
         raise ValueError(
-            "gpu_sel='random' draws per-event randomness; use the "
-            "sequential engine (make_replay) for it"
+            "gpu_sel='random' draws per-event randomness; use the table or "
+            "sequential engine for it"
         )
 
 
@@ -206,6 +218,11 @@ def make_table_builders(policies, sel_idx: int):
             scores = []
             sdev = jnp.full(state.num_nodes, -1, jnp.int32)
             for i, (fn, _) in enumerate(policies):
+                if fn.policy_name == "RandomScore":
+                    # its score row is a per-event draw the replay body
+                    # recomputes; the table slot is never read
+                    scores.append(jnp.zeros(state.num_nodes, jnp.int32))
+                    continue
                 res = _group_fn(fn, which)(state, tpod, ctx)
                 scores.append(res.raw_scores)
                 if i == sel_idx:
@@ -243,16 +260,19 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
     policies: [(policy_fn, weight)] — all must be table-izable (raw score a
     pure function of node state + pod spec; RandomScore is not).
 
-    report=True emits the per-event metric rows (frag/alloc/power — the
-    reference recomputes these cluster-wide after every event,
-    simulator.go:426-427, its dominant cost). Here per-node frag/power
-    metric tables are refreshed only for the event's touched node and
-    reduced per event. Placements/devices/state stay bit-identical to the
-    sequential engine; the float metric rows agree within last-ulp
-    tolerance (the same kernels run, but XLA may fuse the single-row
-    refresh differently from the full-cluster sweep).
+    The replay is metric-free: per-event report rows (the reference
+    recomputes frag/alloc/power cluster-wide after every event,
+    simulator.go:426-427, its dominant cost) are reconstructed from the
+    emitted (event_node, event_dev) telemetry by the shared vectorized
+    post-pass, tpusim.sim.metrics.compute_event_metrics — identical across
+    engines by construction. `report` is accepted for signature
+    compatibility and must be False.
     """
-    reject_randomized(policies, gpu_sel)
+    if report:
+        raise ValueError(
+            "the table engine replays metric-free; build the report series "
+            "with tpusim.sim.metrics.compute_event_metrics"
+        )
     cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report)
     if cache_key in _TABLE_REPLAY_CACHE:
         return _TABLE_REPLAY_CACHE[cache_key]
@@ -277,31 +297,32 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
             tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
         type_id = types.type_id
 
-        key, k_init = jax.random.split(key)
-        score_tbl, sdev_tbl, feas_tbl = _init_tables(state, types, tp, k_init)
+        # the event key chain must stay byte-for-byte the sequential
+        # oracle's (it never burns a split before its scan), so the random
+        # replay path below sees identical per-event keys; no table-ized
+        # column kernel consumes rng, so init can reuse the root key as-is
+        score_tbl, sdev_tbl, feas_tbl = _init_tables(state, types, tp, key)
 
         placed = jnp.full(num_pods, -1, jnp.int32)
         masks = jnp.zeros((num_pods, MAX_GPUS_PER_NODE), jnp.bool_)
         failed = jnp.zeros(num_pods, jnp.bool_)
-        if report:
-            frag_tbl = cluster_frag_amounts(state, tp)  # f32[N, 7]
-            pc0, pg0 = power_rows(state)
-            power_tbl = jnp.stack([pc0, pg0], -1)  # f32[N, 2]
-        else:
-            frag_tbl = power_tbl = jnp.zeros((0,))
 
         def body(carry, ev):
             (state, score_tbl, sdev_tbl, feas_tbl, dirty,
-             placed, masks, failed, arr_cpu, arr_gpu,
-             frag_tbl, power_tbl, key) = carry
+             placed, masks, failed, arr_cpu, arr_gpu, key) = carry
             kind, idx = ev
             pod = jax.tree.map(lambda a: a[idx], pods)
             t_id = type_id[idx]
-            key, k_col, k_sel = jax.random.split(key, 3)
+            # the sequential oracle's split discipline exactly (engine.py
+            # body: key, sub = split(key); schedule_one: k_rand, k_sel =
+            # split(sub)) — this is what makes the per-event random draws
+            # below bit-identical to the oracle's
+            key, sub = jax.random.split(key)
+            k_rand, k_sel = jax.random.split(sub)
 
             # refresh the one column whose node changed last event
             col_scores, col_sdev, col_feas = _columns(
-                _row_state(state, dirty), types, tp, k_col
+                _row_state(state, dirty), types, tp, k_rand
             )
             score_tbl = jax.lax.dynamic_update_slice(
                 score_tbl, col_scores[:, :, None], (0, 0, dirty)
@@ -319,7 +340,15 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
                 )
                 total = jnp.zeros(n, jnp.int32)
                 for i, (fn, weight) in enumerate(policies):
-                    raw = score_tbl[i, t_id]
+                    if fn.policy_name == "RandomScore":
+                        # per-event draw, recomputed instead of table-read —
+                        # through the ONE canonical kernel (the oracle's
+                        # schedule_one calls the same fn with the same
+                        # feasible mask and k_rand)
+                        ctx = ScoreContext(tp=tp, feasible=feasible, rng=k_rand)
+                        raw = fn(state, pod, ctx).raw_scores
+                    else:
+                        raw = score_tbl[i, t_id]
                     if fn.normalize == "minmax":
                         raw = minmax_normalize_i32(raw, feasible)
                     elif fn.normalize == "pwr":
@@ -368,41 +397,19 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
              node, dev) = jax.lax.switch(
                 jnp.clip(kind, 0, 2), [do_create, do_delete, do_skip]
             )
-            if report:
-                # refresh the touched node's metric rows post-commit (via
-                # the SAME vmapped kernels the init/sequential paths use),
-                # then reduce the per-row-recomputed tables
-                row = _row_state(state2, dirty2)
-                fr = cluster_frag_amounts(row, tp)  # [1, 7]
-                pc, pg = power_rows(row)
-                frag_tbl2 = jax.lax.dynamic_update_slice(
-                    frag_tbl, fr, (dirty2, 0)
-                )
-                power_tbl2 = jax.lax.dynamic_update_slice(
-                    power_tbl, jnp.stack([pc[0], pg[0]])[None, :], (dirty2, 0)
-                )
-                mrow = assemble_metrics_row(
-                    frag_tbl2.sum(0), state2, arr_cpu2, arr_gpu2,
-                    power_tbl2[:, 0].sum(), power_tbl2[:, 1].sum(),
-                )
-            else:
-                frag_tbl2, power_tbl2, mrow = frag_tbl, power_tbl, ()
             return (
                 state2, score_tbl, sdev_tbl, feas_tbl, dirty2,
-                placed2, masks2, failed2, arr_cpu2, arr_gpu2,
-                frag_tbl2, power_tbl2, key,
-            ), (mrow, node, dev)
+                placed2, masks2, failed2, arr_cpu2, arr_gpu2, key,
+            ), (node, dev)
 
         init = (state, score_tbl, sdev_tbl, feas_tbl, jnp.int32(0),
-                placed, masks, failed, jnp.int32(0), jnp.int32(0),
-                frag_tbl, power_tbl, key)
+                placed, masks, failed, jnp.int32(0), jnp.int32(0), key)
         # unroll amortizes per-iteration fixed costs (~20% wall on the openb
         # replay); higher factors showed no further gain
-        (state, _, _, _, _, placed, masks, failed, _, _, _, _, _), (
-            rows, nodes, devs
+        (state, _, _, _, _, placed, masks, failed, _, _, _), (
+            nodes, devs
         ) = jax.lax.scan(body, init, (ev_kind, ev_pod), unroll=4)
-        metrics = EventMetrics(*rows) if report else None
-        return ReplayResult(state, placed, masks, failed, metrics, nodes, devs)
+        return ReplayResult(state, placed, masks, failed, None, nodes, devs)
 
     _TABLE_REPLAY_CACHE[cache_key] = replay
     return replay
