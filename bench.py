@@ -11,6 +11,12 @@ Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "placements/sec", "vs_baseline": N}
 plus auxiliary quality numbers (GPU allocation ratio) on stderr.
 
+Methodology (pinned round 5, the ONE protocol behind every throughput
+number in BENCH_r*/BENCH_DETAILS/ENGINES.md): stable minimum over
+WARM_RUNS (6) warm replays after one compile run — the tunneled chip's
+wall clocks vary ±20% run to run, and the minimum estimates the
+noise-free device cost; raw samples ship alongside (wall_samples_s).
+
 `--all` additionally measures every sweep policy (the 6 reference-cached
 methods + PWR), pinning the sequential path's throughput (RandomScore /
 gpu_sel=random cannot use the table engine) and the 16-seed batched
@@ -29,6 +35,10 @@ sys.path.insert(0, REPO)
 # Implied reference throughput: 8152 placements / ~10 min on 2 vCPU
 # (BASELINE.md "Implied placement throughput").
 BASELINE_PLACEMENTS_PER_SEC = 13.59
+
+# warm replays per measurement; headline = min over these (the stable
+# minimum — see measure_policy)
+WARM_RUNS = 6
 
 # (name, policies, gpu_sel, dim_ext, norm) — the sweep's method configs
 # (experiments/generate_run_scripts.py METHODS)
@@ -101,9 +111,16 @@ def measure_policy(nodes, pods, name, policies, gpu_sel, dim_ext, norm):
     t0 = time.perf_counter()
     result = run()
     compile_and_first = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    result = run()
-    wall = time.perf_counter() - t0
+    # THE methodology (pinned round 5, used by every throughput number in
+    # BENCH/BENCH_DETAILS/ENGINES): stable minimum over WARM_RUNS warm
+    # replays — the minimum estimates the tunnel-noise-free device cost on
+    # a link with ±20% run-to-run variance; all samples are reported
+    samples = []
+    for _ in range(WARM_RUNS):
+        t0 = time.perf_counter()
+        result = run()
+        samples.append(time.perf_counter() - t0)
+    wall = min(samples)
 
     events = int(ev_kind.shape[0])
     unscheduled = int(np.asarray(result.ever_failed).sum())
@@ -115,6 +132,7 @@ def measure_policy(nodes, pods, name, policies, gpu_sel, dim_ext, norm):
         "events": events,
         "placements": placements,
         "wall_s": round(wall, 3),
+        "wall_samples_s": [round(s, 3) for s in samples],
         "placements_per_sec": round(placements / wall, 1),
         "gpu_alloc_pct": round(gpu_alloc_pct(state), 2),
         "compile_first_s": round(compile_and_first, 1),
@@ -152,13 +170,18 @@ def measure_batched(nodes, pods, seeds=16):
     sims = [mk(42 + s) for s in range(seeds)]
     pods_lists = [s.prepare_pods() for s in sims]
     schedule_pods_batch(sims, pods_lists)  # compile + first
-    t0 = time.perf_counter()
-    results = schedule_pods_batch(sims, pods_lists)
-    wall = time.perf_counter() - t0
+    # same stable-minimum protocol as measure_policy, over the device phase
+    walls, dev_walls = [], []
+    for _ in range(WARM_RUNS):
+        t0 = time.perf_counter()
+        results = schedule_pods_batch(sims, pods_lists)
+        walls.append(time.perf_counter() - t0)
+        dev_walls.append(sims[0]._last_batch_device_s)
+    wall = min(walls)
     # like-for-like with the per-policy rows (which time only the device
     # replay): throughput over the device phase; total wall (incl. host
     # spec prep + result slicing) reported alongside
-    device_wall = sims[0]._last_batch_device_s
+    device_wall = min(dev_walls)
     placements = sum(
         r.events - len(r.unscheduled_pods) for r in results
     )
@@ -168,6 +191,7 @@ def measure_batched(nodes, pods, seeds=16):
         "events": sum(r.events for r in results),
         "placements": placements,
         "wall_s": round(device_wall, 3),
+        "wall_samples_s": [round(s, 3) for s in dev_walls],
         "wall_incl_host_prep_s": round(wall, 3),
         "placements_per_sec": round(placements / device_wall, 1),
         "gpu_alloc_pct": round(

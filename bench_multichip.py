@@ -117,11 +117,11 @@ def main():
             from tpusim.parallel.shard_engine import make_shardmap_table_replay
 
             replay = make_shardmap_table_replay(
-                policies, mesh, gpu_sel="FGDScore", report=False
+                policies, mesh, gpu_sel="FGDScore"
             )
         else:
             replay = make_sharded_table_replay(
-                policies, mesh, gpu_sel="FGDScore", report=False
+                policies, mesh, gpu_sel="FGDScore"
             )
 
         t0 = time.perf_counter()

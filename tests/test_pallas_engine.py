@@ -84,6 +84,35 @@ def test_pallas_dotprod_dim_ext(dim_ext, norm):
     _assert_equal(r0, r1)
 
 
+@pytest.mark.parametrize(
+    "weights", [(500, 500), (100, 900), (50, 950)], ids=lambda w: f"{w[0]}"
+)
+def test_pallas_weighted_multi_policy(weights):
+    """The reference's PWR+FGD weighted mixes (generate_run_scripts.py
+    AllMethodList rows 08/11/12) run fused: Σ wᵢ·normalizeᵢ(colᵢ) in i32,
+    placements bit-identical to the table engine."""
+    rng = np.random.default_rng(47)
+    state, tp = random_cluster(rng, num_nodes=24)
+    pods = random_pods(rng, num_pods=40)
+    ev_kind, ev_pod = _events_with_deletes(40, rng)
+    rank = jnp.asarray(rng.permutation(24).astype(np.int32))
+    policies = [
+        (make_policy("PWRScore"), weights[0]),
+        (make_policy("FGDScore"), weights[1]),
+    ]
+    key = jax.random.PRNGKey(3)
+    types = build_pod_types(pods)
+    r0 = make_table_replay(policies, gpu_sel="FGDScore")(
+        state, pods, types, ev_kind, ev_pod, tp, key, rank
+    )
+    r1 = make_pallas_replay(policies, gpu_sel="FGDScore", interpret=True)(
+        state, pods, types, ev_kind, ev_pod, tp, key, rank
+    )
+    _assert_equal(r0, r1)
+    assert np.array_equal(np.asarray(r0.event_node), np.asarray(r1.event_node))
+    assert np.array_equal(np.asarray(r0.event_dev), np.asarray(r1.event_dev))
+
+
 def test_pallas_fgd_gpu_sel_best():
     """gpuSelMethod=best routes Reserve through the best-fit device pick
     instead of FGD's own (open_gpu_share.go:285-304)."""
@@ -177,8 +206,11 @@ def test_supports_gating():
     assert supports([(fgd, 1000)], "best")
     assert supports([(bestfit, 1000)], "best")
     assert not supports([(fgd, 1000)], "random")
-    assert not supports([(fgd, 1000), (bestfit, 1)], "best")
+    # weighted mixes run fused since round 5 when every policy has a column
+    assert supports([(fgd, 1000), (bestfit, 1)], "best")
+    assert supports([(make_policy("PWRScore"), 500), (fgd, 500)], "FGDScore")
     assert not supports([(simon, 1000)], "best")  # no column
+    assert not supports([(fgd, 1000), (simon, 1)], "best")  # one lacks a column
     assert not supports([(fgd, 1000)], "PWRScore")
     with pytest.raises(ValueError):
         make_pallas_replay([(rand, 1000)], gpu_sel="best")

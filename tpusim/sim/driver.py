@@ -176,8 +176,8 @@ class Simulator:
         )
         # fused whole-replay Pallas engine (tpusim.sim.pallas_engine): one
         # kernel for the entire event loop, ~4x the table engine on chip;
-        # single-policy configs only. On CPU backends it runs in
-        # interpreter mode — only sensible when forced (engine: pallas).
+        # needs a column kernel per enabled policy. On CPU backends it runs
+        # in interpreter mode — only sensible when forced (engine: pallas).
         if self.cfg.engine not in ("auto", "sequential", "table", "pallas"):
             raise ValueError(
                 f"unknown engine {self.cfg.engine!r}: expected auto | "
@@ -192,9 +192,9 @@ class Simulator:
         )
         if self.cfg.engine == "pallas" and not self._pallas_ok:
             raise ValueError(
-                "engine: pallas requires a single-policy config with a "
-                "registered Pallas column kernel (see "
-                "tpusim.sim.pallas_engine.supports)"
+                "engine: pallas requires a registered Pallas column kernel "
+                "for every enabled policy and a non-random gpuSelMethod "
+                "(see tpusim.sim.pallas_engine.supports)"
             )
         self._pallas_fn = None
         if self._pallas_ok and self.cfg.engine in ("auto", "pallas"):

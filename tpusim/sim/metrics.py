@@ -95,7 +95,6 @@ def compute_event_metrics(
 ) -> EventMetrics:
     """EventMetrics for a replayed event stream, from telemetry alone."""
     n = init_state.num_nodes
-    e = int(ev_kind.shape[0])
     pod = jax.tree.map(lambda a: a[ev_pod], specs)
 
     valid = event_node >= 0

@@ -34,16 +34,18 @@ identical to the table engine on the full openb trace in the TPU lane
 (tests/test_tpu.py); the CPU lane pins interpreter-mode equality on
 randomized small traces (tests/test_pallas_engine.py).
 
-Scope: single-policy configurations (the reference's own experiment protocol
-enables one Score plugin at weight 1000, SURVEY.md §5.6) whose policy has a
-column kernel in PALLAS_COLUMNS — FGD, BestFit, GpuPacking, GpuClustering,
-PWR, and DotProduct (all 4 dim-extension methods) — with gpu_sel in {best,
-worst, policy self-select}. Per-event reporting configs run here too since
-round 5: the kernel replays metric-free and the shared post-pass
-(tpusim.sim.metrics) reconstructs the report series from the emitted
-(event_node, event_dev) telemetry. driver.run_events picks this engine
-automatically on TPU backends and falls back to the table/sequential
-engines otherwise.
+Scope: configurations where EVERY enabled Score plugin has a column kernel
+in PALLAS_COLUMNS — FGD, BestFit, GpuPacking, GpuClustering, PWR, and
+DotProduct (all 4 dim-extension methods) — with gpu_sel in {best, worst,
+enabled self-select policy}. That covers the reference's full experiment
+protocol: the single-plugin-at-weight-1000 rows (SURVEY.md §5.6) AND the
+PWR+FGD weighted mixes (generate_run_scripts.py rows 08/11/12), whose
+Σ wᵢ·normalizeᵢ(colᵢ) accumulation runs fused since round 5. Per-event
+reporting configs run here too: the kernel replays metric-free and the
+shared post-pass (tpusim.sim.metrics) reconstructs the report series from
+the emitted (event_node, event_dev) telemetry. driver.run_events picks
+this engine automatically on TPU backends and falls back to the
+table/sequential engines otherwise.
 """
 
 from __future__ import annotations
@@ -551,17 +553,21 @@ def supports(policies, gpu_sel: str) -> bool:
     """Whether make_pallas_replay can run this configuration. Per-event
     reporting is no longer gated here: engines replay metric-free and the
     shared post-pass (tpusim.sim.metrics) reconstructs the report series
-    from the telemetry this kernel already emits."""
-    if len(policies) != 1:
+    from the telemetry this kernel already emits. Weighted multi-policy
+    configs (the reference's PWR+FGD mixes,
+    generate_run_scripts.py:39-41) run fused since round 5 — every
+    enabled policy needs a column kernel."""
+    if not policies:
         return False
-    fn, _ = policies[0]
-    if _resolve_column(fn) is None:
+    if any(_resolve_column(fn) is None for fn, _ in policies):
         return False
     if gpu_sel not in _SUPPORTED_GPU_SEL:
         return False
-    # a self-select gpuSelMethod must name the enabled policy (otherwise
+    # a self-select gpuSelMethod must name an enabled policy (otherwise
     # there is no sdev source; the reference would fail plugin lookup too)
-    if gpu_sel in SELF_SELECT_POLICIES and gpu_sel != fn.policy_name:
+    if gpu_sel in SELF_SELECT_POLICIES and gpu_sel not in {
+        fn.policy_name for fn, _ in policies
+    }:
         return False
     return True
 
@@ -607,10 +613,16 @@ def _pack_events(specs: PodSpec, type_id, ev_kind, ev_pod):
 _CH = 128  # lane-chunk width: the node/event axes are laid out [*, C, 128]
 
 
-def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
-    """The fused replay kernel for a static (column_fn, Ks, normalize,
-    gpu_sel, weight) configuration. See module docstring for the masked-op
-    calculus; every step mirrors a line of sim/step.py or table_engine.py.
+def _make_kernel(columns, ks, gpu_sel):
+    """The fused replay kernel for a static configuration. `columns` is a
+    tuple of (column_fn, normalize, weight, is_selector) — one per enabled
+    Score plugin; multi-policy rows accumulate Σ wᵢ · normalizeᵢ(colᵢ) in
+    i32 exactly like the table engine's do_create (and the vendored
+    RunScorePlugins weighted sum). The score table stacks per-policy
+    blocks as [n_pol·K, C, 128]; the sdev table carries only the
+    gpuSelMethod selector's Reserve picks. See module docstring for the
+    masked-op calculus; every step mirrors a line of sim/step.py or
+    table_engine.py.
 
     Layout (round-4 v2): the node axis is chunked as (C, 128) and the
     tables as [K, C, 128], because Mosaic supports dynamic slicing on
@@ -619,6 +631,7 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
     (.., 1, 128) chunk instead of rewriting whole [K, N] tables — ~12x
     less masked-write traffic per event than the v1 flat layout."""
     self_select = gpu_sel in SELF_SELECT_POLICIES
+    n_pol = len(columns)
 
     def kernel(
         ev_ref,  # [F, Ec, 128] i32
@@ -637,7 +650,7 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
         dirty,  # SMEM (1,) i32
     ):
         i = pl.program_id(0)
-        kdim, nc, _ = score_ref.shape
+        kdim, nc, _ = feas_ref.shape  # K types; score_ref is [n_pol*K,..]
         n = nc * _CH
         p = placed_ref.shape[1]
 
@@ -685,7 +698,18 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
 
         def refresh_column(d):
             node = node_scalars(d)
-            col_score, col_sdev = column_fn(node, types, tp, aux)
+            col_scores = []
+            col_sdev = jnp.full((kdim, 1), -1, jnp.int32)
+            for column_fn, _, _, is_sel in columns:
+                cs, cd = column_fn(node, types, tp, aux)
+                col_scores.append(cs)
+                if is_sel:
+                    col_sdev = cd
+            col_score = (
+                col_scores[0]
+                if n_pol == 1
+                else jnp.concatenate(col_scores, axis=0)
+            )  # (n_pol*K, 1)
             col_feas = _feas_column(node, types)
             c, l = d // _CH, d % _CH
             hit = (lane1 == l).reshape(1, 1, _CH)
@@ -694,9 +718,9 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
                 (sdev_ref, col_sdev),
                 (feas_ref, col_feas),
             ):
-                blk = ref[:, pl.ds(c, 1), :]  # (K,1,128)
+                blk = ref[:, pl.ds(c, 1), :]  # (rows,1,128)
                 ref[:, pl.ds(c, 1), :] = jnp.where(
-                    hit, col.reshape(kdim, 1, 1), blk
+                    hit, col.reshape(col.shape[0], 1, 1), blk
                 )
 
         @pl.when(i == 0)
@@ -760,22 +784,26 @@ def _make_kernel(column_fn, ks, normalize, gpu_sel, weight):
         # ---- creation: Filter -> Score row -> selectHost -> Reserve -> Bind
         @pl.when(kind == 0)
         def _():
-            raw = score_ref[pl.ds(tid, 1), :, :].reshape(nc, _CH)
             feas_row = feas_ref[pl.ds(tid, 1), :, :].reshape(nc, _CH) != 0
             # nodeSelector pinning is a per-event mask, not a table column
             feasible = feas_row & ((ppin < 0) | (nid == ppin))
-            if normalize in ("minmax", "pwr"):
-                lo = jnp.min(jnp.where(feasible, raw, _INT_MAX))
-                hi = jnp.max(jnp.where(feasible, raw, -_INT_MAX))
-                rngv = hi - lo
-                degen = 0 if normalize == "minmax" else MAX_NODE_SCORE
-                scaled = jnp.where(
-                    rngv == 0,
-                    degen,
-                    (raw - lo) * MAX_NODE_SCORE // jnp.maximum(rngv, 1),
+            total = jnp.zeros((nc, _CH), jnp.int32)
+            for pi, (_, normalize, weight, _) in enumerate(columns):
+                raw = score_ref[pl.ds(tid + pi * kdim, 1), :, :].reshape(
+                    nc, _CH
                 )
-                raw = jnp.where(feasible, scaled, raw)
-            total = weight * raw
+                if normalize in ("minmax", "pwr"):
+                    lo = jnp.min(jnp.where(feasible, raw, _INT_MAX))
+                    hi = jnp.max(jnp.where(feasible, raw, -_INT_MAX))
+                    rngv = hi - lo
+                    degen = 0 if normalize == "minmax" else MAX_NODE_SCORE
+                    scaled = jnp.where(
+                        rngv == 0,
+                        degen,
+                        (raw - lo) * MAX_NODE_SCORE // jnp.maximum(rngv, 1),
+                    )
+                    raw = jnp.where(feasible, scaled, raw)
+                total = total + weight * raw
             # selectHost: max weighted score, smallest tie-break rank wins
             best = jnp.max(jnp.where(feasible, total, -_INT_MAX))
             wkey = jnp.where(
@@ -929,18 +957,28 @@ def make_pallas_replay(
     reject_randomized(policies, gpu_sel)
     if not supports(policies, gpu_sel):
         raise ValueError(
-            "pallas engine supports single-policy configs with a "
-            f"registered column kernel; got {[f.policy_name for f, _ in policies]}"
-            f" / gpu_sel={gpu_sel}"
+            "pallas engine needs a registered column kernel for EVERY "
+            "enabled policy and gpu_sel in {best, worst, an enabled "
+            "self-select policy}; got "
+            f"{[f.policy_name for f, _ in policies]} / gpu_sel={gpu_sel}"
         )
     cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, interpret)
     if cache_key in _PALLAS_REPLAY_CACHE:
         return _PALLAS_REPLAY_CACHE[cache_key]
 
-    fn, weight = policies[0]
-    column_fn = _resolve_column(fn)
-    normalize = fn.normalize
-    weight = int(weight)
+    # (column_fn, normalize, weight, is_selector) per enabled plugin; the
+    # selector is the policy the gpuSelMethod delegates Reserve picks to
+    # (the allocateGpuIdFunc registry, plugin/open_gpu_share.go:39)
+    columns = tuple(
+        (
+            _resolve_column(fn),
+            fn.normalize,
+            int(w),
+            gpu_sel == fn.policy_name and fn.policy_name in SELF_SELECT_POLICIES,
+        )
+        for fn, w in policies
+    )
+    n_pol = len(columns)
 
     @jax.jit
     def replay(
@@ -995,9 +1033,9 @@ def make_pallas_replay(
         ec = (e + epad) // _CH
         ev3 = ev.reshape(ev.shape[0], ec, _CH)
 
-        kernel = _make_kernel(column_fn, ks, normalize, gpu_sel, weight)
+        kernel = _make_kernel(columns, ks, gpu_sel)
         out_shape = (
-            jax.ShapeDtypeStruct((kdim, nc, _CH), jnp.int32),  # score
+            jax.ShapeDtypeStruct((n_pol * kdim, nc, _CH), jnp.int32),  # score
             jax.ShapeDtypeStruct((kdim, nc, _CH), jnp.int32),  # sdev
             jax.ShapeDtypeStruct((kdim, nc, _CH), jnp.int32),  # feas
             jax.ShapeDtypeStruct((nc, _CH), jnp.int32),  # cpu_left
