@@ -56,6 +56,86 @@ def main(argv=None):
     total = len(groups) * len(seeds)
     t_all = time.perf_counter()
     done = 0
+    # one-group pipeline: group i's host tails (bellman, log/CSV writes)
+    # run while group i+1's vmapped replay executes on the chip — the only
+    # concurrency a 1-vCPU host driving a remote accelerator has.
+    # Each entry: {"trace","mid","pending","st","t0"}
+    inflight = None
+
+    def transient(e) -> bool:
+        # the TPU tunnel occasionally drops a remote call mid-sweep; a
+        # transient runtime/RPC failure must not kill a multi-hour grid.
+        # Deterministic errors (bad flags, missing traces, filesystem
+        # errors, assertion bugs) surface immediately.
+        import jax
+
+        if isinstance(
+            e,
+            (FileNotFoundError, FileExistsError, IsADirectoryError,
+             NotADirectoryError, PermissionError),
+        ):
+            return False
+        return isinstance(e, (jax.errors.JaxRuntimeError, OSError))
+
+    def run_group_unpipelined(trace, mid, pending):
+        """Retry path: run one group start-to-finish (batch, then per-seed
+        fallback granularity on the last attempt)."""
+        for attempt in range(3):
+            try:
+                if len(pending) > 1 and not args.no_batch:
+                    runner.run_experiment_batch(
+                        [runner.get_args(a) for _, a, _ in pending]
+                    )
+                    for _, argv_exp, marker in pending:
+                        marker.write_text(" ".join(argv_exp))
+                else:
+                    # per-seed markers: a failure on a late seed must not
+                    # discard earlier seeds' completion records
+                    for _, argv_exp, marker in pending:
+                        if marker.exists() and marker.read_text() == " ".join(
+                            argv_exp
+                        ):
+                            continue
+                        runner.run_experiment(runner.get_args(argv_exp))
+                        marker.write_text(" ".join(argv_exp))
+                return
+            except Exception as e:  # noqa: BLE001 — transient() filters
+                if not transient(e) or attempt == 2:
+                    raise
+                print(
+                    f"[sweep] {trace} {mid} seeds="
+                    f"{[s for s, _, _ in pending]} attempt {attempt + 1} "
+                    f"failed ({e}); retrying",
+                    flush=True,
+                )
+                time.sleep(5)
+
+    def flush(entry):
+        nonlocal done
+        try:
+            runner.finish_experiment_batch(entry["st"])
+            for _, argv_exp, marker in entry["pending"]:
+                marker.write_text(" ".join(argv_exp))
+        except Exception as e:  # noqa: BLE001 — transient() filters
+            if not transient(e):
+                raise
+            print(
+                f"[sweep] {entry['trace']} {entry['mid']} finish failed "
+                f"({e}); re-running group unpipelined",
+                flush=True,
+            )
+            run_group_unpipelined(
+                entry["trace"], entry["mid"], entry["pending"]
+            )
+        done += len(entry["pending"])
+        print(
+            f"[sweep {done}/{total}] {entry['trace']} {entry['mid']} "
+            f"seeds={[s for s, _, _ in entry['pending']]} "
+            f"{time.perf_counter() - entry['t0']:.1f}s "
+            f"(total {time.perf_counter() - t_all:.0f}s)",
+            flush=True,
+        )
+
     for trace, (mid, flags, gpusel, dimext, norm) in groups:
         # one group = the same experiment across seeds; uncached seeds run
         # as ONE vmapped device replay (driver.run_batch) unless --no-batch
@@ -85,59 +165,46 @@ def main(argv=None):
         if not pending:
             continue
         t0 = time.perf_counter()
-        # the TPU tunnel occasionally drops a remote_compile call mid-sweep;
-        # a transient runtime/RPC failure must not kill a multi-hour grid.
-        # Deterministic errors (bad flags, missing traces, assertion bugs)
-        # surface immediately — only backend/transport errors retry.
-        import jax
-
-        for attempt in range(3):
+        if len(pending) > 1 and not args.no_batch:
             try:
-                if len(pending) > 1 and not args.no_batch:
-                    # one vmapped replay for the whole group; markers land
-                    # only after every seed's outputs are written
-                    runner.run_experiment_batch(
-                        [runner.get_args(a) for _, a, _ in pending]
-                    )
-                    for _, argv_exp, marker in pending:
-                        marker.write_text(" ".join(argv_exp))
-                else:
-                    # per-seed markers: a failure on a late seed must not
-                    # discard earlier seeds' completion records
-                    for _, argv_exp, marker in pending:
-                        if marker.exists() and marker.read_text() == " ".join(
-                            argv_exp
-                        ):
-                            continue
-                        runner.run_experiment(runner.get_args(argv_exp))
-                        marker.write_text(" ".join(argv_exp))
-                break
-            except (jax.errors.JaxRuntimeError, OSError) as e:
-                # OSError covers the tunnel's transport failures (connection
-                # resets, timeouts, DNS) — but its deterministic filesystem
-                # subclasses must surface immediately, not after 3 retries.
-                if isinstance(
-                    e,
-                    (FileNotFoundError, FileExistsError, IsADirectoryError,
-                     NotADirectoryError, PermissionError),
-                ):
+                st = runner.dispatch_experiment_batch(
+                    [runner.get_args(a) for _, a, _ in pending]
+                )
+            except Exception as e:  # noqa: BLE001 — transient() filters
+                if not transient(e):
                     raise
-                if attempt == 2:
-                    raise
+                if inflight is not None:
+                    flush(inflight)
+                    inflight = None
+                run_group_unpipelined(trace, mid, pending)
+                done += len(pending)
                 print(
-                    f"[sweep] {trace} {mid} seeds={[s for s, _, _ in pending]} "
-                    f"attempt {attempt + 1} failed ({e}); retrying",
+                    f"[sweep {done}/{total}] {trace} {mid} (retried) "
+                    f"{time.perf_counter() - t0:.1f}s",
                     flush=True,
                 )
-                time.sleep(5)
-        done += len(pending)
-        print(
-            f"[sweep {done}/{total}] {trace} {mid} "
-            f"seeds={[s for s, _, _ in pending]} "
-            f"{time.perf_counter() - t0:.1f}s "
-            f"(total {time.perf_counter() - t_all:.0f}s)",
-            flush=True,
-        )
+                continue
+            if inflight is not None:
+                flush(inflight)
+            inflight = {
+                "trace": trace, "mid": mid, "pending": pending,
+                "st": st, "t0": t0,
+            }
+        else:
+            if inflight is not None:
+                flush(inflight)
+                inflight = None
+            run_group_unpipelined(trace, mid, pending)
+            done += len(pending)
+            print(
+                f"[sweep {done}/{total}] {trace} {mid} "
+                f"seeds={[s for s, _, _ in pending]} "
+                f"{time.perf_counter() - t0:.1f}s "
+                f"(total {time.perf_counter() - t_all:.0f}s)",
+                flush=True,
+            )
+    if inflight is not None:
+        flush(inflight)
     print(f"[sweep] {total} experiments in {time.perf_counter() - t_all:.0f}s")
 
 

@@ -222,10 +222,10 @@ def test_scheduler_config_rejects_unknown(tmp_path):
 
 
 def test_scheduler_config_rejects_extenders_and_pct(tmp_path):
-    """Configs asking for capabilities this build doesn't have (extender
-    protocol, partial node scoring) must fail loudly, not silently compute
-    something different (ref accepts both: simulator.go:185-197 extenders;
-    utils.go:234 forces percentageOfNodesToScore=100)."""
+    """Partial node scoring fails loudly (the reference forces
+    percentageOfNodesToScore=100, utils.go:234); extenders parse into the
+    host-loop protocol since round 5 (tests/test_extender.py covers the
+    live contract)."""
     from tpusim.config.scheduler import SchedulerConfigError, load_scheduler_config
 
     base = {
@@ -248,8 +248,8 @@ def test_scheduler_config_rejects_extenders_and_pct(tmp_path):
     p.write_text(
         yaml.dump({**base, "extenders": [{"urlPrefix": "http://x/"}]})
     )
-    with pytest.raises(SchedulerConfigError, match="extender"):
-        load_scheduler_config(str(p))
+    cfg = load_scheduler_config(str(p))  # round 5: extenders parse
+    assert cfg.extenders[0].url_prefix == "http://x/"
 
 
 # ---- queue sorts (pkg/algo) ----
@@ -494,6 +494,36 @@ def test_applier_end_to_end():
     i = pods["demo/train-pod-1"]
     assert result.node_names[result.placed_node[i]] == "gpu-node-b"
     assert result.dev_mask[i].sum() == 2
+
+
+@pytest.mark.parametrize("bundle", ["new1", "new2"])
+def test_applier_sample_bundles(bundle):
+    """The new1/new2 sample bundles (mirroring /root/reference/example/
+    {new1,new2}: a PWR heterogeneous-cluster quick start and a typed-GPU-
+    request FGD one) run end-to-end with every pod placed."""
+    from tpusim.apply import Applier, ApplyOptions
+
+    out = io.StringIO()
+    applier = Applier(
+        ApplyOptions(
+            simon_config=os.path.join(
+                REPO, f"example/{bundle}/test-cluster-config.yaml"
+            ),
+            default_scheduler_config=os.path.join(
+                REPO, f"example/{bundle}/test-scheduler-config.yaml"
+            ),
+            base_dir=REPO,
+        )
+    )
+    result = applier.run(out=out)
+    assert not result.unscheduled_pods, out.getvalue()
+    assert "Success!" in out.getvalue()
+    if bundle == "new2":
+        # the typed requests must land on matching GPU models
+        pods = {p.name: i for i, p in enumerate(result.pods)}
+        names = result.node_names
+        assert names[result.placed_node[pods["pai-gpu/gpu-pod-00"]]] == "pai-node-00"
+        assert names[result.placed_node[pods["pai-gpu/gpu-pod-01"]]] == "pai-node-02"
 
 
 def test_cli_version_and_gen_doc(tmp_path, capsys):

@@ -79,6 +79,7 @@ class Applier:
             deschedule_policy=cc.deschedule.policy,
             use_timestamps=cc.use_timestamps,
             engine=cc.engine,
+            extenders=self.sched_cfg.extenders,
         )
 
     def _load_apps(self, node_names: Sequence[str]) -> List[tuple]:

@@ -62,6 +62,9 @@ class SchedulerConfig:
     norm_method: str = "max"  # node|pod|max
     percentage_of_nodes_to_score: int = 100
     scheduler_name: str = SCHEDULER_NAME
+    # HTTP scheduler extenders (tpusim.sim.extender.ExtenderConfig tuple;
+    # ref: simulator.go:196 WithExtenders pass-through)
+    extenders: tuple = ()
 
     def policy_tuple(self) -> Tuple[Tuple[str, int], ...]:
         return tuple(self.policies)
@@ -117,14 +120,12 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
             "always scores 100% of nodes (the reference forces the same, "
             "utils.go:234)"
         )
-    if doc.get("extenders"):
-        raise SchedulerConfigError(
-            "scheduler extenders are not supported: there is no external "
-            "extender protocol over the array state"
-        )
+    extenders = _parse_extenders(doc.get("extenders") or [])
     profiles = doc.get("profiles") or []
     if not profiles:
-        return default_scheduler_config()
+        cfg = default_scheduler_config()
+        cfg.extenders = extenders
+        return cfg
     profile = profiles[0]
     plugins = profile.get("plugins") or {}
     score = plugins.get("score") or {}
@@ -163,8 +164,75 @@ def parse_scheduler_config(doc: dict) -> SchedulerConfig:
     # forced defaults (utils.go:234-235, 312)
     cfg.percentage_of_nodes_to_score = 100
     cfg.scheduler_name = profile.get("schedulerName") or SCHEDULER_NAME
+    cfg.extenders = extenders
     _validate_methods(cfg)
     return cfg
+
+
+def _parse_extenders(entries) -> tuple:
+    """`extenders:` list → ExtenderConfig tuple (the v1beta1 Extender
+    fields; apis/config/types.go:109). The reference hands these straight
+    to the vendored scheduler (simulator.go:196); here they drive the
+    host-loop extender replay (tpusim.sim.extender). Verbs this build
+    cannot honor are rejected loudly rather than silently dropped."""
+    from tpusim.sim.extender import ExtenderConfig
+
+    out = []
+    for e in entries:
+        if not isinstance(e, dict) or not e.get("urlPrefix"):
+            raise SchedulerConfigError(
+                f"extender entry must be a mapping with urlPrefix: {e!r}"
+            )
+        for unsupported in ("bindVerb", "preemptVerb"):
+            if e.get(unsupported):
+                raise SchedulerConfigError(
+                    f"extender {unsupported} is not supported: binding/"
+                    "preemption are array scatter updates in this "
+                    "simulator, not delegable side effects"
+                )
+        if e.get("enableHTTPS") and str(e["urlPrefix"]).startswith("http:"):
+            raise SchedulerConfigError(
+                "extender enableHTTPS=true with an http:// urlPrefix"
+            )
+        managed = tuple(
+            str(m.get("name"))
+            for m in (e.get("managedResources") or [])
+            if isinstance(m, dict) and m.get("name")
+        )
+        out.append(
+            ExtenderConfig(
+                url_prefix=str(e["urlPrefix"]),
+                filter_verb=str(e.get("filterVerb") or ""),
+                prioritize_verb=str(e.get("prioritizeVerb") or ""),
+                weight=int(e.get("weight", 1) or 1),
+                node_cache_capable=bool(e.get("nodeCacheCapable")),
+                ignorable=bool(e.get("ignorable")),
+                managed_resources=managed,
+                http_timeout_s=_parse_duration_s(e.get("httpTimeout"), 30.0),
+            )
+        )
+    return tuple(out)
+
+
+_DURATION_UNITS = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3}
+
+
+def _parse_duration_s(value, default: float) -> float:
+    """httpTimeout is a metav1.Duration: a Go duration string ('30s',
+    '1m30s', '500ms') in real configs; bare numbers are read as seconds."""
+    if value is None or value == "":
+        return default
+    if isinstance(value, (int, float)):
+        return float(value)
+    import re as _re
+
+    parts = _re.findall(r"(\d+(?:\.\d+)?)(h|ms|m|s)", str(value))
+    if not parts or "".join(f"{n}{u}" for n, u in parts) != str(value):
+        raise SchedulerConfigError(
+            f"extender httpTimeout {value!r} is not a duration "
+            "('30s', '1m30s', '500ms') or a number of seconds"
+        )
+    return sum(float(n) * _DURATION_UNITS[u] for n, u in parts)
 
 
 def _validate_methods(cfg: SchedulerConfig) -> None:

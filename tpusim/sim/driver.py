@@ -77,6 +77,11 @@ class SimulatorConfig:
     # honors `sequential`; `pallas` has no batched form and batches run
     # the (bit-identical) table engine instead.
     engine: str = "auto"
+    # HTTP scheduler extenders (tpusim.sim.extender.ExtenderConfig tuple).
+    # When set, every replay runs the host-loop extender engine — the only
+    # execution mode that can splice per-cycle HTTP round-trips between
+    # Score and selectHost (ref: simulator.go:196 WithExtenders)
+    extenders: tuple = ()
 
 
 @dataclass
@@ -197,6 +202,7 @@ class Simulator:
                 "(see tpusim.sim.pallas_engine.supports)"
             )
         self._pallas_fn = None
+        self._extender_fn = None  # built lazily on first extender replay
         if self._pallas_ok and self.cfg.engine in ("auto", "pallas"):
             # Mosaic lowers on TPU backends only; anywhere else (cpu, gpu)
             # a forced `engine: pallas` runs the interpreter — correct but
@@ -208,8 +214,35 @@ class Simulator:
                 interpret=jax.default_backend() != "tpu",
             )
 
+    def _attach_metrics(self, out, state, specs, ev_kind, ev_pod,
+                        n_events=None):
+        """Reconstruct the per-event report series from the replay's
+        telemetry (the shared post-pass) when reporting is on, and log the
+        engine the dispatch used. `n_events` = true (pre-padding) event
+        count for the log line."""
+        if self.cfg.report_per_event:
+            from tpusim.sim.metrics import compute_event_metrics
+
+            out = out._replace(
+                metrics=compute_event_metrics(
+                    state, specs, ev_kind, ev_pod, out.event_node,
+                    out.event_dev, self.typical,
+                )
+            )
+        # name the engine in the log: the fused engine's documented f32
+        # divergence channel means TPU-vs-CPU result diffs must be
+        # diagnosable from simon.log alone (the analysis parser ignores
+        # unknown line families, so the CSV lanes are unaffected)
+        if n_events is None:
+            n_events = int(ev_kind.shape[0])
+        self.log.info(
+            f"[Engine] replay of {n_events} events ran on: {self._last_engine}"
+        )
+        return out
+
     def run_events(
-        self, state, specs, ev_kind, ev_pod, key, bucket: int = 512, types=None
+        self, state, specs, ev_kind, ev_pod, key, bucket: int = 512,
+        types=None, pod_rows=None
     ):
         """Run the compiled replay on prepared arrays, auto-selecting the
         fastest engine that supports the configuration. Small batches
@@ -224,6 +257,29 @@ class Simulator:
         pod specs repeatedly (chunked streams) may pass a prebuilt
         `types = build_pod_types(specs)` to skip the host-side dedup."""
         from tpusim.sim.table_engine import build_pod_types, pad_pod_types
+
+        if self.cfg.extenders:
+            # extenders splice HTTP round-trips into every cycle — only
+            # the host-loop engine can honor them; no padding needed
+            if pod_rows is None:
+                raise ValueError(
+                    "extender-configured replays need the PodRow list "
+                    "(run_events(..., pod_rows=...)) to build the "
+                    "ExtenderArgs payloads"
+                )
+            if self._extender_fn is None:
+                from tpusim.sim.extender import make_extender_replay
+
+                self._extender_fn = make_extender_replay(
+                    self._policy_fns, self.cfg.gpu_sel_method,
+                    self.cfg.extenders,
+                )
+            self._last_engine = "extender"
+            out = self._extender_fn(
+                state, specs, ev_kind, ev_pod, self.typical, key,
+                self.rank, pod_rows, self.nodes,
+            )
+            return self._attach_metrics(out, state, specs, ev_kind, ev_pod)
 
         p, e = int(specs.cpu.shape[0]), int(ev_kind.shape[0])
         p2, e2 = _bucket_sizes(p, e, bucket)
@@ -268,24 +324,9 @@ class Simulator:
             out = self.replay_fn(
                 state, specs, ev_kind, ev_pod, self.typical, key, self.rank
             )
-        if self.cfg.report_per_event:
-            # the per-event report series, reconstructed from the replay's
-            # telemetry by the shared vectorized post-pass (still on
-            # device: the caller's device_fetch moves everything in one
-            # transfer)
-            from tpusim.sim.metrics import compute_event_metrics
-
-            out = out._replace(
-                metrics=compute_event_metrics(
-                    state, specs, ev_kind, ev_pod, out.event_node,
-                    out.event_dev, self.typical,
-                )
-            )
-        # name the engine in the log: the fused engine's documented f32
-        # divergence channel means TPU-vs-CPU result diffs must be
-        # diagnosable from simon.log alone (the analysis parser ignores
-        # unknown line families, so the CSV lanes are unaffected)
-        self.log.info(f"[Engine] replay of {e} events ran on: {self._last_engine}")
+        # post-pass metrics stay on device: the caller's device_fetch
+        # moves everything in one transfer
+        out = self._attach_metrics(out, state, specs, ev_kind, ev_pod, e)
         return _slice_result(out, p, e)
 
     # ---- workload prep (core.go:103-142) ----
@@ -310,6 +351,20 @@ class Simulator:
         # values embed the cum_prob cutoff context of their first
         # computation, so sharing across experiments would make report
         # values depend on sweep order.
+        self._bellman_eval = None
+        self.log.info(f"Num of Total Pods: {len(self.workload_pods)}")
+        self.log.info(f"Num of Total Pod Sepc: {len(self._typical_info)}")
+
+    def adopt_typical_pods(self, other: "Simulator"):
+        """set_typical_pods, copying the (immutable) distribution from a
+        same-workload sibling instead of recomputing + re-uploading it —
+        the seed-batched sweep path, where all S sims share the workload
+        the distribution derives from (schedule_pods_batch validates
+        that). Emits the same log lines; the Bellman evaluator stays
+        per-experiment (its memo embeds evaluation-order context)."""
+        self.typical = other.typical
+        self._typical_info = other._typical_info
+        self._typical_host = other._typical_host
         self._bellman_eval = None
         self.log.info(f"Num of Total Pods: {len(self.workload_pods)}")
         self.log.info(f"Num of Total Pod Sepc: {len(self._typical_info)}")
@@ -371,7 +426,8 @@ class Simulator:
         specs = pods_to_specs(pods, self.node_index)
         ev_kind, ev_pod = build_events(pods, use_timestamps)
         out = self.run_events(
-            state, specs, jnp.asarray(ev_kind), jnp.asarray(ev_pod), key
+            state, specs, jnp.asarray(ev_kind), jnp.asarray(ev_pod), key,
+            pod_rows=pods,
         )
         out = device_fetch(out)
         return self._finish_replay(out, pods, ev_kind, ev_pod, state)
@@ -620,6 +676,7 @@ class Simulator:
             self.run_events(
                 state, vspecs, jnp.asarray(ev_kind), jnp.asarray(ev_pod),
                 jax.random.PRNGKey(self.cfg.seed + 1),
+                pod_rows=[res.pods[int(i)] for i in v],
             )
         )
         # the victim reschedule goes through the reporting loop in the
@@ -945,10 +1002,27 @@ def schedule_pods_batch(
     are padded to common bucketed shapes, exactly like
     Simulator.run_events does for a single run. Results are bit-identical
     to per-sim schedule_pods calls (same engine kernels, vmapped)."""
+    return finish_pods_batch(dispatch_pods_batch(sims, pods_list, bucket))
+
+
+def dispatch_pods_batch(
+    sims: Sequence["Simulator"], pods_list, bucket: int = 512
+) -> dict:
+    """The host-prep + device-dispatch half of schedule_pods_batch. JAX
+    dispatch is asynchronous, so the returned handle's device work runs
+    while the caller does host work (the sweep pipelines group i's host
+    tails under group i+1's replay — the only concurrency available on a
+    1-vCPU host driving a remote chip). finish_pods_batch(handle) blocks
+    on the results and completes the per-sim bookkeeping."""
     from tpusim.sim.table_engine import build_pod_types, pad_pod_types
     from tpusim.types import PodSpec
 
     lead = sims[0]
+    if lead.cfg.extenders:
+        raise ValueError(
+            "schedule_pods_batch cannot run extender configs (per-cycle "
+            "HTTP round-trips do not batch); run each sim's run() instead"
+        )
     for s in sims[1:]:
         same = (
             s.cfg.policies == lead.cfg.policies
@@ -1072,12 +1146,33 @@ def schedule_pods_batch(
                 out.event_node, out.event_dev, lead.typical,
             )
         )
-    out = device_fetch(out)
+    return {
+        "sims": sims, "pods_list": pods_list, "ev_list": ev_list,
+        "out": out, "use_table": use_table, "t0": t0, "t_dev": t_dev,
+        # dispatch-phase host wall: under the sweep's pipeline, unrelated
+        # groups' work runs between dispatch and finish, so wall clocks
+        # must sum the two phases rather than span them
+        "prep_s": time.perf_counter() - t0,
+    }
+
+
+def finish_pods_batch(handle: dict) -> List[SimulateResult]:
+    """Block on a dispatch_pods_batch handle and finish per-sim host work
+    (fetch, slicing, report emission, result recording)."""
+    sims = handle["sims"]
+    pods_list = handle["pods_list"]
+    ev_list = handle["ev_list"]
+    use_table = handle["use_table"]
+    lead = sims[0]
+    t_fin = time.perf_counter()
+    out = device_fetch(handle["out"])
     # device-phase wall (replay dispatch + fetch), excluding the host-side
-    # spec padding above and result slicing below — the like-for-like
-    # number against a single run_events call (bench.py batched row)
-    lead._last_batch_device_s = time.perf_counter() - t_dev
-    wall = time.perf_counter() - t0
+    # spec padding and result slicing — the like-for-like number against a
+    # single run_events call (bench.py batched row). Only meaningful when
+    # dispatch and finish run back-to-back (schedule_pods_batch, the bench
+    # path); a pipelined caller interleaves other work in between
+    lead._last_batch_device_s = time.perf_counter() - handle["t_dev"]
+    wall = handle["prep_s"] + (time.perf_counter() - t_fin)
 
     # the logged name is the engine SEMANTICS (what a cross-backend result
     # diff needs) and must match a single run's line exactly — the batch
@@ -1131,16 +1226,37 @@ def _batched_frag_amounts(sims) -> np.ndarray:
 def run_batch(sims: Sequence["Simulator"]) -> List[SimulateResult]:
     """run() for a seed batch: per-sim host prep and reporting, one
     batched device replay (see schedule_pods_batch)."""
+    return finish_run_batch(dispatch_run_batch(sims))
+
+
+def dispatch_run_batch(sims: Sequence["Simulator"]) -> dict:
+    """Host prep + async device dispatch of a seed batch (the dispatch
+    half of run_batch; see dispatch_pods_batch). The typical-pod
+    distribution is computed once on the lead sim and adopted by its
+    same-workload siblings."""
     pods_list = []
+    lead = sims[0]
     for sim in sims:
         sim._reset_run_state()
-        sim.set_typical_pods()
+        if (
+            sim is lead
+            or sim.workload_pods != lead.workload_pods
+            or sim.cfg.typical_pods != lead.cfg.typical_pods
+        ):
+            sim.set_typical_pods()
+        else:
+            sim.adopt_typical_pods(lead)
         sim.set_skyline_pods()
         pods_list.append(sim.prepare_pods())
         sim.log.info(
             f"Number of original workload pods: {len(sim.workload_pods)}"
         )
-    results = schedule_pods_batch(sims, pods_list)
+    return dispatch_pods_batch(sims, pods_list)
+
+
+def finish_run_batch(handle: dict) -> List[SimulateResult]:
+    sims = handle["sims"]
+    results = finish_pods_batch(handle)
     amounts = _batched_frag_amounts(sims)
     for i, (sim, res) in enumerate(zip(sims, results)):
         sim.report_failed([u.pod for u in res.unscheduled_pods])

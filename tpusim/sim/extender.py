@@ -1,0 +1,364 @@
+"""Scheduler extenders — the k8s HTTP extender protocol over the array state.
+
+The reference passes configured extenders straight into the vendored
+scheduler (simulator.go:196 `scheduler.WithExtenders(...)`), which speaks
+the extenderv1 HTTP contract (vendored core/extender.go): per scheduling
+cycle, each extender's `filterVerb` receives ExtenderArgs{Pod, Nodes |
+NodeNames} and returns a node subset, then `prioritizeVerb` returns a
+HostPriorityList whose weighted scores, scaled by MaxNodeScore /
+MaxExtenderPriority (100/10), are ADDED to the plugin score sum before
+selectHost (generic_scheduler.go:520-560).
+
+This build reproduces that contract with a host-driven event loop: the
+Filter/Score half of the cycle runs as the same jitted kernel every engine
+uses (sim.step.score_pod), the extender HTTP round-trips splice between it
+and the jitted select_and_bind, and deletions run the jitted unschedule.
+Semantics mirrored from the vendored code:
+
+  - interest gate: an extender with managedResources is only consulted for
+    pods requesting one of them (IsInterested); an empty list means every
+    pod. GPU requests are surfaced as the openb annotation resource name
+    (alibabacloud.com/gpu-milli).
+  - filter: missing filterVerb passes all nodes through; a returned name
+    not in the input is an error; FailedNodes are simply absent from the
+    subset; a transport/Error failure fails the CYCLE (pod unschedulable)
+    unless the extender is `ignorable` (findNodesThatPassExtenders).
+  - prioritize: errors are IGNORED (the vendored goroutine drops them);
+    combinedScores[host] += score × weight; the sum joins the plugin total
+    as combined × (MaxNodeScore / MaxExtenderPriority).
+  - nodeCacheCapable: NodeNames-only payloads both ways.
+  - bindVerb / preemptVerb are rejected at config parse: binding is an
+    array scatter here, not a delegable side effect (config.scheduler).
+
+A per-event HTTP + device round-trip is inherently serial, so this path is
+for correctness/integration (the reference ships no extender experiment);
+run_events dispatches to it whenever extenders are configured.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
+
+# extenderv1.MaxExtenderPriority (vendored extender/v1/types.go)
+MAX_EXTENDER_PRIORITY = 10
+
+ANNO_GPU_MILLI = "alibabacloud.com/gpu-milli"
+ANNO_GPU_COUNT = "alibabacloud.com/gpu-count"
+ANNO_GPU_MODEL = "alibabacloud.com/gpu-card-model"
+
+
+@dataclass(frozen=True)
+class ExtenderConfig:
+    """One `extenders:` entry of KubeSchedulerConfiguration (the v1beta1
+    Extender fields this build supports; apis/config/types.go:109)."""
+
+    url_prefix: str
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    weight: int = 1
+    node_cache_capable: bool = False
+    ignorable: bool = False
+    # resource names from managedResources[].name; empty = all pods
+    managed_resources: Tuple[str, ...] = ()
+    http_timeout_s: float = 30.0
+
+    def is_interested(self, pod) -> bool:
+        """IsInterested (core/extender.go): no managed resources = every
+        pod; otherwise the pod must request one of them."""
+        if not self.managed_resources:
+            return True
+        requested = set()
+        if pod.cpu_milli > 0:
+            requested.add("cpu")
+        if pod.memory_mib > 0:
+            requested.add("memory")
+        if pod.num_gpu > 0 or pod.gpu_milli > 0:
+            requested.add(ANNO_GPU_MILLI)
+            requested.add(ANNO_GPU_COUNT)
+        return bool(requested & set(self.managed_resources))
+
+
+class ExtenderError(RuntimeError):
+    pass
+
+
+def _pod_json(pod) -> dict:
+    """v1.Pod-shaped payload for one trace pod (the openb annotation
+    contract the reference's pods carry, open-gpu-share/utils/const.go)."""
+    annotations = {}
+    if pod.gpu_milli or pod.num_gpu:
+        annotations[ANNO_GPU_MILLI] = str(pod.gpu_milli)
+        annotations[ANNO_GPU_COUNT] = str(pod.num_gpu)
+    if pod.gpu_spec:
+        annotations[ANNO_GPU_MODEL] = pod.gpu_spec
+    return {
+        "metadata": {"name": pod.name, "annotations": annotations},
+        "spec": {
+            "containers": [
+                {
+                    "name": "app",
+                    "resources": {
+                        "requests": {
+                            "cpu": f"{pod.cpu_milli}m",
+                            "memory": f"{pod.memory_mib}Mi",
+                        }
+                    },
+                }
+            ]
+        },
+    }
+
+
+def _node_json(node) -> dict:
+    labels = {}
+    if node.model:
+        labels[ANNO_GPU_MODEL] = node.model
+    return {
+        "metadata": {"name": node.name, "labels": labels},
+        "status": {
+            "allocatable": {
+                "cpu": f"{node.cpu_milli}m",
+                "memory": f"{node.memory_mib}Mi",
+                ANNO_GPU_COUNT: str(node.gpu),
+            }
+        },
+    }
+
+
+def _post(url: str, payload: dict, timeout: float) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+class ExtenderClient:
+    """Filter/Prioritize round-trips for one configured extender."""
+
+    def __init__(self, cfg: ExtenderConfig):
+        self.cfg = cfg
+
+    def _args(self, pod, nodes) -> dict:
+        args = {"pod": _pod_json(pod)}
+        if self.cfg.node_cache_capable:
+            args["nodenames"] = [n.name for n in nodes]
+        else:
+            args["nodes"] = {"items": [_node_json(n) for n in nodes]}
+        return args
+
+    def filter(self, pod, nodes) -> List[str]:
+        """Surviving node names (subset of input). Raises ExtenderError on
+        transport failure or a result carrying Error/unknown names —
+        the caller applies the `ignorable` policy."""
+        if not self.cfg.filter_verb:
+            return [n.name for n in nodes]
+        url = f"{self.cfg.url_prefix.rstrip('/')}/{self.cfg.filter_verb}"
+        try:
+            result = _post(url, self._args(pod, nodes), self.cfg.http_timeout_s)
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            raise ExtenderError(f"extender {url} filter failed: {e}") from e
+        if result.get("error"):
+            raise ExtenderError(
+                f"extender {url} returned error: {result['error']}"
+            )
+        known = {n.name for n in nodes}
+        if self.cfg.node_cache_capable and result.get("nodenames") is not None:
+            names = list(result["nodenames"])
+        elif result.get("nodes") is not None:
+            names = [
+                item["metadata"]["name"]
+                for item in result["nodes"].get("items") or []
+            ]
+        else:
+            names = [n.name for n in nodes]
+        for name in names:
+            if name not in known:
+                raise ExtenderError(
+                    f"extender {url} claims a filtered node {name!r} not in "
+                    "the input node list"
+                )
+        return names
+
+    def prioritize(self, pod, nodes) -> Optional[dict]:
+        """{node name: extender score} or None on error (the vendored
+        scheduler ignores prioritize errors, generic_scheduler.go:536)."""
+        if not self.cfg.prioritize_verb:
+            return {}
+        url = f"{self.cfg.url_prefix.rstrip('/')}/{self.cfg.prioritize_verb}"
+        try:
+            result = _post(url, self._args(pod, nodes), self.cfg.http_timeout_s)
+            return {
+                item["host"]: int(item["score"]) for item in (result or [])
+            }
+        except (urllib.error.URLError, OSError, json.JSONDecodeError,
+                KeyError, TypeError, ValueError):
+            return None
+
+
+def extend_cycle(
+    clients: Sequence[ExtenderClient],
+    pod_row,
+    node_rows,
+    feasible: np.ndarray,  # bool[N] plugin-filter survivors
+    total: np.ndarray,  # i32[N] weighted plugin scores
+) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """Splice the extender protocol into one scheduling cycle: filter each
+    interested extender sequentially over the surviving set, then add the
+    weighted prioritize sum. Returns (feasible, total, ok) — ok=False means
+    a non-ignorable extender failed and the cycle must fail the pod."""
+    name_to_idx = {n.name: i for i, n in enumerate(node_rows)}
+    feasible = np.asarray(feasible).copy()
+    total = np.asarray(total).copy()
+    interested = [c for c in clients if c.cfg.is_interested(pod_row)]
+
+    # filter phase: sequential subsetting (findNodesThatPassExtenders)
+    for c in interested:
+        if not c.cfg.filter_verb:
+            continue
+        nodes = [node_rows[i] for i in np.flatnonzero(feasible)]
+        if not nodes:
+            break
+        try:
+            survivors = c.filter(pod_row, nodes)
+        except ExtenderError:
+            if c.cfg.ignorable:
+                continue
+            return feasible, total, False
+        keep = np.zeros_like(feasible)
+        for name in survivors:
+            keep[name_to_idx[name]] = True
+        feasible &= keep
+
+    # prioritize phase: combinedScores scaled into the plugin range
+    # (generic_scheduler.go:555-557)
+    combined = np.zeros(len(node_rows), np.int64)
+    nodes = [node_rows[i] for i in np.flatnonzero(feasible)]
+    if nodes:
+        for c in interested:
+            scores = c.prioritize(pod_row, nodes)
+            if not scores:
+                continue
+            for name, score in scores.items():
+                idx = name_to_idx.get(name)
+                if idx is not None:
+                    combined[idx] += score * c.cfg.weight
+    total = total + (
+        combined * (MAX_NODE_SCORE // MAX_EXTENDER_PRIORITY)
+    ).astype(np.int32)
+    return feasible, total, True
+
+
+def make_extender_replay(policies, gpu_sel, extenders: Sequence[ExtenderConfig]):
+    """Host-driven replay honoring configured extenders. Same call shape as
+    the other engines minus the types table:
+    replay(state, specs, ev_kind, ev_pod, tp, key, rank, pod_rows,
+    node_rows) -> ReplayResult. Placements with NO extender interference
+    are bit-identical to the sequential engine (same kernels, same key
+    discipline); extender filter/prioritize splice between score_pod and
+    select_and_bind exactly where the vendored scheduler calls them."""
+    from tpusim.sim.engine import EV_CREATE, EV_DELETE, ReplayResult
+    from tpusim.sim.step import (
+        Placement,
+        score_pod,
+        select_and_bind,
+        unschedule,
+    )
+
+    clients = [ExtenderClient(c) for c in extenders]
+
+    @jax.jit
+    def _score(state, pod, k_rand):
+        return score_pod(state, pod, k_rand, policies, gpu_sel, None)
+
+    @jax.jit
+    def _score_tp(state, pod, k_rand, tp):
+        return score_pod(state, pod, k_rand, policies, gpu_sel, tp)
+
+    @jax.jit
+    def _bind(state, pod, feasible, total, sdev, k_sel, rank):
+        return select_and_bind(
+            state, pod, feasible, total, sdev, gpu_sel, k_sel, rank
+        )
+
+    @jax.jit
+    def _unbind(state, pod, node, mask):
+        return unschedule(state, pod, Placement(node, mask))
+
+    def replay(state, specs, ev_kind, ev_pod, tp, key, rank, pod_rows,
+               node_rows) -> ReplayResult:
+        num_pods = int(specs.cpu.shape[0])
+        placed = np.full(num_pods, -1, np.int32)
+        masks = np.zeros((num_pods, MAX_GPUS_PER_NODE), bool)
+        failed = np.zeros(num_pods, bool)
+        ev_kind = np.asarray(ev_kind)
+        ev_pod = np.asarray(ev_pod)
+        e = len(ev_kind)
+        event_node = np.full(e, -1, np.int32)
+        event_dev = np.zeros((e, MAX_GPUS_PER_NODE), bool)
+        if rank is None:
+            rank = jnp.arange(state.num_nodes, dtype=jnp.int32)
+
+        for i in range(e):
+            kind, idx = int(ev_kind[i]), int(ev_pod[i])
+            pod = jax.tree.map(lambda a: a[idx], specs)
+            # the sequential oracle's per-event key discipline
+            key, sub = jax.random.split(key)
+            k_rand, k_sel = jax.random.split(sub)
+            if kind == EV_CREATE:
+                feasible, total, sdev = (
+                    _score_tp(state, pod, k_rand, tp)
+                    if tp is not None
+                    else _score(state, pod, k_rand)
+                )
+                feasible_h, total_h, ok = extend_cycle(
+                    clients, pod_rows[idx], node_rows,
+                    np.asarray(feasible), np.asarray(total),
+                )
+                if not ok:
+                    failed[idx] = True
+                    continue
+                state, pl = _bind(
+                    state, pod, jnp.asarray(feasible_h),
+                    jnp.asarray(total_h), sdev, k_sel, rank,
+                )
+                node = int(pl.node)
+                placed[idx] = node
+                masks[idx] = np.asarray(pl.dev_mask)
+                failed[idx] = node < 0
+                event_node[i] = node
+                event_dev[i] = masks[idx]
+            elif kind == EV_DELETE:
+                node, mask = placed[idx], masks[idx]
+                state = _unbind(
+                    state, pod, jnp.int32(node), jnp.asarray(mask)
+                )
+                event_node[i] = node
+                event_dev[i] = mask
+                placed[idx] = -1
+                masks[idx] = False
+
+        return ReplayResult(
+            state,
+            jnp.asarray(placed),
+            jnp.asarray(masks),
+            jnp.asarray(failed),
+            None,
+            jnp.asarray(event_node),
+            jnp.asarray(event_dev),
+        )
+
+    return replay

@@ -149,6 +149,40 @@ def select_and_bind(
     return new_state, Placement(jnp.where(ok, node, -1).astype(jnp.int32), dev_mask)
 
 
+def score_pod(
+    state: NodeState,
+    pod: PodSpec,
+    k_rand,
+    policies: Sequence[Tuple[object, int]],
+    gpu_sel: str = "best",
+    tp=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Filter + Score + Normalize for one pod — the pre-selection half of
+    the cycle, shared by schedule_one and the extender host loop (which
+    splices HTTP extender filter/prioritize results between this and
+    select_and_bind, mirroring where the vendored generic_scheduler calls
+    its extenders, generic_scheduler.go:143-210 + 520-560). Returns
+    (feasible bool[N], total i32[N] weighted scores, policy_share_dev
+    i32[N])."""
+    n = state.num_nodes
+    feasible = filter_nodes(state, pod)
+    ctx = ScoreContext(tp=tp, feasible=feasible, rng=k_rand)
+
+    total = jnp.zeros(n, jnp.int32)
+    policy_share_dev = jnp.full(n, -1, jnp.int32)
+    for fn, weight in policies:
+        res = fn(state, pod, ctx)
+        raw = res.raw_scores
+        if fn.normalize == "minmax":
+            raw = minmax_normalize_i32(raw, feasible)
+        elif fn.normalize == "pwr":
+            raw = pwr_normalize_i32(raw, feasible)
+        total = total + jnp.int32(weight) * raw
+        if gpu_sel == fn.policy_name and fn.policy_name in SELF_SELECT_POLICIES:
+            policy_share_dev = res.share_dev
+    return feasible, total, policy_share_dev
+
+
 def schedule_one(
     state: NodeState,
     pod: PodSpec,
@@ -172,25 +206,12 @@ def schedule_one(
     (spreads load across tied idle nodes instead of packing).
     """
     n = state.num_nodes
-    feasible = filter_nodes(state, pod)
     k_rand, k_sel = jax.random.split(key)
     if tiebreak_rank is None:
         tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
-    ctx = ScoreContext(tp=tp, feasible=feasible, rng=k_rand)
-
-    total = jnp.zeros(n, jnp.int32)
-    policy_share_dev = jnp.full(n, -1, jnp.int32)
-    for fn, weight in policies:
-        res = fn(state, pod, ctx)
-        raw = res.raw_scores
-        if fn.normalize == "minmax":
-            raw = minmax_normalize_i32(raw, feasible)
-        elif fn.normalize == "pwr":
-            raw = pwr_normalize_i32(raw, feasible)
-        total = total + jnp.int32(weight) * raw
-        if gpu_sel == fn.policy_name and fn.policy_name in SELF_SELECT_POLICIES:
-            policy_share_dev = res.share_dev
-
+    feasible, total, policy_share_dev = score_pod(
+        state, pod, k_rand, policies, gpu_sel, tp
+    )
     return select_and_bind(
         state, pod, feasible, total, policy_share_dev, gpu_sel, k_sel,
         tiebreak_rank,
