@@ -139,9 +139,11 @@ def measure_policy(nodes, pods, name, policies, gpu_sel, dim_ext, norm):
     }
 
 
-def measure_batched(nodes, pods, seeds=16):
+def measure_batched(nodes, pods, seeds=16, report=False):
     """Aggregate throughput of the seed-batched vmapped replay (FGD config;
-    see ENGINES.md) — the sweep's execution mode."""
+    see ENGINES.md) — the sweep's execution mode. report=True measures the
+    full-report configuration (replay + the vectorized metrics post-pass),
+    i.e. the device phase of the artifact protocol's seed groups."""
     import jax
     import numpy as np
 
@@ -160,7 +162,7 @@ def measure_batched(nodes, pods, seeds=16):
             tuning_seed=seed,
             seed=seed,
             shuffle_pod=True,
-            report_per_event=False,
+            report_per_event=report,
             typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
         )
         sim = Simulator(nodes, cfg)
@@ -187,7 +189,8 @@ def measure_batched(nodes, pods, seeds=16):
     )
     return {
         "policy": "FGD",
-        "engine": f"table, {seeds}-seed vmap batch",
+        "engine": f"table, {seeds}-seed vmap batch"
+        + (" + report post-pass" if report else ""),
         "events": sum(r.events for r in results),
         "placements": placements,
         "wall_s": round(device_wall, 3),
@@ -236,6 +239,8 @@ def main():
             rows.append(row)
             print(f"[bench-all] {json.dumps(row)}", file=sys.stderr)
         rows.append(measure_batched(nodes, pods))
+        print(f"[bench-all] {json.dumps(rows[-1])}", file=sys.stderr)
+        rows.append(measure_batched(nodes, pods, report=True))
         print(f"[bench-all] {json.dumps(rows[-1])}", file=sys.stderr)
         out = os.path.join(REPO, "BENCH_DETAILS.json")
         with open(out, "w") as f:

@@ -4,9 +4,10 @@
 
 The openb cluster (1523 nodes) is tiled out to --nodes heterogeneous nodes
 (same SKU mix) and a --pods creation stream is sampled from the openb
-typical-pod distribution. Replays on the incremental table engine (single
-device; for the node-axis sharded multi-device path see
-tpusim.parallel.make_sharded_table_replay and tests/test_parallel.py).
+typical-pod distribution. --engine picks the replay engine (the fused
+Pallas engine's VMEM-resident tables bound its N; the table engine scales
+to 100k nodes — measured table in ENGINES.md); for the node-axis sharded
+multi-device path see tpusim.parallel and tests/test_parallel.py.
 
     python bench_scale.py                     # 100k nodes, 1M pods, 1 chip
     python bench_scale.py --nodes 10000 --pods 100000
@@ -77,6 +78,12 @@ def main():
     ap.add_argument("--pods", type=int, default=1_000_000)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument(
+        "--engine", type=str, default="auto",
+        help="replay engine (auto | sequential | table | pallas): the "
+        "N-scaling comparison in ENGINES.md runs table vs pallas at "
+        "several --nodes values",
+    )
+    ap.add_argument(
         "--chunk",
         type=int,
         default=200_000,
@@ -105,6 +112,7 @@ def main():
         gpu_sel_method="FGDScore",
         seed=args.seed,
         report_per_event=False,
+        engine=args.engine,
         typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
     )
     sim = Simulator(nodes, cfg)
@@ -150,9 +158,11 @@ def main():
         s.gpu_cnt.sum() * MILLI
     )
     print(
-        f"[scale] nodes={args.nodes} pods={args.pods} wall={wall:.1f}s "
+        f"[scale] nodes={args.nodes} pods={args.pods} "
+        f"engine={sim._last_engine} wall={wall:.1f}s "
         f"(first incl. compile {first:.1f}s) placed={placed} "
-        f"throughput={placed / wall:.0f} placements/s gpu_alloc={alloc:.2f}%"
+        f"throughput={placed / wall:.0f} placements/s "
+        f"us_per_event={1e6 * wall / args.pods:.1f} gpu_alloc={alloc:.2f}%"
     )
 
 

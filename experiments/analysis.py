@@ -374,29 +374,56 @@ def build_result_from_sim(sim, meta: Dict[str, str] = None) -> dict:
                 s[key][active].astype(np.float64).tolist()
             )
         # cdol timeline (the parser's create/delete/failed/skipped calculus
-        # over the attempt + rollback lines)
+        # over the attempt + rollback lines), vectorized — a 10k-iteration
+        # Python loop was ~half of this lane's cost at sweep scale. Event
+        # streams carry at most one create and one later delete per pod
+        # name (build_events), so "name in live" at a delete collapses to:
+        # successfully created earlier in THIS replay, or live carried
+        # over from an earlier replay (deschedule victims re-create pods
+        # the main replay left live).
         names = rep["pod_names"]
         failed = rep["failed"]
-        for idx in np.flatnonzero(active):
-            name = str(names[idx])
-            if kinds[idx] == EV_CREATE:
-                if failed[idx]:
-                    verb = "failed"  # rollback line follows the attempt
-                else:
-                    verb = "create"
-                    cum += 1
-                    live.add(name)
-            else:
-                if name in live:
-                    verb = "delete"
-                    cum -= 1
-                    live.discard(name)
-                else:
-                    verb = "skipped"
-            cdol["id"].append(int(idx))
-            cdol["event"].append(verb)
-            cdol["pod_name"].append(name)
-            cdol["cum_pod"].append(cum)
+        act = np.flatnonzero(active)
+        if len(act):
+            k_act = kinds[act]
+            is_create = k_act == EV_CREATE
+            fail_act = failed[act]
+            name_act = names[act]
+            create_pos = {
+                name_act[j]: j for j in np.flatnonzero(is_create)
+            }
+            e_act = len(act)
+            cpos = np.fromiter(
+                (create_pos.get(n, e_act) for n in name_act),
+                np.int64, count=e_act,
+            )
+            cposc = np.minimum(cpos, e_act - 1)
+            created_ok_before = (
+                (cpos < np.arange(e_act)) & ~fail_act[cposc]
+            )
+            prev_live = np.fromiter(
+                (n in live for n in name_act), bool, count=e_act
+            )
+            is_delete_live = ~is_create & (created_ok_before | prev_live)
+            verbs = np.where(
+                is_create,
+                np.where(fail_act, "failed", "create"),
+                np.where(is_delete_live, "delete", "skipped"),
+            )
+            delta = np.where(
+                is_create & ~fail_act, 1, np.where(is_delete_live, -1, 0)
+            )
+            cums = cum + np.cumsum(delta)
+            cum = int(cums[-1])
+            # carry the live set across replays (net effect of this one)
+            for j in np.flatnonzero(is_create & ~fail_act):
+                live.add(name_act[j])
+            for j in np.flatnonzero(is_delete_live):
+                live.discard(name_act[j])
+            cdol["id"].extend(act.tolist())
+            cdol["event"].extend(verbs.tolist())
+            cdol["pod_name"].extend(name_act.tolist())
+            cdol["cum_pod"].extend(cums.tolist())
 
     # fail block: the same Repr -> regex -> grouping the parser applies,
     # run over the reprs this run logged (sim.report_failed stash)

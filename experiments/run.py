@@ -116,6 +116,12 @@ def get_args(argv=None):
         help="replay engine: auto | sequential | table | pallas (ENGINES.md)",
     )
     p.add_argument(
+        "--mesh", type=int, default=0,
+        help="shard the node axis over an N-device mesh (shard_map "
+        "engine, MULTICHIP.md); placements and merged CSVs are identical "
+        "to single-device runs",
+    )
+    p.add_argument(
         "--analysis-from-log",
         action="store_true",
         help="build the analysis CSVs by re-parsing simon.log (the "
@@ -258,6 +264,7 @@ def _build_sim(args):
         report_per_event=not args.no_per_event_report,
         use_timestamps=args.use_timestamps,
         engine=args.engine,
+        mesh=args.mesh,
         typical_pods=TypicalPodsConfig(
             is_involved_cpu_pods=args.is_involved_cpu_pods.lower() == "true",
             pod_popularity_threshold=args.pod_popularity_threshold,

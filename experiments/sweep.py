@@ -56,11 +56,16 @@ def main(argv=None):
     total = len(groups) * len(seeds)
     t_all = time.perf_counter()
     done = 0
-    # one-group pipeline: group i's host tails (bellman, log/CSV writes)
-    # run while group i+1's vmapped replay executes on the chip — the only
-    # concurrency a 1-vCPU host driving a remote accelerator has.
-    # Each entry: {"trace","mid","pending","st","t0"}
-    inflight = None
+    # pipelined groups: group i's host tails (bellman, log/CSV writes) run
+    # while groups i+1/i+2's vmapped replays execute on the chip — the
+    # only concurrency a 1-vCPU host driving a remote accelerator has.
+    # Two groups of lookahead cover the case where one group's device
+    # phase outlasts the next group's host build, so the eventual fetch
+    # never blocks. Each entry: {"trace","mid","pending","st","t0"}
+    from collections import deque
+
+    LOOKAHEAD = 2
+    inflight = deque()
 
     def transient(e) -> bool:
         # the TPU tunnel occasionally drops a remote call mid-sweep; a
@@ -173,9 +178,8 @@ def main(argv=None):
             except Exception as e:  # noqa: BLE001 — transient() filters
                 if not transient(e):
                     raise
-                if inflight is not None:
-                    flush(inflight)
-                    inflight = None
+                while inflight:
+                    flush(inflight.popleft())
                 run_group_unpipelined(trace, mid, pending)
                 done += len(pending)
                 print(
@@ -184,16 +188,15 @@ def main(argv=None):
                     flush=True,
                 )
                 continue
-            if inflight is not None:
-                flush(inflight)
-            inflight = {
+            inflight.append({
                 "trace": trace, "mid": mid, "pending": pending,
                 "st": st, "t0": t0,
-            }
+            })
+            while len(inflight) > LOOKAHEAD:
+                flush(inflight.popleft())
         else:
-            if inflight is not None:
-                flush(inflight)
-                inflight = None
+            while inflight:
+                flush(inflight.popleft())
             run_group_unpipelined(trace, mid, pending)
             done += len(pending)
             print(
@@ -203,8 +206,8 @@ def main(argv=None):
                 f"(total {time.perf_counter() - t_all:.0f}s)",
                 flush=True,
             )
-    if inflight is not None:
-        flush(inflight)
+    while inflight:
+        flush(inflight.popleft())
     print(f"[sweep] {total} experiments in {time.perf_counter() - t_all:.0f}s")
 
 

@@ -1,0 +1,106 @@
+"""The mesh product path (SimulatorConfig.mesh / customConfig.mesh /
+run.py --mesh): an end-to-end experiment sharded over the virtual 8-device
+mesh must write analysis CSVs byte-identical to the single-device run —
+sharding is an execution detail, not semantics (round-3/4 review item 4:
+the engine existed but had no product path)."""
+
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load_runner():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "exp_run_mesh", REPO / "experiments" / "run.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_mesh_experiment_csvs_identical(tmp_path):
+    from tests.test_experiments import _write_tiny_trace  # reuse fixture
+
+    run = _load_runner()
+    node_csv, pod_csv = _write_tiny_trace(tmp_path)
+    outs = {}
+    for label, extra in (("single", []), ("mesh", ["--mesh", "8"])):
+        outdir = tmp_path / label
+        run.run_experiment(run.get_args(
+            ["-d", str(outdir), "-f", str(pod_csv), "--node-trace",
+             str(node_csv), "-FGD", "1000", "-gpusel", "FGDScore", *extra]
+        ))
+        outs[label] = outdir
+    files = sorted(
+        p.name for p in outs["single"].iterdir() if p.name.startswith("analysis")
+    )
+    assert files
+    for name in files:
+        a = (outs["single"] / name).read_bytes()
+        b = (outs["mesh"] / name).read_bytes()
+        assert a == b, f"{name} differs between single-device and mesh runs"
+    # the log names the engine (diagnosability), otherwise line-for-line
+    la = (outs["single"] / "simon.log").read_text().splitlines()
+    lb = (outs["mesh"] / "simon.log").read_text().splitlines()
+    diff = [i for i, (x, y) in enumerate(zip(la, lb)) if x != y]
+    assert all("[Engine]" in la[i] for i in diff)
+    assert any("shard_map (mesh=8)" in lb[i] for i in diff)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_mesh_knob_via_simon_cr(tmp_path):
+    """customConfig.mesh reaches the applier path."""
+    import yaml
+
+    from tpusim.apply import Applier, ApplyOptions
+
+    cc = {
+        "apiVersion": "simon/v1alpha1",
+        "kind": "Config",
+        "metadata": {"name": "mesh-test"},
+        "spec": {
+            "cluster": {"customConfig": "example/test-cluster"},
+            "customConfig": {"mesh": 8},
+        },
+    }
+    p = tmp_path / "cc.yaml"
+    p.write_text(yaml.dump(cc))
+    import io
+
+    out = io.StringIO()
+    applier = Applier(
+        ApplyOptions(
+            simon_config=str(p),
+            default_scheduler_config=str(
+                REPO / "example/test-scheduler-config.yaml"
+            ),
+            base_dir=str(REPO),
+        )
+    )
+    result = applier.run(out=out)
+    assert not result.unscheduled_pods
+    assert "shard_map (mesh=8)" in out.getvalue()
+
+
+def test_mesh_validation():
+    from tpusim.io.trace import NodeRow
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+
+    nodes = [NodeRow("n0", 8000, 16384, 2, "V100M16")]
+    with pytest.raises(ValueError, match="devices"):
+        Simulator(nodes, SimulatorConfig(mesh=4096))
+    with pytest.raises(ValueError, match="random"):
+        Simulator(
+            nodes,
+            SimulatorConfig(
+                policies=(("RandomScore", 1000),), mesh=min(8, len(jax.devices()))
+            ),
+        )

@@ -79,6 +79,7 @@ class Applier:
             deschedule_policy=cc.deschedule.policy,
             use_timestamps=cc.use_timestamps,
             engine=cc.engine,
+            mesh=cc.mesh,
             extenders=self.sched_cfg.extenders,
         )
 

@@ -76,6 +76,11 @@ class CustomConfig:
     # this build's execution strategies, ENGINES.md):
     # auto | sequential | table | pallas. Validated by Simulator.__init__.
     engine: str = "auto"
+    # Device-mesh width for the explicit-collective shard_map engine
+    # (MULTICHIP.md): 0 = single device; N > 1 shards the node axis over
+    # an N-device jax.sharding.Mesh. The multi-chip analogue of the
+    # reference's process fan-out (experiments/README.md step 2).
+    mesh: int = 0
 
 
 @dataclass
@@ -197,6 +202,7 @@ def parse_simon_cr(doc: dict, base_dir: str = ".") -> SimonCR:
         typical_pods=_typical(cc_raw.get("typicalPodsConfig") or {}),
         use_timestamps=bool(cc_raw.get("useTimestamps", False)),
         engine=str(cc_raw.get("engine") or "auto"),
+        mesh=int(cc_raw.get("mesh") or 0),
     )
 
     apps = []

@@ -82,6 +82,14 @@ class SimulatorConfig:
     # execution mode that can splice per-cycle HTTP round-trips between
     # Score and selectHost (ref: simulator.go:196 WithExtenders)
     extenders: tuple = ()
+    # Device-mesh width: 0 = single device; N > 1 shards the node axis
+    # over an N-device jax.sharding.Mesh and replays on the
+    # explicit-collective shard_map engine (tpusim.parallel.shard_engine;
+    # MULTICHIP.md). Placements stay bit-identical to the single-device
+    # table engine, so merged analysis CSVs are unchanged. Requires N
+    # visible devices and a deterministic config (no RandomScore /
+    # gpuSelMethod random / extenders).
+    mesh: int = 0
 
 
 @dataclass
@@ -203,6 +211,38 @@ class Simulator:
             )
         self._pallas_fn = None
         self._extender_fn = None  # built lazily on first extender replay
+        self._shard_fn = None
+        if self.cfg.mesh:
+            # node-axis sharding over an N-device mesh: the shard_map
+            # engine with hand-written collectives (flat per-event cost;
+            # MULTICHIP.md). Built eagerly so misconfigurations (too few
+            # devices, randomized configs) fail at construction.
+            from tpusim.parallel import make_mesh
+            from tpusim.parallel.shard_engine import make_shardmap_table_replay
+
+            if self.cfg.extenders:
+                raise ValueError("mesh and extenders cannot combine")
+            if self.cfg.engine != "auto":
+                # the mesh path IS an engine choice (the sharded table
+                # engine); silently overriding a forced engine would
+                # attribute shard_map numbers to whatever was requested
+                raise ValueError(
+                    f"mesh={self.cfg.mesh} selects the shard_map engine; "
+                    f"it cannot combine with engine={self.cfg.engine!r} "
+                    "(leave engine: auto)"
+                )
+            if self.cfg.mesh > len(jax.devices()):
+                raise ValueError(
+                    f"mesh={self.cfg.mesh} needs {self.cfg.mesh} devices; "
+                    f"{len(jax.devices())} visible (virtual CPU meshes: set "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                    "JAX_PLATFORMS=cpu)"
+                )
+            self._mesh = make_mesh(self.cfg.mesh)
+            self._shard_fn = make_shardmap_table_replay(
+                self._policy_fns, self._mesh,
+                gpu_sel=self.cfg.gpu_sel_method,
+            )
         if self._pallas_ok and self.cfg.engine in ("auto", "pallas"):
             # Mosaic lowers on TPU backends only; anywhere else (cpu, gpu)
             # a forced `engine: pallas` runs the interpreter — correct but
@@ -286,7 +326,7 @@ class Simulator:
         # dedup types from the UNPADDED specs (no spurious zero type); the
         # type_id axis is padded alongside the pod axis (padded events only
         # ever reference pod 0)
-        if self.cfg.engine == "sequential":
+        if self.cfg.engine == "sequential" and not self.cfg.mesh:
             types = None
         elif types is None:
             types = build_pod_types(specs)
@@ -296,6 +336,28 @@ class Simulator:
         if types is not None and tid is not None:
             types = types._replace(type_id=tid)
         ev_kind, ev_pod = _pad_events(ev_kind, ev_pod, e2, xp=jnp)
+
+        if self._shard_fn is not None:
+            # mesh path: pad the node axis to the mesh width, shard state
+            # + tie-break rank, replay with explicit collectives, then
+            # slice the node axis back (pad rows are never chosen and
+            # metric-inert). Metrics post-pass runs on the padded state so
+            # telemetry indices line up
+            from tpusim.parallel import pad_nodes, shard_state
+
+            n0 = state.num_nodes
+            state_p, rank_p = pad_nodes(state, self.rank, self.cfg.mesh)
+            state_p = shard_state(state_p, self._mesh)
+            self._last_engine = f"shard_map (mesh={self.cfg.mesh})"
+            out = self._shard_fn(
+                state_p, specs, types, ev_kind, ev_pod, self.typical, key,
+                rank_p,
+            )
+            out = self._attach_metrics(out, state_p, specs, ev_kind, ev_pod, e)
+            out = out._replace(
+                state=jax.tree.map(lambda a: a[:n0], out.state)
+            )
+            return _slice_result(out, p, e)
 
         out = None
         if types is not None:
@@ -1022,6 +1084,11 @@ def dispatch_pods_batch(
         raise ValueError(
             "schedule_pods_batch cannot run extender configs (per-cycle "
             "HTTP round-trips do not batch); run each sim's run() instead"
+        )
+    if lead.cfg.mesh:
+        raise ValueError(
+            "schedule_pods_batch cannot run mesh configs (the shard_map "
+            "engine owns the device axis); run each sim's run() instead"
         )
     for s in sims[1:]:
         same = (
