@@ -207,9 +207,10 @@ def main():
                 "by hand — local Filter/Score/table-refresh, a 3-scalar "
                 "selectHost reduction (pmax best score, pmin winner rank, "
                 "psum winner node id), owner-local bind with one 8-lane "
-                "psum, and per-event metric rows as LOCAL partials summed "
-                "once after the scan — so the per-event collective payload "
-                "is independent of cluster and mesh size"
+                "psum; per-event metrics never touch the loop (the shared "
+                "post-pass, tpusim.sim.metrics, reconstructs the report "
+                "series from the replicated telemetry) — so the per-event "
+                "collective payload is independent of cluster and mesh size"
                 + (
                     f" (this run: {r8} us/event at 8 devices vs {r1} at 1, "
                     f"ratio {r8 / r1:.2f})"
@@ -219,6 +220,24 @@ def main():
                 + ". Run-to-run variance on the shared host is ~20-50%; "
                 "the signal is the ratio staying ~1, not the absolute "
                 "numbers.\n"
+            )
+            f.write(
+                "\n## Product path (round 5)\n\n"
+                "Sharding is a config knob, not a bench-only engine: "
+                "`customConfig.mesh: N` in the Simon CR, "
+                "`SimulatorConfig.mesh`, or `experiments/run.py --mesh N` "
+                "route every replay through this engine on an N-device "
+                "mesh (the single-chip tunnel auto-falls back to N virtual "
+                "CPU devices via tpusim.virtual_mesh). Verified end to "
+                "end: a full sweep-protocol cell (openb default x FGD x "
+                "tune 1.3, per-event reports) run with --mesh 8 writes "
+                "ALL analysis CSV families byte-identical to the "
+                "single-device run on the same backend "
+                "(tests/test_mesh_product.py pins the same on the tiny "
+                "trace + the Simon-CR knob). Cross-backend runs (virtual "
+                "CPU mesh vs real TPU) differ only in the documented f32 "
+                "last-ulp report channel; placements are identical "
+                "everywhere.\n"
             )
     print(f"[multichip] wrote {args.out}")
 

@@ -236,6 +236,13 @@ def _load_trace_cached(path: str, loader):
 def _build_sim(args):
     """Construct the configured Simulator + outdir/paths for one experiment
     (the setup half of run_experiment)."""
+    if getattr(args, "mesh", 0) and args.mesh > 1:
+        # single-chip tunnel + --mesh N: emulate the mesh on N virtual CPU
+        # devices (a no-op on real multi-device platforms); must come from
+        # the leaf module BEFORE anything initializes the backend
+        from tpusim.virtual_mesh import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(args.mesh)
     from tpusim.io.trace import load_node_csv, load_pod_csv
     from tpusim.sim.driver import Simulator, SimulatorConfig
     from tpusim.sim.typical import TypicalPodsConfig

@@ -17,3 +17,8 @@ __all__ = [
     "shard_state",
     "state_sharding",
 ]
+
+# Virtual-mesh bootstrap (force_virtual_cpu_devices) deliberately does NOT
+# live or re-export here: importing this package — even for a submodule —
+# initializes the JAX backend through its module graph, after which the
+# platform switch is a no-op. Import it from tpusim.virtual_mesh instead.

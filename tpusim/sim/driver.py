@@ -341,8 +341,7 @@ class Simulator:
             # mesh path: pad the node axis to the mesh width, shard state
             # + tie-break rank, replay with explicit collectives, then
             # slice the node axis back (pad rows are never chosen and
-            # metric-inert). Metrics post-pass runs on the padded state so
-            # telemetry indices line up
+            # metric-inert)
             from tpusim.parallel import pad_nodes, shard_state
 
             n0 = state.num_nodes
@@ -353,7 +352,11 @@ class Simulator:
                 state_p, specs, types, ev_kind, ev_pod, self.typical, key,
                 rank_p,
             )
-            out = self._attach_metrics(out, state_p, specs, ev_kind, ev_pod, e)
+            # the post-pass runs on the UNPADDED state: pad rows are never
+            # chosen (every valid event_node < n0), and the f32 initial
+            # totals then bracket exactly like a single-device run — so
+            # the analysis CSVs come out byte-identical, not merely close
+            out = self._attach_metrics(out, state, specs, ev_kind, ev_pod, e)
             out = out._replace(
                 state=jax.tree.map(lambda a: a[:n0], out.state)
             )

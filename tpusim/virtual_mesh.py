@@ -1,0 +1,38 @@
+"""Virtual-mesh bootstrap — importable WITHOUT touching the rest of the
+packages that initialize the JAX backend through their module graphs
+(importing tpusim.parallel — even a submodule of it, since the package
+__init__ always runs first — creates device values; after that the
+platform can no longer be switched). Lives directly under tpusim, whose
+__init__ stays import-light by design."""
+
+from __future__ import annotations
+
+
+def force_virtual_cpu_devices(n_devices: int) -> None:
+    """Best-effort: before first backend init, force an n-device virtual
+    CPU platform when the only accelerator is the single-chip 'axon' TPU
+    tunnel. Plain JAX_PLATFORMS env vars are not enough in this image —
+    the sitecustomize-registered axon PJRT plugin wins backend selection
+    regardless — so drop its factory registration pre-init (the strategy
+    tests/conftest.py and __graft_entry__.py use). No-op on real
+    multi-device platforms or once a backend is up."""
+    import os
+    import re
+
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    if _xb._backends:  # backend already up; nothing safe to do
+        return
+    if n_devices > 1 and "axon" in _xb._backend_factories:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
