@@ -288,3 +288,33 @@ def test_analysis_stop_marker(tmp_path):
     out = ana.parse_log(str(log))
     assert out["summary"]["unscheduled"] == 3
     assert out["frag"]["origin_milli"] == [10.0]
+
+
+def test_bellman_series_cache_identical(tmp_path, monkeypatch):
+    """The persistent Bellman-series cache (content-keyed, like the XLA
+    compile cache) must reproduce uncached results byte-identically — incl.
+    multi-stage experiments, where a first-call cache hit replays its
+    inputs before any later stage evaluates (memo-order dependence)."""
+    run = _load("exp_run_bc", EXP / "run.py")
+    node_csv, pod_csv = _write_tiny_trace(tmp_path)
+    base = ["-f", str(pod_csv), "--node-trace", str(node_csv),
+            "-FGD", "1000", "-gpusel", "FGDScore",
+            "--workload-inflation-ratio", "1.6"]  # second bellman stage
+
+    outs = {}
+    # warm2 exercises the second-warm-run ordering hazard: a first-call
+    # hit must not let LATER stages read/write the cache (their values
+    # embed the warmed memo's evaluation order)
+    for label, cache in (("nocache", ""), ("cold", str(tmp_path / "bc")),
+                         ("warm", str(tmp_path / "bc")),
+                         ("warm2", str(tmp_path / "bc"))):
+        monkeypatch.setenv("TPUSIM_BELLMAN_CACHE", cache)
+        outdir = tmp_path / label
+        run.run_experiment(run.get_args(["-d", str(outdir)] + base))
+        outs[label] = outdir
+    entries = list((tmp_path / "bc").glob("*.npy"))
+    assert len(entries) == 1, "only the FIRST stage's series may be cached"
+    for name in ("analysis.csv", "analysis_frag.csv", "analysis_allo.csv"):
+        ref = (outs["nocache"] / name).read_bytes()
+        for label in ("cold", "warm", "warm2"):
+            assert (outs[label] / name).read_bytes() == ref, f"{name} ({label})"

@@ -10,6 +10,7 @@ device via tpusim.sim.engine.make_replay.
 from __future__ import annotations
 
 import math
+import os
 import sys
 import time
 from dataclasses import dataclass, field
@@ -118,6 +119,28 @@ class SimulateResult:
     creation_rank: np.ndarray = None
 
 
+_BELLMAN_SRC_DIGEST = None
+
+
+def _bellman_source_digest() -> bytes:
+    """sha256 of the native Bellman evaluator source + the Python fallback
+    — the cache-key version salt (computed once per process)."""
+    global _BELLMAN_SRC_DIGEST
+    if _BELLMAN_SRC_DIGEST is None:
+        import hashlib
+
+        h = hashlib.sha256()
+        base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in ("native/bellman.cpp", "native/__init__.py",
+                    "ops/frag.py"):
+            path = os.path.join(base, rel)
+            if os.path.isfile(path):
+                with open(path, "rb") as f:
+                    h.update(f.read())
+        _BELLMAN_SRC_DIGEST = h.digest()
+    return _BELLMAN_SRC_DIGEST
+
+
 class Simulator:
     """Drives one cluster + workload through the compiled replay.
 
@@ -135,6 +158,7 @@ class Simulator:
         self.rank = jnp.asarray(tiebreak_rank(len(self.nodes), self.cfg.seed))
         self.log = LogSink(stream=None)
         self._bellman_eval = None
+        self._bellman_pending_replay = None
         self.workload_pods: List[PodRow] = []
         self.typical: Optional[TypicalPods] = None
         self.node_total_milli_cpu = int(sum(n.cpu_milli for n in self.nodes))
@@ -417,6 +441,7 @@ class Simulator:
         # computation, so sharing across experiments would make report
         # values depend on sweep order.
         self._bellman_eval = None
+        self._bellman_pending_replay = None
         self.log.info(f"Num of Total Pods: {len(self.workload_pods)}")
         self.log.info(f"Num of Total Pod Sepc: {len(self._typical_info)}")
 
@@ -431,6 +456,7 @@ class Simulator:
         self._typical_info = other._typical_info
         self._typical_host = other._typical_host
         self._bellman_eval = None
+        self._bellman_pending_replay = None
         self.log.info(f"Num of Total Pods: {len(self.workload_pods)}")
         self.log.info(f"Num of Total Pod Sepc: {len(self._typical_info)}")
 
@@ -792,13 +818,20 @@ class Simulator:
         per-event full-cluster sweep because the value function depends on
         node state alone. The whole event stream is evaluated in ONE native
         call (BellmanEvaluator.eval_series) instead of per-event ctypes
-        round-trips."""
+        round-trips.
+
+        The series is a deterministic pure function of (typical rows, start
+        state, event stream incl. telemetry), so — like XLA's persistent
+        compilation cache — a content-keyed disk cache (TPUSIM_BELLMAN_CACHE,
+        default <repo>/.bellman_cache, empty disables) lets artifact
+        REGENERATION skip the dominant per-experiment host cost. Caching is
+        first-call-only per Simulator: later calls (inflation/deschedule
+        stages) depend on the warmed memo, whose state embeds evaluation
+        order; a first-call cache hit therefore stashes its inputs and
+        replays them before any later call evaluates, keeping multi-stage
+        values bit-identical to an uncached run."""
         from tpusim.sim.engine import EV_CREATE
 
-        if self._bellman_eval is None:
-            from tpusim.native import BellmanEvaluator
-
-            self._bellman_eval = BellmanEvaluator(self._typical_host_rows())
         kinds = np.asarray(ev_kind)
         ev_pods = np.asarray(ev_pod)
         pod_cpu = np.fromiter(
@@ -809,16 +842,71 @@ class Simulator:
         )
 
         start_state = device_fetch(start_state)
-        return self._bellman_eval.eval_series(
-            np.asarray(start_state.cpu_left),
-            np.asarray(start_state.gpu_left),
-            np.asarray(start_state.gpu_type),
-            np.asarray(out.event_node),
-            np.asarray(out.event_dev),
+        inputs = (
+            np.ascontiguousarray(np.asarray(start_state.cpu_left, np.int32)),
+            np.ascontiguousarray(np.asarray(start_state.gpu_left, np.int32)),
+            np.ascontiguousarray(np.asarray(start_state.gpu_type, np.int32)),
+            np.ascontiguousarray(np.asarray(out.event_node, np.int32)),
+            np.ascontiguousarray(np.asarray(out.event_dev, np.uint8)),
             np.where(kinds == EV_CREATE, 1, -1).astype(np.int8),
-            pod_cpu[ev_pods],
-            pod_gpu[ev_pods],
+            np.ascontiguousarray(pod_cpu[ev_pods]),
+            np.ascontiguousarray(pod_gpu[ev_pods]),
         )
+
+        # "first call" = nothing evaluated OR pending yet: after a cache
+        # hit the evaluator is still unbuilt, but later stages must NOT
+        # read/write the cache (their values embed the warmed memo's
+        # evaluation order — caching them would poison the content keys)
+        first_call = (
+            self._bellman_eval is None and self._bellman_pending_replay is None
+        )
+        cache_path = self._bellman_cache_path(inputs) if first_call else None
+        if cache_path is not None and os.path.isfile(cache_path):
+            self._bellman_pending_replay = inputs
+            return np.load(cache_path)
+
+        if self._bellman_eval is None:
+            from tpusim.native import BellmanEvaluator
+
+            self._bellman_eval = BellmanEvaluator(self._typical_host_rows())
+            pending = getattr(self, "_bellman_pending_replay", None)
+            if pending is not None:
+                # a later stage after a first-call cache hit: rebuild the
+                # memo state the cached call would have produced
+                self._bellman_eval.eval_series(*pending)
+                self._bellman_pending_replay = None
+
+        series = self._bellman_eval.eval_series(*inputs)
+        if cache_path is not None:
+            os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+            tmp = f"{cache_path}.{os.getpid()}.tmp.npy"
+            with open(tmp, "wb") as f:
+                np.save(f, series)
+            os.replace(tmp, cache_path)
+        return series
+
+    def _bellman_cache_path(self, inputs):
+        """Content-keyed cache file for a FIRST bellman series of this
+        simulator, or None when caching is disabled."""
+        import hashlib
+
+        cache_dir = os.environ.get(
+            "TPUSIM_BELLMAN_CACHE",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), ".bellman_cache"),
+        )
+        if not cache_dir:
+            return None
+        h = hashlib.sha256()
+        # version salt: the evaluator SOURCE participates in the key (like
+        # the compiler version in XLA's persistent cache), so changing the
+        # native Bellman logic invalidates every cached series
+        h.update(_bellman_source_digest())
+        for row in self._typical_host_rows():
+            h.update(repr(row).encode())
+        for a in inputs:
+            h.update(a.tobytes())
+        return os.path.join(cache_dir, h.hexdigest() + ".npy")
 
     def _emit_event_reports(self, out, pods, ev_kind, ev_pod, start_state):
         """Per-event log block: `[i] attempt to ...` line (simulator.go:410,
