@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-tpu bench bench-scale sweep native clean
+.PHONY: test test-tpu bench bench-scale bench-scale-smoke sweep native clean
 
 test:
 	python -m pytest tests/ -q
@@ -15,6 +15,12 @@ bench:
 
 bench-scale:
 	python bench_scale.py
+
+# fast scale-lane regression gate on the CPU backend: 10k nodes trips the
+# blocked table-engine select (ENGINES.md "blocked table" row); a few
+# thousand pods keep the whole run to a couple of minutes
+bench-scale-smoke:
+	JAX_PLATFORMS=cpu python bench_scale.py --nodes 10000 --pods 5000 --chunk 5000
 
 sweep:
 	python experiments/sweep.py

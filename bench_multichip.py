@@ -222,6 +222,31 @@ def main():
                 "numbers.\n"
             )
             f.write(
+                "\n## Blocked local selectHost (round 6)\n\n"
+                "`make_shardmap_table_replay(..., block_size=...)` (driven "
+                "by `SimulatorConfig.block_size`, default auto) layers the "
+                "blocked table engine's incremental reductions onto each "
+                "shard for configs whose policies all use "
+                "`normalize: \"none\"` (FGD, DotProd, Packing, Clustering "
+                "— including this file's FGD lane): each device keeps "
+                "per-(type, block-of-B) summaries of (max total, min "
+                "tie-break rank, winner node), refreshed only at the "
+                "touched node's block, so the per-event selectHost input "
+                "on each device shrinks from nloc node rows to nloc/B "
+                "block maxima before the device contributes its scalar to "
+                "the collective. The cross-device payload itself was "
+                "already N-independent (3 scalars + one 8-lane psum) and "
+                "is unchanged; what shrinks is the local reduction feeding "
+                "it — the dominant per-event cost at nloc = N/D >= ~10k. "
+                "Placements stay bit-identical (the block summaries feed "
+                "the same lexicographic (max score, min rank) combine — "
+                "sim.step.block_reduce/packed_argmax, shared with the "
+                "single-device blocked engine). Normalized policies "
+                "(minmax/pwr) keep the flat local path: their per-event "
+                "global-extrema pmin/pmax collectives need the full local "
+                "rows anyway.\n"
+            )
+            f.write(
                 "\n## Product path (round 5)\n\n"
                 "Sharding is a config knob, not a bench-only engine: "
                 "`customConfig.mesh: N` in the Simon CR, "

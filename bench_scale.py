@@ -84,6 +84,13 @@ def main():
         "several --nodes values",
     )
     ap.add_argument(
+        "--block-size", type=int, default=0,
+        help="table-engine select layout (SimulatorConfig.block_size): "
+        "0 = auto (blocked incremental reductions at large N), > 0 "
+        "forces that block size, -1 forces the flat O(N) select — the "
+        "blocked-vs-flat rows in ENGINES.md compare 0 against -1",
+    )
+    ap.add_argument(
         "--chunk",
         type=int,
         default=200_000,
@@ -113,6 +120,7 @@ def main():
         seed=args.seed,
         report_per_event=False,
         engine=args.engine,
+        block_size=args.block_size,
         typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
     )
     sim = Simulator(nodes, cfg)
@@ -124,9 +132,12 @@ def main():
     ev_kind, ev_pod = jnp.asarray(ev_kind), jnp.asarray(ev_pod)
     key = jax.random.PRNGKey(args.seed)
 
-    from tpusim.sim.table_engine import build_pod_types
+    from tpusim.sim.table_engine import build_pod_types, resolve_block_size
 
     types = build_pod_types(specs)  # hoisted: identical for every chunk
+    k_types = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+    # the block size the table engine will resolve for this shape (0 = flat)
+    eff_block = resolve_block_size(args.block_size, args.nodes, k_types)
 
     def run_chunked():
         state = sim.init_state
@@ -159,7 +170,8 @@ def main():
     )
     print(
         f"[scale] nodes={args.nodes} pods={args.pods} "
-        f"engine={sim._last_engine} wall={wall:.1f}s "
+        f"engine={sim._last_engine} block={eff_block or 'flat'} "
+        f"wall={wall:.1f}s "
         f"(first incl. compile {first:.1f}s) placed={placed} "
         f"throughput={placed / wall:.0f} placements/s "
         f"us_per_event={1e6 * wall / args.pods:.1f} gpu_alloc={alloc:.2f}%"

@@ -1,0 +1,214 @@
+"""Blocked table engine (tpusim.sim.table_engine, block_size > 0) must be
+bit-identical to the flat table engine — and transitively to the sequential
+oracle, whose equality tests/test_table_engine.py pins — for every policy,
+normalizer, and per-event-random config, across block sizes. The blocked
+path only changes the select-phase data layout (block aggregates + two-level
+packed_argmax), never the kernels, so placements, device masks, telemetry,
+and final state must match exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.fixtures import random_cluster, random_pods
+from tpusim.policies import make_policy
+from tpusim.sim.engine import EV_CREATE, EV_DELETE
+from tpusim.sim.table_engine import (
+    BLOCKED_MIN_NODES,
+    build_pod_types,
+    make_table_replay,
+    resolve_block_size,
+)
+
+NUM_NODES = 140
+
+
+def _events_with_deletes(num_pods, rng):
+    kinds, idxs = [], []
+    seen = set()
+    for i in range(num_pods):
+        kinds.append(EV_CREATE)
+        idxs.append(i)
+        if rng.random() < 0.34 and i > 0:
+            victim = int(rng.integers(0, i + 1))
+            if victim not in seen:
+                seen.add(victim)
+                kinds.append(EV_DELETE)
+                idxs.append(victim)
+    return jnp.asarray(kinds, jnp.int32), jnp.asarray(idxs, jnp.int32)
+
+
+def _assert_equal(r0, r1):
+    """Full equality contract: placements, device masks, failure flags,
+    telemetry (event_node/event_dev — what the metric post-pass consumes),
+    and final cluster state."""
+    assert np.array_equal(np.asarray(r0.placed_node), np.asarray(r1.placed_node))
+    assert np.array_equal(np.asarray(r0.dev_mask), np.asarray(r1.dev_mask))
+    assert np.array_equal(np.asarray(r0.ever_failed), np.asarray(r1.ever_failed))
+    assert np.array_equal(np.asarray(r0.event_node), np.asarray(r1.event_node))
+    assert np.array_equal(np.asarray(r0.event_dev), np.asarray(r1.event_dev))
+    for a, b in zip(jax.tree.leaves(r0.state), jax.tree.leaves(r1.state)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize(
+    "policies,gpu_sel,blocks",
+    [
+        # normalize: none — full {8, 128, N} sweep
+        ([("FGDScore", 1000)], "FGDScore", (8, 128, NUM_NODES)),
+        ([("BestFitScore", 1000)], "best", (8, NUM_NODES)),  # minmax
+        ([("PWRScore", 1000)], "PWRScore", (8,)),  # pwr
+        # weighted mix with per-policy normalization (the reference's
+        # PWR+FGD rows): totals combine a stored-extrema normalized plane
+        # with a raw plane
+        ([("PWRScore", 500), ("FGDScore", 500)], "FGDScore", (8,)),
+        # per-event randomness: the blocked maker must keep the oracle's
+        # key-split discipline bit-for-bit (it runs the flat body for
+        # RandomScore configs; gpu_sel=random stays blocked with the same
+        # k_sel draw)
+        ([("RandomScore", 1000)], "random", (8,)),
+        ([("FGDScore", 1000)], "random", (8, 128)),
+    ],
+    ids=lambda p: "+".join(n for n, _ in p) if isinstance(p, list) else str(p),
+)
+def test_blocked_matches_flat(policies, gpu_sel, blocks):
+    rng = np.random.default_rng(7)
+    state, tp = random_cluster(rng, num_nodes=NUM_NODES)
+    pods = random_pods(rng, num_pods=60)
+    ev_kind, ev_pod = _events_with_deletes(60, rng)
+    pol = [(make_policy(name), w) for name, w in policies]
+    key = jax.random.PRNGKey(3)
+    rank = jnp.asarray(rng.permutation(NUM_NODES).astype(np.int32))
+    types = build_pod_types(pods)
+
+    flat = make_table_replay(pol, gpu_sel=gpu_sel, block_size=-1)
+    r0 = flat(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+    for block in blocks:
+        blocked = make_table_replay(pol, gpu_sel=gpu_sel, block_size=block)
+        r1 = blocked(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+        _assert_equal(r0, r1)
+
+
+def test_blocked_matches_flat_openb_prefix():
+    """The pinned cross-engine equality contract on real trace data: an
+    openb cluster prefix replay must come out bit-identical between the
+    flat and blocked layouts (block not dividing N exercises the sentinel
+    padding columns)."""
+    import os
+
+    from tpusim.io.trace import (
+        build_events,
+        load_node_csv,
+        load_pod_csv,
+        nodes_to_state,
+        pods_to_specs,
+        tiebreak_rank,
+    )
+    from tpusim.sim.typical import TypicalPodsConfig, get_typical_pods, pad_typical_pods
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    nodes = load_node_csv(
+        os.path.join(repo, "data/csv/openb_node_list_gpu_node.csv")
+    )
+    pods = load_pod_csv(
+        os.path.join(repo, "data/csv/openb_pod_list_default.csv")
+    )[:250]
+    state = nodes_to_state(nodes)
+    tp, _ = get_typical_pods(pods, TypicalPodsConfig())
+    tp = pad_typical_pods(tp)
+    specs = pods_to_specs(pods)
+    ev_kind, ev_pod = build_events(pods)
+    ev_kind, ev_pod = jnp.asarray(ev_kind), jnp.asarray(ev_pod)
+    rank = jnp.asarray(tiebreak_rank(len(nodes), 42))
+    key = jax.random.PRNGKey(42)
+    types = build_pod_types(specs)
+    pol = [(make_policy("FGDScore"), 1000)]
+
+    flat = make_table_replay(pol, gpu_sel="FGDScore", block_size=-1)
+    r0 = flat(state, specs, types, ev_kind, ev_pod, tp, key, rank)
+    for block in (8, 128, len(nodes)):
+        blocked = make_table_replay(pol, gpu_sel="FGDScore", block_size=block)
+        r1 = blocked(state, specs, types, ev_kind, ev_pod, tp, key, rank)
+        _assert_equal(r0, r1)
+
+
+def test_blocked_pinned_pods():
+    """nodeSelector-pinned pods bypass the block summaries (single
+    candidate) and must still match the flat feasibility-mask semantics."""
+    rng = np.random.default_rng(13)
+    state, tp = random_cluster(rng, num_nodes=16)
+    pods = random_pods(rng, num_pods=20)
+    pinned = np.full(20, -1, np.int32)
+    pinned[3] = 5
+    pinned[7] = 2
+    pinned[11] = 15
+    # unknown nodeSelector name: pods_to_specs pins to index N (out of
+    # range) — must FAIL, not land on a clipped node (review round 6)
+    pinned[13] = 16
+    pods = pods._replace(pinned=jnp.asarray(pinned))
+    ev_kind = jnp.zeros(20, jnp.int32)
+    ev_pod = jnp.arange(20, dtype=jnp.int32)
+    pol = [(make_policy("FGDScore"), 1000)]
+    key = jax.random.PRNGKey(1)
+    types = build_pod_types(pods)
+
+    flat = make_table_replay(pol, gpu_sel="FGDScore", block_size=-1)
+    r0 = flat(state, pods, types, ev_kind, ev_pod, tp, key)
+    blocked = make_table_replay(pol, gpu_sel="FGDScore", block_size=4)
+    r1 = blocked(state, pods, types, ev_kind, ev_pod, tp, key)
+    _assert_equal(r0, r1)
+    assert int(np.asarray(r1.placed_node)[13]) == -1  # out-of-range pin fails
+
+
+def test_resolve_block_size_heuristic():
+    """Auto keeps small clusters (openb N=1523) on the flat path, turns on
+    ~sqrt(N/K) power-of-two blocks at scale, honors explicit overrides,
+    and clamps forced sizes to N."""
+    assert resolve_block_size(0, 1523, 151) == 0  # openb stays flat
+    assert resolve_block_size(0, BLOCKED_MIN_NODES - 1, 10) == 0
+    b = resolve_block_size(0, 100_000, 151)
+    assert b > 0 and (b & (b - 1)) == 0  # power of two
+    assert 16 <= b <= 1024
+    big = resolve_block_size(0, 100_000, 1)
+    assert big >= b  # fewer types -> cheaper refresh -> larger blocks
+    assert resolve_block_size(64, 100, 151) == 64
+    assert resolve_block_size(7, 100, 151) == 7
+    assert resolve_block_size(512, 40, 151) == 40  # clamped to N
+    assert resolve_block_size(-1, 100_000, 151) == 0  # forced flat
+
+
+def test_driver_block_size_knob():
+    """SimulatorConfig.block_size routes through run_events with results
+    (including the metric post-pass) unchanged vs the flat layout."""
+    from tpusim.io.trace import NodeRow, PodRow, pods_to_specs
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+
+    rng = np.random.default_rng(31)
+    nodes = [
+        NodeRow(f"n{i}", 32000, 131072, int(g), "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], 12))
+    ]
+    pods = [
+        PodRow(f"p{i}", int(rng.choice([1000, 4000])), 1024,
+               int(rng.choice([0, 1])), 500)
+        for i in range(25)
+    ]
+    results = []
+    for bs in (-1, 5):
+        sim = Simulator(nodes, SimulatorConfig(
+            policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+            report_per_event=True, block_size=bs,
+        ))
+        sim.set_workload_pods(pods)
+        sim.set_typical_pods()
+        specs = pods_to_specs(pods)
+        ev_kind = jnp.zeros(25, jnp.int32)
+        ev_pod = jnp.arange(25, dtype=jnp.int32)
+        results.append(sim.run_events(
+            sim.init_state, specs, ev_kind, ev_pod, jax.random.PRNGKey(2)
+        ))
+    r0, r1 = results
+    _assert_equal(r0, r1)
+    for a, b in zip(r0.metrics, r1.metrics):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

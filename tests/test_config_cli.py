@@ -251,6 +251,20 @@ def test_scheduler_config_rejects_extenders_and_pct(tmp_path):
     cfg = load_scheduler_config(str(p))  # round 5: extenders parse
     assert cfg.extenders[0].url_prefix == "http://x/"
 
+    # k8s validation parity: an explicit weight: 0 with prioritizeVerb set
+    # must be rejected, not silently coerced to 1
+    p.write_text(yaml.dump({**base, "extenders": [
+        {"urlPrefix": "http://x/", "prioritizeVerb": "prioritize",
+         "weight": 0}
+    ]}))
+    with pytest.raises(SchedulerConfigError, match="weight"):
+        load_scheduler_config(str(p))
+    # weight 0 without a prioritize verb keeps the lenient default
+    p.write_text(yaml.dump({**base, "extenders": [
+        {"urlPrefix": "http://x/", "filterVerb": "filter", "weight": 0}
+    ]}))
+    assert load_scheduler_config(str(p)).extenders[0].weight == 1
+
 
 # ---- queue sorts (pkg/algo) ----
 

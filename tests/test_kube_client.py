@@ -401,6 +401,24 @@ def test_exec_plugin_token(tmp_path, api_server):
     assert [n.name for n in cluster.nodes] == ["node-a", "node-b"]
 
 
+def test_exec_plugin_clock_skew_margin(tmp_path, api_server):
+    """client-go parity: a slightly-stale expirationTimestamp (clock skew
+    between this host and the plugin's clock) must not abort ingestion —
+    only credentials stale beyond the margin (default 30s) are fatal."""
+    import datetime
+
+    stale = (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(seconds=10)
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
+    body = (
+        '[ "$1" = get-token ]\n'
+        'echo \'{"kind": "ExecCredential", "status": {"token": "x", '
+        f'"expirationTimestamp": "{stale}"}}}}\'\n'
+    )
+    KubeClient(_exec_kubeconfig(tmp_path, api_server, body))  # no raise
+
+
 def test_exec_plugin_failures(tmp_path, api_server):
     """Plugin failure modes surface as typed errors naming the plugin:
     non-zero exit, invalid JSON, wrong kind, and a missing binary."""

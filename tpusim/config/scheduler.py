@@ -199,12 +199,21 @@ def _parse_extenders(entries) -> tuple:
             for m in (e.get("managedResources") or [])
             if isinstance(m, dict) and m.get("name")
         )
+        # k8s validation requires a positive weight whenever prioritizeVerb
+        # is set (ValidateExtender); coercing an explicit `weight: 0` to 1
+        # would silently score with a weight the config never asked for
+        weight = e.get("weight")
+        if e.get("prioritizeVerb") and weight is not None and int(weight) < 1:
+            raise SchedulerConfigError(
+                f"extender weight must be a positive integer when "
+                f"prioritizeVerb is set, got {weight!r}"
+            )
         out.append(
             ExtenderConfig(
                 url_prefix=str(e["urlPrefix"]),
                 filter_verb=str(e.get("filterVerb") or ""),
                 prioritize_verb=str(e.get("prioritizeVerb") or ""),
-                weight=int(e.get("weight", 1) or 1),
+                weight=int(weight or 1),
                 node_cache_capable=bool(e.get("nodeCacheCapable")),
                 ignorable=bool(e.get("ignorable")),
                 managed_resources=managed,

@@ -37,6 +37,13 @@ from typing import Dict, List, Optional, Sequence
 import yaml
 
 
+# Clock-skew margin for exec-credential expirationTimestamp checks:
+# stamps stale by no more than this many seconds are accepted (client-go
+# parity — upstream uses the stamp only for refresh scheduling). Override
+# with TPUSIM_EXEC_CRED_SKEW_S for hosts with worse clock discipline.
+EXEC_CRED_SKEW_MARGIN_S = 30.0
+
+
 class KubeClientError(RuntimeError):
     pass
 
@@ -171,12 +178,27 @@ def _run_exec_plugin(spec: dict, kubeconfig_path: str, cluster: dict = None):
             # RFC3339 always carries an offset; be lenient and read a naive
             # stamp as UTC rather than crash comparing naive vs aware
             exp_dt = exp_dt.replace(tzinfo=datetime.timezone.utc)
-        if exp_dt <= datetime.datetime.now(datetime.timezone.utc):
-            # an already-expired credential would only surface later as an
+        # client-go only uses expirationTimestamp to decide when to re-run
+        # the plugin and still sends the returned token; hard-failing on
+        # any stale stamp would abort ingestion on mere clock skew between
+        # this host and the plugin's clock. Allow a skew margin
+        # (TPUSIM_EXEC_CRED_SKEW_S, default 30s) and only treat
+        # credentials stale beyond it as fatal.
+        try:
+            margin_s = float(
+                os.environ.get("TPUSIM_EXEC_CRED_SKEW_S",
+                               EXEC_CRED_SKEW_MARGIN_S)
+            )
+        except ValueError:
+            margin_s = EXEC_CRED_SKEW_MARGIN_S
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if exp_dt + datetime.timedelta(seconds=margin_s) <= now:
+            # a long-expired credential would only surface later as an
             # opaque 401; fail with the actual cause instead
             raise KubeClientError(
                 f"exec credential plugin {command!r} returned an expired "
-                f"credential (expirationTimestamp {exp})"
+                f"credential (expirationTimestamp {exp}, more than "
+                f"{margin_s:g}s stale)"
             )
     token = status.get("token")
     cert = status.get("clientCertificateData")

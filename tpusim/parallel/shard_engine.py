@@ -44,12 +44,13 @@ from jax.sharding import PartitionSpec as P
 from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
 from tpusim.policies.base import feasible_min_max, minmax_scale_i32
 from tpusim.sim.engine import ReplayResult
-from tpusim.sim.step import choose_devices
+from tpusim.sim.step import block_reduce, choose_devices, packed_argmax
 from tpusim.sim.table_engine import (
     PodTypes,
     _row_state,
     make_table_builders,
     reject_randomized,
+    resolve_block_size,
     selector_index,
 )
 from tpusim.types import NodeState, PodSpec
@@ -62,13 +63,25 @@ _INT_MAX = jnp.int32(jnp.iinfo(jnp.int32).max)
 
 
 def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
-                               report: bool = False):
+                               report: bool = False, block_size: int = 0):
     """Build the explicit-collective sharded replayer. The node count must
     already be padded to a multiple of the mesh size (parallel.pad_nodes)
     and `state`/`tiebreak_rank` sharded over it (parallel.shard_state).
     Metric-free like every engine; build the report series with
     tpusim.sim.metrics.compute_event_metrics over the replicated
-    telemetry."""
+    telemetry.
+
+    block_size (resolve_block_size over the PER-DEVICE node count) turns
+    on blocked local selectHost inputs for configs whose policies all use
+    normalize == "none": each shard keeps per-(type, block-of-B) summaries
+    (max total, min tie-break rank, winner node) refreshed only at the
+    touched node's block, so the per-device selectHost reduction consumes
+    nloc/B block maxima instead of nloc node rows. The cross-device
+    collective payload itself was already N-independent (3 scalars + one
+    8-lane mask) and is unchanged — the block maxima shrink what each
+    device reduces before contributing its scalar. Normalized policies
+    (minmax/pwr need global extrema collectives per event) keep the flat
+    local path regardless of block_size."""
     if report:
         raise ValueError(
             "the shard_map engine replays metric-free; build the report "
@@ -79,6 +92,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     _columns, _init_tables = make_table_builders(policies, sel_idx)
     npol = len(policies)
     n_dev = mesh.shape[NODE_AXIS]
+    all_none_norm = all(fn.normalize == "none" for fn, _ in policies)
 
     def shard_fn(state, rank, pods, types, ev_kind, ev_pod, tp, key):
         """Runs per shard: state/rank are the LOCAL node rows."""
@@ -97,12 +111,51 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             axis=-1,
         )  # [K, nloc, C]
 
+        k_types = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+        bsz = (
+            resolve_block_size(block_size, nloc, k_types)
+            if all_none_norm else 0
+        )
+
+        def _local_totals(rows):
+            """Weighted totals with -INT_MAX at infeasible entries from a
+            packed-layout slice [..., C] (none-normalize configs only)."""
+            tot = jnp.zeros(rows.shape[:-1], jnp.int32)
+            for i, (_, weight) in enumerate(policies):
+                tot = tot + jnp.int32(weight) * rows[..., i]
+            return jnp.where(rows[..., npol + 1] != 0, tot, -_INT_MAX)
+
+        if bsz:
+            nbl = -(-nloc // bsz)
+            nloc_p = nbl * bsz
+            if nloc_p != nloc:
+                # sentinel columns: feas 0 -> -INT_MAX totals, never chosen
+                packed_p = jnp.pad(
+                    packed_tbl, ((0, 0), (0, nloc_p - nloc), (0, 0))
+                )
+                rank_p = jnp.pad(
+                    rank, (0, nloc_p - nloc),
+                    constant_values=jnp.iinfo(jnp.int32).max,
+                )
+            else:
+                packed_p, rank_p = packed_tbl, rank
+            packed_tbl = packed_p
+            loffs = jnp.arange(nbl, dtype=jnp.int32) * bsz
+            lt, lr, la = block_reduce(
+                _local_totals(packed_tbl).reshape(k_types, nbl, bsz),
+                rank_p.reshape(nbl, bsz),
+            )
+            lwn = loffs[None, :] + la  # [K, nbl] local winner node indices
+        else:
+            rank_p = rank
+            lt = lr = lwn = jnp.zeros((0, 0), jnp.int32)
+
         placed = jnp.full(num_pods, -1, jnp.int32)
         masks = jnp.zeros((num_pods, MAX_GPUS_PER_NODE), jnp.bool_)
         failed = jnp.zeros(num_pods, jnp.bool_)
 
         def body(carry, ev):
-            (state, packed_tbl, dirty, placed, masks, failed,
+            (state, packed_tbl, lt, lr, lwn, dirty, placed, masks, failed,
              arr_cpu, arr_gpu, key) = carry
             kind, idx = ev
             pod = jax.tree.map(lambda a: a[idx], pods)
@@ -137,34 +190,91 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                 packed_tbl, new_col, lic, axis=1
             )
 
-            def do_create():
-                row = packed_tbl[t_id]  # [nloc, C]
-                feasible = (row[:, npol + 1] != 0) & (
-                    (pod.pinned < 0) | (gids == pod.pinned)
+            if bsz:
+                # dirty-block summary refresh for all K types: non-owner
+                # shards recompute an unchanged block (idempotent), owners
+                # fold the refreshed column in — O(K*B) either way
+                blk = lic // bsz
+                j0 = blk * bsz
+                rows_blk = jax.lax.dynamic_slice(
+                    packed_tbl, (0, j0, 0),
+                    (k_types, bsz, npol + 2),
                 )
-                total = jnp.zeros(nloc, jnp.int32)
-                for i, (fn, weight) in enumerate(policies):
-                    raw = row[:, i]
-                    if fn.normalize in ("minmax", "pwr"):
-                        # local extrema + pmin/pmax = the global reduction;
-                        # the scaling core is the same code the unsharded
-                        # engines normalize with
-                        lo_l, hi_l = feasible_min_max(raw, feasible)
-                        lo = jax.lax.pmin(lo_l, NODE_AXIS)
-                        hi = jax.lax.pmax(hi_l, NODE_AXIS)
-                        raw = minmax_scale_i32(
-                            raw, feasible, lo, hi,
-                            0 if fn.normalize == "minmax" else MAX_NODE_SCORE,
-                        )
-                    total = total + jnp.int32(weight) * raw
+                rank_blk = jax.lax.dynamic_slice(rank_p, (j0,), (bsz,))
+                bm, brk, bar = block_reduce(
+                    _local_totals(rows_blk), rank_blk
+                )
+                lt = jax.lax.dynamic_update_slice(lt, bm[:, None], (0, blk))
+                lr = jax.lax.dynamic_update_slice(lr, brk[:, None], (0, blk))
+                lwn = jax.lax.dynamic_update_slice(
+                    lwn, (j0 + bar)[:, None], (0, blk)
+                )
 
-                # selectHost: local argmax + 3 scalar collectives
-                best_l = jnp.max(jnp.where(feasible, total, -_INT_MAX))
-                wkey = jnp.where(
-                    feasible & (total == best_l), -rank, -_INT_MAX
-                )
-                am_l = jnp.argmax(wkey).astype(jnp.int32)
-                rank_l = -wkey[am_l]  # INT_MAX when shard has no candidate
+            def do_create():
+                if bsz:
+                    # blocked local selectHost: reduce nloc/B block
+                    # summaries instead of nloc rows; the 3-scalar
+                    # collective combine below is unchanged
+                    lt_row = jax.lax.dynamic_index_in_dim(lt, t_id, 0, False)
+                    lr_row = jax.lax.dynamic_index_in_dim(lr, t_id, 0, False)
+                    lw_row = jax.lax.dynamic_index_in_dim(lwn, t_id, 0, False)
+                    blk_i, best_l, okb = packed_argmax(
+                        lt_row, lt_row != -_INT_MAX, lr_row
+                    )
+                    am_l = lw_row[blk_i]
+                    rank_l = jnp.where(okb, lr_row[blk_i], _INT_MAX)
+                    # pinned pods: exactly one candidate, owned by exactly
+                    # one shard — the winner is the pinned node iff Filter
+                    # passes there (the flat path encodes the same through
+                    # its feasibility mask)
+                    pin_l = pod.pinned - offset
+                    owns_pin = (pin_l >= 0) & (pin_l < nloc)
+                    pin_c = jnp.clip(pin_l, 0, nloc - 1)
+                    pin_row = jax.lax.dynamic_slice(
+                        packed_tbl, (t_id, pin_c, 0), (1, 1, npol + 2)
+                    )[0, 0]
+                    pin_ok = owns_pin & (pin_row[npol + 1] != 0)
+                    pin_tot = jnp.zeros((), jnp.int32)
+                    for i, (_, weight) in enumerate(policies):
+                        pin_tot = pin_tot + jnp.int32(weight) * pin_row[i]
+                    pinned = pod.pinned >= 0
+                    best_l = jnp.where(
+                        pinned, jnp.where(pin_ok, pin_tot, -_INT_MAX), best_l
+                    )
+                    rank_l = jnp.where(
+                        pinned, jnp.where(pin_ok, rank[pin_c], _INT_MAX),
+                        rank_l,
+                    )
+                    am_l = jnp.where(pinned, pin_c, am_l)
+                else:
+                    row = packed_tbl[t_id]  # [nloc, C]
+                    feasible = (row[:, npol + 1] != 0) & (
+                        (pod.pinned < 0) | (gids == pod.pinned)
+                    )
+                    total = jnp.zeros(nloc, jnp.int32)
+                    for i, (fn, weight) in enumerate(policies):
+                        raw = row[:, i]
+                        if fn.normalize in ("minmax", "pwr"):
+                            # local extrema + pmin/pmax = the global
+                            # reduction; the scaling core is the same code
+                            # the unsharded engines normalize with
+                            lo_l, hi_l = feasible_min_max(raw, feasible)
+                            lo = jax.lax.pmin(lo_l, NODE_AXIS)
+                            hi = jax.lax.pmax(hi_l, NODE_AXIS)
+                            raw = minmax_scale_i32(
+                                raw, feasible, lo, hi,
+                                0 if fn.normalize == "minmax"
+                                else MAX_NODE_SCORE,
+                            )
+                        total = total + jnp.int32(weight) * raw
+
+                    # selectHost: local argmax + 3 scalar collectives
+                    best_l = jnp.max(jnp.where(feasible, total, -_INT_MAX))
+                    wkey = jnp.where(
+                        feasible & (total == best_l), -rank, -_INT_MAX
+                    )
+                    am_l = jnp.argmax(wkey).astype(jnp.int32)
+                    rank_l = -wkey[am_l]  # INT_MAX when no candidate
                 g_best = jax.lax.pmax(best_l, NODE_AXIS)
                 g_rank = jax.lax.pmin(
                     jnp.where(best_l == g_best, rank_l, _INT_MAX), NODE_AXIS
@@ -175,12 +285,19 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                     jnp.where(win, offset + am_l, 0), NODE_AXIS
                 ).astype(jnp.int32)
 
-                # Reserve/Bind: owner-local row update; one [8] psum
+                # Reserve: owner-local device choice; one [8] psum
                 # publishes the device mask for the replicated bookkeeping
+                # (the Bind scatter runs outside the switch — see below)
                 ln = jnp.clip(gnode - offset, 0, nloc - 1)
                 owner = (gnode >= offset) & (gnode < offset + nloc)
+                if bsz:
+                    pdev = jax.lax.dynamic_slice(
+                        packed_tbl, (t_id, ln, npol), (1, 1, 1)
+                    )[0, 0, 0]
+                else:
+                    pdev = row[ln, npol]
                 dmask_l = choose_devices(
-                    state.gpu_left[ln], pod, row[ln, npol], gpu_sel, k_sel
+                    state.gpu_left[ln], pod, pdev, gpu_sel, k_sel
                 ) & ok
                 dev_mask = (
                     jax.lax.psum(
@@ -189,89 +306,70 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                     )
                     > 0
                 )
-                apply = owner & ok
-                from tpusim.policies.clustering import pod_affinity_class
-
-                cls = pod_affinity_class(pod)
-                new_state = state._replace(
-                    cpu_left=state.cpu_left.at[ln].add(
-                        jnp.where(apply, -pod.cpu, 0)
-                    ),
-                    mem_left=state.mem_left.at[ln].add(
-                        jnp.where(apply, -pod.mem, 0)
-                    ),
-                    gpu_left=state.gpu_left.at[ln].add(
-                        jnp.where(apply, -dev_mask.astype(jnp.int32) * pod.gpu_milli, 0)
-                    ),
-                    aff_cnt=state.aff_cnt.at[ln, jnp.maximum(cls, 0)].add(
-                        jnp.where(apply & (cls >= 0), 1, 0)
-                    ),
-                )
-                node_out = jnp.where(ok, gnode, -1)
-                return (
-                    new_state,
-                    placed.at[idx].set(node_out),
-                    masks.at[idx].set(dev_mask),
-                    failed.at[idx].set(~ok),
-                    node_out,
-                    arr_cpu + pod.cpu,
-                    arr_gpu + pod.total_gpu_milli(),
-                    node_out,
-                    dev_mask,
-                )
+                return jnp.where(ok, gnode, -1), dev_mask
 
             def do_delete():
-                gnode = placed[idx]
-                dmask = masks[idx]
-                ln = jnp.clip(gnode - offset, 0, nloc - 1)
-                apply = (gnode >= offset) & (gnode < offset + nloc)
-                from tpusim.policies.clustering import pod_affinity_class
-
-                cls = pod_affinity_class(pod)
-                new_state = state._replace(
-                    cpu_left=state.cpu_left.at[ln].add(
-                        jnp.where(apply, pod.cpu, 0)
-                    ),
-                    mem_left=state.mem_left.at[ln].add(
-                        jnp.where(apply, pod.mem, 0)
-                    ),
-                    gpu_left=state.gpu_left.at[ln].add(
-                        jnp.where(apply, dmask.astype(jnp.int32) * pod.gpu_milli, 0)
-                    ),
-                    aff_cnt=state.aff_cnt.at[ln, jnp.maximum(cls, 0)].add(
-                        jnp.where(apply & (cls >= 0), -1, 0)
-                    ),
-                )
-                return (
-                    new_state,
-                    placed.at[idx].set(-1),
-                    masks.at[idx].set(False),
-                    failed,
-                    gnode,
-                    arr_cpu,
-                    arr_gpu,
-                    gnode,
-                    dmask,
-                )
+                return placed[idx], masks[idx]
 
             def do_skip():
                 return (
-                    state, placed, masks, failed, dirty, arr_cpu, arr_gpu,
-                    jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
+                    jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_)
                 )
 
-            (state2, placed2, masks2, failed2, dirty2, arr_cpu2, arr_gpu2,
-             node, dev) = jax.lax.switch(
-                jnp.clip(kind, 0, 2), [do_create, do_delete, do_skip]
+            # the switch returns only the replicated (node, dev_mask)
+            # decision: a carried buffer returned from a switch branch
+            # cannot alias the carry, and the resulting per-event copies
+            # of state/placed/masks dominated the loop at large nloc
+            # (same restructure as the single-device table engine)
+            kc = jnp.clip(kind, 0, 2)
+            node, dev = jax.lax.switch(kc, [do_create, do_delete, do_skip])
+            is_create = kc == 0
+            is_delete = kc == 1
+            lbind = jnp.clip(node - offset, 0, nloc - 1)
+            apply = (node >= 0) & (node >= offset) & (node < offset + nloc)
+            rs = jnp.where(is_delete, 1, -1)  # delete returns, create takes
+            from tpusim.policies.clustering import pod_affinity_class
+
+            cls = pod_affinity_class(pod)
+            state = state._replace(
+                cpu_left=state.cpu_left.at[lbind].add(
+                    jnp.where(apply, rs * pod.cpu, 0)
+                ),
+                mem_left=state.mem_left.at[lbind].add(
+                    jnp.where(apply, rs * pod.mem, 0)
+                ),
+                gpu_left=state.gpu_left.at[lbind].add(
+                    jnp.where(apply, rs, 0)
+                    * dev.astype(jnp.int32) * pod.gpu_milli
+                ),
+                aff_cnt=state.aff_cnt.at[lbind, jnp.maximum(cls, 0)].add(
+                    jnp.where(apply & (cls >= 0), -rs, 0)
+                ),
             )
+            placed = placed.at[idx].set(
+                jnp.where(is_create, node,
+                          jnp.where(is_delete, -1, placed[idx]))
+            )
+            masks = masks.at[idx].set(
+                jnp.where(is_create, dev,
+                          jnp.where(is_delete, False, masks[idx]))
+            )
+            failed = failed.at[idx].set(
+                jnp.where(is_create, node < 0, failed[idx])
+            )
+            arr_cpu = arr_cpu + jnp.where(is_create, pod.cpu, 0)
+            arr_gpu = arr_gpu + jnp.where(is_create, pod.total_gpu_milli(), 0)
+            # node == -1 (failed create) leaves no owner, so every shard
+            # skips the next refresh — same as the pre-restructure behavior
+            dirty = jnp.where(kc == 2, dirty, node)
             return (
-                state2, packed_tbl, dirty2, placed2, masks2, failed2,
-                arr_cpu2, arr_gpu2, key,
+                state, packed_tbl, lt, lr, lwn, dirty, placed, masks,
+                failed, arr_cpu, arr_gpu, key,
             ), (node, dev)
 
-        init = (state, packed_tbl, jnp.int32(0), placed, masks, failed,
-                jnp.int32(0), jnp.int32(0), key)
-        (state, _, _, placed, masks, failed, _, _, _), (
+        init = (state, packed_tbl, lt, lr, lwn, jnp.int32(0), placed, masks,
+                failed, jnp.int32(0), jnp.int32(0), key)
+        (state, _, _, _, _, _, placed, masks, failed, _, _, _), (
             nodes, devs
         ) = jax.lax.scan(body, init, (ev_kind, ev_pod))
         return state, placed, masks, failed, None, nodes, devs
@@ -282,14 +380,21 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     from tpusim.types import TypicalPods
 
     tp_specs = TypicalPods(*([P()] * len(TypicalPods._fields)))
-    mapped = jax.shard_map(
-        shard_fn,
-        mesh=mesh,
-        in_specs=(state_specs, P(NODE_AXIS), spec_r, types_specs,
-                  P(), P(), tp_specs, P()),
-        out_specs=(state_specs, P(), P(), P(), None, P(), P()),
-        check_vma=False,
-    )
+    in_specs = (state_specs, P(NODE_AXIS), spec_r, types_specs,
+                P(), P(), tp_specs, P())
+    out_specs = (state_specs, P(), P(), P(), None, P(), P())
+    if hasattr(jax, "shard_map"):
+        mapped = jax.shard_map(
+            shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    else:  # pre-0.5 jax spells it jax.experimental.shard_map.shard_map
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        mapped = _shard_map(
+            shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
 
     @jax.jit
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,

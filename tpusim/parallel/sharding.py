@@ -138,6 +138,11 @@ def make_sharded_table_replay(
     shared post-pass (tpusim.sim.metrics) over the replicated telemetry."""
     from tpusim.sim.table_engine import make_table_replay
 
+    # force the flat select: this engine's premise is letting the SPMD
+    # partitioner shard the flat [.., N] tables along the node axis; the
+    # blocked layout's block-summary tables would be partitioned
+    # unpredictably (the explicit-collective shard_engine is the path that
+    # composes with blocking — see its block_size knob)
     return _shard_replay_fn(
-        make_table_replay(policies, gpu_sel=gpu_sel), mesh, 1
+        make_table_replay(policies, gpu_sel=gpu_sel, block_size=-1), mesh, 1
     )

@@ -9,10 +9,13 @@ kernel `(NodeState, PodSpec, ScoreContext) -> PolicyResult`.
 from __future__ import annotations
 
 from tpusim.policies.base import (
+    NORMALIZE_DEGENERATE,
     PolicyFn,
     PolicyResult,
     ScoreContext,
+    feasible_min_max,
     minmax_normalize_i32,
+    minmax_scale_i32,
     pwr_normalize_i32,
 )
 from tpusim.policies.bestfit import bestfit_score
@@ -75,13 +78,24 @@ POLICY_NAMES = (
     "DotProductScore",
 )
 
+# The normalizers decompose into a block-reducible reduction half
+# (feasible_min_max: associative min/max, so global extrema come exactly
+# from per-block extrema) and an elementwise apply half (minmax_scale_i32,
+# with NORMALIZE_DEGENERATE supplying each mode's zero-range value). The
+# blocked table engine and the shard_map engine rely on this split to
+# reduce over block/shard summaries instead of all N nodes while staying
+# bit-identical to minmax_normalize_i32 / pwr_normalize_i32.
+
 __all__ = [
     "PolicyFn",
     "PolicyResult",
     "ScoreContext",
     "make_policy",
     "make_dotprod",
+    "feasible_min_max",
     "minmax_normalize_i32",
+    "minmax_scale_i32",
     "pwr_normalize_i32",
+    "NORMALIZE_DEGENERATE",
     "POLICY_NAMES",
 ]

@@ -88,3 +88,9 @@ def pwr_normalize_i32(scores, feasible):
     but the degenerate all-equal case maps to 100, not 0."""
     lo, hi = feasible_min_max(scores, feasible)
     return minmax_scale_i32(scores, feasible, lo, hi, MAX_NODE_SCORE)
+
+
+# zero-range (all-equal) value per normalize mode — what block-reducing
+# callers pass as `degenerate` to minmax_scale_i32 so their apply half
+# matches minmax_normalize_i32 / pwr_normalize_i32 exactly
+NORMALIZE_DEGENERATE = {"minmax": 0, "pwr": MAX_NODE_SCORE}

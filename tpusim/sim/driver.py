@@ -78,6 +78,13 @@ class SimulatorConfig:
     # honors `sequential`; `pallas` has no batched form and batches run
     # the (bit-identical) table engine instead.
     engine: str = "auto"
+    # table-engine select layout (tpusim.sim.table_engine.resolve_block_size):
+    # 0 = auto (blocked incremental reductions over ~sqrt(N/K)-node blocks
+    # at large N, flat elsewhere — openb-scale traces stay flat), > 0 =
+    # force that block size, < 0 = force the flat O(N) select. Placements
+    # are bit-identical either way; this is purely a throughput knob for
+    # the 100k-node scale lane.
+    block_size: int = 0
     # HTTP scheduler extenders (tpusim.sim.extender.ExtenderConfig tuple).
     # When set, every replay runs the host-loop extender engine — the only
     # execution mode that can splice per-cycle HTTP round-trips between
@@ -210,6 +217,7 @@ class Simulator:
             self._policy_fns,
             gpu_sel=self.cfg.gpu_sel_method,
             report=False,
+            block_size=self.cfg.block_size,
         )
         # fused whole-replay Pallas engine (tpusim.sim.pallas_engine): one
         # kernel for the entire event loop, ~4x the table engine on chip;
@@ -266,6 +274,7 @@ class Simulator:
             self._shard_fn = make_shardmap_table_replay(
                 self._policy_fns, self._mesh,
                 gpu_sel=self.cfg.gpu_sel_method,
+                block_size=self.cfg.block_size,
             )
         if self._pallas_ok and self.cfg.engine in ("auto", "pallas"):
             # Mosaic lowers on TPU backends only; anywhere else (cpu, gpu)
@@ -1190,6 +1199,7 @@ def dispatch_pods_batch(
             and s.cfg.report_per_event == lead.cfg.report_per_event
             and s.cfg.use_timestamps == lead.cfg.use_timestamps
             and s.cfg.engine == lead.cfg.engine
+            and s.cfg.block_size == lead.cfg.block_size
             and s.cfg.typical_pods == lead.cfg.typical_pods
             and s.nodes == lead.nodes
             # the batched replay scores every seed against lead's typical

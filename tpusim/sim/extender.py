@@ -23,6 +23,15 @@ Semantics mirrored from the vendored code:
     not in the input is an error; FailedNodes are simply absent from the
     subset; a transport/Error failure fails the CYCLE (pod unschedulable)
     unless the extender is `ignorable` (findNodesThatPassExtenders).
+    DEVIATION: the membership check applies to BOTH payload shapes here,
+    while the vendored scheduler only enforces it on the nodeCacheCapable
+    NodeNames path — for non-nodeCacheCapable extenders it accepts the
+    returned Nodes items verbatim (extender.go:331-335), trusting the
+    extender to echo real node objects. This build's nodes are rows of a
+    fixed array, so an out-of-set name cannot be scheduled onto and
+    raising ExtenderError (or skipping, if ignorable) is the closest
+    array-state behavior; a verbatim-echo extender that renames nodes
+    would proceed upstream but fail the cycle here.
   - prioritize: errors are IGNORED (the vendored goroutine drops them);
     combinedScores[host] += score × weight; the sum joins the plugin total
     as combined × (MaxNodeScore / MaxExtenderPriority).

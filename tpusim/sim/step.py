@@ -106,32 +106,60 @@ def choose_devices(gpu_left, pod, policy_dev_scalar, gpu_sel: str, key):
     return jnp.where(has_gpu, jnp.where(is_share, share_mask, whole_mask), False)
 
 
-def select_and_bind(
+def packed_argmax(
+    total: jnp.ndarray,  # i32[M] scores (any granularity: nodes or blocks)
+    valid: jnp.ndarray,  # bool[M]
+    rank: jnp.ndarray,  # i32[M] tie-break rank (smaller wins)
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """selectHost's lexicographic (max score, min tie-break rank) argmax —
+    the ONE packed-key reduction shared by the sequential oracle, the flat
+    table engine, and the blocked table engine (which runs it twice: per
+    block over nodes, then globally over block summaries; identical combine
+    in, bit-identical winner out). Returns (index, best_score, ok).
+
+    Two reductions: max score over valid entries, then argmax of -rank
+    among the winners (= min rank); validity of the result is read off the
+    winner key instead of a third reduction
+    (generic_scheduler.go:187-212)."""
+    best = jnp.max(jnp.where(valid, total, -_INT_MAX))
+    wkey = jnp.where(valid & (total == best), -rank, -_INT_MAX)
+    idx = jnp.argmax(wkey).astype(jnp.int32)
+    ok = wkey[idx] != -_INT_MAX
+    return idx, best, ok
+
+
+def block_reduce(tot: jnp.ndarray, rank: jnp.ndarray):
+    """Per-block (max total, min tie-break rank among the maxima, argmax)
+    over the trailing axis — the in-block half of the blocked two-level
+    selectHost, shared by the single-device blocked table engine and the
+    shard_map engine's blocked local select so the combine cannot drift
+    between them. `tot` uses -INT_MAX as the infeasible/empty sentinel;
+    rows whose max stays at the sentinel are discarded by the global
+    combine's validity gate, so their (rank, argmax) outputs are
+    don't-cares. `rank` broadcasts against `tot`."""
+    m = tot.max(-1)
+    wkey = jnp.where(tot == m[..., None], -rank, -_INT_MAX)
+    a = jnp.argmax(wkey, -1).astype(jnp.int32)
+    r = jnp.take_along_axis(
+        jnp.broadcast_to(rank, tot.shape), a[..., None], -1
+    )[..., 0]
+    return m, r, a
+
+
+def bind_selected(
     state: NodeState,
     pod: PodSpec,
-    feasible: jnp.ndarray,  # bool[N]
-    total: jnp.ndarray,  # i32[N] weighted scores
-    policy_dev: jnp.ndarray,  # i32[N] per-node policy device pick (-1 none)
+    node: jnp.ndarray,  # i32 chosen node index in [0, N) (ignored when ~ok)
+    ok: jnp.ndarray,  # bool — selection succeeded
+    policy_dev_scalar: jnp.ndarray,  # i32 policy device pick at `node`
     gpu_sel: str,
     key,
-    tiebreak_rank: jnp.ndarray,
 ) -> Tuple[NodeState, Placement]:
-    """selectHost + Reserve + Bind for already-computed scores — the single
-    source of truth shared by the sequential engine (schedule_one) and the
-    incremental table engine, so the two stay bit-identical by construction.
-
-    selectHost: max weighted score over feasible nodes, smallest tie-break
-    rank wins (the reference's lexicographic order over randomly-prefixed
-    node names; generic_scheduler.go:187-212). Two reductions: max score,
-    then argmax of -rank among the winners (= min rank); feasibility of the
-    result is read off the winner key instead of a third reduction."""
-    best = jnp.max(jnp.where(feasible, total, -_INT_MAX))
-    wkey = jnp.where(feasible & (total == best), -tiebreak_rank, -_INT_MAX)
-    node = jnp.argmax(wkey).astype(jnp.int32)
-    ok = wkey[node] != -_INT_MAX
-
+    """Reserve + Bind for an already-selected node — the post-selectHost
+    half of the cycle, shared by every engine so the scatter semantics
+    cannot diverge."""
     # Reserve: concrete device allocation on the chosen node.
-    dev_mask = choose_devices(state.gpu_left[node], pod, policy_dev[node], gpu_sel, key)
+    dev_mask = choose_devices(state.gpu_left[node], pod, policy_dev_scalar, gpu_sel, key)
     dev_mask = dev_mask & ok
 
     # Bind: scatter-commit the placement.
@@ -147,6 +175,127 @@ def select_and_bind(
         ),
     )
     return new_state, Placement(jnp.where(ok, node, -1).astype(jnp.int32), dev_mask)
+
+
+class PendingCommit(NamedTuple):
+    """One event's deferred effects, applied at the START of the next scan
+    iteration (or in the post-scan epilogue for the last event).
+
+    The table engines software-pipeline every carried-buffer write by one
+    event: within a scan body, a buffer read scheduled before a write to
+    the same buffer forces XLA to preserve the old value — a whole-buffer
+    copy per event (at 100k nodes the state copies alone cost more than
+    the actual per-event compute on the CPU backend). Deferring the commit
+    makes every body strictly write-then-read: apply the previous event's
+    scatters first, then read state/tables freely. Bit-identical by
+    construction — the same scatters land before anything reads them.
+
+    node == -1 encodes a no-op state commit (failed create / skip / the
+    pre-first-event initial value). pod_write is the bookkeeping row index
+    (the P-th dummy row for skip events); failed_write is the row for the
+    ever-failed flag (dummy unless the event was a creation attempt)."""
+
+    node: jnp.ndarray  # i32 touched node, -1 = none
+    dev_mask: jnp.ndarray  # bool[8]
+    rs: jnp.ndarray  # i32 +1 delete (returns resources), -1 create
+    cpu: jnp.ndarray  # i32 pod milli-CPU
+    mem: jnp.ndarray  # i32 pod MiB
+    gpu_milli: jnp.ndarray  # i32 pod per-GPU milli
+    cls: jnp.ndarray  # i32 affinity class (-1 none)
+    pod_write: jnp.ndarray  # i32 row for placed/masks ([P] = dummy)
+    placed_val: jnp.ndarray  # i32 value for placed[pod_write]
+    mask_val: jnp.ndarray  # bool[8] value for masks[pod_write]
+    failed_write: jnp.ndarray  # i32 row for failed ([P] = dummy)
+    failed_val: jnp.ndarray  # bool
+
+
+def no_pending_commit(num_pods: int) -> "PendingCommit":
+    """The inert pre-first-event PendingCommit (all writes hit dummies)."""
+    z = jnp.int32(0)
+    return PendingCommit(
+        node=jnp.int32(-1),
+        dev_mask=jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
+        rs=jnp.int32(-1), cpu=z, mem=z, gpu_milli=z, cls=jnp.int32(-1),
+        pod_write=jnp.int32(num_pods), placed_val=jnp.int32(-1),
+        mask_val=jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
+        failed_write=jnp.int32(num_pods), failed_val=jnp.bool_(False),
+    )
+
+
+def make_pending_commit(
+    kind: jnp.ndarray,  # i32 clipped event kind: 0 create, 1 delete, 2 skip
+    idx: jnp.ndarray,  # i32 pod index of the event
+    node: jnp.ndarray,  # i32 touched node (-1 = none: failed create / skip)
+    dev_mask: jnp.ndarray,  # bool[8] devices touched
+    pod: PodSpec,
+    num_pods: int,
+) -> "PendingCommit":
+    """Encode one event's effects for the next iteration's apply_commit.
+
+    Semantics match the former in-branch commits exactly: a successful
+    create consumes (node, dev_mask); a delete returns the recorded
+    resources (node/dev_mask are the freed placement); failed creates and
+    skips are state-inert via node == -1; placed/masks are written for
+    create (the placement / -1 on failure) and delete (-1/False) but not
+    skip; the ever-failed flag is only written by creation attempts
+    (simulator.go:444-455)."""
+    is_create = kind == 0
+    is_skip = kind == 2
+    return PendingCommit(
+        node=node,
+        dev_mask=dev_mask,
+        rs=jnp.where(kind == 1, 1, -1),  # delete returns, create consumes
+        cpu=pod.cpu, mem=pod.mem, gpu_milli=pod.gpu_milli,
+        cls=pod_affinity_class(pod),
+        pod_write=jnp.where(is_skip, num_pods, idx).astype(jnp.int32),
+        placed_val=jnp.where(is_create, node, -1).astype(jnp.int32),
+        mask_val=jnp.where(is_create, dev_mask, False),
+        failed_write=jnp.where(is_create, idx, num_pods).astype(jnp.int32),
+        failed_val=node < 0,
+    )
+
+
+def apply_commit(state: NodeState, placed, masks, failed, p: "PendingCommit"):
+    """Apply a PendingCommit's scatters — the write-only half of the
+    pipelined event loop. placed/masks/failed carry one extra dummy row
+    ([P]) that absorbs skip-event writes."""
+    apply = p.node >= 0
+    sel = jnp.maximum(p.node, 0)
+    state = state._replace(
+        cpu_left=state.cpu_left.at[sel].add(jnp.where(apply, p.rs * p.cpu, 0)),
+        mem_left=state.mem_left.at[sel].add(jnp.where(apply, p.rs * p.mem, 0)),
+        gpu_left=state.gpu_left.at[sel].add(
+            jnp.where(apply, p.rs, 0) * p.dev_mask.astype(jnp.int32)
+            * p.gpu_milli
+        ),
+        aff_cnt=state.aff_cnt.at[sel, jnp.maximum(p.cls, 0)].add(
+            jnp.where(apply & (p.cls >= 0), -p.rs, 0)
+        ),
+    )
+    placed = placed.at[p.pod_write].set(p.placed_val)
+    masks = masks.at[p.pod_write].set(p.mask_val)
+    failed = failed.at[p.failed_write].set(p.failed_val)
+    return state, placed, masks, failed
+
+
+def select_and_bind(
+    state: NodeState,
+    pod: PodSpec,
+    feasible: jnp.ndarray,  # bool[N]
+    total: jnp.ndarray,  # i32[N] weighted scores
+    policy_dev: jnp.ndarray,  # i32[N] per-node policy device pick (-1 none)
+    gpu_sel: str,
+    key,
+    tiebreak_rank: jnp.ndarray,
+) -> Tuple[NodeState, Placement]:
+    """selectHost + Reserve + Bind for already-computed scores — the single
+    source of truth shared by the sequential engine (schedule_one) and the
+    incremental table engine, so the two stay bit-identical by construction.
+    Composed from packed_argmax (selectHost) + bind_selected (Reserve/Bind)
+    so the blocked table engine can reuse both halves around its
+    block-summary reduction."""
+    node, _, ok = packed_argmax(total, feasible, tiebreak_rank)
+    return bind_selected(state, pod, node, ok, policy_dev[node], gpu_sel, key)
 
 
 def score_pod(

@@ -38,18 +38,55 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpusim.constants import MAX_GPUS_PER_NODE
-from tpusim.policies import ScoreContext, minmax_normalize_i32, pwr_normalize_i32
+from tpusim.policies import (
+    NORMALIZE_DEGENERATE,
+    ScoreContext,
+    minmax_normalize_i32,
+    minmax_scale_i32,
+    pwr_normalize_i32,
+)
 from tpusim.sim.engine import ReplayResult
 from tpusim.sim.step import (
     SELF_SELECT_POLICIES,
-    Placement,
+    apply_commit,
+    block_reduce,
+    choose_devices,
     filter_nodes,
-    select_and_bind,
-    unschedule,
+    make_pending_commit,
+    no_pending_commit,
+    packed_argmax,
 )
 from tpusim.types import NodeState, PodSpec
 
 _INT_MAX = jnp.int32(jnp.iinfo(jnp.int32).max)
+
+# Below this node count the flat O(N) select wins: the blocked path's extra
+# per-event fixed costs (dirty-block refresh + two-level combine) outweigh
+# the reduction savings, and openb-scale traces (N=1523) must not regress.
+BLOCKED_MIN_NODES = 8192
+
+
+def resolve_block_size(block_size: int, num_nodes: int, num_types: int) -> int:
+    """Static block-size decision for the blocked table engine.
+
+    block_size > 0 forces that block size, < 0 forces the flat path, and 0
+    (auto) picks a balanced ~sqrt block: the per-event cost is
+    O(K*B) dirty-block aggregate refresh + O(N/B) block-summary combine, so
+    the balance point is B ~ sqrt(N/K) (the plain ~sqrt(N) rule, refined by
+    the pod-type count K that multiplies the refresh), rounded to a power
+    of two and clamped to [16, 1024]. Auto stays flat below
+    BLOCKED_MIN_NODES. Returns 0 for "run the flat path"."""
+    if block_size < 0:
+        return 0
+    if block_size > 0:
+        return min(block_size, num_nodes)
+    if num_nodes < BLOCKED_MIN_NODES:
+        return 0
+    import math
+
+    b = int(math.sqrt(3.0 * num_nodes / max(num_types, 1)))
+    b = max(16, min(1024, 1 << max(b - 1, 1).bit_length()))
+    return min(b, num_nodes)
 
 
 class PodTypes(NamedTuple):
@@ -254,11 +291,27 @@ def make_table_builders(policies, sel_idx: int):
     return columns, init_tables
 
 
-def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
+def make_table_replay(
+    policies, gpu_sel: str = "best", report: bool = False, block_size: int = 0
+):
     """Build the jitted incremental replayer for a static policy config.
 
     policies: [(policy_fn, weight)] — all must be table-izable (raw score a
     pure function of node state + pod spec; RandomScore is not).
+
+    block_size selects the select-phase data layout (resolve_block_size):
+    0 (auto) runs the blocked incremental-reduction path at large N and the
+    flat path elsewhere; > 0 forces that block size; < 0 forces flat.
+    Configs containing RandomScore always run flat — its score row is a
+    per-event draw over all N feasible nodes, so there is nothing
+    incremental to reduce. The blocked path maintains, per
+    (policy, type, block-of-B-nodes), the block min/max feeding the
+    normalizers plus the block's (max total, min tie-break rank, node)
+    summary, refreshes only the touched node's block per event (O(B)) and
+    reduces the final selectHost over N/B block summaries (O(N/B)) —
+    bit-identical to the flat path because the same packed_argmax combine
+    consumes exact block maxima (max/min are associative) and the same
+    minmax_scale_i32 apply consumes exact global extrema.
 
     The replay is metric-free: per-event report rows (the reference
     recomputes frag/alloc/power cluster-wide after every event,
@@ -273,12 +326,311 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
             "the table engine replays metric-free; build the report series "
             "with tpusim.sim.metrics.compute_event_metrics"
         )
-    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report)
+    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
+                 int(block_size))
     if cache_key in _TABLE_REPLAY_CACHE:
         return _TABLE_REPLAY_CACHE[cache_key]
     num_pol = len(policies)
     sel_idx = selector_index(policies, gpu_sel)
     _columns, _init_tables = make_table_builders(policies, sel_idx)
+    has_random = any(fn.policy_name == "RandomScore" for fn, _ in policies)
+    # policies whose normalizer needs global (lo, hi) extrema over feasible
+    # nodes; the blocked path maintains these via block min/max aggregates
+    norm_idx = [
+        i for i, (fn, _) in enumerate(policies)
+        if fn.normalize in ("minmax", "pwr")
+    ]
+    norm_deg = [
+        NORMALIZE_DEGENERATE[policies[i][0].normalize] for i in norm_idx
+    ]
+
+    def _totals(raws, feas, slo, shi):
+        """Weighted normalized totals with a -INT_MAX sentinel at
+        infeasible entries. raws: i32[num_pol, ..., X]; feas: bool[..., X];
+        slo/shi: i32[len(norm_idx), ...] stored extrema per normalized
+        policy. The apply half is the shared minmax_scale_i32, so feasible
+        entries match the oracle's minmax/pwr_normalize_i32 bit-for-bit
+        whenever slo/shi equal the current feasible extrema."""
+        tot = jnp.zeros(feas.shape, jnp.int32)
+        for i, (fn, weight) in enumerate(policies):
+            raw = raws[i]
+            if fn.normalize in ("minmax", "pwr"):
+                j = norm_idx.index(i)
+                raw = minmax_scale_i32(
+                    raw, feas, slo[j][..., None], shi[j][..., None],
+                    norm_deg[j],
+                )
+            tot = tot + jnp.int32(weight) * raw
+        return jnp.where(feas, tot, -_INT_MAX)
+
+    def _blocked_replay(
+        state, pods, type_id, types, ev_kind, ev_pod, tp, key, rank,
+        score_tbl, sdev_tbl, feas_tbl, placed, masks, failed, bsz, k_types,
+    ):
+        """The blocked O(B + N/B) select path: tables padded to a whole
+        number of B-node blocks (sentinel columns: infeasible, rank
+        INT_MAX), plus the incremental aggregates
+
+            brmin/brmax[pn, K, N/B]  block raw-score extrema over feasible
+                                     nodes per normalized policy (their
+                                     min/max over blocks == the global
+                                     feasible_min_max extrema exactly)
+            bt/br/bn[K, N/B]         per block: max weighted total, min
+                                     tie-break rank among the maxima, and
+                                     that winner's node id — the block
+                                     summaries the final packed_argmax
+                                     reduces over
+
+        bt rows are built with *stored* per-type extrema (slo/shi); a
+        per-event drift check against the current blocked extrema rebuilds
+        one type's summary row (inside a cond, so the O(N) rebuild only
+        costs when an extremum actually moved) before the select consumes
+        it — which is what keeps normalized policies bit-identical to the
+        flat path."""
+        n = state.num_nodes
+        num_pods = pods.cpu.shape[0]
+        nblk = -(-n // bsz)
+        n_pad = nblk * bsz
+        n_norm = len(norm_idx)
+        if n_pad != n:
+            pad = n_pad - n
+            score_tbl = jnp.pad(score_tbl, ((0, 0), (0, 0), (0, pad)))
+            sdev_tbl = jnp.pad(
+                sdev_tbl, ((0, 0), (0, pad)), constant_values=-1
+            )
+            feas_tbl = jnp.pad(feas_tbl, ((0, 0), (0, pad)))
+            rank_p = jnp.pad(
+                rank, (0, pad), constant_values=jnp.iinfo(jnp.int32).max
+            )
+        else:
+            rank_p = rank
+        offs = jnp.arange(nblk, dtype=jnp.int32) * bsz
+
+        if n_norm:
+            sel0 = jnp.stack([score_tbl[i] for i in norm_idx])
+            brmin = jnp.where(feas_tbl, sel0, _INT_MAX).reshape(
+                n_norm, k_types, nblk, bsz
+            ).min(-1)
+            brmax = jnp.where(feas_tbl, sel0, -_INT_MAX).reshape(
+                n_norm, k_types, nblk, bsz
+            ).max(-1)
+            slo = brmin.min(-1)  # [pn, K] == per-row feasible_min_max
+            shi = brmax.max(-1)
+        else:
+            brmin = jnp.zeros((0, k_types, nblk), jnp.int32)
+            brmax = jnp.zeros((0, k_types, nblk), jnp.int32)
+            slo = jnp.zeros((0, k_types), jnp.int32)
+            shi = jnp.zeros((0, k_types), jnp.int32)
+
+        tot0 = _totals(score_tbl, feas_tbl, slo, shi)  # [K, n_pad]
+        bt, br, ba = block_reduce(
+            tot0.reshape(k_types, nblk, bsz), rank_p.reshape(nblk, bsz)
+        )
+        bn = offs[None, :] + ba  # [K, nblk] global winner node ids
+
+        def body(carry, ev):
+            (state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
+             brmin, brmax, slo, shi, pend, dirty,
+             placed, masks, failed, arr_cpu, arr_gpu, key) = carry
+            kind, idx = ev
+            pod = jax.tree.map(lambda a: a[idx], pods)
+            t_id = type_id[idx]
+            # identical key-split discipline to the flat path / oracle
+            key, sub = jax.random.split(key)
+            k_rand, k_sel = jax.random.split(sub)
+
+            # apply the PREVIOUS event's deferred scatters first — every
+            # carried buffer is written before anything reads it, so all
+            # updates alias in place (PendingCommit)
+            state, placed, masks, failed = apply_commit(
+                state, placed, masks, failed, pend
+            )
+
+            # dirty-column refresh — same kernels, same order as the flat
+            # path; dirty < n always, so sentinel columns are never written
+            col_scores, col_sdev, col_feas = _columns(
+                _row_state(state, dirty), types, tp, k_rand
+            )
+            score_tbl = jax.lax.dynamic_update_slice(
+                score_tbl, col_scores[:, :, None], (0, 0, dirty)
+            )
+            sdev_tbl = jax.lax.dynamic_update_slice(
+                sdev_tbl, col_sdev[:, None], (0, dirty)
+            )
+            feas_tbl = jax.lax.dynamic_update_slice(
+                feas_tbl, col_feas[:, None], (0, dirty)
+            )
+
+            # dirty-block aggregate refresh for ALL K types: O(K*B)
+            blk = dirty // bsz
+            j0 = blk * bsz
+            raw_blk = jax.lax.dynamic_slice(
+                score_tbl, (0, 0, j0), (num_pol, k_types, bsz)
+            )
+            feas_blk = jax.lax.dynamic_slice(
+                feas_tbl, (0, j0), (k_types, bsz)
+            )
+            rank_blk = jax.lax.dynamic_slice(rank_p, (j0,), (bsz,))
+            if n_norm:
+                selb = jnp.stack([raw_blk[i] for i in norm_idx])
+                mn = jnp.where(feas_blk, selb, _INT_MAX).min(-1)
+                mx = jnp.where(feas_blk, selb, -_INT_MAX).max(-1)
+                brmin = jax.lax.dynamic_update_slice(
+                    brmin, mn[:, :, None], (0, 0, blk)
+                )
+                brmax = jax.lax.dynamic_update_slice(
+                    brmax, mx[:, :, None], (0, 0, blk)
+                )
+            # block totals use the STORED extrema — consistent with every
+            # other block of each type's summary row by construction
+            tot_blk = _totals(raw_blk, feas_blk, slo, shi)
+            bm, brk, bar = block_reduce(tot_blk, rank_blk)
+            bt = jax.lax.dynamic_update_slice(bt, bm[:, None], (0, blk))
+            br = jax.lax.dynamic_update_slice(br, brk[:, None], (0, blk))
+            bn = jax.lax.dynamic_update_slice(
+                bn, (j0 + bar)[:, None], (0, blk)
+            )
+
+            # extrema drift check + conditional summary-row rebuild for
+            # this event's type — outside the event switch, so only [N/B]
+            # rows (never whole tables) cross a cond/switch boundary
+            if n_norm:
+                brmin_row = jax.lax.dynamic_index_in_dim(
+                    brmin, t_id, 1, False
+                )
+                brmax_row = jax.lax.dynamic_index_in_dim(
+                    brmax, t_id, 1, False
+                )
+                lo_cur = brmin_row.min(-1)
+                hi_cur = brmax_row.max(-1)
+                slo_col = jax.lax.dynamic_index_in_dim(slo, t_id, 1, False)
+                shi_col = jax.lax.dynamic_index_in_dim(shi, t_id, 1, False)
+                changed = jnp.any(
+                    (lo_cur != slo_col) | (hi_cur != shi_col)
+                )
+
+                def rebuild():
+                    raws = jax.lax.dynamic_index_in_dim(
+                        score_tbl, t_id, 1, False
+                    )  # [num_pol, n_pad]
+                    fr = jax.lax.dynamic_index_in_dim(
+                        feas_tbl, t_id, 0, False
+                    )
+                    tot = _totals(
+                        raws[:, None, :], fr[None, :],
+                        lo_cur[:, None], hi_cur[:, None],
+                    )[0]
+                    m2, r2, a2 = block_reduce(
+                        tot.reshape(nblk, bsz), rank_p.reshape(nblk, bsz)
+                    )
+                    return m2, r2, offs + a2, lo_cur, hi_cur
+
+                def keep():
+                    return (
+                        jax.lax.dynamic_index_in_dim(bt, t_id, 0, False),
+                        jax.lax.dynamic_index_in_dim(br, t_id, 0, False),
+                        jax.lax.dynamic_index_in_dim(bn, t_id, 0, False),
+                        slo_col,
+                        shi_col,
+                    )
+
+                bt_row, br_row, bn_row, lo_new, hi_new = jax.lax.cond(
+                    changed, rebuild, keep
+                )
+                bt = jax.lax.dynamic_update_slice(
+                    bt, bt_row[None], (t_id, 0)
+                )
+                br = jax.lax.dynamic_update_slice(
+                    br, br_row[None], (t_id, 0)
+                )
+                bn = jax.lax.dynamic_update_slice(
+                    bn, bn_row[None], (t_id, 0)
+                )
+                slo = jax.lax.dynamic_update_slice(
+                    slo, lo_new[:, None], (0, t_id)
+                )
+                shi = jax.lax.dynamic_update_slice(
+                    shi, hi_new[:, None], (0, t_id)
+                )
+            else:
+                bt_row = jax.lax.dynamic_index_in_dim(bt, t_id, 0, False)
+                br_row = jax.lax.dynamic_index_in_dim(br, t_id, 0, False)
+                bn_row = jax.lax.dynamic_index_in_dim(bn, t_id, 0, False)
+
+            def do_create():
+                # selectHost over N/B block summaries — the same
+                # packed_argmax combine the oracle runs over N nodes
+                blk_i, _, okb = packed_argmax(
+                    bt_row, bt_row != -_INT_MAX, br_row
+                )
+                cand = bn_row[blk_i]
+                # nodeSelector-pinned pods have exactly one candidate: the
+                # winner is the pinned node iff Filter passes there (score
+                # values cannot matter with a single candidate), matching
+                # the oracle's per-event pinned feasibility mask. An
+                # out-of-range pin (unknown nodeSelector name — trace.py
+                # encodes it as index n) can never be feasible.
+                pin = jnp.clip(pod.pinned, 0, n - 1)
+                pin_feas = (
+                    jax.lax.dynamic_slice(feas_tbl, (t_id, pin), (1, 1))[0, 0]
+                    & (pod.pinned < n)
+                )
+                node = jnp.where(
+                    pod.pinned >= 0,
+                    jnp.where(pin_feas, pin, -1),
+                    jnp.where(okb, cand, -1),
+                ).astype(jnp.int32)
+                ok = node >= 0
+                sel = jnp.maximum(node, 0)
+                dev_scalar = jax.lax.dynamic_slice(
+                    sdev_tbl, (t_id, sel), (1, 1)
+                )[0, 0]
+                dmask = choose_devices(
+                    state.gpu_left[sel], pod, dev_scalar, gpu_sel, k_sel
+                ) & ok
+                return jnp.where(ok, sel, -1).astype(jnp.int32), dmask
+
+            def do_delete():
+                return placed[idx], masks[idx]
+
+            def do_skip():
+                return (
+                    jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_)
+                )
+
+            kc = jnp.clip(kind, 0, 2)
+            node, dev = jax.lax.switch(kc, [do_create, do_delete, do_skip])
+            # defer this event's scatters to the next iteration
+            pend = make_pending_commit(kc, idx, node, dev, pod, num_pods)
+            arr_cpu = arr_cpu + jnp.where(kc == 0, pod.cpu, 0)
+            arr_gpu = arr_gpu + jnp.where(kc == 0, pod.total_gpu_milli(), 0)
+            dirty = jnp.where(kc == 2, dirty, jnp.maximum(node, 0))
+            return (
+                state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
+                brmin, brmax, slo, shi, pend, dirty,
+                placed, masks, failed, arr_cpu, arr_gpu, key,
+            ), (node, dev)
+
+        init = (state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
+                brmin, brmax, slo, shi, no_pending_commit(num_pods),
+                jnp.int32(0), placed, masks, failed,
+                jnp.int32(0), jnp.int32(0), key)
+        # same unroll as the flat path: the per-event variable work is tiny
+        # here, so amortizing the loop's fixed costs matters even more
+        carry, (nodes, devs) = jax.lax.scan(
+            body, init, (ev_kind, ev_pod), unroll=4
+        )
+        (state, placed, masks, failed) = (
+            carry[0], carry[13], carry[14], carry[15]
+        )
+        # the last event's commit is still pending
+        state, placed, masks, failed = apply_commit(
+            state, placed, masks, failed, carry[11]
+        )
+        return ReplayResult(
+            state, placed[:num_pods], masks[:num_pods], failed[:num_pods],
+            None, nodes, devs,
+        )
 
     @jax.jit
     def replay(
@@ -296,6 +648,8 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
         if tiebreak_rank is None:
             tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
         type_id = types.type_id
+        k_types = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+        bsz = 0 if has_random else resolve_block_size(block_size, n, k_types)
 
         # the event key chain must stay byte-for-byte the sequential
         # oracle's (it never burns a split before its scan), so the random
@@ -303,12 +657,21 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
         # column kernel consumes rng, so init can reuse the root key as-is
         score_tbl, sdev_tbl, feas_tbl = _init_tables(state, types, tp, key)
 
-        placed = jnp.full(num_pods, -1, jnp.int32)
-        masks = jnp.zeros((num_pods, MAX_GPUS_PER_NODE), jnp.bool_)
-        failed = jnp.zeros(num_pods, jnp.bool_)
+        # one extra dummy row absorbs skip-event writes of the pipelined
+        # commit (PendingCommit.pod_write); sliced off before returning
+        placed = jnp.full(num_pods + 1, -1, jnp.int32)
+        masks = jnp.zeros((num_pods + 1, MAX_GPUS_PER_NODE), jnp.bool_)
+        failed = jnp.zeros(num_pods + 1, jnp.bool_)
+
+        if bsz:
+            return _blocked_replay(
+                state, pods, type_id, types, ev_kind, ev_pod, tp, key,
+                tiebreak_rank, score_tbl, sdev_tbl, feas_tbl,
+                placed, masks, failed, bsz, k_types,
+            )
 
         def body(carry, ev):
-            (state, score_tbl, sdev_tbl, feas_tbl, dirty,
+            (state, score_tbl, sdev_tbl, feas_tbl, pend, dirty,
              placed, masks, failed, arr_cpu, arr_gpu, key) = carry
             kind, idx = ev
             pod = jax.tree.map(lambda a: a[idx], pods)
@@ -320,7 +683,15 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
             key, sub = jax.random.split(key)
             k_rand, k_sel = jax.random.split(sub)
 
-            # refresh the one column whose node changed last event
+            # apply the PREVIOUS event's deferred scatters first: every
+            # carried buffer is written before anything reads it this
+            # iteration, so all updates alias in place (PendingCommit)
+            state, placed, masks, failed = apply_commit(
+                state, placed, masks, failed, pend
+            )
+
+            # refresh the one column whose node changed last event (from
+            # the just-committed state)
             col_scores, col_sdev, col_feas = _columns(
                 _row_state(state, dirty), types, tp, k_rand
             )
@@ -354,62 +725,53 @@ def make_table_replay(policies, gpu_sel: str = "best", report: bool = False):
                     elif fn.normalize == "pwr":
                         raw = pwr_normalize_i32(raw, feasible)
                     total = total + jnp.int32(weight) * raw
-                new_state, pl = select_and_bind(
-                    state, pod, feasible, total, sdev_tbl[t_id], gpu_sel,
-                    k_sel, tiebreak_rank,
-                )
-                return (
-                    new_state,
-                    placed.at[idx].set(pl.node),
-                    masks.at[idx].set(pl.dev_mask),
-                    failed.at[idx].set(pl.node < 0),
-                    jnp.maximum(pl.node, 0),
-                    # arrived counters accumulate per creation event
-                    # regardless of outcome (simulator.go:406-408)
-                    arr_cpu + pod.cpu,
-                    arr_gpu + pod.total_gpu_milli(),
-                    pl.node,
-                    pl.dev_mask,
-                )
+                # the oracle's selectHost + Reserve halves; the Bind
+                # scatter is deferred via PendingCommit, outside the switch
+                sel, _, ok = packed_argmax(total, feasible, tiebreak_rank)
+                dmask = choose_devices(
+                    state.gpu_left[sel], pod, sdev_tbl[t_id, sel], gpu_sel,
+                    k_sel,
+                ) & ok
+                return jnp.where(ok, sel, -1).astype(jnp.int32), dmask
 
             def do_delete():
-                pl = Placement(placed[idx], masks[idx])
-                new_state = unschedule(state, pod, pl)
-                return (
-                    new_state,
-                    placed.at[idx].set(-1),
-                    masks.at[idx].set(False),
-                    failed,
-                    jnp.maximum(pl.node, 0),
-                    arr_cpu,
-                    arr_gpu,
-                    pl.node,
-                    pl.dev_mask,
-                )
+                return placed[idx], masks[idx]
 
             def do_skip():
                 return (
-                    state, placed, masks, failed, dirty, arr_cpu, arr_gpu,
-                    jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
+                    jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_)
                 )
 
-            (state2, placed2, masks2, failed2, dirty2, arr_cpu2, arr_gpu2,
-             node, dev) = jax.lax.switch(
-                jnp.clip(kind, 0, 2), [do_create, do_delete, do_skip]
-            )
+            kc = jnp.clip(kind, 0, 2)
+            node, dev = jax.lax.switch(kc, [do_create, do_delete, do_skip])
+            # defer this event's scatters to the next iteration; arrived
+            # counters accumulate per creation event regardless of outcome
+            # (simulator.go:406-408)
+            pend = make_pending_commit(kc, idx, node, dev, pod, num_pods)
+            arr_cpu = arr_cpu + jnp.where(kc == 0, pod.cpu, 0)
+            arr_gpu = arr_gpu + jnp.where(kc == 0, pod.total_gpu_milli(), 0)
+            dirty = jnp.where(kc == 2, dirty, jnp.maximum(node, 0))
             return (
-                state2, score_tbl, sdev_tbl, feas_tbl, dirty2,
-                placed2, masks2, failed2, arr_cpu2, arr_gpu2, key,
+                state, score_tbl, sdev_tbl, feas_tbl, pend, dirty,
+                placed, masks, failed, arr_cpu, arr_gpu, key,
             ), (node, dev)
 
-        init = (state, score_tbl, sdev_tbl, feas_tbl, jnp.int32(0),
+        init = (state, score_tbl, sdev_tbl, feas_tbl,
+                no_pending_commit(num_pods), jnp.int32(0),
                 placed, masks, failed, jnp.int32(0), jnp.int32(0), key)
         # unroll amortizes per-iteration fixed costs (~20% wall on the openb
         # replay); higher factors showed no further gain
-        (state, _, _, _, _, placed, masks, failed, _, _, _), (
+        (state, _, _, _, pend, _, placed, masks, failed, _, _, _), (
             nodes, devs
         ) = jax.lax.scan(body, init, (ev_kind, ev_pod), unroll=4)
-        return ReplayResult(state, placed, masks, failed, None, nodes, devs)
+        # the last event's commit is still pending
+        state, placed, masks, failed = apply_commit(
+            state, placed, masks, failed, pend
+        )
+        return ReplayResult(
+            state, placed[:num_pods], masks[:num_pods], failed[:num_pods],
+            None, nodes, devs,
+        )
 
     _TABLE_REPLAY_CACHE[cache_key] = replay
     return replay

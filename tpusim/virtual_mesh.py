@@ -10,12 +10,21 @@ from __future__ import annotations
 
 def force_virtual_cpu_devices(n_devices: int) -> None:
     """Best-effort: before first backend init, force an n-device virtual
-    CPU platform when the only accelerator is the single-chip 'axon' TPU
-    tunnel. Plain JAX_PLATFORMS env vars are not enough in this image —
-    the sitecustomize-registered axon PJRT plugin wins backend selection
-    regardless — so drop its factory registration pre-init (the strategy
-    tests/conftest.py and __graft_entry__.py use). No-op on real
-    multi-device platforms or once a backend is up."""
+    CPU platform when the host would otherwise come up with fewer devices
+    than the requested mesh. Two cases act:
+
+    - the single-chip 'axon' TPU tunnel: plain JAX_PLATFORMS env vars are
+      not enough in this image — the sitecustomize-registered axon PJRT
+      plugin wins backend selection regardless — so drop its factory
+      registration pre-init (the strategy tests/conftest.py and
+      __graft_entry__.py use);
+    - a plain CPU-only host (no accelerator plugin at all): the default
+      backend is a single CPU device, so --mesh N would fail Simulator
+      construction with 'needs N devices'; forcing
+      --xla_force_host_platform_device_count gives it the virtual mesh.
+
+    No-op on real multi-device accelerator platforms (cuda, multi-chip
+    tpu, ...) or once a backend is up."""
     import os
     import re
 
@@ -24,15 +33,20 @@ def force_virtual_cpu_devices(n_devices: int) -> None:
 
     if _xb._backends:  # backend already up; nothing safe to do
         return
-    if n_devices > 1 and "axon" in _xb._backend_factories:
-        flags = re.sub(
-            r"--xla_force_host_platform_device_count=\d+",
-            "",
-            os.environ.get("XLA_FLAGS", ""),
-        )
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        jax.config.update("jax_platforms", "cpu")
-        _xb._backend_factories.pop("axon", None)
+    accel = [
+        name for name in _xb._backend_factories
+        if name not in ("cpu", "interpreter")
+    ]
+    if n_devices <= 1 or accel not in ([], ["axon"]):
+        return
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    _xb._backend_factories.pop("axon", None)
