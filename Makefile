@@ -1,10 +1,16 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-tpu bench bench-scale bench-scale-smoke sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke sweep native clean
 
+# full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
+# sweeps, openb kill/resume, the full Bellman replay)
 test:
 	python -m pytest tests/ -q
+
+# the tier-1 lane (ROADMAP.md verify command): slow-marked tests excluded
+test-fast:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 
 # on-accelerator lane: golden frag values + engine equivalence on the chip
 test-tpu:
@@ -21,6 +27,15 @@ bench-scale:
 # thousand pods keep the whole run to a couple of minutes
 bench-scale-smoke:
 	JAX_PLATFORMS=cpu python bench_scale.py --nodes 10000 --pods 5000 --chunk 5000
+
+# kill/resume gate (ENGINES.md "Checkpoint/resume"): replay an openb
+# prefix, kill the run right after a mid-trace checkpoint lands, resume in
+# a fresh process, and assert the final placements/metrics/tables are
+# byte-identical to the uninterrupted run — plus the fault-injection
+# determinism suite. Runs the full file including the slow openb case
+# (the synthetic kill/resume subset is already wired into tier-1).
+resume-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py -q
 
 sweep:
 	python experiments/sweep.py

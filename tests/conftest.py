@@ -43,6 +43,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: on-accelerator tests (TPUSIM_TPU_TESTS=1 pytest -m tpu)"
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 lane (pytest -m 'not slow'); run "
+        "explicitly via `make resume-smoke` or plain pytest",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

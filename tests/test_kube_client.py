@@ -633,3 +633,103 @@ def test_client_cleans_up_credential_material(tmp_path, api_server):
     del client
     gc.collect()
     assert not os.path.exists(path)
+
+
+# ---- transient-failure retries (ISSUE 2 satellite) ----
+
+_FLAKY = {"failures_left": 0, "status": 500, "retry_after": None, "hits": 0}
+
+
+class _FlakyHandler(BaseHTTPRequestHandler):
+    """Fails the first N GETs with a configurable status, then serves an
+    empty node list — the recorded shape of a flaky LB hop."""
+
+    def do_GET(self):
+        _FLAKY["hits"] += 1
+        if _FLAKY["failures_left"] > 0:
+            _FLAKY["failures_left"] -= 1
+            self.send_response(_FLAKY["status"])
+            if _FLAKY["retry_after"] is not None:
+                self.send_header("Retry-After", str(_FLAKY["retry_after"]))
+            self.end_headers()
+            return
+        data = json.dumps({"apiVersion": "v1", "items": []}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def flaky_server():
+    srv = HTTPServer(("127.0.0.1", 0), _FlakyHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    _FLAKY.update(failures_left=0, status=500, retry_after=None, hits=0)
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def _no_sleep(monkeypatch):
+    import time as _time
+
+    slept = []
+    monkeypatch.setattr(_time, "sleep", lambda s: slept.append(s))
+    return slept
+
+
+def test_get_retries_transient_5xx(tmp_path, flaky_server, monkeypatch):
+    """Two 503s then success: the default 3-attempt budget absorbs the
+    flake with backoff sleeps instead of failing ingestion."""
+    slept = _no_sleep(monkeypatch)
+    _FLAKY.update(failures_left=2, status=503)
+    client = KubeClient(_kubeconfig(tmp_path, flaky_server))
+    assert client.get("/api/v1/nodes") == {"apiVersion": "v1", "items": []}
+    assert _FLAKY["hits"] == 3 and len(slept) == 2
+    assert slept[0] <= slept[1] <= 8.0  # capped exponential, jittered
+
+
+def test_get_retry_honors_retry_after(tmp_path, flaky_server, monkeypatch):
+    slept = _no_sleep(monkeypatch)
+    _FLAKY.update(failures_left=1, status=429, retry_after=3)
+    client = KubeClient(_kubeconfig(tmp_path, flaky_server))
+    assert client.get("/api/v1/nodes")["items"] == []
+    assert slept == [3.0]  # the server's delta-seconds wins over backoff
+
+
+def test_get_retries_exhausted_raises(tmp_path, flaky_server, monkeypatch):
+    _no_sleep(monkeypatch)
+    _FLAKY.update(failures_left=99, status=500)
+    client = KubeClient(_kubeconfig(tmp_path, flaky_server))
+    with pytest.raises(KubeClientError, match="after 3 attempts"):
+        client.get("/api/v1/nodes")
+    assert _FLAKY["hits"] == 3
+
+
+def test_get_retry_count_env_override(tmp_path, flaky_server, monkeypatch):
+    """TPUSIM_HTTP_RETRIES=1 disables retrying entirely."""
+    _no_sleep(monkeypatch)
+    monkeypatch.setenv("TPUSIM_HTTP_RETRIES", "1")
+    _FLAKY.update(failures_left=1, status=500)
+    client = KubeClient(_kubeconfig(tmp_path, flaky_server))
+    with pytest.raises(KubeClientError):
+        client.get("/api/v1/nodes")
+    assert _FLAKY["hits"] == 1
+
+
+def test_get_does_not_retry_semantic_statuses(tmp_path, flaky_server,
+                                              monkeypatch):
+    """404/403 are group-version fallback answers, never retried."""
+    slept = _no_sleep(monkeypatch)
+    _FLAKY.update(failures_left=1, status=404)
+    client = KubeClient(_kubeconfig(tmp_path, flaky_server))
+    with pytest.raises(FileNotFoundError):
+        client.get("/api/v1/nodes")
+    _FLAKY.update(failures_left=1, status=403, hits=0)
+    with pytest.raises(PermissionError):
+        client.get("/api/v1/nodes")
+    assert slept == [] and _FLAKY["hits"] == 1

@@ -134,6 +134,7 @@ def test_truncation_counter_fires_on_pathological_distribution():
     assert v_deep != pytest.approx(ev.eval(*node), abs=1e-6)
 
 
+@pytest.mark.slow  # a full openb replay through the Bellman series
 def test_truncation_never_fires_on_full_openb_replay():
     """The max_depth=64 bound (absent from the Go reference,
     frag.go:231-283) must be pure headroom on the real workload: replay the

@@ -57,6 +57,10 @@ def test_pallas_matches_table_engine(policy, gpu_sel):
     assert np.array_equal(np.asarray(r0.event_dev), np.asarray(r1.event_dev))
 
 
+# interpreter-mode sweeps are minutes of tier-1 wall for variant coverage
+# the core per-policy equality tests already give; the full sweep still
+# runs under plain pytest / `make test` and on-chip in the TPU lane
+@pytest.mark.slow
 @pytest.mark.parametrize("norm", ["max", "node", "pod"])
 @pytest.mark.parametrize("dim_ext", ["merge", "share", "divide", "extend"])
 def test_pallas_dotprod_dim_ext(dim_ext, norm):
@@ -84,6 +88,7 @@ def test_pallas_dotprod_dim_ext(dim_ext, norm):
     _assert_equal(r0, r1)
 
 
+@pytest.mark.slow  # see test_pallas_dotprod_dim_ext
 @pytest.mark.parametrize(
     "weights", [(500, 500), (100, 900), (50, 950)], ids=lambda w: f"{w[0]}"
 )

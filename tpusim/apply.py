@@ -44,6 +44,19 @@ class ApplyOptions:
     extended_resources: List[str] = field(default_factory=lambda: ["gpu"])
     base_dir: str = "."
     report_tables: bool = False
+    # exact checkpoint/resume of the main replay (ISSUE 2; README
+    # "Checkpoint/resume"): segment length in events, 0 = off
+    checkpoint_every: int = 0
+    checkpoint_dir: str = ""
+    # fault injection (README "Fault injection"): MTBF-style schedule
+    # knobs, all in EVENTS; mtbf 0 = no node failures, evict 0 = no
+    # preemptions. Any non-zero rate routes the main schedule through
+    # Simulator.run_with_faults.
+    fault_mtbf: float = 0.0
+    fault_mttr: float = 0.0
+    fault_evict_every: float = 0.0
+    fault_seed: int = 0
+    fault_max_retries: int = 3
 
 
 class Applier:
@@ -81,6 +94,24 @@ class Applier:
             engine=cc.engine,
             mesh=cc.mesh,
             extenders=self.sched_cfg.extenders,
+            checkpoint_every=self.options.checkpoint_every,
+            checkpoint_dir=self.options.checkpoint_dir,
+        )
+
+    def _fault_config(self):
+        """FaultConfig from the --fault-* flags, or None when fault
+        injection is off (no failure/eviction rate configured)."""
+        o = self.options
+        if o.fault_mtbf <= 0 and o.fault_evict_every <= 0:
+            return None
+        from tpusim.sim.faults import FaultConfig
+
+        return FaultConfig(
+            mtbf_events=o.fault_mtbf,
+            mttr_events=o.fault_mttr,
+            evict_every_events=o.fault_evict_every,
+            seed=o.fault_seed,
+            max_retries=o.fault_max_retries,
         )
 
     def _load_apps(self, node_names: Sequence[str]) -> List[tuple]:
@@ -140,7 +171,11 @@ class Applier:
         workload = cluster.workload_pods()
         ds_pods = cluster.daemonset_pods()
         sim.set_workload_pods(workload + ds_pods)
-        sim.run()
+        fault_cfg = self._fault_config()
+        if fault_cfg is not None:
+            sim.run_with_faults(fault_cfg)
+        else:
+            sim.run()
 
         # snapshot export at InitSchedule (core.go:160-185)
         self._export_snapshots(sim, "init_schedule")

@@ -58,6 +58,39 @@ def _build_parser() -> argparse.ArgumentParser:
     p_apply.add_argument(
         "--report", action="store_true", help="print placement report tables"
     )
+    # exact checkpoint/resume of the main replay (README "Checkpoint/resume")
+    p_apply.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="EVENTS",
+        help="checkpoint the replay every N events (0 = off); a killed run "
+        "re-invoked with identical inputs resumes bit-identically",
+    )
+    p_apply.add_argument(
+        "--checkpoint-dir", default="",
+        help="checkpoint directory (default: $TPUSIM_CHECKPOINT_DIR or "
+        "<repo>/.tpusim_checkpoints)",
+    )
+    # fault injection (README "Fault injection"); all rates in EVENTS
+    p_apply.add_argument(
+        "--fault-mtbf", type=float, default=0.0, metavar="EVENTS",
+        help="mean events between node failures (0 = no failures)",
+    )
+    p_apply.add_argument(
+        "--fault-mttr", type=float, default=0.0, metavar="EVENTS",
+        help="mean events until a failed node recovers (0 = permanent loss)",
+    )
+    p_apply.add_argument(
+        "--fault-evict-every", type=float, default=0.0, metavar="EVENTS",
+        help="mean events between single-pod evictions (0 = off)",
+    )
+    p_apply.add_argument(
+        "--fault-seed", type=int, default=0,
+        help="fault-schedule PRNG seed (fixed seed -> identical disruption)",
+    )
+    p_apply.add_argument(
+        "--fault-max-retries", type=int, default=3,
+        help="retry budget per evicted pod before it becomes terminally "
+        "unscheduled",
+    )
 
     sub.add_parser("version", help="print version")
 
@@ -81,6 +114,13 @@ def cmd_apply(args) -> int:
         ],
         base_dir=args.base_dir,
         report_tables=args.report,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
+        fault_mtbf=args.fault_mtbf,
+        fault_mttr=args.fault_mttr,
+        fault_evict_every=args.fault_evict_every,
+        fault_seed=args.fault_seed,
+        fault_max_retries=args.fault_max_retries,
     )
     Applier(opts).run()
     return 0

@@ -43,6 +43,42 @@ import yaml
 # with TPUSIM_EXEC_CRED_SKEW_S for hosts with worse clock discipline.
 EXEC_CRED_SKEW_MARGIN_S = 30.0
 
+# Transient-failure retry policy for the List calls (client-go's default
+# rest client retries connection resets and Retry-After'd statuses; a
+# single raw urlopen used to turn one flaky LB hop into a failed
+# ingestion). Attempts are capped-exponential with jitter; 429/5xx honor
+# a server Retry-After header. TPUSIM_HTTP_RETRIES overrides the total
+# attempt count (default 3; 1 disables retrying).
+HTTP_RETRY_ATTEMPTS = 3
+HTTP_RETRY_BASE_S = 0.5
+HTTP_RETRY_CAP_S = 8.0
+HTTP_RETRY_STATUSES = frozenset({429} | set(range(500, 600)))
+
+
+def _retry_attempts() -> int:
+    try:
+        return max(1, int(os.environ.get("TPUSIM_HTTP_RETRIES",
+                                         HTTP_RETRY_ATTEMPTS)))
+    except ValueError:
+        return HTTP_RETRY_ATTEMPTS
+
+
+def _retry_delay_s(attempt: int, retry_after=None) -> float:
+    """Sleep before retry `attempt` (1-based count of failures so far):
+    a server-provided Retry-After wins (delta-seconds form; HTTP-date
+    values fall back to the computed backoff), else capped exponential
+    base*2^(attempt-1) with half-magnitude jitter so a fleet of clients
+    does not re-dogpile the API server in lockstep."""
+    import random
+
+    if retry_after is not None:
+        try:
+            return max(0.0, min(float(retry_after), 4 * HTTP_RETRY_CAP_S))
+        except (TypeError, ValueError):
+            pass  # HTTP-date form: not worth a date parser here
+    delay = min(HTTP_RETRY_BASE_S * (2 ** (attempt - 1)), HTTP_RETRY_CAP_S)
+    return delay * (0.5 + 0.5 * random.random())
+
 
 class KubeClientError(RuntimeError):
     pass
@@ -347,31 +383,50 @@ class KubeClient:
         return ctx
 
     def get(self, path: str) -> dict:
+        """One List call with transient-failure retries: 429/5xx responses
+        (honoring Retry-After) and connection-level URLError/OSError get
+        capped-exponential-backoff re-attempts (default 3 total,
+        TPUSIM_HTTP_RETRIES override); 404/403 are semantic answers the
+        group-version fallback machinery consumes and never retry."""
+        import time
+
         req = urllib.request.Request(
             self.server + path, headers=self._headers
         )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ssl_ctx
-            ) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                raise FileNotFoundError(path) from e
-            if e.code == 403:
-                # RBAC denial; list_all treats this like 404 so a denied
-                # deprecated group-version (e.g. policy/v1beta1) can fall
-                # through to a listable candidate (e.g. policy/v1)
-                raise PermissionError(
-                    f"GET {path}: HTTP 403 {e.reason}"
+        attempts = _retry_attempts()
+        for attempt in range(1, attempts + 1):
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout, context=self._ssl_ctx
+                ) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    raise FileNotFoundError(path) from e
+                if e.code == 403:
+                    # RBAC denial; list_all treats this like 404 so a denied
+                    # deprecated group-version (e.g. policy/v1beta1) can fall
+                    # through to a listable candidate (e.g. policy/v1)
+                    raise PermissionError(
+                        f"GET {path}: HTTP 403 {e.reason}"
+                    ) from e
+                if e.code in HTTP_RETRY_STATUSES and attempt < attempts:
+                    time.sleep(_retry_delay_s(
+                        attempt, (e.headers or {}).get("Retry-After")
+                    ))
+                    continue
+                raise KubeClientError(
+                    f"GET {path} failed: HTTP {e.code} {e.reason}"
+                    + (f" after {attempt} attempts" if attempt > 1 else "")
                 ) from e
-            raise KubeClientError(
-                f"GET {path} failed: HTTP {e.code} {e.reason}"
-            ) from e
-        except (urllib.error.URLError, OSError) as e:
-            raise KubeClientError(
-                f"cannot reach API server {self.server}: {e}"
-            ) from e
+            except (urllib.error.URLError, OSError) as e:
+                if attempt < attempts:
+                    time.sleep(_retry_delay_s(attempt))
+                    continue
+                raise KubeClientError(
+                    f"cannot reach API server {self.server}: {e}"
+                    + (f" after {attempt} attempts" if attempt > 1 else "")
+                ) from e
 
     def list_all(self, paths: Sequence[str], kind: str) -> List[dict]:
         """First listable endpoint → items with kind/apiVersion injected

@@ -181,3 +181,110 @@ def cluster_vg_totals(storages: Sequence[Optional[NodeStorage]]) -> Tuple[int, i
             req += vg.requested
             cap += vg.capacity
     return req, cap
+
+
+# ---------------------------------------------------------------------------
+# Replay checkpoints — exact resume of the chunked event scan
+# ---------------------------------------------------------------------------
+#
+# A checkpoint is the engine's complete scan carry (table_engine.Flat/
+# BlockedTableCarry, or the shard engine's gathered snapshot) plus the
+# telemetry accumulated so far, written after every completed segment of a
+# chunked replay (driver.SimulatorConfig.checkpoint_every). Files are
+# content-addressed like the Bellman series cache (driver._bellman_cache_path):
+# the name is the sha256 of everything that determines the run — a source-code
+# version salt, the initial state, the pod specs, the event stream, the PRNG
+# key, the tie-break rank, and a config string — so a resumed process can only
+# ever pick up a checkpoint of the *identical* run, and any code or input
+# change silently starts fresh instead of resuming into divergence. All carry
+# leaves are exact dtypes (i32/bool/u32), so a save/load round-trip is
+# bit-transparent and resume reproduces the uninterrupted scan exactly
+# (pinned by tests/test_checkpoint.py).
+
+CHECKPOINT_SUFFIX = ".ckpt.npz"
+
+
+def checkpoint_digest(chunks) -> str:
+    """sha256 hex over an iterable of byte chunks — the content key of one
+    replay run. Callers feed every run-defining input (see the section
+    comment); the driver prepends its source-version salt."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for c in chunks:
+        h.update(c)
+    return h.hexdigest()
+
+
+def checkpoint_path(cache_dir: str, digest: str, cursor: int) -> str:
+    return os.path.join(
+        cache_dir, f"{digest}.e{cursor:010d}{CHECKPOINT_SUFFIX}"
+    )
+
+
+def save_checkpoint(
+    cache_dir: str, digest: str, cursor: int, arrays: Dict[str, "object"]
+) -> str:
+    """Write one checkpoint atomically (tmp + rename, the Bellman-cache
+    discipline — a killed writer leaves no torn file). `arrays` maps leaf
+    names to numpy arrays; `cursor` is the number of events already
+    consumed. Returns the file path."""
+    import numpy as np
+
+    os.makedirs(cache_dir, exist_ok=True)
+    path = checkpoint_path(cache_dir, digest, cursor)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, __cursor__=np.int64(cursor), **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def find_checkpoint(cache_dir: str, digest: str) -> Optional[Tuple[int, str]]:
+    """Latest (cursor, path) checkpoint for a run digest, or None. Torn or
+    foreign files never match — the digest prefix is the whole contract."""
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return None
+    best: Optional[Tuple[int, str]] = None
+    prefix = digest + ".e"
+    for fname in os.listdir(cache_dir):
+        if not (fname.startswith(prefix) and fname.endswith(CHECKPOINT_SUFFIX)):
+            continue
+        try:
+            cursor = int(fname[len(prefix):-len(CHECKPOINT_SUFFIX)])
+        except ValueError:
+            continue
+        if best is None or cursor > best[0]:
+            best = (cursor, os.path.join(cache_dir, fname))
+    return best
+
+
+def load_checkpoint(path: str) -> Tuple[int, Dict[str, "object"]]:
+    """(cursor, {leaf name: numpy array}) from a checkpoint file."""
+    import numpy as np
+
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != "__cursor__"}
+        cursor = int(z["__cursor__"])
+    return cursor, arrays
+
+
+def prune_checkpoints(cache_dir: str, digest: str, keep_cursor: int) -> None:
+    """Drop a run's checkpoints below `keep_cursor` (each save supersedes
+    its predecessors; only the newest is ever resumed from). Missing files
+    are fine — concurrent resumers may race here."""
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return
+    prefix = digest + ".e"
+    for fname in os.listdir(cache_dir):
+        if not (fname.startswith(prefix) and fname.endswith(CHECKPOINT_SUFFIX)):
+            continue
+        try:
+            cursor = int(fname[len(prefix):-len(CHECKPOINT_SUFFIX)])
+        except ValueError:
+            continue
+        if cursor < keep_cursor:
+            try:
+                os.unlink(os.path.join(cache_dir, fname))
+            except OSError:
+                pass

@@ -28,7 +28,8 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
-
+#include <functional>  // std::greater — not transitively provided by every
+                       // libstdc++; older toolchains fail the on-demand build
 #include <vector>
 
 namespace {

@@ -37,6 +37,8 @@ Placements are bit-identical to the single-device table engine.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -47,6 +49,7 @@ from tpusim.sim.engine import ReplayResult
 from tpusim.sim.step import block_reduce, choose_devices, packed_argmax
 from tpusim.sim.table_engine import (
     PodTypes,
+    _pad_rank,
     _row_state,
     make_table_builders,
     reject_randomized,
@@ -60,6 +63,27 @@ from tpusim.parallel.sharding import NODE_AXIS
 _INT_MAX = jnp.int32(jnp.iinfo(jnp.int32).max)
 
 
+class ShardTableCarry(NamedTuple):
+    """Complete sharded-engine state between two events — the shard_map
+    scan carry, promoted to a pytree the driver can gather to host
+    (np.asarray on each leaf collects the shards), checkpoint, and feed
+    back in; jit re-shards it against the same mesh on resume, so the
+    continued scan is bit-identical to the uninterrupted one. state and
+    the packed table / block summaries are node-axis sharded; everything
+    else is replicated (identical on every shard by construction)."""
+
+    state: NodeState  # node-axis sharded, [nloc] rows per shard
+    packed_tbl: jnp.ndarray  # i32[K, nloc(_p), npol+2] scores|sdev|feas
+    lt: jnp.ndarray  # i32[K, nloc/B] block max totals ([0,0] when flat)
+    lr: jnp.ndarray  # i32[K, nloc/B] block min winner ranks
+    lwn: jnp.ndarray  # i32[K, nloc/B] block winner LOCAL node indices
+    dirty: jnp.ndarray  # i32 global node id to refresh next (replicated)
+    placed: jnp.ndarray  # i32[P] (replicated)
+    masks: jnp.ndarray  # bool[P, 8]
+    failed: jnp.ndarray  # bool[P]
+    arr_cpu: jnp.ndarray  # i32
+    arr_gpu: jnp.ndarray  # i32
+    key: jnp.ndarray  # PRNG key after the events consumed so far
 
 
 def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
@@ -94,14 +118,26 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     n_dev = mesh.shape[NODE_AXIS]
     all_none_norm = all(fn.normalize == "none" for fn, _ in policies)
 
-    def shard_fn(state, rank, pods, types, ev_kind, ev_pod, tp, key):
-        """Runs per shard: state/rank are the LOCAL node rows."""
+    def _local_totals(rows):
+        """Weighted totals with -INT_MAX at infeasible entries from a
+        packed-layout slice [..., C] (none-normalize configs only)."""
+        tot = jnp.zeros(rows.shape[:-1], jnp.int32)
+        for i, (_, weight) in enumerate(policies):
+            tot = tot + jnp.int32(weight) * rows[..., i]
+        return jnp.where(rows[..., npol + 1] != 0, tot, -_INT_MAX)
+
+    def _resolve_bsz(nloc: int, k_types: int) -> int:
+        return (
+            resolve_block_size(block_size, nloc, k_types)
+            if all_none_norm else 0
+        )
+
+    def _init_shard(state, rank, pods, types, tp, key):
+        """Per-shard carry at event 0: local table shards + blocked local
+        summaries + replicated bookkeeping (state/rank are the LOCAL node
+        rows)."""
         nloc = state.num_nodes
-        me = jax.lax.axis_index(NODE_AXIS)
-        offset = (me * nloc).astype(jnp.int32)
-        gids = offset + jnp.arange(nloc, dtype=jnp.int32)
         num_pods = pods.cpu.shape[0]
-        type_id = types.type_id
 
         key, k_init = jax.random.split(key)
         s0, d0, f0 = _init_tables(state, types, tp, k_init)
@@ -112,34 +148,17 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         )  # [K, nloc, C]
 
         k_types = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
-        bsz = (
-            resolve_block_size(block_size, nloc, k_types)
-            if all_none_norm else 0
-        )
-
-        def _local_totals(rows):
-            """Weighted totals with -INT_MAX at infeasible entries from a
-            packed-layout slice [..., C] (none-normalize configs only)."""
-            tot = jnp.zeros(rows.shape[:-1], jnp.int32)
-            for i, (_, weight) in enumerate(policies):
-                tot = tot + jnp.int32(weight) * rows[..., i]
-            return jnp.where(rows[..., npol + 1] != 0, tot, -_INT_MAX)
+        bsz = _resolve_bsz(nloc, k_types)
 
         if bsz:
             nbl = -(-nloc // bsz)
             nloc_p = nbl * bsz
             if nloc_p != nloc:
                 # sentinel columns: feas 0 -> -INT_MAX totals, never chosen
-                packed_p = jnp.pad(
+                packed_tbl = jnp.pad(
                     packed_tbl, ((0, 0), (0, nloc_p - nloc), (0, 0))
                 )
-                rank_p = jnp.pad(
-                    rank, (0, nloc_p - nloc),
-                    constant_values=jnp.iinfo(jnp.int32).max,
-                )
-            else:
-                packed_p, rank_p = packed_tbl, rank
-            packed_tbl = packed_p
+            rank_p = _pad_rank(rank, nloc_p)
             loffs = jnp.arange(nbl, dtype=jnp.int32) * bsz
             lt, lr, la = block_reduce(
                 _local_totals(packed_tbl).reshape(k_types, nbl, bsz),
@@ -147,12 +166,31 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             )
             lwn = loffs[None, :] + la  # [K, nbl] local winner node indices
         else:
-            rank_p = rank
             lt = lr = lwn = jnp.zeros((0, 0), jnp.int32)
 
         placed = jnp.full(num_pods, -1, jnp.int32)
         masks = jnp.zeros((num_pods, MAX_GPUS_PER_NODE), jnp.bool_)
         failed = jnp.zeros(num_pods, jnp.bool_)
+        z = jnp.int32(0)
+        return ShardTableCarry(
+            state, packed_tbl, lt, lr, lwn, z, placed, masks, failed,
+            z, z, key,
+        )
+
+    def _chunk_shard(carry, rank, pods, types, ev_kind, ev_pod, tp):
+        """Advance a per-shard carry over one event segment (the scan the
+        one-shot replay runs over the whole stream)."""
+        nloc = carry.state.num_nodes
+        me = jax.lax.axis_index(NODE_AXIS)
+        offset = (me * nloc).astype(jnp.int32)
+        gids = offset + jnp.arange(nloc, dtype=jnp.int32)
+        num_pods = pods.cpu.shape[0]
+        type_id = types.type_id
+        k_types = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+        bsz = _resolve_bsz(nloc, k_types)
+        rank_p = (
+            _pad_rank(rank, carry.packed_tbl.shape[1]) if bsz else rank
+        )
 
         def body(carry, ev):
             (state, packed_tbl, lt, lr, lwn, dirty, placed, masks, failed,
@@ -362,17 +400,13 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             # node == -1 (failed create) leaves no owner, so every shard
             # skips the next refresh — same as the pre-restructure behavior
             dirty = jnp.where(kc == 2, dirty, node)
-            return (
+            return ShardTableCarry(
                 state, packed_tbl, lt, lr, lwn, dirty, placed, masks,
                 failed, arr_cpu, arr_gpu, key,
             ), (node, dev)
 
-        init = (state, packed_tbl, lt, lr, lwn, jnp.int32(0), placed, masks,
-                failed, jnp.int32(0), jnp.int32(0), key)
-        (state, _, _, _, _, _, placed, masks, failed, _, _, _), (
-            nodes, devs
-        ) = jax.lax.scan(body, init, (ev_kind, ev_pod))
-        return state, placed, masks, failed, None, nodes, devs
+        carry, (nodes, devs) = jax.lax.scan(body, carry, (ev_kind, ev_pod))
+        return carry, nodes, devs
 
     state_specs = NodeState(*([P(NODE_AXIS)] * len(NodeState._fields)))
     spec_r = PodSpec(*([P()] * 6))
@@ -380,27 +414,81 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     from tpusim.types import TypicalPods
 
     tp_specs = TypicalPods(*([P()] * len(TypicalPods._fields)))
-    in_specs = (state_specs, P(NODE_AXIS), spec_r, types_specs,
-                P(), P(), tp_specs, P())
-    out_specs = (state_specs, P(), P(), P(), None, P(), P())
-    if hasattr(jax, "shard_map"):
-        mapped = jax.shard_map(
-            shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
-        )
-    else:  # pre-0.5 jax spells it jax.experimental.shard_map.shard_map
+    # the carry's table shards / block summaries live on the node axis;
+    # bookkeeping is replicated (identical on every shard by construction)
+    carry_specs = ShardTableCarry(
+        state=state_specs,
+        packed_tbl=P(None, NODE_AXIS),
+        lt=P(None, NODE_AXIS), lr=P(None, NODE_AXIS), lwn=P(None, NODE_AXIS),
+        dirty=P(), placed=P(), masks=P(), failed=P(),
+        arr_cpu=P(), arr_gpu=P(), key=P(),
+    )
+
+    def _wrap(fn, in_specs, out_specs):
+        if hasattr(jax, "shard_map"):
+            return jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        # pre-0.5 jax spells it jax.experimental.shard_map.shard_map
         from jax.experimental.shard_map import shard_map as _shard_map
 
-        mapped = _shard_map(
-            shard_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        return _shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_rep=False,
         )
 
+    mapped_init = _wrap(
+        _init_shard,
+        (state_specs, P(NODE_AXIS), spec_r, types_specs, tp_specs, P()),
+        carry_specs,
+    )
+    mapped_chunk = _wrap(
+        _chunk_shard,
+        (carry_specs, P(NODE_AXIS), spec_r, types_specs, P(), P(), tp_specs),
+        (carry_specs, P(), P()),
+    )
+
     @jax.jit
+    def init_carry(state, pods, types, tp, key, tiebreak_rank):
+        return mapped_init(state, tiebreak_rank, pods, types, tp, key)
+
+    @jax.jit
+    def run_chunk(carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank):
+        carry, nodes, devs = mapped_chunk(
+            carry, tiebreak_rank, pods, types, ev_kind, ev_pod, tp
+        )
+        return carry, (nodes, devs)
+
+    @jax.jit
+    def finish(carry):
+        """No pending-commit epilogue here (the shard engine binds in the
+        event body); shaped like the table engine's finish so the driver's
+        chunked dispatch is engine-agnostic."""
+        return carry.state, carry.placed, carry.masks, carry.failed
+
+    @jax.jit
+    def _replay_impl(state, pods, types, ev_kind, ev_pod, tp, key,
+                     tiebreak_rank) -> ReplayResult:
+        carry = init_carry(state, pods, types, tp, key, tiebreak_rank)
+        carry, (nodes, devs) = run_chunk(
+            carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank
+        )
+        return ReplayResult(
+            carry.state, carry.placed, carry.masks, carry.failed, None,
+            nodes, devs,
+        )
+
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,
                tiebreak_rank) -> ReplayResult:
-        out = mapped(state, tiebreak_rank, pods, types, ev_kind, ev_pod,
-                     tp, key)
-        return ReplayResult(*out)
+        return _replay_impl(
+            state, pods, types, ev_kind, ev_pod, tp, key, tiebreak_rank
+        )
 
+    # checkpoint/resume surface (driver chunked dispatch): a host gather of
+    # the carry (np.asarray per leaf) is the snapshot; jit re-shards it on
+    # the way back in, and the continued scan is bit-identical
+    replay.init_carry = init_carry
+    replay.run_chunk = run_chunk
+    replay.finish = finish
     return replay

@@ -90,6 +90,20 @@ class SimulatorConfig:
     # execution mode that can splice per-cycle HTTP round-trips between
     # Score and selectHost (ref: simulator.go:196 WithExtenders)
     extenders: tuple = ()
+    # Exact checkpoint/resume of the event scan (ENGINES.md
+    # "Checkpoint/resume"): > 0 cuts every table/shard-engine replay into
+    # checkpoint_every-event segments and persists the full engine carry
+    # (state + score/feas/sdev tables + blocked summaries + the
+    # PendingCommit pipeline register + the PRNG key) plus the telemetry
+    # accumulated so far to a content-addressed file after each segment. A
+    # killed run re-invoked with identical inputs resumes at the last
+    # completed segment and finishes bit-identically to an uninterrupted
+    # scan. 0 disables (the default: one unsegmented scan).
+    checkpoint_every: int = 0
+    # Where checkpoint files live; resolution order: this field if
+    # non-empty, else $TPUSIM_CHECKPOINT_DIR, else
+    # <repo>/.tpusim_checkpoints. Only consulted when checkpoint_every > 0.
+    checkpoint_dir: str = ""
     # Device-mesh width: 0 = single device; N > 1 shards the node axis
     # over an N-device jax.sharding.Mesh and replays on the
     # explicit-collective shard_map engine (tpusim.parallel.shard_engine;
@@ -127,6 +141,72 @@ class SimulateResult:
 
 
 _BELLMAN_SRC_DIGEST = None
+_ENGINE_SRC_DIGEST = None
+
+
+def _engine_source_digest() -> bytes:
+    """sha256 over every source file that determines a replay trajectory —
+    the checkpoint content key's version salt (the Bellman-cache pattern):
+    changing any engine/policy/op code invalidates all prior checkpoints
+    instead of resuming into divergence."""
+    global _ENGINE_SRC_DIGEST
+    if _ENGINE_SRC_DIGEST is None:
+        import glob
+        import hashlib
+
+        h = hashlib.sha256()
+        base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        files = [
+            os.path.join(base, rel)
+            for rel in (
+                "sim/engine.py", "sim/step.py", "sim/table_engine.py",
+                "parallel/shard_engine.py", "io/storage.py", "constants.py",
+                "types.py",
+            )
+        ]
+        files += glob.glob(os.path.join(base, "policies", "*.py"))
+        files += glob.glob(os.path.join(base, "ops", "*.py"))
+        for path in sorted(files):
+            if os.path.isfile(path):
+                with open(path, "rb") as f:
+                    h.update(f.read())
+        _ENGINE_SRC_DIGEST = h.digest()
+    return _ENGINE_SRC_DIGEST
+
+
+def validate_events(ev_kind, ev_pod, num_pods: int) -> None:
+    """Trace validation at run_events entry: a malformed event stream must
+    fail loudly HERE, not produce silent wrong answers downstream — under
+    jit, an out-of-range pod index turns the Bind scatter into a dropped
+    write (XLA scatter semantics) and an unknown kind is clipped into
+    EV_SKIP, both of which replay 'successfully' with quietly wrong
+    placements and metrics."""
+    from tpusim.sim.engine import EV_CREATE, EV_SKIP
+
+    kinds = np.asarray(ev_kind)
+    pods = np.asarray(ev_pod)
+    if kinds.ndim != 1 or pods.shape != kinds.shape:
+        raise ValueError(
+            f"event stream shape mismatch: ev_kind {kinds.shape} vs "
+            f"ev_pod {pods.shape} (want matching 1-D arrays)"
+        )
+    bad = (kinds < EV_CREATE) | (kinds > EV_SKIP)
+    if bad.any():
+        i = int(np.flatnonzero(bad)[0])
+        raise ValueError(
+            f"event {i}: unknown kind {int(kinds[i])} (expected EV_CREATE=0"
+            " | EV_DELETE=1 | EV_SKIP=2; NodeFail/NodeRecover/Evict fault"
+            " events are host-level — route them through"
+            " Simulator.schedule_pods_with_faults, not run_events)"
+        )
+    oob = (pods < 0) | (pods >= num_pods)
+    if oob.any():
+        i = int(np.flatnonzero(oob)[0])
+        raise ValueError(
+            f"event {i}: pod index {int(pods[i])} out of range for "
+            f"{num_pods} pods — a bad trace would otherwise become a "
+            "silent no-op scatter under jit"
+        )
 
 
 def _bellman_source_digest() -> bytes:
@@ -331,6 +411,11 @@ class Simulator:
         `types = build_pod_types(specs)` to skip the host-side dedup."""
         from tpusim.sim.table_engine import build_pod_types, pad_pod_types
 
+        # fail loudly on malformed traces BEFORE anything is dispatched —
+        # under jit a bad pod index or kind degrades into silent no-op
+        # scatters (see validate_events)
+        validate_events(ev_kind, ev_pod, int(specs.cpu.shape[0]))
+
         if self.cfg.extenders:
             # extenders splice HTTP round-trips into every cycle — only
             # the host-loop engine can honor them; no padding needed
@@ -381,10 +466,24 @@ class Simulator:
             state_p, rank_p = pad_nodes(state, self.rank, self.cfg.mesh)
             state_p = shard_state(state_p, self._mesh)
             self._last_engine = f"shard_map (mesh={self.cfg.mesh})"
-            out = self._shard_fn(
-                state_p, specs, types, ev_kind, ev_pod, self.typical, key,
-                rank_p,
-            )
+            # guard on the TRUE event count e, not the padded stream: a
+            # tiny replay padded to a 512 bucket must not pay the digest/
+            # checkpoint machinery it can never benefit from
+            if 0 < self.cfg.checkpoint_every < e:
+                # chunked scan with gather-to-host snapshots between
+                # segments (exact resume; ENGINES.md "Checkpoint/resume").
+                # Streams that fit in one segment skip the machinery — no
+                # checkpoint could ever be written, so the digest/eval_shape
+                # overhead would buy nothing
+                out = self._run_chunked(
+                    self._shard_fn, state_p, specs, types, ev_kind, ev_pod,
+                    key, rank_p,
+                )
+            else:
+                out = self._shard_fn(
+                    state_p, specs, types, ev_kind, ev_pod, self.typical,
+                    key, rank_p,
+                )
             # the post-pass runs on the UNPADDED state: pad rows are never
             # chosen (every valid event_node < n0), and the f32 initial
             # totals then bracket exactly like a single-device run — so
@@ -411,12 +510,29 @@ class Simulator:
                     or (self.cfg.engine == "auto" and big
                         and jax.default_backend() == "tpu")
                 )
-                fn = self._pallas_fn if use_pallas else self._table_fn
-                self._last_engine = "pallas" if use_pallas else "table"
-                out = fn(
-                    state, specs, types, ev_kind, ev_pod, self.typical, key,
-                    self.rank,
-                )
+                if use_pallas:
+                    # graceful degradation: a replay that would overflow
+                    # the fused kernel's VMEM budget, or whose kernel dies
+                    # / returns corrupt telemetry (the NaN/inf channel of
+                    # its f32 score math), falls back to the blocked table
+                    # engine with a [Degrade] warning instead of dying
+                    out = self._run_pallas_degradable(
+                        state, specs, types, ev_kind, ev_pod, key
+                    )
+                if out is None:
+                    self._last_engine = "table"
+                    # single-segment streams (true count e, not the padded
+                    # stream) skip the checkpoint machinery entirely
+                    if 0 < self.cfg.checkpoint_every < e:
+                        out = self._run_chunked(
+                            self._table_fn, state, specs, types, ev_kind,
+                            ev_pod, key, self.rank,
+                        )
+                    else:
+                        out = self._table_fn(
+                            state, specs, types, ev_kind, ev_pod,
+                            self.typical, key, self.rank,
+                        )
         if out is None:
             self._last_engine = "sequential"
             out = self.replay_fn(
@@ -426,6 +542,218 @@ class Simulator:
         # moves everything in one transfer
         out = self._attach_metrics(out, state, specs, ev_kind, ev_pod, e)
         return _slice_result(out, p, e)
+
+    # ---- graceful degradation (ISSUE 2: survive instead of dying) ----
+
+    def _run_pallas_degradable(self, state, specs, types, ev_kind, ev_pod,
+                               key):
+        """Run the fused Pallas engine behind the degradation guards.
+        Returns its ReplayResult, or None after a [Degrade] log line when
+        the replay must fall back to the (blocked) table engine: VMEM
+        overflow is predicted BEFORE dispatch (pallas_engine.fits_vmem —
+        the measured ceiling is N ≤ 4096 at K = 151), and a kernel that
+        dies mid-scan or returns out-of-range telemetry (the observable
+        shadow of NaN/inf contaminating its f32 score tables) is caught
+        AFTER. The table engine replays the identical schedule, so
+        degradation changes throughput, never results."""
+        from tpusim.sim import pallas_engine
+
+        n = state.num_nodes
+        k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+        if not pallas_engine.fits_vmem(
+            n, k, len(self._policy_fns), int(specs.cpu.shape[0]),
+            int(ev_kind.shape[0]),
+        ):
+            self.log.info(
+                f"[Degrade] fused pallas kernel would overflow VMEM at "
+                f"N={n}, K={k} (ENGINES.md spill list): falling back to "
+                "the blocked table engine"
+            )
+            return None
+        self._last_engine = "pallas"
+        try:
+            out = self._pallas_fn(
+                state, specs, types, ev_kind, ev_pod, self.typical, key,
+                self.rank,
+            )
+            bad = self._pallas_result_suspect(out, n)
+        except (AttributeError, NameError, ImportError):
+            # definite programming errors in the pallas path — degradation
+            # must not silently paper over a broken build
+            raise
+        except Exception as err:  # Mosaic OOM / lowering / runtime death
+            self.log.info(
+                f"[Degrade] pallas replay died mid-scan "
+                f"({type(err).__name__}: {err}): falling back to the "
+                "blocked table engine"
+            )
+            return None
+        if bad:
+            self.log.info(
+                f"[Degrade] pallas replay returned corrupt telemetry "
+                f"({bad}; NaN/inf in the f32 score tables?): falling back "
+                "to the blocked table engine"
+            )
+            return None
+        return out
+
+    def _pallas_result_suspect(self, out, num_nodes: int):
+        """Cheap host-side sanity screen over a fused-kernel result: every
+        placement/telemetry index must lie in [-1, N). NaN/inf poisoning
+        the kernel's f32 score path surfaces as wild argmax indices, which
+        this catches without exporting the tables themselves. Returns a
+        description or None. Costs one [E]+[P] i32 readback — noise next
+        to the replay itself."""
+        ev_node = np.asarray(out.event_node)
+        placed = np.asarray(out.placed_node)
+        if ev_node.size and ((ev_node < -1) | (ev_node >= num_nodes)).any():
+            return "event_node out of range"
+        if placed.size and ((placed < -1) | (placed >= num_nodes)).any():
+            return "placed_node out of range"
+        return None
+
+    # ---- exact checkpoint/resume of the chunked event scan ----
+
+    def _checkpoint_dir(self) -> str:
+        d = self.cfg.checkpoint_dir or os.environ.get(
+            "TPUSIM_CHECKPOINT_DIR", ""
+        )
+        if not d:
+            d = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))), ".tpusim_checkpoints")
+        return d
+
+    def _run_digest(self, state, specs, ev_kind, ev_pod, key, rank) -> str:
+        """Content key of one replay run: the engine-source version salt +
+        every input that determines the trajectory (initial state, pod
+        specs, typical pods, event stream, PRNG key, tie-break rank) + the
+        scheduling config. checkpoint_every deliberately does NOT
+        participate — chunk boundaries are an arbitrary partition, so a
+        resume may use a different segment length."""
+        from tpusim.io.storage import checkpoint_digest
+
+        cfg = self.cfg
+
+        def chunks():
+            yield _engine_source_digest()
+            yield repr((
+                tuple(cfg.policies), cfg.gpu_sel_method, cfg.dim_ext_method,
+                cfg.norm_method, cfg.block_size, cfg.mesh,
+            )).encode()
+            for leaf in (
+                jax.tree.leaves(state) + jax.tree.leaves(specs)
+                + jax.tree.leaves(self.typical)
+                + [ev_kind, ev_pod, key, rank]
+            ):
+                yield np.asarray(leaf).tobytes()
+
+        return checkpoint_digest(chunks())
+
+    def _run_chunked(self, fn, state, specs, types, ev_kind, ev_pod, key,
+                     rank):
+        """Chunked replay with exact checkpoint/resume: cut the event scan
+        into checkpoint_every-event segments via the engine's carry surface
+        (fn.init_carry / run_chunk / finish), snapshot the full carry to
+        host after each segment (for the shard engine this IS the
+        gather-to-host snapshot — np.asarray collects the shards), persist
+        it content-addressed (tpusim.io.storage), and on entry resume from
+        the newest matching checkpoint. Chaining segments is bit-identical
+        to one unsegmented scan (see table_engine.FlatTableCarry), so a
+        killed-and-resumed run reproduces the uninterrupted run's
+        placements, telemetry, metrics, and final tables exactly."""
+        from tpusim.io import storage as ckpt
+        from tpusim.sim.engine import ReplayResult
+
+        e = int(ev_kind.shape[0])
+        every = max(1, int(self.cfg.checkpoint_every))
+        cache_dir = self._checkpoint_dir()
+        digest = self._run_digest(state, specs, ev_kind, ev_pod, key, rank)
+        template = jax.eval_shape(
+            fn.init_carry, state, specs, types, self.typical, key, rank
+        )
+        tleaves, tdef = jax.tree.flatten(template)
+
+        carry = None
+        cursor = 0
+        node_parts: list = []
+        dev_parts: list = []
+        found = ckpt.find_checkpoint(cache_dir, digest)
+        if found is not None:
+            try:
+                cursor0, arrays = ckpt.load_checkpoint(found[1])
+                leaves = [arrays[f"c{i:03d}"] for i in range(len(tleaves))]
+                if any(
+                    a.shape != t.shape or a.dtype != t.dtype
+                    for a, t in zip(leaves, tleaves)
+                ):
+                    raise ValueError("carry layout mismatch")
+                carry = jax.tree.unflatten(
+                    tdef, [jnp.asarray(a) for a in leaves]
+                )
+                node_parts = [arrays["event_node"]]
+                dev_parts = [arrays["event_dev"]]
+                cursor = cursor0
+                self.log.info(
+                    f"[Checkpoint] resumed replay at event {cursor}/{e} "
+                    f"from {os.path.basename(found[1])}"
+                )
+            except Exception as err:
+                # torn/stale file: content addressing makes starting fresh
+                # always safe. DELETE the unusable file — find_checkpoint
+                # always picks the max cursor, so a bad high-cursor file
+                # left behind would shadow every good checkpoint this run
+                # writes below it and permanently disable resume
+                self.log.info(
+                    f"[Checkpoint] dropping unusable checkpoint "
+                    f"{os.path.basename(found[1])} ({err}); starting fresh"
+                )
+                try:
+                    os.unlink(found[1])
+                except OSError:
+                    pass
+                carry, cursor, node_parts, dev_parts = None, 0, [], []
+        if carry is None:
+            carry = fn.init_carry(
+                state, specs, types, self.typical, key, rank
+            )
+
+        while cursor < e:
+            end = min(cursor + every, e)
+            carry, (nseg, dseg) = fn.run_chunk(
+                carry, specs, types, ev_kind[cursor:end],
+                ev_pod[cursor:end], self.typical, rank,
+            )
+            node_parts.append(np.asarray(nseg))
+            dev_parts.append(np.asarray(dseg))
+            cursor = end
+            if cursor < e:
+                # gather-to-host snapshot + atomic content-addressed save;
+                # the final segment skips it (the run completes right after)
+                host = jax.tree.map(np.asarray, carry)
+                arrays = {
+                    f"c{i:03d}": a
+                    for i, a in enumerate(jax.tree.leaves(host))
+                }
+                arrays["event_node"] = np.concatenate(node_parts)
+                arrays["event_dev"] = np.concatenate(dev_parts)
+                ckpt.save_checkpoint(cache_dir, digest, cursor, arrays)
+                ckpt.prune_checkpoints(cache_dir, digest, cursor)
+
+        state_f, placed, masks, failed = fn.finish(carry)
+        ckpt.prune_checkpoints(cache_dir, digest, e + 1)  # run completed
+        nodes = (
+            np.concatenate(node_parts) if node_parts
+            else np.zeros(0, np.int32)
+        )
+        devs = (
+            np.concatenate(dev_parts) if dev_parts
+            else np.zeros((0, 8), bool)
+        )
+        return ReplayResult(
+            state_f, placed, masks, failed, None,
+            jnp.asarray(nodes), jnp.asarray(devs),
+        )
 
     # ---- workload prep (core.go:103-142) ----
 
@@ -649,6 +977,24 @@ class Simulator:
         self.cluster_analysis("InitSchedule")
         return res
 
+    def run_with_faults(self, fault_cfg=None, faults=None) -> SimulateResult:
+        """run() under fault injection: same experiment orchestration, the
+        main schedule replaced by schedule_pods_with_faults (the CLI's
+        --fault-* flags land here)."""
+        self._reset_run_state()
+        self.set_typical_pods()
+        self.set_skyline_pods()
+        pods = self.prepare_pods()
+        self.log.info(
+            f"Number of original workload pods: {len(self.workload_pods)}"
+        )
+        res = self.schedule_pods_with_faults(
+            pods, faults=faults, fault_cfg=fault_cfg
+        )
+        self.report_failed([u.pod for u in res.unscheduled_pods])
+        self.cluster_analysis("InitSchedule")
+        return res
+
     def report_failed(self, pods) -> None:
         """Failed-pods detail block + the direct-CSV path's stash (every
         block the log carries contributes to the fail-spec grouping, like
@@ -799,6 +1145,267 @@ class Simulator:
         res.unscheduled_pods = list(res.unscheduled_pods) + failed
         self.log.info(f"[DescheduleCluster] Num of Failed Pods: {len(failed)}")
         return failed
+
+    # ---- fault injection (tpusim.sim.faults) ----
+
+    def schedule_pods_with_faults(
+        self, pods: Sequence[PodRow], faults=None, fault_cfg=None
+    ) -> SimulateResult:
+        """schedule_pods under a fault schedule: NodeFail / NodeRecover /
+        Evict events fire between compiled replay segments, evicted pods
+        re-enter through a capped-exponential-backoff retry queue
+        (tpusim.sim.queues.RetryQueue), and pods out of retries become
+        terminal UnscheduledPods (reason "max-retries-exceeded").
+
+        `faults`: an explicit FaultEvent list (the trace-column mode), or
+        None to generate an MTBF-style schedule from `fault_cfg`
+        (tpusim.sim.faults.generate_fault_schedule — seeded, so the whole
+        disruption outcome is bit-reproducible; tests/test_faults.py pins
+        that). Segments run through run_events unchanged, so fault replays
+        inherit engine selection AND checkpoint/resume.
+
+        Creation-ordered traces only (use_timestamps=False, the experiment
+        pipeline's mode): a trace-deletion of a pod created in an earlier
+        segment would need cross-segment placement memory the engine call
+        surface does not carry — deletions under faults are modeled as
+        Evict events instead. Disruption totals land in
+        `self.last_disruption` and the `[Disruption]` log block."""
+        from tpusim.sim.engine import (
+            EV_CREATE,
+            EV_EVICT,
+            EV_NODE_FAIL,
+            EV_NODE_RECOVER,
+        )
+        from tpusim.sim.deschedule import evict as evict_pods
+        from tpusim.sim.faults import (
+            FaultConfig,
+            fail_node,
+            generate_fault_schedule,
+            pick_eviction_victim,
+            recover_node,
+            validate_fault_schedule,
+        )
+        from tpusim.sim.metrics import DisruptionMetrics
+        from tpusim.sim.queues import RetryQueue
+        from tpusim.sim.reports import disruption_report_block
+        from tpusim.sim.table_engine import build_pod_types
+
+        if self.cfg.use_timestamps:
+            raise ValueError(
+                "schedule_pods_with_faults replays creation-ordered traces "
+                "(use_timestamps=False); model deletions as Evict fault "
+                "events instead"
+            )
+        if self.typical is None:
+            self.set_typical_pods()
+        fcfg = fault_cfg or FaultConfig()
+        pods = list(pods)
+        ev_kind, ev_pod = build_events(pods, False)
+        num_events = len(ev_kind)
+        if faults is None:
+            faults = generate_fault_schedule(
+                len(self.nodes), num_events, fcfg
+            )
+        faults = sorted(faults, key=lambda f: f.pos)  # stable: ties keep order
+        validate_fault_schedule(faults, len(self.nodes), len(pods))
+        t0 = time.perf_counter()
+
+        num_pods = len(pods)
+        specs = pods_to_specs(pods, self.node_index)
+        types = build_pod_types(specs)
+        state = jax.tree.map(jnp.asarray, self.init_state)
+        gpu_cnt = np.asarray(self.init_state.gpu_cnt)
+        ndev = int(self.init_state.gpu_left.shape[1])
+        placed = np.full(num_pods, -1, np.int32)
+        masks = np.zeros((num_pods, ndev), bool)
+        ever_failed = np.zeros(num_pods, bool)
+        creation_rank = np.full(num_pods, -1, np.int64)
+        base_key = jax.random.PRNGKey(self.cfg.seed)
+        rq = RetryQueue(
+            fcfg.backoff_base, fcfg.backoff_cap, fcfg.max_retries
+        )
+        dm = DisruptionMetrics()
+        attempts: dict = {}  # pod -> consecutive failed retries so far
+        evicted_at: dict = {}  # pod -> eviction position (latency clock)
+        down_at: dict = {}  # node -> failure position
+        state_box = {"state": state, "rank": 0, "events": 0, "segs": 0}
+
+        def frag_total(st):
+            from tpusim.ops.frag import cluster_frag_report, frag_sum_except_q3
+
+            return float(frag_sum_except_q3(
+                cluster_frag_report(st, self.typical)[0]
+            ))
+
+        def run_segment(seg_kind, seg_pod):
+            """One compiled segment via the normal run_events dispatch;
+            merges its placements into the host bookkeeping."""
+            seg_kind = np.asarray(seg_kind)
+            seg_pod = np.asarray(seg_pod)
+            seg_key = jax.random.fold_in(base_key, state_box["segs"])
+            state_box["segs"] += 1
+            pre_state = state_box["state"]
+            out = device_fetch(self.run_events(
+                pre_state, specs, jnp.asarray(seg_kind),
+                jnp.asarray(seg_pod), seg_key, types=types, pod_rows=pods,
+            ))
+            self._emit_event_reports(out, pods, seg_kind, seg_pod, pre_state)
+            state_box["state"] = jax.tree.map(jnp.asarray, out.state)
+            created = seg_pod[seg_kind == EV_CREATE]
+            placed[created] = np.asarray(out.placed_node)[created]
+            masks[created] = np.asarray(out.dev_mask)[created]
+            ever_failed[created] |= np.asarray(out.ever_failed)[created]
+            creation_rank[created] = (
+                state_box["rank"] + np.arange(created.size)
+            )
+            state_box["rank"] += int(created.size)
+            state_box["events"] += int(seg_kind.size)
+
+        def evict_bookkeep(pod_i: int, pos: int):
+            placed[pod_i] = -1
+            masks[pod_i] = False
+            evicted_at[pod_i] = pos
+            dm.evicted_pods += 1
+            att = attempts.get(pod_i, 0) + 1
+            attempts[pod_i] = att
+            # rq.dead is THE terminal list; totals are read off it after
+            # the loop instead of being double-counted here
+            if rq.push(pod_i, pos, att) is None:
+                ever_failed[pod_i] = True
+            else:
+                dm.retries_enqueued += 1
+
+        def apply_fault(f, pos: int):
+            if f.kind == EV_NODE_FAIL:
+                if f.node in down_at:
+                    return  # already down
+                victims = np.flatnonzero(placed == f.node)
+                state_box["state"] = fail_node(state_box["state"], f.node)
+                down_at[f.node] = pos
+                dm.node_failures += 1
+                self.log.info(
+                    f"[Fault] node {self.node_names[f.node]} failed at "
+                    f"event {pos}: {victims.size} pods evicted"
+                )
+                for v in victims.tolist():
+                    evict_bookkeep(int(v), pos)
+            elif f.kind == EV_NODE_RECOVER:
+                if f.node not in down_at:
+                    return  # never failed / already recovered
+                before = frag_total(state_box["state"])
+                state_box["state"] = recover_node(state_box["state"], f.node)
+                after = frag_total(state_box["state"])
+                dm.post_recovery_frag_delta.append(after - before)
+                dm.node_recoveries += 1
+                dm.failed_node_gpu_events += int(gpu_cnt[f.node]) * (
+                    pos - down_at.pop(f.node)
+                )
+                self.log.info(
+                    f"[Fault] node {self.node_names[f.node]} recovered at "
+                    f"event {pos} (frag delta {after - before:+.1f})"
+                )
+            else:  # EV_EVICT
+                v = pick_eviction_victim(placed, pos, fcfg.seed, f.pod)
+                if v is None:
+                    return  # nothing placed to evict
+                state_box["state"] = evict_pods(
+                    state_box["state"], specs, jnp.asarray(placed),
+                    jnp.asarray(masks), [v],
+                )
+                self.log.info(
+                    f"[Fault] pod {pods[v].name} evicted from node "
+                    f"{self.node_names[int(placed[v])]} at event {pos}"
+                )
+                evict_bookkeep(int(v), pos)
+
+        fi = 0
+        cursor = 0
+        while True:
+            candidates = [num_events] if cursor < num_events else []
+            if fi < len(faults):
+                candidates.append(min(faults[fi].pos, num_events))
+            nr = rq.next_ready()
+            if nr is not None:
+                candidates.append(min(nr, num_events))
+            if not candidates:
+                break
+            stop = min(candidates)
+            if stop > cursor:
+                run_segment(ev_kind[cursor:stop], ev_pod[cursor:stop])
+                cursor = stop
+            pos = stop
+            # faults fire first so a retry due at the same position sees
+            # the post-fault cluster (never re-lands on the dying node)
+            while fi < len(faults) and min(faults[fi].pos, num_events) <= pos:
+                apply_fault(faults[fi], pos)
+                fi += 1
+            # once the trace and fault stream are drained, flush the queue
+            # regardless of backoff — there is nothing left to wait for
+            thresh = (
+                pos if (cursor < num_events or fi < len(faults))
+                else float("inf")
+            )
+            due = rq.pop_due(thresh)
+            if due:
+                retry_idx = np.array([p for p, _ in due], np.int32)
+                run_segment(
+                    np.zeros(retry_idx.size, np.int32), retry_idx
+                )
+                for pod_i, _att in due:
+                    if placed[pod_i] >= 0:
+                        dm.rescheduled_pods += 1
+                        dm.reschedule_latency_events.append(
+                            pos - evicted_at.pop(pod_i)
+                        )
+                        # the budget is max_retries CONSECUTIVE failures
+                        # (FaultConfig doc): a successful reschedule resets
+                        # it, so a long-lived pod evicted many separate
+                        # times is not eventually killed by accumulation
+                        attempts.pop(pod_i, None)
+                    else:
+                        att = attempts[pod_i] + 1
+                        attempts[pod_i] = att
+                        if rq.push(pod_i, pos, att) is not None:
+                            dm.retries_enqueued += 1
+
+        # capacity still dark at trace end counts to the end-of-trace clock
+        for node_i, t_fail in down_at.items():
+            dm.failed_node_gpu_events += int(gpu_cnt[node_i]) * max(
+                num_events - t_fail, 0
+            )
+        # the retry queue's dead list is the single source of truth for
+        # out-of-retries pods
+        dead_pods = {p for p, _ in rq.dead}
+        dm.unscheduled_after_retries = len(rq.dead)
+
+        self.analysis_summary.update(disruption_report_block(self.log, dm))
+        self.last_disruption = dm
+
+        skipped = np.array([p.unscheduled for p in pods], bool)
+        unscheduled = []
+        for i in range(num_pods):
+            if skipped[i]:
+                unscheduled.append(UnscheduledPod(
+                    pods[i], reason="pod-unscheduled annotation"
+                ))
+            elif i in dead_pods:
+                unscheduled.append(UnscheduledPod(
+                    pods[i], reason="max-retries-exceeded"
+                ))
+            elif placed[i] < 0 and bool(ever_failed[i]):
+                unscheduled.append(UnscheduledPod(pods[i]))
+        self.last_result = SimulateResult(
+            unscheduled_pods=unscheduled,
+            placed_node=placed,
+            dev_mask=masks,
+            state=jax.tree.map(np.asarray, state_box["state"]),
+            pods=pods,
+            node_names=self.node_names,
+            wall_seconds=time.perf_counter() - t0,
+            events=state_box["events"],
+            creation_rank=creation_rank,
+        )
+        return self.last_result
 
     # ---- reporting (analysis.go) ----
 

@@ -26,6 +26,14 @@ from tpusim.types import NodeState, PodSpec
 EV_CREATE = 0
 EV_DELETE = 1
 EV_SKIP = 2  # padding / `simon/pod-unscheduled`-annotated pods (simulator.go:391-399)
+# Fault-injection vocabulary (ISSUE 2; tpusim.sim.faults): host-level
+# events the DRIVER replays between compiled segments — they touch many
+# pods at once (a node failure evicts every pod on the node), which breaks
+# the one-node-one-pod-per-event invariant the compiled engines are built
+# on, so they must never enter run_events (validate_events rejects them).
+EV_NODE_FAIL = 3  # node crashes; its pods are evicted into the retry queue
+EV_NODE_RECOVER = 4  # node returns, empty
+EV_EVICT = 5  # single-pod eviction (preemption), pod re-enters via retry
 
 _power_nodes = jax.vmap(node_power)
 

@@ -1,0 +1,208 @@
+"""Failure injection: node crash/recover and pod eviction as simulable
+events (ISSUE 2; the Tesserae / Gavel line in PAPERS.md treats preemption
+and node churn as first-class scheduler inputs — this module gives the
+replay the same vocabulary).
+
+Fault events are HOST-LEVEL: a node failure evicts every pod on the node
+at once, which breaks the one-node-one-pod-per-event invariant the
+compiled engines are built on. The driver therefore splits the base trace
+at fault positions, replays each segment on the normal compiled engines
+(run_events — so fault runs inherit checkpoint/resume and engine
+selection unchanged), and applies the fault transitions between segments
+(Simulator.schedule_pods_with_faults).
+
+Schedules are either explicit FaultEvent lists (the "trace column" mode —
+callers build them from real incident logs) or generated MTBF-style from
+a seeded generator (generate_fault_schedule): geometric inter-failure and
+repair gaps measured in EVENTS, not wall time, so a fixed seed gives a
+bit-reproducible schedule on any backend.
+
+A DOWN node is encoded as mem_left == -1 — the same sentinel node-axis
+padding rows carry (tpusim.parallel.pad_nodes; filter_nodes fails the mem
+check for every request, pod.mem >= 0 always), so no engine needs a new
+feasibility input. The rest of the row is reset to idle so a down node
+never skews the used-capacity aggregates; the capacity it holds while
+down is accounted separately (DisruptionMetrics.failed_node_gpu_events).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpusim.constants import MAX_GPUS_PER_NODE, MILLI
+from tpusim.sim.engine import EV_EVICT, EV_NODE_FAIL, EV_NODE_RECOVER
+from tpusim.types import NodeState
+
+FAULT_KINDS = (EV_NODE_FAIL, EV_NODE_RECOVER, EV_EVICT)
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One fault, anchored between two base-trace events.
+
+    pos: the fault fires after `pos` base events have been replayed
+    (clamped to the trace length; several faults may share a position and
+    fire in list order). kind: EV_NODE_FAIL | EV_NODE_RECOVER | EV_EVICT.
+    node: target node index (fail/recover). pod: target pod index for
+    EV_EVICT; -1 picks a seeded-random placed pod at replay time."""
+
+    pos: int
+    kind: int
+    node: int = -1
+    pod: int = -1
+
+
+@dataclass
+class FaultConfig:
+    """Knobs of the seeded MTBF-style schedule + the retry policy.
+
+    mtbf_events / mttr_events: mean events between node failures / until a
+    failed node returns (0 disables failures / makes them permanent).
+    evict_every_events: mean events between single-pod evictions (0 = off).
+    Backoff: an evicted pod re-enters the stream
+    min(backoff_base * 2^(attempt-1), backoff_cap) events after its
+    eviction; after max_retries CONSECUTIVE failed attempts it is terminal
+    (UnscheduledPod, reason "max-retries-exceeded") — a successful
+    reschedule resets the budget."""
+
+    mtbf_events: float = 0.0
+    mttr_events: float = 0.0
+    evict_every_events: float = 0.0
+    seed: int = 0
+    max_retries: int = 3
+    backoff_base: int = 8
+    backoff_cap: int = 256
+
+
+def _geometric(rng: np.random.Generator, mean: float) -> int:
+    """Integer gap >= 1 with the given mean (geometric — the discrete
+    memoryless distribution, i.e. MTBF measured in events)."""
+    p = min(1.0, 1.0 / max(mean, 1.0))
+    return int(rng.geometric(p))
+
+
+def generate_fault_schedule(
+    num_nodes: int, num_events: int, cfg: FaultConfig
+) -> List[FaultEvent]:
+    """Seeded MTBF-style schedule over a num_events-long trace.
+
+    A time walk draws geometric inter-failure gaps; each failure hits a
+    uniformly-chosen currently-UP node and (when mttr_events > 0)
+    schedules that node's recovery a geometric repair gap later. An
+    independent walk emits single-pod evictions (pod chosen at replay
+    time from the placed set, seeded by position). Deterministic for a
+    fixed (cfg.seed, num_nodes, num_events) — the acceptance contract for
+    reproducible disruption metrics."""
+    rng = np.random.default_rng(cfg.seed)
+    events: List[FaultEvent] = []
+    if cfg.mtbf_events > 0 and num_nodes > 0:
+        recover_at = {}  # node -> scheduled recovery position
+        t = _geometric(rng, cfg.mtbf_events)
+        while t < num_events:
+            up = [
+                i for i in range(num_nodes)
+                if recover_at.get(i, -1) <= t
+            ]
+            if not up:
+                t += _geometric(rng, cfg.mtbf_events)
+                continue
+            node = int(up[rng.integers(0, len(up))])
+            events.append(FaultEvent(pos=t, kind=EV_NODE_FAIL, node=node))
+            if cfg.mttr_events > 0:
+                back = t + _geometric(rng, cfg.mttr_events)
+                recover_at[node] = back
+                if back < num_events:
+                    events.append(
+                        FaultEvent(pos=back, kind=EV_NODE_RECOVER, node=node)
+                    )
+            else:
+                recover_at[node] = num_events + 1  # permanent loss
+            t += _geometric(rng, cfg.mtbf_events)
+    if cfg.evict_every_events > 0:
+        t = _geometric(rng, cfg.evict_every_events)
+        while t < num_events:
+            events.append(FaultEvent(pos=t, kind=EV_EVICT))
+            t += _geometric(rng, cfg.evict_every_events)
+    events.sort(key=lambda e: e.pos)  # stable: same-pos order preserved
+    return events
+
+
+def is_down(state: NodeState) -> jnp.ndarray:
+    """bool[N] — which nodes carry the down sentinel."""
+    return state.mem_left < 0
+
+
+def _reset_node(state: NodeState, node: int, mem_left) -> NodeState:
+    """Reset one node's row to empty-at-capacity with the given mem_left —
+    the shared core of fail/recover (only the mem sentinel differs)."""
+    node = jnp.asarray(node, jnp.int32)
+    gpu_full = (
+        jnp.arange(MAX_GPUS_PER_NODE, dtype=jnp.int32) < state.gpu_cnt[node]
+    ).astype(jnp.int32) * MILLI
+    return state._replace(
+        cpu_left=state.cpu_left.at[node].set(state.cpu_cap[node]),
+        mem_left=state.mem_left.at[node].set(mem_left),
+        gpu_left=state.gpu_left.at[node].set(gpu_full),
+        aff_cnt=state.aff_cnt.at[node].set(0),
+    )
+
+
+def fail_node(state: NodeState, node: int) -> NodeState:
+    """Crash one node: the row is reset wholesale to the DOWN encoding
+    (mem_left -1 blocks every request; cpu/gpu read as idle so the dead
+    node doesn't leak into the used-capacity aggregates). The caller owns
+    evicting the node's pods into the retry queue — their resources do not
+    need returning because the whole row is re-derived from capacity."""
+    return _reset_node(state, node, -1)
+
+
+def recover_node(state: NodeState, node: int) -> NodeState:
+    """Bring a failed node back, EMPTY (a recovered host rejoins with no
+    pods — its previous tenants are in the retry queue or already placed
+    elsewhere)."""
+    return _reset_node(state, node, state.mem_cap[jnp.asarray(node, jnp.int32)])
+
+
+def pick_eviction_victim(
+    placed: np.ndarray, pos: int, seed: int, explicit_pod: int = -1
+) -> Optional[int]:
+    """Victim of an EV_EVICT event: the explicit pod if it is currently
+    placed, else a seeded-uniform draw over the placed set (seeded by
+    schedule seed + position, so two runs of the same schedule evict the
+    same pods). None when nothing is placed."""
+    if explicit_pod >= 0:
+        return explicit_pod if placed[explicit_pod] >= 0 else None
+    candidates = np.flatnonzero(placed >= 0)
+    if candidates.size == 0:
+        return None
+    rng = np.random.default_rng(np.uint64(seed) + np.uint64(pos) * 2654435761)
+    return int(candidates[rng.integers(0, candidates.size)])
+
+
+def validate_fault_schedule(
+    faults: Sequence[FaultEvent], num_nodes: int, num_pods: int
+) -> None:
+    """Same fail-loudly contract as driver.validate_events, for the fault
+    stream: bad targets must raise here, not become silent no-ops."""
+    for i, ev in enumerate(faults):
+        if ev.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"fault {i}: kind {ev.kind} is not EV_NODE_FAIL={EV_NODE_FAIL}"
+                f" | EV_NODE_RECOVER={EV_NODE_RECOVER} | EV_EVICT={EV_EVICT}"
+            )
+        if ev.kind in (EV_NODE_FAIL, EV_NODE_RECOVER) and not (
+            0 <= ev.node < num_nodes
+        ):
+            raise ValueError(
+                f"fault {i}: node {ev.node} out of range for {num_nodes} nodes"
+            )
+        if ev.kind == EV_EVICT and ev.pod >= num_pods:
+            raise ValueError(
+                f"fault {i}: pod {ev.pod} out of range for {num_pods} pods"
+            )
+        if ev.pos < 0:
+            raise ValueError(f"fault {i}: negative position {ev.pos}")

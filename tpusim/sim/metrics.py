@@ -37,6 +37,9 @@ integers and to f32 tolerance for the float series).
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
+from typing import List
+
 import jax
 import jax.numpy as jnp
 
@@ -186,3 +189,53 @@ def compute_event_metrics(
         power_cpu=pw[:, 0],
         power_gpu=pw[:, 1],
     )
+
+
+@dataclass
+class DisruptionMetrics:
+    """Fault-replay disruption accounting (ISSUE 2; filled by
+    Simulator.schedule_pods_with_faults, reported by
+    reports.disruption_report_block). The clock is the EVENT counter —
+    trace positions, not wall time — so every number is bit-reproducible
+    under a fixed fault seed; that reproducibility is itself a pinned
+    acceptance criterion (tests/test_faults.py)."""
+
+    node_failures: int = 0
+    node_recoveries: int = 0
+    evicted_pods: int = 0  # node-crash evictions + single-pod preemptions
+    retries_enqueued: int = 0
+    rescheduled_pods: int = 0  # evicted pods that found a home again
+    unscheduled_after_retries: int = 0  # hit max_retries -> terminal
+    # Σ gpu_count × events-down per failed node: "failed-node GPU-hours"
+    # with the event counter as the clock
+    failed_node_gpu_events: int = 0
+    # per rescheduled pod: placement position - eviction position
+    reschedule_latency_events: List[int] = field(default_factory=list)
+    # per recovery: cluster frag (frag_sum_except_q3 of the amounts row)
+    # right after the node returned minus right before — how much
+    # fragmentation the re-added empty capacity exposes
+    post_recovery_frag_delta: List[float] = field(default_factory=list)
+
+    def mean_reschedule_latency(self) -> float:
+        lat = self.reschedule_latency_events
+        return float(sum(lat)) / len(lat) if lat else 0.0
+
+    def as_dict(self) -> dict:
+        """Scalar summary for the direct-CSV stash / log parsing."""
+        return {
+            "node_failures": self.node_failures,
+            "node_recoveries": self.node_recoveries,
+            "evicted_pods": self.evicted_pods,
+            "retries_enqueued": self.retries_enqueued,
+            "rescheduled_pods": self.rescheduled_pods,
+            "unscheduled_after_retries": self.unscheduled_after_retries,
+            "failed_node_gpu_events": self.failed_node_gpu_events,
+            "mean_reschedule_latency_events": self.mean_reschedule_latency(),
+            "max_reschedule_latency_events": (
+                max(self.reschedule_latency_events)
+                if self.reschedule_latency_events else 0
+            ),
+            "post_recovery_frag_delta_sum": float(
+                sum(self.post_recovery_frag_delta)
+            ),
+        }

@@ -79,6 +79,63 @@ _INT_MAX = np.int32(np.iinfo(np.int32).max)
 
 _EV_FIELDS = 12  # packed per-event row size (see _pack_events)
 
+# Per-core VMEM budget the fused kernel's resident set must fit in. Real
+# TPU cores carry ~16 MiB; the default leaves headroom for Mosaic's own
+# scratch. Exceeding it used to surface as an opaque Mosaic allocation
+# failure mid-compile (or a wedged device) — driver.run_events now probes
+# fits_vmem() first and degrades to the blocked table engine instead
+# (ISSUE 2 graceful degradation). Override with TPUSIM_PALLAS_VMEM_BYTES.
+DEFAULT_VMEM_BUDGET = 14 * 2**20
+
+
+def vmem_resident_bytes(
+    n_nodes: int, k_types: int, num_pol: int, num_pods: int, num_events: int
+) -> int:
+    """Estimated VMEM-resident footprint of the fused kernel: the
+    score/sdev/feas tables ([K, N] i32 per policy + 2), the node state
+    (~14 i32 lanes per node), the packed event rows ([_EV_FIELDS, E] i32),
+    and the pod-axis bookkeeping ([1, P] rows). The node axis is padded to
+    a 128 multiple like make_pallas_replay does."""
+    n = -(-n_nodes // 128) * 128
+    tables = (num_pol + 2) * k_types * n * 4
+    state = 14 * n * 4
+    events = _EV_FIELDS * num_events * 4
+    pods = 12 * num_pods * 4
+    return tables + state + events + pods
+
+
+def _compiler_params_cls():
+    """pltpu compiler-params class across the 0.5.x rename; a clear error
+    beats `None(...)` when a future jax drops both spellings."""
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cls is None:
+        raise RuntimeError(
+            "jax.experimental.pallas.tpu exposes neither CompilerParams "
+            "nor TPUCompilerParams; this jax version is unsupported by "
+            "the fused pallas engine (use engine: table)"
+        )
+    return cls
+
+
+def fits_vmem(
+    n_nodes: int, k_types: int, num_pol: int, num_pods: int, num_events: int
+) -> bool:
+    """Whether the fused kernel's resident set fits the VMEM budget — the
+    driver's pre-dispatch degradation probe (ENGINES.md spill list: the
+    measured ceiling is N ≤ 4096 at K = 151 on a 16 MiB core)."""
+    import os
+
+    try:
+        budget = int(os.environ.get("TPUSIM_PALLAS_VMEM_BYTES",
+                                    DEFAULT_VMEM_BUDGET))
+    except ValueError:
+        budget = DEFAULT_VMEM_BUDGET
+    return vmem_resident_bytes(
+        n_nodes, k_types, num_pol, num_pods, num_events
+    ) <= budget
+
 
 def _iota(shape, dim):
     return jax.lax.broadcasted_iota(jnp.int32, shape, dim)
@@ -1069,7 +1126,9 @@ def make_pallas_replay(
             in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 25,
             out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 12),
             scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
-            compiler_params=pltpu.CompilerParams(
+            # jax renamed TPUCompilerParams -> CompilerParams in 0.5.x;
+            # accept either so the engine survives both sides of the rename
+            compiler_params=_compiler_params_cls()(
                 dimension_semantics=("arbitrary",),
             ),
             interpret=interpret,

@@ -12,7 +12,8 @@ resource share (pkg/apply + algo/greed.go).
 
 from __future__ import annotations
 
-from typing import List, Sequence
+import heapq
+from typing import List, Optional, Sequence, Tuple
 
 from tpusim.io.trace import NodeRow, PodRow
 
@@ -65,3 +66,59 @@ def app_queue(
         out = greed_sort(out, nodes)
     out = affinity_sort(out)
     return toleration_sort(out)
+
+
+class RetryQueue:
+    """Backoff requeue for fault-evicted pods (tpusim.sim.faults; the
+    kube-scheduler backoff-queue shape: per-attempt exponential delay with
+    a cap, then a terminal state).
+
+    Attempt k re-enters the event stream min(base * 2^(k-1), cap) events
+    after its eviction; a pod that has already failed max_retries attempts
+    goes to `dead` instead (the driver reports it as an UnscheduledPod
+    with reason "max-retries-exceeded"). Ordering is a (ready_position,
+    insertion_seq) heap — deterministic FIFO among same-position retries,
+    which the fault-replay determinism tests pin."""
+
+    def __init__(self, base: int = 8, cap: int = 256, max_retries: int = 3):
+        if base < 1 or cap < base or max_retries < 0:
+            raise ValueError(
+                f"RetryQueue(base={base}, cap={cap}, max_retries="
+                f"{max_retries}): want base >= 1 <= cap and retries >= 0"
+            )
+        self.base = int(base)
+        self.cap = int(cap)
+        self.max_retries = int(max_retries)
+        self._heap: List[Tuple[int, int, int, int]] = []
+        self._seq = 0
+        self.dead: List[Tuple[int, int]] = []  # (pod, attempts burned)
+
+    def backoff(self, attempt: int) -> int:
+        """Events to wait before attempt `attempt` (1-based)."""
+        return min(self.base * (1 << max(attempt - 1, 0)), self.cap)
+
+    def push(self, pod: int, evicted_at: int, attempt: int) -> Optional[int]:
+        """Enqueue retry `attempt` for `pod`; returns its ready position,
+        or None when the pod is out of retries (terminal)."""
+        if attempt > self.max_retries:
+            self.dead.append((pod, attempt - 1))
+            return None
+        ready = evicted_at + self.backoff(attempt)
+        heapq.heappush(self._heap, (ready, self._seq, pod, attempt))
+        self._seq += 1
+        return ready
+
+    def next_ready(self) -> Optional[int]:
+        """Position of the earliest queued retry (None when empty)."""
+        return self._heap[0][0] if self._heap else None
+
+    def pop_due(self, pos: int) -> List[Tuple[int, int]]:
+        """All (pod, attempt) retries due at or before `pos`, FIFO."""
+        due = []
+        while self._heap and self._heap[0][0] <= pos:
+            _, _, pod, attempt = heapq.heappop(self._heap)
+            due.append((pod, attempt))
+        return due
+
+    def __len__(self) -> int:
+        return len(self._heap)
