@@ -48,6 +48,7 @@ from tpusim.policies import (
 from tpusim.sim.engine import ReplayResult
 from tpusim.sim.step import (
     SELF_SELECT_POLICIES,
+    PendingCommit,
     apply_commit,
     block_reduce,
     choose_devices,
@@ -195,6 +196,69 @@ def _row_state(state: NodeState, node) -> NodeState:
     )
 
 
+def _pad_rank(rank: jnp.ndarray, n_pad: int) -> jnp.ndarray:
+    """Tie-break rank padded to the blocked layout's node count; sentinel
+    rows carry rank INT_MAX so a pad column can never win a tie."""
+    n = rank.shape[0]
+    if n_pad == n:
+        return rank
+    return jnp.pad(
+        rank, (0, n_pad - n), constant_values=jnp.iinfo(jnp.int32).max
+    )
+
+
+class FlatTableCarry(NamedTuple):
+    """Complete engine state between two events of the FLAT table replay —
+    the lax.scan carry, promoted to a serializable pytree so a run can be
+    cut at any event boundary, round-tripped through host memory / a
+    checkpoint file (tpusim.io.storage.save_checkpoint), and resumed
+    bit-identically: the scan body is a pure function of (carry, event), so
+    `scan(body, c, ev[:k]); scan(body, ·, ev[k:])` IS `scan(body, c, ev)`.
+
+    All leaves are exact dtypes (i32 / bool / u32 PRNG key) — serialization
+    cannot perturb them."""
+
+    state: NodeState
+    score_tbl: jnp.ndarray  # i32[num_pol, K, N]
+    sdev_tbl: jnp.ndarray  # i32[K, N]
+    feas_tbl: jnp.ndarray  # bool[K, N]
+    pend: PendingCommit  # the software-pipeline register (one event deep)
+    dirty: jnp.ndarray  # i32 node whose column the next event refreshes
+    placed: jnp.ndarray  # i32[P+1] (dummy row absorbs skip writes)
+    masks: jnp.ndarray  # bool[P+1, 8]
+    failed: jnp.ndarray  # bool[P+1]
+    arr_cpu: jnp.ndarray  # i32 arrived milli-CPU so far
+    arr_gpu: jnp.ndarray  # i32 arrived milli-GPU so far
+    key: jnp.ndarray  # PRNG key after the events consumed so far
+
+
+class BlockedTableCarry(NamedTuple):
+    """FlatTableCarry plus the blocked select-phase aggregates
+    (tables/summaries padded to a whole number of B-node blocks). Same
+    resume contract; the extra leaves are exactly the per-(policy, type,
+    block) summaries ENGINES.md round 6 describes."""
+
+    state: NodeState
+    score_tbl: jnp.ndarray  # i32[num_pol, K, n_pad]
+    sdev_tbl: jnp.ndarray  # i32[K, n_pad]
+    feas_tbl: jnp.ndarray  # bool[K, n_pad]
+    bt: jnp.ndarray  # i32[K, N/B] per-block max weighted total
+    br: jnp.ndarray  # i32[K, N/B] min tie-break rank among the maxima
+    bn: jnp.ndarray  # i32[K, N/B] the block winner's global node id
+    brmin: jnp.ndarray  # i32[pn, K, N/B] block raw-score minima (normalizers)
+    brmax: jnp.ndarray  # i32[pn, K, N/B] block raw-score maxima
+    slo: jnp.ndarray  # i32[pn, K] stored per-type lo extrema
+    shi: jnp.ndarray  # i32[pn, K] stored per-type hi extrema
+    pend: PendingCommit
+    dirty: jnp.ndarray
+    placed: jnp.ndarray
+    masks: jnp.ndarray
+    failed: jnp.ndarray
+    arr_cpu: jnp.ndarray
+    arr_gpu: jnp.ndarray
+    key: jnp.ndarray
+
+
 _TABLE_REPLAY_CACHE = {}
 
 
@@ -320,6 +384,19 @@ def make_table_replay(
     post-pass, tpusim.sim.metrics.compute_event_metrics — identical across
     engines by construction. `report` is accepted for signature
     compatibility and must be False.
+
+    The returned replayer also exposes the checkpoint/resume surface the
+    driver's chunked dispatch uses (ENGINES.md "Checkpoint/resume"):
+
+        carry = replay.init_carry(state, pods, types, tp, key, rank)
+        carry, (nodes, devs) = replay.run_chunk(
+            carry, pods, types, ev_kind_seg, ev_pod_seg, tp, rank)   # × S
+        state, placed, masks, failed = replay.finish(carry)
+
+    is bit-identical to one replay(...) call over the concatenated
+    segments, for any segmentation — including a host/disk round-trip of
+    the carry between run_chunk calls (Flat/BlockedTableCarry hold only
+    exact-dtype leaves).
     """
     if report:
         raise ValueError(
@@ -363,13 +440,13 @@ def make_table_replay(
             tot = tot + jnp.int32(weight) * raw
         return jnp.where(feas, tot, -_INT_MAX)
 
-    def _blocked_replay(
-        state, pods, type_id, types, ev_kind, ev_pod, tp, key, rank,
-        score_tbl, sdev_tbl, feas_tbl, placed, masks, failed, bsz, k_types,
+    def make_blocked_body(
+        pods, type_id, types, tp, rank_p, n, num_pods, bsz, k_types, nblk,
+        offs,
     ):
-        """The blocked O(B + N/B) select path: tables padded to a whole
-        number of B-node blocks (sentinel columns: infeasible, rank
-        INT_MAX), plus the incremental aggregates
+        """Scan body of the blocked O(B + N/B) select path: tables padded
+        to a whole number of B-node blocks (sentinel columns: infeasible,
+        rank INT_MAX), plus the incremental aggregates
 
             brmin/brmax[pn, K, N/B]  block raw-score extrema over feasible
                                      nodes per normalized policy (their
@@ -387,46 +464,7 @@ def make_table_replay(
         costs when an extremum actually moved) before the select consumes
         it — which is what keeps normalized policies bit-identical to the
         flat path."""
-        n = state.num_nodes
-        num_pods = pods.cpu.shape[0]
-        nblk = -(-n // bsz)
-        n_pad = nblk * bsz
         n_norm = len(norm_idx)
-        if n_pad != n:
-            pad = n_pad - n
-            score_tbl = jnp.pad(score_tbl, ((0, 0), (0, 0), (0, pad)))
-            sdev_tbl = jnp.pad(
-                sdev_tbl, ((0, 0), (0, pad)), constant_values=-1
-            )
-            feas_tbl = jnp.pad(feas_tbl, ((0, 0), (0, pad)))
-            rank_p = jnp.pad(
-                rank, (0, pad), constant_values=jnp.iinfo(jnp.int32).max
-            )
-        else:
-            rank_p = rank
-        offs = jnp.arange(nblk, dtype=jnp.int32) * bsz
-
-        if n_norm:
-            sel0 = jnp.stack([score_tbl[i] for i in norm_idx])
-            brmin = jnp.where(feas_tbl, sel0, _INT_MAX).reshape(
-                n_norm, k_types, nblk, bsz
-            ).min(-1)
-            brmax = jnp.where(feas_tbl, sel0, -_INT_MAX).reshape(
-                n_norm, k_types, nblk, bsz
-            ).max(-1)
-            slo = brmin.min(-1)  # [pn, K] == per-row feasible_min_max
-            shi = brmax.max(-1)
-        else:
-            brmin = jnp.zeros((0, k_types, nblk), jnp.int32)
-            brmax = jnp.zeros((0, k_types, nblk), jnp.int32)
-            slo = jnp.zeros((0, k_types), jnp.int32)
-            shi = jnp.zeros((0, k_types), jnp.int32)
-
-        tot0 = _totals(score_tbl, feas_tbl, slo, shi)  # [K, n_pad]
-        bt, br, ba = block_reduce(
-            tot0.reshape(k_types, nblk, bsz), rank_p.reshape(nblk, bsz)
-        )
-        bn = offs[None, :] + ba  # [K, nblk] global winner node ids
 
         def body(carry, ev):
             (state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
@@ -605,70 +643,16 @@ def make_table_replay(
             arr_cpu = arr_cpu + jnp.where(kc == 0, pod.cpu, 0)
             arr_gpu = arr_gpu + jnp.where(kc == 0, pod.total_gpu_milli(), 0)
             dirty = jnp.where(kc == 2, dirty, jnp.maximum(node, 0))
-            return (
+            return BlockedTableCarry(
                 state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
                 brmin, brmax, slo, shi, pend, dirty,
                 placed, masks, failed, arr_cpu, arr_gpu, key,
             ), (node, dev)
 
-        init = (state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
-                brmin, brmax, slo, shi, no_pending_commit(num_pods),
-                jnp.int32(0), placed, masks, failed,
-                jnp.int32(0), jnp.int32(0), key)
-        # same unroll as the flat path: the per-event variable work is tiny
-        # here, so amortizing the loop's fixed costs matters even more
-        carry, (nodes, devs) = jax.lax.scan(
-            body, init, (ev_kind, ev_pod), unroll=4
-        )
-        (state, placed, masks, failed) = (
-            carry[0], carry[13], carry[14], carry[15]
-        )
-        # the last event's commit is still pending
-        state, placed, masks, failed = apply_commit(
-            state, placed, masks, failed, carry[11]
-        )
-        return ReplayResult(
-            state, placed[:num_pods], masks[:num_pods], failed[:num_pods],
-            None, nodes, devs,
-        )
+        return body
 
-    @jax.jit
-    def replay(
-        state: NodeState,
-        pods: PodSpec,  # [P]
-        types: PodTypes,  # host-side build_pod_types(pods)
-        ev_kind: jnp.ndarray,  # i32[E]
-        ev_pod: jnp.ndarray,  # i32[E]
-        tp,
-        key,
-        tiebreak_rank=None,
-    ) -> ReplayResult:
-        n = state.num_nodes
-        num_pods = pods.cpu.shape[0]
-        if tiebreak_rank is None:
-            tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
-        type_id = types.type_id
-        k_types = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
-        bsz = 0 if has_random else resolve_block_size(block_size, n, k_types)
-
-        # the event key chain must stay byte-for-byte the sequential
-        # oracle's (it never burns a split before its scan), so the random
-        # replay path below sees identical per-event keys; no table-ized
-        # column kernel consumes rng, so init can reuse the root key as-is
-        score_tbl, sdev_tbl, feas_tbl = _init_tables(state, types, tp, key)
-
-        # one extra dummy row absorbs skip-event writes of the pipelined
-        # commit (PendingCommit.pod_write); sliced off before returning
-        placed = jnp.full(num_pods + 1, -1, jnp.int32)
-        masks = jnp.zeros((num_pods + 1, MAX_GPUS_PER_NODE), jnp.bool_)
-        failed = jnp.zeros(num_pods + 1, jnp.bool_)
-
-        if bsz:
-            return _blocked_replay(
-                state, pods, type_id, types, ev_kind, ev_pod, tp, key,
-                tiebreak_rank, score_tbl, sdev_tbl, feas_tbl,
-                placed, masks, failed, bsz, k_types,
-            )
+    def make_flat_body(pods, type_id, types, tp, tiebreak_rank, n, num_pods):
+        """Scan body of the flat O(N) select path."""
 
         def body(carry, ev):
             (state, score_tbl, sdev_tbl, feas_tbl, pend, dirty,
@@ -751,27 +735,155 @@ def make_table_replay(
             arr_cpu = arr_cpu + jnp.where(kc == 0, pod.cpu, 0)
             arr_gpu = arr_gpu + jnp.where(kc == 0, pod.total_gpu_milli(), 0)
             dirty = jnp.where(kc == 2, dirty, jnp.maximum(node, 0))
-            return (
+            return FlatTableCarry(
                 state, score_tbl, sdev_tbl, feas_tbl, pend, dirty,
                 placed, masks, failed, arr_cpu, arr_gpu, key,
             ), (node, dev)
 
-        init = (state, score_tbl, sdev_tbl, feas_tbl,
-                no_pending_commit(num_pods), jnp.int32(0),
-                placed, masks, failed, jnp.int32(0), jnp.int32(0), key)
-        # unroll amortizes per-iteration fixed costs (~20% wall on the openb
-        # replay); higher factors showed no further gain
-        (state, _, _, _, pend, _, placed, masks, failed, _, _, _), (
-            nodes, devs
-        ) = jax.lax.scan(body, init, (ev_kind, ev_pod), unroll=4)
-        # the last event's commit is still pending
-        state, placed, masks, failed = apply_commit(
-            state, placed, masks, failed, pend
+        return body
+
+    @jax.jit
+    def init_carry(state, pods, types, tp, key, tiebreak_rank=None):
+        """Engine state at event 0: score/sdev/feas tables from the
+        committed state + an inert pipeline register (and, on the blocked
+        path, the per-(policy, type, block) aggregates).
+
+        The event key chain must stay byte-for-byte the sequential
+        oracle's (it never burns a split before its scan), so the random
+        replay path sees identical per-event keys; no table-ized column
+        kernel consumes rng, so init can reuse the root key as-is."""
+        n = state.num_nodes
+        num_pods = pods.cpu.shape[0]
+        k_types = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+        bsz = 0 if has_random else resolve_block_size(block_size, n, k_types)
+        if tiebreak_rank is None:
+            tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
+        score_tbl, sdev_tbl, feas_tbl = _init_tables(state, types, tp, key)
+
+        # one extra dummy row absorbs skip-event writes of the pipelined
+        # commit (PendingCommit.pod_write); sliced off by finish()
+        placed = jnp.full(num_pods + 1, -1, jnp.int32)
+        masks = jnp.zeros((num_pods + 1, MAX_GPUS_PER_NODE), jnp.bool_)
+        failed = jnp.zeros(num_pods + 1, jnp.bool_)
+        pend = no_pending_commit(num_pods)
+        z = jnp.int32(0)
+        if not bsz:
+            return FlatTableCarry(
+                state, score_tbl, sdev_tbl, feas_tbl, pend, z,
+                placed, masks, failed, z, z, key,
+            )
+
+        nblk = -(-n // bsz)
+        n_pad = nblk * bsz
+        n_norm = len(norm_idx)
+        rank_p = _pad_rank(tiebreak_rank, n_pad)
+        if n_pad != n:
+            pad = n_pad - n
+            score_tbl = jnp.pad(score_tbl, ((0, 0), (0, 0), (0, pad)))
+            sdev_tbl = jnp.pad(
+                sdev_tbl, ((0, 0), (0, pad)), constant_values=-1
+            )
+            feas_tbl = jnp.pad(feas_tbl, ((0, 0), (0, pad)))
+        offs = jnp.arange(nblk, dtype=jnp.int32) * bsz
+
+        if n_norm:
+            sel0 = jnp.stack([score_tbl[i] for i in norm_idx])
+            brmin = jnp.where(feas_tbl, sel0, _INT_MAX).reshape(
+                n_norm, k_types, nblk, bsz
+            ).min(-1)
+            brmax = jnp.where(feas_tbl, sel0, -_INT_MAX).reshape(
+                n_norm, k_types, nblk, bsz
+            ).max(-1)
+            slo = brmin.min(-1)  # [pn, K] == per-row feasible_min_max
+            shi = brmax.max(-1)
+        else:
+            brmin = jnp.zeros((0, k_types, nblk), jnp.int32)
+            brmax = jnp.zeros((0, k_types, nblk), jnp.int32)
+            slo = jnp.zeros((0, k_types), jnp.int32)
+            shi = jnp.zeros((0, k_types), jnp.int32)
+
+        tot0 = _totals(score_tbl, feas_tbl, slo, shi)  # [K, n_pad]
+        bt, br, ba = block_reduce(
+            tot0.reshape(k_types, nblk, bsz), rank_p.reshape(nblk, bsz)
         )
-        return ReplayResult(
-            state, placed[:num_pods], masks[:num_pods], failed[:num_pods],
-            None, nodes, devs,
+        bn = offs[None, :] + ba  # [K, nblk] global winner node ids
+        return BlockedTableCarry(
+            state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
+            brmin, brmax, slo, shi, pend, z,
+            placed, masks, failed, z, z, key,
         )
 
+    @jax.jit
+    def run_chunk(carry, pods, types, ev_kind, ev_pod, tp,
+                  tiebreak_rank=None):
+        """Advance `carry` over a segment of the event stream; returns
+        (carry', (event_node, event_dev)) for the segment. Chaining
+        run_chunk calls over any partition of the stream is bit-identical
+        to one replay() over the whole stream — the scan body is a pure
+        function of (carry, event), and every carry leaf is an exact dtype
+        (i32/bool/u32), so even a host/disk round-trip between chunks
+        cannot perturb the trajectory."""
+        n = carry.state.num_nodes
+        num_pods = pods.cpu.shape[0]
+        if tiebreak_rank is None:
+            tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
+        type_id = types.type_id
+        if isinstance(carry, BlockedTableCarry):
+            k_types, nblk = carry.bt.shape
+            bsz = carry.score_tbl.shape[2] // nblk
+            rank_p = _pad_rank(tiebreak_rank, nblk * bsz)
+            offs = jnp.arange(nblk, dtype=jnp.int32) * bsz
+            body = make_blocked_body(
+                pods, type_id, types, tp, rank_p, n, num_pods, bsz,
+                k_types, nblk, offs,
+            )
+        else:
+            body = make_flat_body(
+                pods, type_id, types, tp, tiebreak_rank, n, num_pods
+            )
+        # unroll amortizes per-iteration fixed costs (~20% wall on the openb
+        # replay); higher factors showed no further gain
+        return jax.lax.scan(body, carry, (ev_kind, ev_pod), unroll=4)
+
+    @jax.jit
+    def finish(carry):
+        """Post-scan epilogue: apply the last event's still-pending commit
+        and strip the dummy bookkeeping row. Returns (state, placed,
+        masks, failed). A finished carry must not be resumed — the pending
+        commit has landed."""
+        state, placed, masks, failed = apply_commit(
+            carry.state, carry.placed, carry.masks, carry.failed, carry.pend
+        )
+        return state, placed[:-1], masks[:-1], failed[:-1]
+
+    @jax.jit
+    def _replay_impl(
+        state: NodeState,
+        pods: PodSpec,  # [P]
+        types: PodTypes,  # host-side build_pod_types(pods)
+        ev_kind: jnp.ndarray,  # i32[E]
+        ev_pod: jnp.ndarray,  # i32[E]
+        tp,
+        key,
+        tiebreak_rank=None,
+    ) -> ReplayResult:
+        carry = init_carry(state, pods, types, tp, key, tiebreak_rank)
+        carry, (nodes, devs) = run_chunk(
+            carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank
+        )
+        state, placed, masks, failed = finish(carry)
+        return ReplayResult(state, placed, masks, failed, None, nodes, devs)
+
+    def replay(state, pods, types, ev_kind, ev_pod, tp, key,
+               tiebreak_rank=None) -> ReplayResult:
+        return _replay_impl(
+            state, pods, types, ev_kind, ev_pod, tp, key, tiebreak_rank
+        )
+
+    # the chunk-resume surface (driver checkpointing, ENGINES.md
+    # "Checkpoint/resume"): replay == finish ∘ run_chunk* ∘ init_carry
+    replay.init_carry = init_carry
+    replay.run_chunk = run_chunk
+    replay.finish = finish
     _TABLE_REPLAY_CACHE[cache_key] = replay
     return replay
