@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -32,10 +32,30 @@ bench-scale-smoke:
 # prefix, kill the run right after a mid-trace checkpoint lands, resume in
 # a fresh process, and assert the final placements/metrics/tables are
 # byte-identical to the uninterrupted run — plus the fault-injection
-# determinism suite. Runs the full file including the slow openb case
-# (the synthetic kill/resume subset is already wired into tier-1).
+# determinism suite and the obs telemetry-continuity/counter-invariance
+# suite. Runs the full files including slow-marked cases (the synthetic
+# kill/resume + telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py -q
+
+# observability smoke (ENGINES.md "Round 8"): a small profiled scale run
+# emitting the full artifact set — JSONL run record (spans with the
+# compile/execute split + exact scan counters), Prometheus textfile,
+# Chrome-trace timeline — under .tpusim_obs/
+profile-smoke:
+	JAX_PLATFORMS=cpu python bench_scale.py --nodes 2000 --pods 2000 \
+		--chunk 1000 --heartbeat 500 \
+		--profile .tpusim_obs/scale_profile.jsonl \
+		--metrics-out .tpusim_obs/scale_metrics.prom \
+		--trace-out .tpusim_obs/scale_trace.json
+
+# bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
+# measurement under profiling and diff it against the newest committed
+# BENCH_r*.json baseline — exact on events/placements/gpu_alloc
+# (machine-independent), tolerance-gated on same-backend throughput,
+# advisory on cross-backend throughput. Exit 1 on regression.
+bench-gate:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate
 
 sweep:
 	python experiments/sweep.py

@@ -27,18 +27,16 @@ import argparse
 import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
+from tpusim.obs import bench as obs_bench  # noqa: E402 (path insert above)
+from tpusim.obs.bench import WARM_RUNS  # noqa: E402  timing protocol home
+
 # Implied reference throughput: 8152 placements / ~10 min on 2 vCPU
 # (BASELINE.md "Implied placement throughput").
 BASELINE_PLACEMENTS_PER_SEC = 13.59
-
-# warm replays per measurement; headline = min over these (the stable
-# minimum — see measure_policy)
-WARM_RUNS = 6
 
 # (name, policies, gpu_sel, dim_ext, norm) — the sweep's method configs
 # (experiments/generate_run_scripts.py METHODS)
@@ -71,9 +69,14 @@ def gpu_alloc_pct(state) -> float:
     return 100.0 * milli_used / (int(state.gpu_cnt.sum()) * MILLI)
 
 
-def measure_policy(nodes, pods, name, policies, gpu_sel, dim_ext, norm):
+def measure_policy(nodes, pods, name, policies, gpu_sel, dim_ext, norm,
+                   warm_runs=WARM_RUNS, profile=False):
     """One policy's replay throughput + end-state quality (both engines
-    where the config allows; the table engine rejects per-event randomness)."""
+    where the config allows; the table engine rejects per-event
+    randomness). Timing = the shared cold + warm-minimum protocol
+    (tpusim.obs.bench.measure). profile=True runs under obs profiling and
+    returns the RunTelemetry in the row's `_telemetry` key (the bench
+    gate's smoke profile)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -92,6 +95,7 @@ def measure_policy(nodes, pods, name, policies, gpu_sel, dim_ext, norm):
         seed=42,
         shuffle_pod=True,
         report_per_event=False,
+        profile=profile,
         typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
     )
     sim = Simulator(nodes, cfg)
@@ -102,41 +106,34 @@ def measure_policy(nodes, pods, name, policies, gpu_sel, dim_ext, norm):
     ev_kind, ev_pod = build_events(trace)
     ev_kind, ev_pod = jnp.asarray(ev_kind), jnp.asarray(ev_pod)
     key = jax.random.PRNGKey(cfg.seed)
+    box = {}
 
     def run():
         res = sim.run_events(sim.init_state, specs, ev_kind, ev_pod, key, bucket=1)
         jax.block_until_ready(res.state)
-        return res
+        box["result"] = res
 
-    t0 = time.perf_counter()
-    result = run()
-    compile_and_first = time.perf_counter() - t0
-    # THE methodology (pinned round 5, used by every throughput number in
-    # BENCH/BENCH_DETAILS/ENGINES): stable minimum over WARM_RUNS warm
-    # replays — the minimum estimates the tunnel-noise-free device cost on
-    # a link with ±20% run-to-run variance; all samples are reported
-    samples = []
-    for _ in range(WARM_RUNS):
-        t0 = time.perf_counter()
-        result = run()
-        samples.append(time.perf_counter() - t0)
-    wall = min(samples)
+    m = obs_bench.measure(run, warm_runs)
+    result, wall = box["result"], m["min_s"]
 
     events = int(ev_kind.shape[0])
     unscheduled = int(np.asarray(result.ever_failed).sum())
     placements = events - unscheduled
     state = jax.tree.map(np.asarray, result.state)
-    return {
+    row = obs_bench.round_row({
         "policy": name,
         "engine": sim._last_engine,
         "events": events,
         "placements": placements,
-        "wall_s": round(wall, 3),
-        "wall_samples_s": [round(s, 3) for s in samples],
+        "wall_s": wall,
+        "wall_samples_s": m["samples_s"],
         "placements_per_sec": round(placements / wall, 1),
         "gpu_alloc_pct": round(gpu_alloc_pct(state), 2),
-        "compile_first_s": round(compile_and_first, 1),
-    }
+        "compile_first_s": round(m["first_s"], 1),
+    })
+    if profile:
+        row["_telemetry"] = sim.run_telemetry()
+    return row
 
 
 def measure_batched(nodes, pods, seeds=16, report=False):
@@ -171,36 +168,37 @@ def measure_batched(nodes, pods, seeds=16, report=False):
 
     sims = [mk(42 + s) for s in range(seeds)]
     pods_lists = [s.prepare_pods() for s in sims]
-    schedule_pods_batch(sims, pods_lists)  # compile + first
-    # same stable-minimum protocol as measure_policy, over the device phase
-    walls, dev_walls = [], []
-    for _ in range(WARM_RUNS):
-        t0 = time.perf_counter()
-        results = schedule_pods_batch(sims, pods_lists)
-        walls.append(time.perf_counter() - t0)
+    box = {}
+    dev_walls = []
+
+    def run():
+        box["results"] = schedule_pods_batch(sims, pods_lists)
         dev_walls.append(sims[0]._last_batch_device_s)
-    wall = min(walls)
-    # like-for-like with the per-policy rows (which time only the device
-    # replay): throughput over the device phase; total wall (incl. host
-    # spec prep + result slicing) reported alongside
-    device_wall = min(dev_walls)
+
+    # same shared cold + stable-minimum protocol as measure_policy; the
+    # warm samples here are the DEVICE phase (dispatch + fetch) — the
+    # like-for-like number against a single run_events call
+    m = obs_bench.measure(run, WARM_RUNS)
+    results = box["results"]
+    warm_dev = dev_walls[1:]  # drop the compile run's sample
+    device_wall = min(warm_dev)
     placements = sum(
         r.events - len(r.unscheduled_pods) for r in results
     )
-    return {
+    return obs_bench.round_row({
         "policy": "FGD",
         "engine": f"table, {seeds}-seed vmap batch"
         + (" + report post-pass" if report else ""),
         "events": sum(r.events for r in results),
         "placements": placements,
-        "wall_s": round(device_wall, 3),
-        "wall_samples_s": [round(s, 3) for s in dev_walls],
-        "wall_incl_host_prep_s": round(wall, 3),
+        "wall_s": device_wall,
+        "wall_samples_s": warm_dev,
+        "wall_incl_host_prep_s": m["min_s"],
         "placements_per_sec": round(placements / device_wall, 1),
         "gpu_alloc_pct": round(
             float(np.mean([gpu_alloc_pct(r.state) for r in results])), 2
         ),
-    }
+    })
 
 
 def main():
@@ -242,19 +240,15 @@ def main():
         print(f"[bench-all] {json.dumps(rows[-1])}", file=sys.stderr)
         rows.append(measure_batched(nodes, pods, report=True))
         print(f"[bench-all] {json.dumps(rows[-1])}", file=sys.stderr)
-        out = os.path.join(REPO, "BENCH_DETAILS.json")
-        with open(out, "w") as f:
-            json.dump(
-                {
-                    "config": "openb_pod_list_default, tune 1.3, seed 42, "
-                    "warm steady-state on one TPU chip",
-                    "baseline_placements_per_sec": BASELINE_PLACEMENTS_PER_SEC,
-                    "rows": rows,
-                },
-                f,
-                indent=1,
-            )
-        print(f"[bench-all] wrote {out}", file=sys.stderr)
+        obs_bench.write_json(
+            os.path.join(REPO, "BENCH_DETAILS.json"),
+            {
+                "config": "openb_pod_list_default, tune 1.3, seed 42, "
+                "warm steady-state on one TPU chip",
+                "baseline_placements_per_sec": BASELINE_PLACEMENTS_PER_SEC,
+                "rows": rows,
+            },
+        )
 
     print(
         json.dumps(

@@ -24,7 +24,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
@@ -124,15 +123,20 @@ def main():
                 policies, mesh, gpu_sel="FGDScore"
             )
 
-        t0 = time.perf_counter()
-        out = replay(state, specs, types, ev_kind, ev_pod, sim.typical, key, rank)
-        jax.block_until_ready(out.state)
-        cold = time.perf_counter() - t0
+        from tpusim.obs import bench as obs_bench
 
-        t0 = time.perf_counter()
-        out = replay(state, specs, types, ev_kind, ev_pod, sim.typical, key, rank)
-        jax.block_until_ready(out.state)
-        warm = time.perf_counter() - t0
+        box = {}
+
+        def run():
+            box["out"] = replay(
+                state, specs, types, ev_kind, ev_pod, sim.typical, key, rank
+            )
+            jax.block_until_ready(box["out"].state)
+
+        # shared cold/warm protocol (tpusim.obs.bench): every mesh size
+        # compiles its own program, one warm call is the signal
+        m = obs_bench.measure_cold_warm(run)
+        out, cold, warm = box["out"], m["cold_s"], m["warm_s"]
 
         placed = np.asarray(out.placed_node)
         n_placed = int((placed >= 0).sum())

@@ -18,7 +18,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
@@ -99,6 +98,31 @@ def main():
         "carries across chunks, which is exact for this creation-only "
         "stream — mixed create/delete streams must replay in one call)",
     )
+    # observability (tpusim.obs; README "Profiling & telemetry")
+    ap.add_argument(
+        "--profile", default="", metavar="PATH",
+        help="profile the run (phase spans with compile/execute split, "
+        "exact scan counters) and append the JSONL run record here",
+    )
+    ap.add_argument(
+        "--metrics-out", default="", metavar="PATH",
+        help="write a Prometheus textfile snapshot of the run telemetry",
+    )
+    ap.add_argument(
+        "--trace-out", default="", metavar="PATH",
+        help="write a Chrome-trace timeline of the phase spans",
+    )
+    ap.add_argument(
+        "--table-cache", default="", metavar="DIR",
+        help="content-keyed init_tables cache dir: repeat runs skip the "
+        "~27 s N=100k table build bit-identically "
+        "(SimulatorConfig.table_cache_dir)",
+    )
+    ap.add_argument(
+        "--heartbeat", type=int, default=0, metavar="EVENTS",
+        help="in-scan progress line (events/s, ETA) every N events — "
+        "long scans are no longer silent (0 = off)",
+    )
     args = ap.parse_args()
     if args.chunk <= 0:
         ap.error("--chunk must be positive")
@@ -114,6 +138,7 @@ def main():
 
     nodes = synth_cluster(args.nodes, args.seed)
     pods = synth_pods(args.pods, args.seed + 1)
+    profiling = bool(args.profile or args.metrics_out or args.trace_out)
     cfg = SimulatorConfig(
         policies=(("FGDScore", 1000),),
         gpu_sel_method="FGDScore",
@@ -121,6 +146,9 @@ def main():
         report_per_event=False,
         engine=args.engine,
         block_size=args.block_size,
+        profile=profiling,
+        heartbeat_every=args.heartbeat,
+        table_cache_dir=args.table_cache,
         typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
     )
     sim = Simulator(nodes, cfg)
@@ -139,6 +167,10 @@ def main():
     # the block size the table engine will resolve for this shape (0 = flat)
     eff_block = resolve_block_size(args.block_size, args.nodes, k_types)
 
+    from tpusim.obs import bench as obs_bench
+
+    box = {}
+
     def run_chunked():
         state = sim.init_state
         failed_chunks = []
@@ -152,15 +184,15 @@ def main():
             # keep the reduction on device; pull once after the run
             failed_chunks.append(res.ever_failed.sum())
         jax.block_until_ready(state)
-        return state, int(sum(int(np.asarray(f)) for f in failed_chunks))
+        box["out"] = (
+            state, int(sum(int(np.asarray(f)) for f in failed_chunks))
+        )
 
-    t0 = time.perf_counter()
-    final_state, failed = run_chunked()
-    first = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    final_state, failed = run_chunked()
-    wall = time.perf_counter() - t0
+    # shared cold + warm protocol (tpusim.obs.bench): one compile run,
+    # one warm run — the historical bench_scale shape
+    m = obs_bench.measure(run_chunked, warm_runs=1)
+    final_state, failed = box["out"]
+    first, wall = m["first_s"], m["min_s"]
 
     placed = int(args.pods - failed)
     s = jax.tree.map(np.asarray, final_state)
@@ -175,7 +207,21 @@ def main():
         f"(first incl. compile {first:.1f}s) placed={placed} "
         f"throughput={placed / wall:.0f} placements/s "
         f"us_per_event={1e6 * wall / args.pods:.1f} gpu_alloc={alloc:.2f}%"
+        + (f" table_cache={sim.obs.table_cache}" if args.table_cache else "")
     )
+
+    if profiling:
+        from tpusim.obs import emitters
+
+        for p in emitters.emit_all(
+            sim.run_telemetry(),
+            jsonl=args.profile,
+            metrics=args.metrics_out,
+            trace=args.trace_out,
+            meta={"bench": "bench_scale", "nodes": args.nodes,
+                  "pods": args.pods, "block": eff_block},
+        ):
+            print(f"[obs] wrote {p}", file=sys.stderr)
 
 
 if __name__ == "__main__":

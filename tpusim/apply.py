@@ -57,6 +57,14 @@ class ApplyOptions:
     fault_evict_every: float = 0.0
     fault_seed: int = 0
     fault_max_retries: int = 3
+    # observability (README "Profiling & telemetry"; tpusim.obs): any
+    # non-empty output path switches the run into profiling mode (phase
+    # spans get the compile/execute split) and emits the corresponding
+    # artifact after the run.
+    profile_out: str = ""  # JSONL run record (appended)
+    metrics_out: str = ""  # Prometheus textfile (atomic rewrite)
+    trace_out: str = ""  # Chrome-trace timeline
+    heartbeat_every: int = 0  # in-scan progress ticks (0 = off)
 
 
 class Applier:
@@ -96,6 +104,11 @@ class Applier:
             extenders=self.sched_cfg.extenders,
             checkpoint_every=self.options.checkpoint_every,
             checkpoint_dir=self.options.checkpoint_dir,
+            profile=bool(
+                self.options.profile_out or self.options.metrics_out
+                or self.options.trace_out
+            ),
+            heartbeat_every=self.options.heartbeat_every,
         )
 
     def _fault_config(self):
@@ -211,6 +224,7 @@ class Applier:
 
         result = sim.last_result
         sim.finish()
+        self._emit_telemetry(sim, out)
         self._verdict(result, out)
         if self.options.report_tables:
             from tpusim.sim.report_tables import full_report
@@ -226,6 +240,25 @@ class Applier:
                 file=out,
             )
         return result
+
+    def _emit_telemetry(self, sim: Simulator, out):
+        """Write the requested obs artifacts (--profile / --metrics-out /
+        --trace-out) from the full experiment's telemetry — every stage
+        (main schedule, inflation, deschedule, apps) contributed spans
+        and counters to the one recorder."""
+        o = self.options
+        if not (o.profile_out or o.metrics_out or o.trace_out):
+            return
+        from tpusim.obs import emitters
+
+        paths = emitters.emit_all(
+            sim.run_telemetry(),
+            jsonl=o.profile_out,
+            metrics=o.metrics_out,
+            trace=o.trace_out,
+        )
+        for p in paths:
+            print(f"[obs] wrote {p}", file=out)
 
     def _export_snapshots(self, sim: Simulator, tag: str):
         exp = self.cr.custom_config.export

@@ -91,6 +91,29 @@ def _build_parser() -> argparse.ArgumentParser:
         help="retry budget per evicted pod before it becomes terminally "
         "unscheduled",
     )
+    # observability (README "Profiling & telemetry"; tpusim.obs)
+    p_apply.add_argument(
+        "--profile", nargs="?", const="tpusim_profile.jsonl", default="",
+        metavar="PATH",
+        help="profile the run and append a JSONL run record (spans with "
+        "compile/execute split, exact scan counters, degrade/fault "
+        "counts); default path tpusim_profile.jsonl",
+    )
+    p_apply.add_argument(
+        "--metrics-out", default="", metavar="PATH",
+        help="write a Prometheus textfile-collector snapshot of the run's "
+        "telemetry (atomic rewrite; also enables profiling)",
+    )
+    p_apply.add_argument(
+        "--trace-out", default="", metavar="PATH",
+        help="write a Chrome-trace (chrome://tracing / Perfetto) timeline "
+        "of the run's phase spans (also enables profiling)",
+    )
+    p_apply.add_argument(
+        "--heartbeat-every", type=int, default=0, metavar="EVENTS",
+        help="emit an in-scan progress line (events/s, ETA) every N "
+        "processed events of long table-engine scans (0 = off)",
+    )
 
     sub.add_parser("version", help="print version")
 
@@ -121,6 +144,10 @@ def cmd_apply(args) -> int:
         fault_evict_every=args.fault_evict_every,
         fault_seed=args.fault_seed,
         fault_max_retries=args.fault_max_retries,
+        profile_out=args.profile,
+        metrics_out=args.metrics_out,
+        trace_out=args.trace_out,
+        heartbeat_every=args.heartbeat_every,
     )
     Applier(opts).run()
     return 0

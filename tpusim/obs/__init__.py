@@ -1,0 +1,34 @@
+"""tpusim.obs — run telemetry, profiling, and bench regression gating.
+
+The observability plane the replay engines report through (ISSUE 3):
+
+  counters   exact in-scan event counters riding the engines' lax.scan
+             carries — bit-reproducible, checkpoint/fault-transparent
+  spans      phase timers with a dispatch(compile)/block(execute) wall
+             split; Recorder/RunTelemetry accumulate them per run
+  heartbeat  jax.debug.callback progress ticks from inside long scans
+  emitters   JSONL run records, Prometheus textfiles, Chrome traces
+  bench      the shared cold+warm-minimum timing protocol + JSON writer
+             the bench scripts build on
+  gate       `python -m tpusim.obs.gate` — smoke profile diffed against
+             the committed BENCH_r*.json baselines
+
+Layering: obs imports nothing from sim/ (engines and the driver import
+obs, never the reverse), so it can sit under every engine's scan body.
+"""
+
+from tpusim.obs.counters import (  # noqa: F401
+    COUNTER_FIELDS,
+    INVARIANT_FIELDS,
+    NUM_COUNTERS,
+    counter_delta,
+    counters_from_telemetry,
+    counters_to_dict,
+    zero_counters,
+)
+from tpusim.obs.spans import (  # noqa: F401
+    SCHEMA,
+    Recorder,
+    RunTelemetry,
+    Span,
+)

@@ -1,0 +1,98 @@
+"""In-scan replay counters — the exact, bit-reproducible half of obs.
+
+The counter vector rides INSIDE each engine's lax.scan carry (a `ctr`
+leaf of FlatTableCarry / BlockedTableCarry / ShardTableCarry and the
+sequential engine's scan tuple), so the counts are integer adds on
+device, bit-identical across engines for the same trace, and — because
+the carry IS the checkpoint (tpusim.io.storage) — preserved exactly
+across kill/resume and across the fault path's segment splits.
+
+Vocabulary (COUNTER_FIELDS order is the array layout — append-only, the
+JSONL schema names these fields):
+
+    creates       creation events attempted (EV_CREATE)
+    binds         creations that placed (node >= 0)
+    fail_creates  creations rejected (no feasible node)
+    deletes       deletion events applied (EV_DELETE)
+    skips         EV_SKIP events, INCLUDING the driver's bucket padding;
+                  the driver subtracts the padding when it records a run
+                  (Recorder.note_scan(pad_skips=...)), so emitted records
+                  count only trace skips
+    rebuilds      blocked-select summary-row rebuilds (the extrema-drift
+                  cond in the single-device blocked table engine). Engine
+                  -specific by nature: 0 on the flat/sequential/pallas
+                  paths and on the shard engine (which refreshes block
+                  summaries unconditionally) — cross-engine equality
+                  holds for COUNTER_FIELDS[:5], pinned by tests/test_obs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+COUNTER_FIELDS = (
+    "creates", "binds", "fail_creates", "deletes", "skips", "rebuilds",
+)
+NUM_COUNTERS = len(COUNTER_FIELDS)
+# engine-invariant prefix (everything but `rebuilds`)
+INVARIANT_FIELDS = COUNTER_FIELDS[:5]
+
+
+def zero_counters():
+    """i32[NUM_COUNTERS] carry leaf at event 0."""
+    import jax.numpy as jnp
+
+    return jnp.zeros(NUM_COUNTERS, jnp.int32)
+
+
+def counter_delta(kc, node, rebuilt=None):
+    """Per-event counter increment vector from the (clipped) event kind
+    and the replicated placement decision — the ONE definition every
+    engine's scan body adds to its `ctr` leaf, so the counts cannot drift
+    apart across engines. `rebuilt` is the blocked engine's summary-row
+    rebuild predicate (None/0 elsewhere)."""
+    import jax.numpy as jnp
+
+    is_create = kc == 0
+    if rebuilt is None:
+        rebuilt = jnp.bool_(False)
+    return jnp.stack([
+        is_create.astype(jnp.int32),
+        (is_create & (node >= 0)).astype(jnp.int32),
+        (is_create & (node < 0)).astype(jnp.int32),
+        (kc == 1).astype(jnp.int32),
+        (kc == 2).astype(jnp.int32),
+        jnp.asarray(rebuilt).astype(jnp.int32),
+    ])
+
+
+def counters_to_dict(ctr, pad_skips: int = 0) -> Dict[str, int]:
+    """Host dict from a counter vector; `pad_skips` = EV_SKIP events the
+    driver appended as bucket padding (subtracted so records describe the
+    trace, not the executable's padded shape)."""
+    vals = np.asarray(ctr).astype(np.int64)
+    d = {name: int(v) for name, v in zip(COUNTER_FIELDS, vals)}
+    d["skips"] = max(d["skips"] - int(pad_skips), 0)
+    return d
+
+
+def counters_from_telemetry(ev_kind, event_node) -> Optional[np.ndarray]:
+    """Derive the engine-invariant counters from a replay's per-event
+    telemetry — the fallback for engines whose scan carry does not count
+    (the fused Pallas kernel, the host-loop extender engine). Exact by
+    construction for COUNTER_FIELDS[:5]; `rebuilds` is 0 (those engines
+    have no blocked summaries). Returns i64[NUM_COUNTERS]."""
+    kinds = np.asarray(ev_kind)
+    nodes = np.asarray(event_node)
+    if kinds.size != nodes.size:
+        return None
+    is_c = kinds == 0
+    out = np.zeros(NUM_COUNTERS, np.int64)
+    out[0] = int(is_c.sum())
+    out[1] = int((is_c & (nodes >= 0)).sum())
+    out[2] = int((is_c & (nodes < 0)).sum())
+    out[3] = int((kinds == 1).sum())
+    out[4] = int((kinds == 2).sum())
+    return out

@@ -1,0 +1,163 @@
+"""Telemetry emitters: JSONL run records, Prometheus textfiles, Chrome
+traces — the machine-readable outputs of a profiled run.
+
+Three consumers, three formats:
+
+  JSONL    one self-contained record per run, appended (`--profile PATH`)
+           — the regression gate and the reproducibility tests read this
+  Prom     node_exporter textfile-collector gauges (`--metrics-out PATH`)
+           — scrape-ready; written atomically (tmp + rename) per the
+           textfile collector contract so a scraper never sees a torn
+           file
+  Chrome   chrome://tracing / Perfetto "X" (complete) events from the
+           span list (`--trace-out PATH`) — the phase timeline view
+
+All writers are atomic (tmp + os.replace) except the JSONL append, whose
+unit of atomicity is the single O_APPEND write of one line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Iterable, List
+
+_METRIC_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _atomic_write(path: str, text: str):
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def append_jsonl(path: str, record: dict) -> str:
+    """Append one run record as a single JSON line (sorted keys, so two
+    identical records are byte-identical lines — the bit-reproducibility
+    contract is checkable with `diff`)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    line = json.dumps(record, sort_keys=True, separators=(",", ":"))
+    with open(path, "a") as f:
+        f.write(line + "\n")
+    return path
+
+
+def read_jsonl(path: str) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _metric_name(*parts: str) -> str:
+    return _METRIC_RE.sub("_", "_".join(p for p in parts if p)).lower()
+
+
+def prometheus_lines(record: dict, prefix: str = "tpusim") -> List[str]:
+    """Flatten a run record into `# TYPE ... gauge` + sample lines. Only
+    the numeric leaves ship; span walls become
+    `tpusim_span_seconds{name="...",phase="dispatch|block"}`."""
+    det = record.get("deterministic", {})
+    lines: List[str] = []
+
+    def gauge(name: str, value, labels: str = ""):
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {value}")
+
+    gauge(_metric_name(prefix, "events_total"), det.get("events", 0))
+    for group in ("counters", "degrades", "counts", "disruption"):
+        for k, v in sorted(det.get(group, {}).items()):
+            gauge(_metric_name(prefix, group[:-1] if group.endswith("s")
+                               else group, k), v)
+    cache = det.get("table_cache", "off")
+    gauge(_metric_name(prefix, "table_cache_hit"), int(cache == "hit"))
+    timing = record.get("timing", {})
+    if "wall_s" in timing:
+        gauge(_metric_name(prefix, "wall_seconds"), timing["wall_s"])
+    # aggregate spans per (name, phase): a profiled run records MANY spans
+    # with the same name (one 'scan' per chunk/segment/warm run), and the
+    # Prometheus text format forbids duplicate series — node_exporter's
+    # textfile collector would drop the whole file
+    agg: dict = {}
+    counts: dict = {}
+    for s in timing.get("spans", []):
+        name = str(s.get("name", "")).replace('"', "")
+        counts[name] = counts.get(name, 0) + 1
+        for phase in ("dispatch", "block"):
+            key = (name, phase)
+            agg[key] = agg.get(key, 0.0) + float(s.get(f"{phase}_s", 0))
+    if agg:
+        span_metric = _metric_name(prefix, "span_seconds_total")
+        lines.append(f"# TYPE {span_metric} gauge")
+        for (name, phase), v in sorted(agg.items()):
+            lines.append(
+                f'{span_metric}{{name="{name}",phase="{phase}"}} {round(v, 6)}'
+            )
+        count_metric = _metric_name(prefix, "span_count")
+        lines.append(f"# TYPE {count_metric} gauge")
+        for name, n in sorted(counts.items()):
+            lines.append(f'{count_metric}{{name="{name}"}} {n}')
+    return lines
+
+
+def write_prometheus(path: str, record: dict, prefix: str = "tpusim") -> str:
+    _atomic_write(path, "\n".join(prometheus_lines(record, prefix)) + "\n")
+    return path
+
+
+def chrome_trace_events(spans: Iterable, pid: int = 1) -> List[dict]:
+    """Span list -> Chrome trace "X" events (ts/dur in microseconds).
+    Each span renders as two stacked slices — the dispatch (compile)
+    half and the block (execute) half — so the compile/execute split is
+    visible directly on the timeline."""
+    events = []
+    for s in spans:
+        d = s.to_dict() if hasattr(s, "to_dict") else dict(s)
+        base = {"pid": pid, "tid": 1, "ph": "X", "cat": "tpusim"}
+        t0 = d["start_s"] * 1e6
+        if d.get("dispatch_s", 0) > 0:
+            events.append({
+                **base, "name": f"{d['name']}:dispatch",
+                "ts": t0, "dur": d["dispatch_s"] * 1e6,
+                "args": d.get("meta", {}),
+            })
+        if d.get("block_s", 0) > 0:
+            events.append({
+                **base, "name": f"{d['name']}:block",
+                "ts": t0 + d.get("dispatch_s", 0) * 1e6,
+                "dur": d["block_s"] * 1e6,
+                "args": d.get("meta", {}),
+            })
+    return events
+
+
+def write_chrome_trace(path: str, spans: Iterable) -> str:
+    _atomic_write(
+        path,
+        json.dumps({"traceEvents": chrome_trace_events(spans),
+                    "displayTimeUnit": "ms"}),
+    )
+    return path
+
+
+def emit_all(telemetry, jsonl: str = "", metrics: str = "", trace: str = "",
+             meta: dict = None) -> List[str]:
+    """Write every requested emitter output for one RunTelemetry; returns
+    the paths written."""
+    record = telemetry.to_record()
+    if meta:
+        record["deterministic"]["meta"].update(meta)
+    written = []
+    if jsonl:
+        written.append(append_jsonl(jsonl, record))
+    if metrics:
+        written.append(write_prometheus(metrics, record))
+    if trace:
+        written.append(write_chrome_trace(trace, telemetry.spans))
+    return written

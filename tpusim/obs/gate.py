@@ -1,0 +1,197 @@
+"""Bench regression gate: diff a profiled smoke run against the newest
+committed `BENCH_r*.json` baseline (`make bench-gate`).
+
+Every round's driver commits a BENCH_rNN.json capture of `python
+bench.py` ({n, cmd, rc, tail, parsed}); until now nothing ever read them
+back. The gate closes that loop:
+
+  1. parse the newest committed baseline (highest rNN with rc == 0):
+     headline placements/sec from `parsed.value`, plus the
+     machine-INDEPENDENT quality numbers from the tail line —
+     `events=`, `placed=`, `gpu_alloc=` — and the backend it ran on
+     (the jax platform warning names it);
+  2. re-run the same headline measurement (openb default trace, FGD,
+     tune 1.3, seed 42) with obs profiling on, emitting the smoke
+     profile JSONL/Prometheus files under --out;
+  3. fail (exit 1) if a DETERMINISTIC quality number moved — event count
+     or placement count off by even one, GPU allocation beyond
+     --alloc-tol — or if throughput regressed more than --tol on the
+     SAME backend as the baseline. Cross-backend throughput (CPU gate
+     vs a TPU-captured baseline) is advisory: printed, never failed on,
+     because the two machines measure different hardware.
+
+Placements are backend-independent by the engine-equality contracts
+(ENGINES.md; the f32 divergence channel is report-only), so the
+quality half of the gate is exact everywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_TAIL_EVENTS = re.compile(r"events=(\d+)")
+_TAIL_PLACED = re.compile(r"placed=(\d+)")
+_TAIL_ALLOC = re.compile(r"gpu_alloc=([0-9.]+)%")
+_TAIL_BACKEND = re.compile(r"Platform '(\w+)'")
+
+
+def latest_baseline(repo: str = REPO) -> Optional[dict]:
+    """Newest committed BENCH_rNN.json with a clean run, parsed into
+    {path, n, throughput, events, placed, gpu_alloc, backend} (quality
+    fields None when the tail did not carry them)."""
+    best = None
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if data.get("rc") != 0 or not data.get("parsed"):
+            continue
+        n = int(data.get("n", m.group(1)))
+        if best is None or n > best["n"]:
+            tail = data.get("tail", "")
+            ev = _TAIL_EVENTS.search(tail)
+            pl = _TAIL_PLACED.search(tail)
+            al = _TAIL_ALLOC.search(tail)
+            be = _TAIL_BACKEND.search(tail)
+            best = {
+                "path": path,
+                "n": n,
+                "throughput": float(data["parsed"].get("value", 0.0)),
+                "events": int(ev.group(1)) if ev else None,
+                "placed": int(pl.group(1)) if pl else None,
+                "gpu_alloc": float(al.group(1)) if al else None,
+                "backend": be.group(1) if be else "cpu",
+            }
+    return best
+
+
+def compare(base: dict, cur: dict, tol: float, alloc_tol: float
+            ) -> Tuple[bool, List[str]]:
+    """Gate verdict + report lines. `cur` needs {throughput, events,
+    placed, gpu_alloc, backend}."""
+    ok = True
+    msgs = []
+
+    def check(label, b, c, exact=False, tol_abs=None):
+        nonlocal ok
+        if b is None:
+            msgs.append(f"  {label}: baseline missing, current {c} (skip)")
+            return
+        if exact:
+            good = b == c
+        else:
+            good = abs(c - b) <= tol_abs
+        mark = "ok" if good else "REGRESSED"
+        msgs.append(f"  {label}: baseline {b} vs current {c} [{mark}]")
+        ok = ok and good
+
+    check("events", base["events"], cur["events"], exact=True)
+    check("placed pods", base["placed"], cur["placed"], exact=True)
+    check("gpu_alloc %", base["gpu_alloc"], cur["gpu_alloc"],
+          tol_abs=alloc_tol)
+    ratio = (
+        cur["throughput"] / base["throughput"] if base["throughput"] else 0.0
+    )
+    if cur["backend"] == base["backend"]:
+        good = ratio >= 1.0 - tol
+        mark = "ok" if good else "REGRESSED"
+        msgs.append(
+            f"  throughput: baseline {base['throughput']:.1f} vs current "
+            f"{cur['throughput']:.1f} placements/s "
+            f"({100 * ratio:.0f}%, tol -{100 * tol:.0f}%) [{mark}]"
+        )
+        ok = ok and good
+    else:
+        msgs.append(
+            f"  throughput: {cur['throughput']:.1f} placements/s on "
+            f"{cur['backend']!r} (baseline {base['throughput']:.1f} on "
+            f"{base['backend']!r} — cross-backend, advisory only)"
+        )
+    return ok, msgs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--tol", type=float, default=0.5,
+        help="same-backend throughput regression tolerance as a fraction "
+        "(default 0.5 — the tunneled chip's wall clocks vary ±20%%, and "
+        "the gate must not flake on link noise)",
+    )
+    ap.add_argument(
+        "--alloc-tol", type=float, default=0.05,
+        help="absolute GPU-allocation-percent tolerance (default 0.05 — "
+        "one rounding ulp of the 2-decimal bench print)",
+    )
+    ap.add_argument(
+        "--warm-runs", type=int, default=2,
+        help="warm replays for the smoke throughput sample (full bench "
+        "uses 6; 2 keeps the gate fast — quality numbers need only one)",
+    )
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, ".tpusim_obs"),
+        help="smoke-profile output dir (JSONL + Prometheus textfile)",
+    )
+    args = ap.parse_args(argv)
+
+    base = latest_baseline()
+    sys.path.insert(0, REPO)
+    import bench
+
+    import jax
+
+    nodes, pods = bench.load_trace()
+    row = bench.measure_policy(
+        nodes, pods,
+        *next(r for r in bench.POLICY_ROWS if r[0] == "FGD"),
+        warm_runs=args.warm_runs, profile=True,
+    )
+    telemetry = row.pop("_telemetry", None)
+    cur = {
+        "throughput": row["placements_per_sec"],
+        "events": row["events"],
+        "placed": row["placements"],
+        "gpu_alloc": row["gpu_alloc_pct"],
+        "backend": jax.default_backend(),
+    }
+
+    if telemetry is not None:
+        from tpusim.obs import emitters
+
+        paths = emitters.emit_all(
+            telemetry,
+            jsonl=os.path.join(args.out, "gate_profile.jsonl"),
+            metrics=os.path.join(args.out, "gate_metrics.prom"),
+            meta={"gate": "bench-gate", "row": row},
+        )
+        print(f"[gate] smoke profile: {', '.join(paths)}")
+
+    if base is None:
+        print("[gate] no committed BENCH_r*.json baseline found — smoke "
+              "profile recorded, nothing to diff (PASS)")
+        return 0
+
+    ok, msgs = compare(base, cur, args.tol, args.alloc_tol)
+    print(f"[gate] baseline {os.path.basename(base['path'])} "
+          f"(round {base['n']}, backend {base['backend']!r}):")
+    print("\n".join(msgs))
+    print(f"[gate] {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""Heartbeat progress for long compiled scans.
+
+A 100k-node replay is one lax.scan that can run for minutes with zero
+host output. When `SimulatorConfig.heartbeat_every > 0` (or bench_scale
+--heartbeat), the table engine's scan body calls back to the host every
+N processed events via a jax.debug.callback (the io_callback family —
+unordered, safe inside lax.cond/scan and a no-op under tracing), and
+this module turns those ticks into `events/s + ETA` lines on stderr.
+
+The device side only ships the processed-event count; everything rate-
+or time-shaped lives here on the host, so the heartbeat cannot perturb
+the replay trajectory (pure side output). Ticks are rate-limited to one
+line per MIN_INTERVAL_S of wall time — a warm small run stays silent-ish
+no matter how small `every` is.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional
+
+# module-level host state: one scan is in flight per process at a time
+# (the driver replays serially); configure() re-arms it per dispatch
+_STATE = {
+    "total": 0,
+    "label": "",
+    "t0": 0.0,
+    "last_emit": 0.0,
+    "ticks": 0,
+    "sink": None,  # test hook: callable(line) instead of stderr
+}
+
+MIN_INTERVAL_S = 1.0
+
+
+def configure(total_events: int, label: str = "scan", sink=None):
+    """Arm the heartbeat for the next scan: total event count for the ETA
+    and a label for the line. Called by the driver right before each
+    dispatch whose engine was built with a heartbeat."""
+    _STATE.update(
+        total=int(total_events), label=label, t0=time.perf_counter(),
+        last_emit=0.0, ticks=0, sink=sink,
+    )
+
+
+def tick(done):
+    """Host callback the scan body fires every `heartbeat_every` events
+    (jax.debug.callback target — receives the device-side processed-event
+    count)."""
+    now = time.perf_counter()
+    _STATE["ticks"] += 1
+    if now - _STATE["last_emit"] < MIN_INTERVAL_S:
+        return
+    _STATE["last_emit"] = now
+    done = int(done)
+    total = _STATE["total"]
+    dt = max(now - _STATE["t0"], 1e-9)
+    rate = done / dt
+    eta = (total - done) / rate if (total > done and rate > 0) else 0.0
+    line = (
+        f"[obs] {_STATE['label']}: {done}/{total or '?'} events "
+        f"({rate:,.0f} ev/s, eta {eta:,.0f}s)"
+    )
+    sink = _STATE["sink"]
+    if sink is not None:
+        sink(line)
+    else:
+        print(line, file=sys.stderr, flush=True)
+
+
+def tick_count() -> int:
+    """Ticks received since the last configure() (test hook)."""
+    return _STATE["ticks"]
+
+
+def emit_from_scan(processed, every: int):
+    """The device-side hook engines inline into their scan body: fire the
+    host tick when the processed-event count crosses a multiple of
+    `every`. `every` is static (baked into the jaxpr — part of the engine
+    cache key); `processed` is the carry's counter-derived event count.
+    Adds one scalar cond per event — below the measurement noise floor
+    at the bench-scale-smoke shape (ENGINES.md Round 8)."""
+    import jax
+
+    if not every:
+        return
+    jax.lax.cond(
+        (processed % every) == 0,
+        lambda: jax.debug.callback(tick, processed),
+        lambda: None,
+    )

@@ -1,0 +1,218 @@
+"""Phase spans + the run recorder — the timing half of obs.
+
+A Span is one named phase of an experiment (trace_load, typical_pods,
+init_tables, scan, fetch, metrics_postpass, report, ...) with a
+dispatch/block wall split: under JAX's async dispatch, the host returns
+from a jitted call once tracing + compilation + enqueue are done and the
+device work completes later, so
+
+    dispatch_s  host wall until the call returned — on a COLD call this
+                is dominated by trace + XLA compile; on a warm call it is
+                the executable-cache lookup + argument transfer
+    block_s     wall spent waiting for the device result (the execute
+                half). Only attributed when the recorder is enabled
+                (profiling mode blocks on the phase result); an
+                un-profiled run never adds sync points, so its spans
+                carry dispatch walls only.
+
+That is the compile-vs-execute split the JSONL record reports: the first
+scan span of a config shows compile in dispatch_s, every later one shows
+~0 dispatch + pure execute in block_s.
+
+The Recorder accumulates spans, host counters (degrades, cache hits,
+disruption totals), and the engines' in-scan counter vectors
+(obs.counters) across every replay a Simulator runs — fault runs note
+one scan per segment and the vectors sum. RunTelemetry is the snapshot
+the driver attaches to SimulateResult; its to_record() splits the JSONL
+payload into a `deterministic` block (bit-identical across same-seed
+runs and across kill/resume — the acceptance contract tests pin) and a
+`timing` block (machine-dependent walls).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tpusim.obs.counters import (
+    NUM_COUNTERS,
+    counters_to_dict,
+)
+
+SCHEMA = "tpusim-obs-v1"
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float  # relative to the recorder epoch
+    dispatch_s: float  # host wall until dispatch returned (compile on cold)
+    block_s: float  # wall waiting on the device result (execute); 0 = unknown
+    meta: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def total_s(self) -> float:
+        return self.dispatch_s + self.block_s
+
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name,
+            "start_s": round(self.start_s, 6),
+            "dispatch_s": round(self.dispatch_s, 6),
+            "block_s": round(self.block_s, 6),
+            "total_s": round(self.total_s, 6),
+        }
+        if self.meta:
+            d["meta"] = self.meta
+        return d
+
+
+class _SpanHandle:
+    """Yielded by Recorder.span(); call .dispatched() the moment the
+    device call returns to split compile/dispatch from execute/block."""
+
+    __slots__ = ("_t0", "_t_dispatch")
+
+    def __init__(self, t0: float):
+        self._t0 = t0
+        self._t_dispatch = None
+
+    def dispatched(self):
+        if self._t_dispatch is None:
+            self._t_dispatch = time.perf_counter()
+
+
+class Recorder:
+    """Per-Simulator telemetry accumulator. Always cheap to keep on (a
+    span is two perf_counter calls); `enabled` additionally makes the
+    driver block on phase results for the compile/execute attribution
+    and is what --profile turns on."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self.reset()
+
+    def reset(self):
+        self.epoch = time.perf_counter()
+        self.spans: List[Span] = []
+        self.counts: Dict[str, int] = {}
+        self.scan_counters = np.zeros(NUM_COUNTERS, np.int64)
+        self._pending_scans: List[tuple] = []  # (device ctr array, pad_skips)
+        self.scan_events = 0
+        self.engines: List[str] = []
+        self.disruption: Dict[str, int] = {}
+        self.table_cache = "off"  # off | miss | hit
+
+    @contextmanager
+    def span(self, name: str, **meta):
+        t0 = time.perf_counter()
+        h = _SpanHandle(t0)
+        try:
+            yield h
+        finally:
+            t1 = time.perf_counter()
+            td = h._t_dispatch if h._t_dispatch is not None else t1
+            self.spans.append(Span(
+                name=name,
+                start_s=t0 - self.epoch,
+                dispatch_s=td - t0,
+                block_s=t1 - td,
+                meta=meta,
+            ))
+
+    def count(self, name: str, n: int = 1):
+        self.counts[name] = self.counts.get(name, 0) + n
+
+    def note_scan(self, engine: str, counters=None, pad_skips: int = 0,
+                  events: int = 0):
+        """Record one replay dispatch: which engine ran, how many true
+        (un-padded) events, and its in-scan counter vector. The device
+        array is stashed un-materialized — np.asarray would force a sync
+        mid-pipeline — and folded in lazily at snapshot()."""
+        self.engines.append(engine)
+        self.scan_events += int(events)
+        if counters is not None:
+            self._pending_scans.append((counters, int(pad_skips)))
+
+    def note_disruption(self, dm):
+        """Fold a DisruptionMetrics into machine-readable counters (the
+        [Disruption] log block's obs twin)."""
+        self.disruption = {
+            "node_failures": int(dm.node_failures),
+            "node_recoveries": int(dm.node_recoveries),
+            "evicted_pods": int(dm.evicted_pods),
+            "rescheduled_pods": int(dm.rescheduled_pods),
+            "retries_enqueued": int(dm.retries_enqueued),
+            "unscheduled_after_retries": int(dm.unscheduled_after_retries),
+        }
+
+    def _drain_pending(self):
+        for ctr, pad in self._pending_scans:
+            vals = np.asarray(ctr).astype(np.int64).copy()
+            vals[4] = max(int(vals[4]) - pad, 0)  # drop bucket-padding skips
+            self.scan_counters += vals
+        self._pending_scans = []
+
+    def snapshot(self, meta: Optional[dict] = None) -> "RunTelemetry":
+        self._drain_pending()
+        return RunTelemetry(
+            spans=list(self.spans),
+            counters=counters_to_dict(self.scan_counters),
+            counts=dict(self.counts),
+            disruption=dict(self.disruption),
+            engines=list(self.engines),
+            events=self.scan_events,
+            table_cache=self.table_cache,
+            meta=dict(meta or {}),
+        )
+
+
+@dataclass
+class RunTelemetry:
+    """One run's telemetry: the object SimulateResult.telemetry carries
+    and the JSONL emitter serializes."""
+
+    spans: List[Span]
+    counters: Dict[str, int]  # in-scan counters (obs.counters vocabulary)
+    counts: Dict[str, int]  # host-side counters (degrades, cache, retries)
+    disruption: Dict[str, int]
+    engines: List[str]
+    events: int
+    table_cache: str
+    meta: Dict[str, object]
+
+    def to_record(self) -> dict:
+        """The JSONL run record. `deterministic` is bit-identical across
+        same-seed runs and kill/resume (integer counters + config only);
+        `timing` carries the machine-dependent walls."""
+        return {
+            "schema": SCHEMA,
+            "deterministic": {
+                "events": self.events,
+                "counters": self.counters,
+                "degrades": {
+                    k: v for k, v in sorted(self.counts.items())
+                    if k.startswith("degrade_")
+                },
+                "counts": {
+                    k: v for k, v in sorted(self.counts.items())
+                    if not k.startswith("degrade_")
+                },
+                "disruption": self.disruption,
+                "engines": self.engines,
+                "table_cache": self.table_cache,
+                "meta": self.meta,
+            },
+            "timing": {
+                "spans": [s.to_dict() for s in self.spans],
+                "wall_s": round(
+                    max((s.start_s + s.total_s for s in self.spans),
+                        default=0.0),
+                    6,
+                ),
+            },
+        }

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
+from tpusim.obs.counters import counter_delta, zero_counters
 from tpusim.policies.base import feasible_min_max, minmax_scale_i32
 from tpusim.sim.engine import ReplayResult
 from tpusim.sim.step import block_reduce, choose_devices, packed_argmax
@@ -84,6 +85,11 @@ class ShardTableCarry(NamedTuple):
     arr_cpu: jnp.ndarray  # i32
     arr_gpu: jnp.ndarray  # i32
     key: jnp.ndarray  # PRNG key after the events consumed so far
+    # i32[obs.NUM_COUNTERS] exact in-scan counters (tpusim.obs.counters)
+    # — replicated: every shard adds the same delta from the replicated
+    # (kind, node) decision. `rebuilds` stays 0 here (block summaries
+    # refresh unconditionally; there is no drift-cond to count).
+    ctr: jnp.ndarray
 
 
 def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
@@ -174,7 +180,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         z = jnp.int32(0)
         return ShardTableCarry(
             state, packed_tbl, lt, lr, lwn, z, placed, masks, failed,
-            z, z, key,
+            z, z, key, zero_counters(),
         )
 
     def _chunk_shard(carry, rank, pods, types, ev_kind, ev_pod, tp):
@@ -194,7 +200,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
 
         def body(carry, ev):
             (state, packed_tbl, lt, lr, lwn, dirty, placed, masks, failed,
-             arr_cpu, arr_gpu, key) = carry
+             arr_cpu, arr_gpu, key, ctr) = carry
             kind, idx = ev
             pod = jax.tree.map(lambda a: a[idx], pods)
             t_id = type_id[idx]
@@ -400,9 +406,10 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             # node == -1 (failed create) leaves no owner, so every shard
             # skips the next refresh — same as the pre-restructure behavior
             dirty = jnp.where(kc == 2, dirty, node)
+            ctr = ctr + counter_delta(kc, node)
             return ShardTableCarry(
                 state, packed_tbl, lt, lr, lwn, dirty, placed, masks,
-                failed, arr_cpu, arr_gpu, key,
+                failed, arr_cpu, arr_gpu, key, ctr,
             ), (node, dev)
 
         carry, (nodes, devs) = jax.lax.scan(body, carry, (ev_kind, ev_pod))
@@ -421,7 +428,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         packed_tbl=P(None, NODE_AXIS),
         lt=P(None, NODE_AXIS), lr=P(None, NODE_AXIS), lwn=P(None, NODE_AXIS),
         dirty=P(), placed=P(), masks=P(), failed=P(),
-        arr_cpu=P(), arr_gpu=P(), key=P(),
+        arr_cpu=P(), arr_gpu=P(), key=P(), ctr=P(),
     )
 
     def _wrap(fn, in_specs, out_specs):
@@ -476,7 +483,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         )
         return ReplayResult(
             carry.state, carry.placed, carry.masks, carry.failed, None,
-            nodes, devs,
+            nodes, devs, carry.ctr,
         )
 
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,

@@ -104,6 +104,30 @@ class SimulatorConfig:
     # non-empty, else $TPUSIM_CHECKPOINT_DIR, else
     # <repo>/.tpusim_checkpoints. Only consulted when checkpoint_every > 0.
     checkpoint_dir: str = ""
+    # ---- observability (tpusim.obs; ENGINES.md "Round 8") ----
+    # profile=True switches the always-on span recorder into profiling
+    # mode: the driver blocks on each phase result so spans carry the
+    # compile(dispatch)/execute(block) wall split, and derives counters
+    # from telemetry for engines whose scan does not count (pallas,
+    # extender). Placements and metrics are unaffected either way; the
+    # extra sync points cost < 2% on `make bench-scale-smoke` (measured,
+    # ENGINES.md Round 8).
+    profile: bool = False
+    # > 0 fires an obs.heartbeat progress line (events/s, ETA) from
+    # INSIDE the table engine's compiled scan every N processed events —
+    # long-scan liveness for the 100k-node lane. Baked into the engine
+    # jaxpr (part of its cache key); 0 = off. Table engine only (the
+    # shard/pallas loops carry no host callback).
+    heartbeat_every: int = 0
+    # Content-keyed init_tables cache (ROADMAP open item): a directory
+    # here (or $TPUSIM_TABLE_CACHE_DIR when empty) lets repeat runs skip
+    # the ~27 s N=100k K-node-sweep table build by reloading the tables
+    # under the checkpoint content-addressing discipline
+    # (io.storage.save_tables; digest = engine-source salt + config +
+    # state/types/typical). Bit-identical by construction; obs records
+    # the hit/miss. Empty + unset env = disabled. Single-device table
+    # engine only (the shard engine builds its tables sharded).
+    table_cache_dir: str = ""
     # Device-mesh width: 0 = single device; N > 1 shards the node axis
     # over an N-device jax.sharding.Mesh and replays on the
     # explicit-collective shard_map engine (tpusim.parallel.shard_engine;
@@ -138,6 +162,11 @@ class SimulateResult:
     # (-1 = never created); feeds the assume-time annotation, whose purpose
     # is recovering scheduling order from a snapshot
     creation_rank: np.ndarray = None
+    # tpusim.obs.RunTelemetry snapshot for this run: phase spans
+    # (compile/execute split), exact in-scan counters, degrade/fault
+    # counts, table-cache outcome. Always populated (the recorder is
+    # always on); walls are only phase-attributed under cfg.profile.
+    telemetry: object = None
 
 
 _BELLMAN_SRC_DIGEST = None
@@ -162,6 +191,11 @@ def _engine_source_digest() -> bytes:
                 "sim/engine.py", "sim/step.py", "sim/table_engine.py",
                 "parallel/shard_engine.py", "io/storage.py", "constants.py",
                 "types.py",
+                # the counter vocabulary shapes the carry's ctr leaf (and
+                # thus the checkpoint layout); changing it must invalidate
+                # old checkpoints and cached tables rather than resume into
+                # a layout mismatch
+                "obs/counters.py",
             )
         ]
         files += glob.glob(os.path.join(base, "policies", "*.py"))
@@ -244,6 +278,12 @@ class Simulator:
         self.init_state = nodes_to_state(self.nodes)
         self.rank = jnp.asarray(tiebreak_rank(len(self.nodes), self.cfg.seed))
         self.log = LogSink(stream=None)
+        # the observability plane (tpusim.obs): spans + counters are
+        # always recorded (two perf_counter calls per phase); profile=True
+        # additionally blocks per phase for the compile/execute split
+        from tpusim.obs import Recorder
+
+        self.obs = Recorder(enabled=self.cfg.profile)
         self._bellman_eval = None
         self._bellman_pending_replay = None
         self.workload_pods: List[PodRow] = []
@@ -298,6 +338,7 @@ class Simulator:
             gpu_sel=self.cfg.gpu_sel_method,
             report=False,
             block_size=self.cfg.block_size,
+            heartbeat_every=self.cfg.heartbeat_every,
         )
         # fused whole-replay Pallas engine (tpusim.sim.pallas_engine): one
         # kernel for the entire event loop, ~4x the table engine on chip;
@@ -370,18 +411,39 @@ class Simulator:
     def _attach_metrics(self, out, state, specs, ev_kind, ev_pod,
                         n_events=None):
         """Reconstruct the per-event report series from the replay's
-        telemetry (the shared post-pass) when reporting is on, and log the
-        engine the dispatch used. `n_events` = true (pre-padding) event
-        count for the log line."""
+        telemetry (the shared post-pass) when reporting is on, record the
+        scan in obs (engine + in-scan counters, padding-corrected), and
+        log the engine the dispatch used. `n_events` = true (pre-padding)
+        event count for the log line."""
+        true_e = int(ev_kind.shape[0]) if n_events is None else int(n_events)
+        ctr = out.counters
+        if ctr is None and self.obs.enabled:
+            # engines whose loop does not count (fused pallas, extender):
+            # derive the invariant prefix from the per-event telemetry —
+            # exact for everything but `rebuilds` (which those engines
+            # never pay). Profiling mode only: the readback syncs.
+            from tpusim.obs.counters import counters_from_telemetry
+
+            ctr = counters_from_telemetry(
+                np.asarray(ev_kind), np.asarray(out.event_node)
+            )
+        self.obs.note_scan(
+            self._last_engine, counters=ctr,
+            pad_skips=int(out.event_node.shape[0]) - true_e, events=true_e,
+        )
         if self.cfg.report_per_event:
             from tpusim.sim.metrics import compute_event_metrics
 
-            out = out._replace(
-                metrics=compute_event_metrics(
-                    state, specs, ev_kind, ev_pod, out.event_node,
-                    out.event_dev, self.typical,
+            with self.obs.span("metrics_postpass", events=true_e) as h:
+                out = out._replace(
+                    metrics=compute_event_metrics(
+                        state, specs, ev_kind, ev_pod, out.event_node,
+                        out.event_dev, self.typical,
+                    )
                 )
-            )
+                h.dispatched()
+                if self.obs.enabled:
+                    jax.block_until_ready(out.metrics)
         # name the engine in the log: the fused engine's documented f32
         # divergence channel means TPU-vs-CPU result diffs must be
         # diagnosable from simon.log alone (the analysis parser ignores
@@ -391,6 +453,24 @@ class Simulator:
         self.log.info(
             f"[Engine] replay of {n_events} events ran on: {self._last_engine}"
         )
+        return out
+
+    def _dispatch_span(self, thunk, **meta):
+        """Run one engine dispatch under an obs "scan" span. The
+        dispatch/block split is the compile/execute split: the host
+        returns from the jitted call once tracing+compile+enqueue are
+        done, so dispatch_s on a cold call is dominated by compilation;
+        profiling mode then blocks so block_s is the device execution.
+        Un-profiled runs never add the sync point — async pipelining is
+        untouched."""
+        with self.obs.span("scan", **meta) as h:
+            out = thunk()
+            h.dispatched()
+            if self.obs.enabled and out is not None:
+                jax.block_until_ready(
+                    [l for l in jax.tree.leaves(out)
+                     if isinstance(l, jax.Array)]
+                )
         return out
 
     def run_events(
@@ -433,14 +513,26 @@ class Simulator:
                     self.cfg.extenders,
                 )
             self._last_engine = "extender"
-            out = self._extender_fn(
-                state, specs, ev_kind, ev_pod, self.typical, key,
-                self.rank, pod_rows, self.nodes,
+            out = self._dispatch_span(
+                lambda: self._extender_fn(
+                    state, specs, ev_kind, ev_pod, self.typical, key,
+                    self.rank, pod_rows, self.nodes,
+                ),
+                engine="extender", events=int(ev_kind.shape[0]),
             )
             return self._attach_metrics(out, state, specs, ev_kind, ev_pod)
 
         p, e = int(specs.cpu.shape[0]), int(ev_kind.shape[0])
         p2, e2 = _bucket_sizes(p, e, bucket)
+        if self.cfg.heartbeat_every:
+            # arm the host side of the in-scan progress ticks for this
+            # dispatch (ETA needs the event total; the engine only ships
+            # its processed count). The total is the PADDED stream e2 —
+            # that is what the scan processes and what the carry counter
+            # counts, so progress can never read > 100%
+            from tpusim.obs import heartbeat as obs_heartbeat
+
+            obs_heartbeat.configure(e2, "replay")
         # dedup types from the UNPADDED specs (no spurious zero type); the
         # type_id axis is padded alongside the pod axis (padded events only
         # ever reference pod 0)
@@ -475,14 +567,20 @@ class Simulator:
                 # Streams that fit in one segment skip the machinery — no
                 # checkpoint could ever be written, so the digest/eval_shape
                 # overhead would buy nothing
-                out = self._run_chunked(
-                    self._shard_fn, state_p, specs, types, ev_kind, ev_pod,
-                    key, rank_p,
+                out = self._dispatch_span(
+                    lambda: self._run_chunked(
+                        self._shard_fn, state_p, specs, types, ev_kind,
+                        ev_pod, key, rank_p,
+                    ),
+                    engine=self._last_engine, events=e,
                 )
             else:
-                out = self._shard_fn(
-                    state_p, specs, types, ev_kind, ev_pod, self.typical,
-                    key, rank_p,
+                out = self._dispatch_span(
+                    lambda: self._shard_fn(
+                        state_p, specs, types, ev_kind, ev_pod,
+                        self.typical, key, rank_p,
+                    ),
+                    engine=self._last_engine, events=e,
                 )
             # the post-pass runs on the UNPADDED state: pad rows are never
             # chosen (every valid event_node < n0), and the f32 initial
@@ -522,21 +620,42 @@ class Simulator:
                 if out is None:
                     self._last_engine = "table"
                     # single-segment streams (true count e, not the padded
-                    # stream) skip the checkpoint machinery entirely
+                    # stream) skip the checkpoint machinery entirely. The
+                    # content-keyed init_tables reuse (obs records the
+                    # hit/miss; None when disabled) resolves LAZILY on the
+                    # chunked path: a run that resumes from a checkpoint
+                    # restores its carry — tables included — and must not
+                    # pay a table build/load it would immediately discard
                     if 0 < self.cfg.checkpoint_every < e:
-                        out = self._run_chunked(
-                            self._table_fn, state, specs, types, ev_kind,
-                            ev_pod, key, self.rank,
+                        out = self._dispatch_span(
+                            lambda: self._run_chunked(
+                                self._table_fn, state, specs, types,
+                                ev_kind, ev_pod, key, self.rank,
+                                tables_thunk=lambda: self._cached_tables(
+                                    state, types, key
+                                ),
+                            ),
+                            engine="table", events=e,
                         )
                     else:
-                        out = self._table_fn(
-                            state, specs, types, ev_kind, ev_pod,
-                            self.typical, key, self.rank,
+                        out = self._dispatch_span(
+                            lambda: self._table_fn(
+                                state, specs, types, ev_kind, ev_pod,
+                                self.typical, key, self.rank,
+                                tables=self._cached_tables(
+                                    state, types, key
+                                ),
+                            ),
+                            engine="table", events=e,
                         )
         if out is None:
             self._last_engine = "sequential"
-            out = self.replay_fn(
-                state, specs, ev_kind, ev_pod, self.typical, key, self.rank
+            out = self._dispatch_span(
+                lambda: self.replay_fn(
+                    state, specs, ev_kind, ev_pod, self.typical, key,
+                    self.rank,
+                ),
+                engine="sequential", events=e,
             )
         # post-pass metrics stay on device: the caller's device_fetch
         # moves everything in one transfer
@@ -564,6 +683,10 @@ class Simulator:
             n, k, len(self._policy_fns), int(specs.cpu.shape[0]),
             int(ev_kind.shape[0]),
         ):
+            # every [Degrade] channel also lands in an obs counter so a
+            # degraded run is machine-detectable from the JSONL record,
+            # not just greppable from stdout prose
+            self.obs.count("degrade_vmem")
             self.log.info(
                 f"[Degrade] fused pallas kernel would overflow VMEM at "
                 f"N={n}, K={k} (ENGINES.md spill list): falling back to "
@@ -572,9 +695,12 @@ class Simulator:
             return None
         self._last_engine = "pallas"
         try:
-            out = self._pallas_fn(
-                state, specs, types, ev_kind, ev_pod, self.typical, key,
-                self.rank,
+            out = self._dispatch_span(
+                lambda: self._pallas_fn(
+                    state, specs, types, ev_kind, ev_pod, self.typical,
+                    key, self.rank,
+                ),
+                engine="pallas", events=int(ev_kind.shape[0]),
             )
             bad = self._pallas_result_suspect(out, n)
         except (AttributeError, NameError, ImportError):
@@ -582,6 +708,7 @@ class Simulator:
             # must not silently paper over a broken build
             raise
         except Exception as err:  # Mosaic OOM / lowering / runtime death
+            self.obs.count("degrade_runtime")
             self.log.info(
                 f"[Degrade] pallas replay died mid-scan "
                 f"({type(err).__name__}: {err}): falling back to the "
@@ -589,6 +716,7 @@ class Simulator:
             )
             return None
         if bad:
+            self.obs.count("degrade_corrupt")
             self.log.info(
                 f"[Degrade] pallas replay returned corrupt telemetry "
                 f"({bad}; NaN/inf in the f32 score tables?): falling back "
@@ -624,6 +752,93 @@ class Simulator:
                     os.path.abspath(__file__)))), ".tpusim_checkpoints")
         return d
 
+    # ---- content-keyed init_tables cache (ROADMAP open item) ----
+
+    def _table_cache_dir(self) -> str:
+        return self.cfg.table_cache_dir or os.environ.get(
+            "TPUSIM_TABLE_CACHE_DIR", ""
+        )
+
+    def _tables_digest(self, state, types) -> str:
+        """Content key of one table build: the engine-source salt + the
+        scoring config + every input init_tables reads (initial state,
+        pod types, typical pods). Deliberately NOT the event stream, PRNG
+        key, or tie-break rank — the build never consumes them, so every
+        seed/trace over the same cluster + type set shares one entry."""
+        from tpusim.io.storage import checkpoint_digest
+
+        cfg = self.cfg
+
+        def chunks():
+            yield _engine_source_digest()
+            yield repr((
+                tuple(cfg.policies), cfg.gpu_sel_method, cfg.dim_ext_method,
+                cfg.norm_method,
+            )).encode()
+            for leaf in (
+                jax.tree.leaves(state) + jax.tree.leaves(types)
+                + jax.tree.leaves(self.typical)
+            ):
+                yield np.asarray(leaf).tobytes()
+
+        return checkpoint_digest(chunks())
+
+    def _cached_tables(self, state, types, key):
+        """(score_tbl, sdev_tbl, feas_tbl) for the single-device table
+        engine from the content-keyed disk cache, building + persisting
+        on miss — or None when caching is disabled (the engine then
+        builds the tables inside init_carry exactly as before). A hit
+        skips the K-node-sweep build (~27 s at N=100k); results are
+        bit-identical either way because every downstream aggregate is a
+        pure function of the tables. obs records the outcome."""
+        cache_dir = self._table_cache_dir()
+        if not cache_dir:
+            return None
+        from tpusim.io import storage
+
+        names = ("score_tbl", "sdev_tbl", "feas_tbl")
+        digest = self._tables_digest(state, types)
+        found = storage.find_tables(cache_dir, digest)
+        if found is not None:
+            try:
+                with self.obs.span("init_tables", cache="hit") as h:
+                    arrays = storage.load_tables(found)
+                    tables = tuple(jnp.asarray(arrays[k]) for k in names)
+                    h.dispatched()
+                self.obs.table_cache = "hit"
+                self.obs.count("table_cache_hit")
+                self.log.info(
+                    f"[TableCache] reused init tables from "
+                    f"{os.path.basename(found)}"
+                )
+                return tables
+            except Exception as err:
+                # torn/stale file: content addressing makes a rebuild
+                # always safe; drop the unusable entry
+                self.log.info(
+                    f"[TableCache] dropping unusable entry "
+                    f"{os.path.basename(found)} ({err}); rebuilding"
+                )
+                try:
+                    os.unlink(found)
+                except OSError:
+                    pass
+        with self.obs.span("init_tables", cache="miss") as h:
+            tables = self._table_fn.build_tables(
+                state, types, self.typical, key
+            )
+            h.dispatched()
+            host = [np.asarray(t) for t in tables]  # also blocks the build
+        self.obs.table_cache = "miss"
+        self.obs.count("table_cache_miss")
+        path = storage.save_tables(
+            cache_dir, digest, dict(zip(names, host))
+        )
+        self.log.info(
+            f"[TableCache] saved init tables to {os.path.basename(path)}"
+        )
+        return tables
+
     def _run_digest(self, state, specs, ev_kind, ev_pod, key, rank) -> str:
         """Content key of one replay run: the engine-source version salt +
         every input that determines the trajectory (initial state, pod
@@ -651,7 +866,7 @@ class Simulator:
         return checkpoint_digest(chunks())
 
     def _run_chunked(self, fn, state, specs, types, ev_kind, ev_pod, key,
-                     rank):
+                     rank, tables_thunk=None):
         """Chunked replay with exact checkpoint/resume: cut the event scan
         into checkpoint_every-event segments via the engine's carry surface
         (fn.init_carry / run_chunk / finish), snapshot the full carry to
@@ -714,9 +929,17 @@ class Simulator:
                     pass
                 carry, cursor, node_parts, dev_parts = None, 0, [], []
         if carry is None:
-            carry = fn.init_carry(
-                state, specs, types, self.typical, key, rank
-            )
+            # only now resolve the table cache (table engine only): a
+            # resumed run never reaches here and must not pay the build
+            tables = tables_thunk() if tables_thunk is not None else None
+            if tables is not None:
+                carry = fn.init_carry(
+                    state, specs, types, self.typical, key, rank, tables
+                )
+            else:
+                carry = fn.init_carry(
+                    state, specs, types, self.typical, key, rank
+                )
 
         while cursor < e:
             end = min(cursor + every, e)
@@ -750,9 +973,12 @@ class Simulator:
             np.concatenate(dev_parts) if dev_parts
             else np.zeros((0, 8), bool)
         )
+        # the carry's counter leaf accumulated across every segment AND
+        # any resumed-from checkpoint — telemetry continuity through
+        # kill/resume comes for free from the carry being the checkpoint
         return ReplayResult(
             state_f, placed, masks, failed, None,
-            jnp.asarray(nodes), jnp.asarray(devs),
+            jnp.asarray(nodes), jnp.asarray(devs), carry.ctr,
         )
 
     # ---- workload prep (core.go:103-142) ----
@@ -761,6 +987,10 @@ class Simulator:
         self.workload_pods = list(pods)
 
     def set_typical_pods(self):
+        with self.obs.span("typical_pods", pods=len(self.workload_pods)):
+            self._set_typical_pods_impl()
+
+    def _set_typical_pods_impl(self):
         self.typical, self._typical_info = get_typical_pods(
             self.workload_pods, self.cfg.typical_pods
         )
@@ -857,7 +1087,8 @@ class Simulator:
             state, specs, jnp.asarray(ev_kind), jnp.asarray(ev_pod), key,
             pod_rows=pods,
         )
-        out = device_fetch(out)
+        with self.obs.span("fetch", events=len(ev_kind)):
+            out = device_fetch(out)
         return self._finish_replay(out, pods, ev_kind, ev_pod, state)
 
     def _finish_replay(self, out, pods, ev_kind, ev_pod, state):
@@ -895,6 +1126,27 @@ class Simulator:
             time.perf_counter() - t0,
         )
 
+    def _telemetry_meta(self) -> dict:
+        """Deterministic run description for the telemetry record (must be
+        identical across same-seed runs — no walls, no paths)."""
+        cfg = self.cfg
+        return {
+            "policies": [[n, w] for n, w in cfg.policies],
+            "gpu_sel": cfg.gpu_sel_method,
+            "norm": cfg.norm_method,
+            "dim_ext": cfg.dim_ext_method,
+            "seed": cfg.seed,
+            "engine_cfg": cfg.engine,
+            "block_size": cfg.block_size,
+            "mesh": cfg.mesh,
+            "nodes": len(self.nodes),
+        }
+
+    def run_telemetry(self):
+        """Current RunTelemetry snapshot (spans, counters, degrade/fault
+        counts) — also attached to every SimulateResult."""
+        return self.obs.snapshot(meta=self._telemetry_meta())
+
     def _record_result(self, result, pods, events, unscheduled, rank, wall):
         self.last_result = SimulateResult(
             unscheduled_pods=unscheduled,
@@ -906,6 +1158,7 @@ class Simulator:
             wall_seconds=wall,
             events=events,
             creation_rank=rank,
+            telemetry=self.run_telemetry(),
         )
         return self.last_result
 
@@ -962,6 +1215,7 @@ class Simulator:
         self.analysis_summary = {}
         self.failed_pod_lists = []
         self.log.lines = []
+        self.obs.reset()
 
     def run(self) -> SimulateResult:
         """Full experiment (core.go:86-268 minus deschedule/inflation, which
@@ -1380,6 +1634,9 @@ class Simulator:
 
         self.analysis_summary.update(disruption_report_block(self.log, dm))
         self.last_disruption = dm
+        # the [Disruption] block's machine-readable twin: fault totals in
+        # the JSONL record instead of stdout-only prose
+        self.obs.note_disruption(dm)
 
         skipped = np.array([p.unscheduled for p in pods], bool)
         unscheduled = []
@@ -1404,6 +1661,7 @@ class Simulator:
             wall_seconds=time.perf_counter() - t0,
             events=state_box["events"],
             creation_rank=creation_rank,
+            telemetry=self.run_telemetry(),
         )
         return self.last_result
 
@@ -1533,15 +1791,24 @@ class Simulator:
         No-op when per-event reporting is off (the replay carries no
         metrics then). All line families format vectorized over the event
         axis (reports.batch_event_report_msgs) and append in one bulk
-        call."""
-        from tpusim.sim.engine import EV_CREATE, EV_DELETE
-        from tpusim.sim.reports import batch_event_report_msgs
-
+        call. The whole block (the Bellman series dominates) runs under
+        the obs "report" span."""
         m = out.metrics
         if not self.cfg.report_per_event or m is None:
             return
-        from tpusim.sim.reports import event_report_series
+        with self.obs.span("report", events=int(np.asarray(ev_kind).shape[0])):
+            self._emit_event_reports_impl(out, pods, ev_kind, ev_pod,
+                                          start_state)
 
+    def _emit_event_reports_impl(self, out, pods, ev_kind, ev_pod,
+                                 start_state):
+        from tpusim.sim.engine import EV_CREATE, EV_DELETE
+        from tpusim.sim.reports import (
+            batch_event_report_msgs,
+            event_report_series,
+        )
+
+        m = out.metrics
         amounts = np.asarray(m.frag_amounts)
         total_gpus = self.total_gpus
         kinds = np.asarray(ev_kind)

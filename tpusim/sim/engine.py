@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from tpusim.constants import MAX_GPUS_PER_NODE, MILLI
+from tpusim.obs.counters import counter_delta, zero_counters
 from tpusim.ops.energy import node_power
 from tpusim.ops.frag import cluster_frag_amounts, frag_sum_except_q3, frag_sum_q1q2q4
 from tpusim.sim.step import Placement, schedule_one, unschedule
@@ -73,6 +74,12 @@ class ReplayResult(NamedTuple):
     event_node: jnp.ndarray  # i32[E] node touched at each event (-1 none):
     # the chosen node for creations, the freed node for deletions
     event_dev: jnp.ndarray  # bool[E, 8] devices touched at each event
+    # i32[obs.NUM_COUNTERS] exact in-scan counters (tpusim.obs.counters
+    # vocabulary), carried through the scan so they survive chunking,
+    # checkpoint/resume, and fault segmentation bit-identically. None on
+    # engines whose loop does not count (fused pallas, extender) — the
+    # driver derives the invariant prefix from telemetry there.
+    counters: jnp.ndarray = None
 
 
 def cluster_usage(state: NodeState):
@@ -153,7 +160,7 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True):
         failed = jnp.zeros(num_pods, jnp.bool_)
 
         def body(carry, ev):
-            state, placed, masks, failed, arr_cpu, arr_gpu, key = carry
+            state, placed, masks, failed, arr_cpu, arr_gpu, ctr, key = carry
             kind, idx = ev
             pod = jax.tree.map(lambda a: a[idx], pods)
             key, sub = jax.random.split(key)
@@ -195,26 +202,38 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True):
                     jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
                 )
 
+            kc = jnp.clip(kind, 0, 2)
             (state2, placed2, masks2, failed2, arr_cpu2, arr_gpu2, node,
              dev) = jax.lax.switch(
-                jnp.clip(kind, 0, 2), [do_create, do_delete, do_skip], None
+                kc, [do_create, do_delete, do_skip], None
             )
+            # exact in-scan counters (obs vocabulary) — the same
+            # counter_delta every engine adds, so counts cannot diverge
+            ctr2 = ctr + counter_delta(kc, node)
             if report:
                 row = _metrics_row(state2, tp, arr_cpu2, arr_gpu2)
             else:
                 row = ()
-            return (state2, placed2, masks2, failed2, arr_cpu2, arr_gpu2, key), (
+            return (
+                state2, placed2, masks2, failed2, arr_cpu2, arr_gpu2, ctr2,
+                key,
+            ), (
                 row,
                 node,
                 dev,
             )
 
-        init = (state, placed, masks, failed, jnp.int32(0), jnp.int32(0), key)
-        (state, placed, masks, failed, _, _, _), (rows, nodes, devs) = jax.lax.scan(
-            body, init, (ev_kind, ev_pod)
+        init = (
+            state, placed, masks, failed, jnp.int32(0), jnp.int32(0),
+            zero_counters(), key,
+        )
+        (state, placed, masks, failed, _, _, ctr, _), (rows, nodes, devs) = (
+            jax.lax.scan(body, init, (ev_kind, ev_pod))
         )
         metrics = EventMetrics(*rows) if report else None
-        return ReplayResult(state, placed, masks, failed, metrics, nodes, devs)
+        return ReplayResult(
+            state, placed, masks, failed, metrics, nodes, devs, ctr
+        )
 
     _REPLAY_CACHE[cache_key] = replay
     return replay

@@ -38,6 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpusim.constants import MAX_GPUS_PER_NODE
+from tpusim.obs import heartbeat as obs_heartbeat
+from tpusim.obs.counters import counter_delta, zero_counters
 from tpusim.policies import (
     NORMALIZE_DEGENERATE,
     ScoreContext,
@@ -230,6 +232,7 @@ class FlatTableCarry(NamedTuple):
     arr_cpu: jnp.ndarray  # i32 arrived milli-CPU so far
     arr_gpu: jnp.ndarray  # i32 arrived milli-GPU so far
     key: jnp.ndarray  # PRNG key after the events consumed so far
+    ctr: jnp.ndarray  # i32[obs.NUM_COUNTERS] exact in-scan counters
 
 
 class BlockedTableCarry(NamedTuple):
@@ -257,6 +260,7 @@ class BlockedTableCarry(NamedTuple):
     arr_cpu: jnp.ndarray
     arr_gpu: jnp.ndarray
     key: jnp.ndarray
+    ctr: jnp.ndarray  # i32[obs.NUM_COUNTERS]; [5] counts summary rebuilds
 
 
 _TABLE_REPLAY_CACHE = {}
@@ -356,7 +360,8 @@ def make_table_builders(policies, sel_idx: int):
 
 
 def make_table_replay(
-    policies, gpu_sel: str = "best", report: bool = False, block_size: int = 0
+    policies, gpu_sel: str = "best", report: bool = False,
+    block_size: int = 0, heartbeat_every: int = 0,
 ):
     """Build the jitted incremental replayer for a static policy config.
 
@@ -397,6 +402,24 @@ def make_table_replay(
     segments, for any segmentation — including a host/disk round-trip of
     the carry between run_chunk calls (Flat/BlockedTableCarry hold only
     exact-dtype leaves).
+
+    Observability (tpusim.obs): the carry's `ctr` leaf counts events
+    applied/bound/failed/deleted/skipped (and blocked summary rebuilds)
+    with the shared obs.counters.counter_delta, so the counts are exact,
+    engine-invariant, and — being carry state — transparent to
+    checkpoint/resume. heartbeat_every > 0 additionally fires a
+    jax.debug.callback progress tick (obs.heartbeat) every that many
+    processed events from inside the scan; it is part of the engine
+    cache key because it is baked into the jaxpr, and it never touches
+    the trajectory (pure side output).
+
+    `replay(..., tables=...)` / `init_carry(..., tables=...)` accept
+    precomputed (score_tbl, sdev_tbl, feas_tbl) arrays — the driver's
+    content-keyed init_tables cache (io.storage) feeds these to skip the
+    K-node-sweep build on repeat runs; `replay.build_tables` is the
+    jitted builder whose output that cache persists. Results are
+    bit-identical either way (the aggregates are pure functions of the
+    tables).
     """
     if report:
         raise ValueError(
@@ -404,7 +427,7 @@ def make_table_replay(
             "with tpusim.sim.metrics.compute_event_metrics"
         )
     cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
-                 int(block_size))
+                 int(block_size), int(heartbeat_every))
     if cache_key in _TABLE_REPLAY_CACHE:
         return _TABLE_REPLAY_CACHE[cache_key]
     num_pol = len(policies)
@@ -469,7 +492,7 @@ def make_table_replay(
         def body(carry, ev):
             (state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
              brmin, brmax, slo, shi, pend, dirty,
-             placed, masks, failed, arr_cpu, arr_gpu, key) = carry
+             placed, masks, failed, arr_cpu, arr_gpu, key, ctr) = carry
             kind, idx = ev
             pod = jax.tree.map(lambda a: a[idx], pods)
             t_id = type_id[idx]
@@ -532,6 +555,7 @@ def make_table_replay(
             # extrema drift check + conditional summary-row rebuild for
             # this event's type — outside the event switch, so only [N/B]
             # rows (never whole tables) cross a cond/switch boundary
+            rebuilt = None  # obs: did this event pay the O(N) rebuild?
             if n_norm:
                 brmin_row = jax.lax.dynamic_index_in_dim(
                     brmin, t_id, 1, False
@@ -546,6 +570,7 @@ def make_table_replay(
                 changed = jnp.any(
                     (lo_cur != slo_col) | (hi_cur != shi_col)
                 )
+                rebuilt = changed
 
                 def rebuild():
                     raws = jax.lax.dynamic_index_in_dim(
@@ -643,10 +668,15 @@ def make_table_replay(
             arr_cpu = arr_cpu + jnp.where(kc == 0, pod.cpu, 0)
             arr_gpu = arr_gpu + jnp.where(kc == 0, pod.total_gpu_milli(), 0)
             dirty = jnp.where(kc == 2, dirty, jnp.maximum(node, 0))
+            ctr = ctr + counter_delta(kc, node, rebuilt)
+            if heartbeat_every:
+                obs_heartbeat.emit_from_scan(
+                    ctr[0] + ctr[3] + ctr[4], heartbeat_every
+                )
             return BlockedTableCarry(
                 state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
                 brmin, brmax, slo, shi, pend, dirty,
-                placed, masks, failed, arr_cpu, arr_gpu, key,
+                placed, masks, failed, arr_cpu, arr_gpu, key, ctr,
             ), (node, dev)
 
         return body
@@ -656,7 +686,7 @@ def make_table_replay(
 
         def body(carry, ev):
             (state, score_tbl, sdev_tbl, feas_tbl, pend, dirty,
-             placed, masks, failed, arr_cpu, arr_gpu, key) = carry
+             placed, masks, failed, arr_cpu, arr_gpu, key, ctr) = carry
             kind, idx = ev
             pod = jax.tree.map(lambda a: a[idx], pods)
             t_id = type_id[idx]
@@ -735,18 +765,29 @@ def make_table_replay(
             arr_cpu = arr_cpu + jnp.where(kc == 0, pod.cpu, 0)
             arr_gpu = arr_gpu + jnp.where(kc == 0, pod.total_gpu_milli(), 0)
             dirty = jnp.where(kc == 2, dirty, jnp.maximum(node, 0))
+            ctr = ctr + counter_delta(kc, node)
+            if heartbeat_every:
+                obs_heartbeat.emit_from_scan(
+                    ctr[0] + ctr[3] + ctr[4], heartbeat_every
+                )
             return FlatTableCarry(
                 state, score_tbl, sdev_tbl, feas_tbl, pend, dirty,
-                placed, masks, failed, arr_cpu, arr_gpu, key,
+                placed, masks, failed, arr_cpu, arr_gpu, key, ctr,
             ), (node, dev)
 
         return body
 
     @jax.jit
-    def init_carry(state, pods, types, tp, key, tiebreak_rank=None):
+    def init_carry(state, pods, types, tp, key, tiebreak_rank=None,
+                   tables=None):
         """Engine state at event 0: score/sdev/feas tables from the
         committed state + an inert pipeline register (and, on the blocked
         path, the per-(policy, type, block) aggregates).
+
+        `tables` short-circuits the K-node-sweep build with precomputed
+        (score_tbl, sdev_tbl, feas_tbl) — the driver's content-keyed
+        cache path; every downstream aggregate derives from them, so a
+        cached init is bit-identical to a built one.
 
         The event key chain must stay byte-for-byte the sequential
         oracle's (it never burns a split before its scan), so the random
@@ -758,7 +799,10 @@ def make_table_replay(
         bsz = 0 if has_random else resolve_block_size(block_size, n, k_types)
         if tiebreak_rank is None:
             tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
-        score_tbl, sdev_tbl, feas_tbl = _init_tables(state, types, tp, key)
+        if tables is None:
+            score_tbl, sdev_tbl, feas_tbl = _init_tables(state, types, tp, key)
+        else:
+            score_tbl, sdev_tbl, feas_tbl = tables
 
         # one extra dummy row absorbs skip-event writes of the pipelined
         # commit (PendingCommit.pod_write); sliced off by finish()
@@ -770,7 +814,7 @@ def make_table_replay(
         if not bsz:
             return FlatTableCarry(
                 state, score_tbl, sdev_tbl, feas_tbl, pend, z,
-                placed, masks, failed, z, z, key,
+                placed, masks, failed, z, z, key, zero_counters(),
             )
 
         nblk = -(-n // bsz)
@@ -810,7 +854,7 @@ def make_table_replay(
         return BlockedTableCarry(
             state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
             brmin, brmax, slo, shi, pend, z,
-            placed, masks, failed, z, z, key,
+            placed, masks, failed, z, z, key, zero_counters(),
         )
 
     @jax.jit
@@ -866,18 +910,22 @@ def make_table_replay(
         tp,
         key,
         tiebreak_rank=None,
+        tables=None,
     ) -> ReplayResult:
-        carry = init_carry(state, pods, types, tp, key, tiebreak_rank)
+        carry = init_carry(state, pods, types, tp, key, tiebreak_rank, tables)
         carry, (nodes, devs) = run_chunk(
             carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank
         )
         state, placed, masks, failed = finish(carry)
-        return ReplayResult(state, placed, masks, failed, None, nodes, devs)
+        return ReplayResult(
+            state, placed, masks, failed, None, nodes, devs, carry.ctr
+        )
 
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,
-               tiebreak_rank=None) -> ReplayResult:
+               tiebreak_rank=None, tables=None) -> ReplayResult:
         return _replay_impl(
-            state, pods, types, ev_kind, ev_pod, tp, key, tiebreak_rank
+            state, pods, types, ev_kind, ev_pod, tp, key, tiebreak_rank,
+            tables,
         )
 
     # the chunk-resume surface (driver checkpointing, ENGINES.md
@@ -885,5 +933,11 @@ def make_table_replay(
     replay.init_carry = init_carry
     replay.run_chunk = run_chunk
     replay.finish = finish
+    # the standalone table builder the driver's content-keyed cache
+    # persists (io.storage.save_tables); feeding its output back through
+    # `tables=` skips the K-node-sweep init bit-identically
+    replay.build_tables = jax.jit(
+        lambda state, types, tp, key: _init_tables(state, types, tp, key)
+    )
     _TABLE_REPLAY_CACHE[cache_key] = replay
     return replay
