@@ -32,11 +32,14 @@ bench-scale-smoke:
 # prefix, kill the run right after a mid-trace checkpoint lands, resume in
 # a fresh process, and assert the final placements/metrics/tables are
 # byte-identical to the uninterrupted run — plus the fault-injection
-# determinism suite and the obs telemetry-continuity/counter-invariance
-# suite. Runs the full files including slow-marked cases (the synthetic
+# determinism suite, the obs telemetry-continuity/counter-invariance
+# suite, and the decision-provenance suite (cross-engine record
+# invariance incl. the shard top-K collective, decision-stream
+# kill/resume + fault-segment continuity, openb explain/diff goldens).
+# Runs the full files including slow-marked cases (the synthetic
 # kill/resume + telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py tests/test_decisions.py -q
 
 # observability smoke (ENGINES.md "Round 8"): a small profiled scale run
 # emitting the full artifact set — JSONL run record (spans with the

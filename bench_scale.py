@@ -220,6 +220,9 @@ def main():
             trace=args.trace_out,
             meta={"bench": "bench_scale", "nodes": args.nodes,
                   "pods": args.pods, "block": eff_block},
+            counter_series=(
+                sim.event_counter_series() if args.trace_out else None
+            ),
         ):
             print(f"[obs] wrote {p}", file=sys.stderr)
 

@@ -30,6 +30,10 @@ import sys
 from pathlib import Path
 from typing import Dict, List
 
+# script mode (`python experiments/analysis.py`): the package lives one
+# level up (the run.py/sweep.py pattern)
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 ALLO_KEYS = ["MilliCpu", "Memory", "Gpu", "MilliGpu"]
 QUAD_KEYS = [
     "q1_lack_both",
@@ -457,16 +461,54 @@ def analyze_sim(sim, exp_dir: str, meta: Dict[str, str] = None) -> dict:
     return result
 
 
+def diff_decision_runs(path_a: str, path_b: str, buckets: int = 10) -> dict:
+    """Divergence tracing between two decision JSONLs (ISSUE 4; the
+    `tpusim diff` logic, exposed here so sweep analyses can diff
+    policies programmatically): {'first': first-divergence dict or None,
+    'histogram': bucketed divergence counts, 'text': the formatted
+    report}. The per-event placement series these files carry is exactly
+    the comparison the paper's FGD-vs-baseline argument rests on —
+    which event diverged first, and where divergence concentrates."""
+    from tpusim.obs import decisions as obs_decisions
+
+    ha, ra = obs_decisions.read_decisions(path_a)
+    hb, rb = obs_decisions.read_decisions(path_b)
+    return obs_decisions.run_diff(
+        ha, ra, hb, rb,
+        label_a=os.path.basename(path_a),
+        label_b=os.path.basename(path_b),
+        buckets=buckets,
+    )
+
+
 def main():
     ap = argparse.ArgumentParser(description="simulator log → analysis CSVs")
-    ap.add_argument("-g", "--log-dir", required=True, help="experiment directory")
+    ap.add_argument("-g", "--log-dir", help="experiment directory")
     ap.add_argument(
         "-f",
         "--failed-pods",
         action="store_true",
         help="also list failed pods (ref: failed_pods_in_detail)",
     )
+    ap.add_argument(
+        "--diff-decisions", nargs=2, metavar=("RUN_A", "RUN_B"),
+        help="diff two decision JSONLs (tpusim apply --decisions-out) "
+        "instead of parsing logs: first divergence + histogram",
+    )
     args = ap.parse_args()
+    if args.diff_decisions:
+        # exit codes mirror `tpusim diff`: 0 identical, 1 divergence,
+        # 2 unusable input (missing/torn file, runs from different
+        # traces) — a one-line error, never a traceback read as exit 1
+        try:
+            d = diff_decision_runs(*args.diff_decisions)
+        except (OSError, ValueError) as err:
+            print(f"analysis --diff-decisions: {err}", file=sys.stderr)
+            return 2
+        print(d["text"])
+        return 1 if d["first"] else 0
+    if not args.log_dir:
+        ap.error("-g/--log-dir is required (unless --diff-decisions)")
     result = analyze_dir(args.log_dir)
     s = result["summary"]
     print(

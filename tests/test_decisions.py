@@ -1,0 +1,627 @@
+"""Decision-provenance flight recorder (ISSUE 4 tentpole).
+
+The contracts under test:
+  (1) the winner's per-policy contributions SUM: Σ weight·norm equals the
+      recorded selectHost total, exactly, for every placed create;
+  (2) decision records are bit-identical across the flat, blocked,
+      sequential, and shard_map engines (INVARIANT_FIELDS — `block` is
+      the documented engine-specific slot, like the counters' rebuilds);
+  (3) the stream is continuous across checkpoint kill/resume and across
+      fault segmentation;
+  (4) the JSONL persistence round-trips under the digest discipline
+      (torn/edited files fail loudly);
+  (5) `explain`/`diff` produce deterministic golden output on an openb
+      prefix, and `diff` finds a deterministic first-divergence event
+      between FGD and BestFit (the acceptance criterion).
+
+Compile-heavy cases (4-engine invariance, shard top-K collective,
+kill/resume, openb goldens) are slow-marked for the tier-1 time budget
+and run under `make resume-smoke` / plain pytest.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.fixtures import random_cluster, random_pods
+from tpusim.io.trace import NodeRow, PodRow, pods_to_specs
+from tpusim.obs.decisions import (
+    DECISION_TOPK,
+    DecisionLog,
+    DecisionRecord,
+    INVARIANT_FIELDS,
+    decision_rows,
+    divergence_histogram,
+    first_divergence,
+    format_diff,
+    format_explain,
+    read_decisions,
+    run_diff,
+    write_decisions,
+)
+from tpusim.policies import make_policy
+from tpusim.sim.driver import Simulator, SimulatorConfig
+from tpusim.sim.engine import EV_CREATE, EV_DELETE, make_replay
+from tpusim.sim.table_engine import build_pod_types, make_table_replay
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WEIGHTS = (1000, 500)  # two-policy config: the sum check must be non-trivial
+
+
+def _mixed_events(num_pods, rng):
+    kinds, idxs, seen = [], [], set()
+    for i in range(num_pods):
+        kinds.append(EV_CREATE)
+        idxs.append(i)
+        if rng.random() < 0.3 and i > 0:
+            victim = int(rng.integers(0, i + 1))
+            if victim not in seen:
+                seen.add(victim)
+                kinds.append(EV_DELETE)
+                idxs.append(victim)
+    return jnp.asarray(kinds, jnp.int32), jnp.asarray(idxs, jnp.int32)
+
+
+def _driver_inputs():
+    rng = np.random.default_rng(31)
+    nodes = [
+        NodeRow(f"n{i}", 32000, 131072, int(g), "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], 12))
+    ]
+    pods = [
+        PodRow(f"p{i}", int(rng.choice([1000, 4000])), 1024,
+               int(rng.choice([0, 1])), 500)
+        for i in range(30)
+    ]
+    return nodes, pods
+
+
+def _replay(sim, pods):
+    specs = pods_to_specs(pods)
+    return sim.run_events(
+        sim.init_state, specs, jnp.zeros(len(pods), jnp.int32),
+        jnp.arange(len(pods), dtype=jnp.int32), jax.random.PRNGKey(2),
+    )
+
+
+def _run_driver(nodes, pods, every=0, ckdir="", seed=42):
+    sim = Simulator(nodes, SimulatorConfig(
+        policies=(("FGDScore", WEIGHTS[0]), ("BestFitScore", WEIGHTS[1])),
+        gpu_sel_method="FGDScore", report_per_event=False,
+        checkpoint_every=every, checkpoint_dir=ckdir, seed=seed,
+        record_decisions=True,
+    ))
+    sim.set_workload_pods(pods)
+    sim.set_typical_pods()
+    return sim, _replay(sim, pods)
+
+
+def _assert_records_equal(a, b, fields=DecisionRecord._fields):
+    for f in fields:
+        assert np.array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        ), f
+
+
+# ---------------------------------------------------------------------------
+# tier-1: one small driver replay pins the record semantics end to end
+# ---------------------------------------------------------------------------
+
+
+def test_winner_contributions_sum_and_consistency():
+    """Through the driver (table engine): Σ weight·norm == recorded
+    total for every placed create; topk entry 0 IS the committed winner
+    with its total; failed creates record -1/0; the stream is
+    bit-deterministic across two same-seed runs."""
+    nodes, pods = _driver_inputs()
+    sim, r1 = _run_driver(nodes, pods)
+    # second same-seed replay through the SAME sim reuses the compiled
+    # engine (tier-1 time budget); cross-PROCESS byte-identity of the
+    # stream is pinned by the slow openb golden
+    r2 = _replay(sim, pods)
+    assert r1.decisions is not None
+    d = jax.tree.map(np.asarray, r1.decisions)
+    _assert_records_equal(d, jax.tree.map(np.asarray, r2.decisions))
+
+    node = np.asarray(d.node)
+    total = np.asarray(d.total)
+    norm = np.asarray(d.norm)
+    w = np.asarray(WEIGHTS)
+    placed = node >= 0  # all events here are creates
+    assert placed.any()
+    # (1) the acceptance sum: per-policy weighted contributions == total
+    assert np.array_equal((norm @ w)[placed], total[placed])
+    # winner consistency with the replay telemetry + the topk head
+    assert np.array_equal(node, np.asarray(r1.event_node))
+    assert np.array_equal(np.asarray(d.topk_node)[placed, 0], node[placed])
+    assert np.array_equal(np.asarray(d.topk_total)[placed, 0], total[placed])
+    assert (np.asarray(d.feasible)[placed] > 0).all()
+    # runner-up ordering: lexicographic (total desc, rank asc), no dups
+    tkn = np.asarray(d.topk_node)
+    tkt = np.asarray(d.topk_total)
+    tkr = np.asarray(d.topk_rank)
+    for e in np.flatnonzero(placed):
+        valid = tkn[e] >= 0
+        ns, ts, rs = tkn[e][valid], tkt[e][valid], tkr[e][valid]
+        assert len(set(ns.tolist())) == len(ns)
+        for j in range(len(ns) - 1):
+            assert (ts[j] > ts[j + 1]) or (
+                ts[j] == ts[j + 1] and rs[j] < rs[j + 1]
+            )
+    # failed creates (if any) carry the inert sentinels
+    for e in np.flatnonzero(~placed):
+        assert total[e] == 0 and (norm[e] == 0).all()
+        assert (tkn[e] >= -1).all()
+
+
+def test_driver_run_populates_decision_log(tmp_path):
+    """Simulator.run() surfaces SimulateResult.decisions as a DecisionLog
+    whose JSONL write/read round-trips under the digest discipline."""
+    nodes, pods = _driver_inputs()
+    sim = Simulator(nodes, SimulatorConfig(
+        policies=(("FGDScore", WEIGHTS[0]), ("BestFitScore", WEIGHTS[1])),
+        gpu_sel_method="FGDScore", report_per_event=False, seed=42,
+        record_decisions=True,
+    ))
+    sim.set_workload_pods(pods)
+    res = sim.run()
+    log = res.decisions
+    assert isinstance(log, DecisionLog)
+    e = np.asarray(log.ev_kind).shape[0]
+    assert np.asarray(log.records.node).shape[0] == e == res.events
+
+    names = [p.name for p in res.pods]
+    path = str(tmp_path / "run.jsonl")
+    write_decisions(path, log, policies=list(sim.cfg.policies),
+                    meta={"seed": 42}, pod_names=names)
+    header, rows = read_decisions(path)
+    assert header["topk"] == DECISION_TOPK
+    assert header["policies"] == [["FGDScore", 1000], ["BestFitScore", 500]]
+    assert rows == decision_rows(log, names)
+    # explain at the first placed create reproduces the recorded total
+    ev = next(r["e"] for r in rows if r["kind"] == 0 and r["node"] >= 0)
+    text = format_explain(header, rows, ev)
+    assert f"== recorded total {rows[ev]['total']}" in text
+    # a torn/edited payload fails loudly (digest discipline)
+    lines = open(path).read().splitlines()
+    lines[1] = lines[1].replace(
+        f'"node":{rows[0]["node"]}', f'"node":{rows[0]["node"] + 1}', 1
+    )
+    tam = str(tmp_path / "tampered.jsonl")
+    open(tam, "w").write("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="digest mismatch"):
+        read_decisions(tam)
+
+
+# ---------------------------------------------------------------------------
+# tier-1: host-only diff/explain logic
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_rows(nodes_seq):
+    return [
+        {
+            "e": i, "kind": 0, "pod": i, "node": int(n), "total": 10 * i,
+            "raw": [1], "norm": [1],
+            "topk": [[int(n), 10 * i, 0], [-1, 0, -1], [-1, 0, -1]],
+            "feasible": 3, "block": -1, "name": f"p{i}",
+        }
+        for i, n in enumerate(nodes_seq)
+    ]
+
+
+def test_first_divergence_and_histogram():
+    a = _synthetic_rows([1, 2, 3, 4, 5, 6, 7, 8])
+    b = _synthetic_rows([1, 2, 9, 4, 5, 9, 7, 9])
+    first = first_divergence(a, b)
+    assert first["event"] == 2
+    assert first["a"]["node"] == 3 and first["b"]["node"] == 9
+    hist = divergence_histogram(a, b, buckets=4)
+    assert hist["events"] == 8 and hist["diverged"] == 3
+    assert hist["counts"] == [0, 1, 1, 1]  # events 2, 5, 7 / width 2
+    assert hist["first"] == 2 and hist["last"] == 7
+    assert first_divergence(a, a) is None
+    text = format_diff({"policies": [["X", 1]]}, a,
+                       {"policies": [["Y", 1]]}, b)
+    assert "first divergence at event 2" in text
+    assert "3 diverged placements" in text
+    # identical runs: the no-divergence branch
+    assert "no divergence" in format_diff(
+        {"policies": [["X", 1]]}, a, {"policies": [["X", 1]]}, a
+    )
+
+
+def test_run_diff_rejects_mismatched_traces():
+    """run_diff (the `tpusim diff` / analysis entry) errors loudly when
+    the two files describe different traces instead of reporting a bogus
+    divergence — and agrees with the piecewise helpers when they match."""
+    a = _synthetic_rows([1, 2, 3, 4])
+    b = _synthetic_rows([1, 2, 9, 4])
+    d = run_diff({"policies": [["X", 1]]}, a, {"policies": [["Y", 1]]}, b)
+    assert d["first"] == first_divergence(a, b)
+    assert d["histogram"] == divergence_histogram(a, b)
+    assert "first divergence at event 2" in d["text"]
+    # same trace, shorter run: comparable on the overlap
+    assert run_diff({}, a, {}, a[:2])["first"] is None
+    # different pod stream -> not comparable
+    c = _synthetic_rows([1, 2, 9, 4])
+    c[1]["pod"] = 7
+    with pytest.raises(ValueError, match="not comparable"):
+        run_diff({}, a, {}, c)
+    # different event kinds -> not comparable
+    k = _synthetic_rows([1, 2, 9, 4])
+    k[0]["kind"] = 1
+    with pytest.raises(ValueError, match="different traces"):
+        run_diff({}, a, {}, k)
+    # same (kind, pod) indices but different pod NAMES -> not comparable
+    # (unrelated traces both open with 'create pod 0')
+    m = _synthetic_rows([1, 2, 9, 4])
+    m[0]["name"] = "other/pod-0"
+    with pytest.raises(ValueError, match="not comparable"):
+        run_diff({}, a, {}, m)
+
+
+def test_explain_non_create_and_unschedulable():
+    rows = _synthetic_rows([5])
+    rows.append({**rows[0], "e": 1, "kind": 1})
+    rows.append({**rows[0], "e": 2, "node": -1, "total": 0, "feasible": 0,
+                 "topk": [[-1, 0, -1]] * 3})
+    header = {"policies": [["FGDScore", 1000]]}
+    assert "no scheduling decision" in format_explain(header, rows, 1)
+    assert "unschedulable" in format_explain(header, rows, 2)
+    with pytest.raises(ValueError, match="out of range"):
+        format_explain(header, rows, 99)
+    # a file whose norm/weights do not reproduce the recorded total is
+    # unusable input (exit 2 via cmd_explain), not a quietly-annotated
+    # table: here weight 1000 * norm 1 != total 0
+    with pytest.raises(ValueError, match="inconsistent"):
+        format_explain(header, rows, 0)
+    rows[0]["total"] = 1000  # consistent again -> the happy table
+    assert "== recorded total 1000" in format_explain(header, rows, 0)
+
+
+# ---------------------------------------------------------------------------
+# slow lane: cross-engine invariance, kill/resume, faults, openb goldens
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_decisions_engine_invariant():
+    """The same create/delete mix yields bit-identical decision records
+    (INVARIANT_FIELDS) on the flat, blocked, sequential, and shard_map
+    engines; the blocked path additionally records a valid winning block
+    id and the rest record -1 (the documented engine-specific slot).
+    slow-marked: compiles four engines incl. the shard top-K collective
+    merge; runs under `make resume-smoke` / plain pytest."""
+    from tpusim.parallel import make_mesh, pad_nodes, shard_state
+    from tpusim.parallel.shard_engine import make_shardmap_table_replay
+
+    rng = np.random.default_rng(7)
+    state, tp = random_cluster(rng, num_nodes=24)
+    pods = random_pods(rng, num_pods=40)
+    ev_kind, ev_pod = _mixed_events(40, rng)
+    policies = [(make_policy("FGDScore"), WEIGHTS[0]),
+                (make_policy("BestFitScore"), WEIGHTS[1])]
+    key = jax.random.PRNGKey(3)
+    rank = jnp.asarray(rng.permutation(24).astype(np.int32))
+    types = build_pod_types(pods)
+
+    flat = make_table_replay(policies, gpu_sel="FGDScore", block_size=-1,
+                             decisions=True)(
+        state, pods, types, ev_kind, ev_pod, tp, key, rank
+    )
+    blocked = make_table_replay(policies, gpu_sel="FGDScore", block_size=8,
+                                decisions=True)(
+        state, pods, types, ev_kind, ev_pod, tp, key, rank
+    )
+    seq = make_replay(policies, gpu_sel="FGDScore", report=False,
+                      decisions=True)(
+        state, pods, ev_kind, ev_pod, tp, key, rank
+    )
+    mesh = make_mesh(4)
+    st_p, rank_p = pad_nodes(state, rank, 4)
+    shard = make_shardmap_table_replay(policies, mesh, gpu_sel="FGDScore",
+                                       decisions=True)(
+        shard_state(st_p, mesh), pods, types, ev_kind, ev_pod, tp, key,
+        rank_p,
+    )
+
+    ref = flat.decisions
+    for out in (blocked, seq, shard):
+        assert np.array_equal(
+            np.asarray(out.placed_node), np.asarray(flat.placed_node)
+        )
+        _assert_records_equal(ref, out.decisions, INVARIANT_FIELDS)
+    # decision recording must not perturb the trajectory
+    base = make_table_replay(policies, gpu_sel="FGDScore", block_size=-1)(
+        state, pods, types, ev_kind, ev_pod, tp, key, rank
+    )
+    assert base.decisions is None
+    assert np.array_equal(
+        np.asarray(base.placed_node), np.asarray(flat.placed_node)
+    )
+    # block: valid on the blocked engine's placed creates, -1 on flat
+    node = np.asarray(ref.node)
+    placed = node >= 0
+    assert (np.asarray(blocked.decisions.block)[placed] >= 0).all()
+    assert (np.asarray(ref.block) == -1).all()
+
+
+@pytest.mark.slow
+def test_decisions_shard_blocked_local_invariant():
+    """The shard engine's BLOCKED local select path (none-normalize
+    config + block_size) records the same invariant fields as the flat
+    and single-device blocked engines — including with local pad columns
+    present (nloc not a multiple of B), whose synthetic global ids
+    overlap the next shard's range but are infeasible and must never
+    enter the top-K. slow-marked: compiles three engines incl. the
+    shard top-K collective."""
+    from tpusim.parallel import make_mesh, pad_nodes, shard_state
+    from tpusim.parallel.shard_engine import make_shardmap_table_replay
+
+    rng = np.random.default_rng(11)
+    state, tp = random_cluster(rng, num_nodes=28)  # nloc 7, bsz 4 -> pads
+    pods = random_pods(rng, num_pods=40)
+    ev_kind, ev_pod = _mixed_events(40, rng)
+    # both normalize == "none": the shard blocked-local gate
+    policies = [(make_policy("FGDScore"), WEIGHTS[0]),
+                (make_policy("GpuPackingScore"), WEIGHTS[1])]
+    key = jax.random.PRNGKey(5)
+    rank = jnp.asarray(rng.permutation(28).astype(np.int32))
+    types = build_pod_types(pods)
+
+    flat = make_table_replay(policies, gpu_sel="FGDScore", block_size=-1,
+                             decisions=True)(
+        state, pods, types, ev_kind, ev_pod, tp, key, rank
+    )
+    blocked = make_table_replay(policies, gpu_sel="FGDScore", block_size=4,
+                                decisions=True)(
+        state, pods, types, ev_kind, ev_pod, tp, key, rank
+    )
+    mesh = make_mesh(4)
+    st_p, rank_p = pad_nodes(state, rank, 4)
+    shard = make_shardmap_table_replay(
+        policies, mesh, gpu_sel="FGDScore", block_size=4, decisions=True
+    )(shard_state(st_p, mesh), pods, types, ev_kind, ev_pod, tp, key,
+      rank_p)
+
+    for out in (blocked, shard):
+        assert np.array_equal(
+            np.asarray(out.placed_node), np.asarray(flat.placed_node)
+        )
+        _assert_records_equal(flat.decisions, out.decisions,
+                              INVARIANT_FIELDS)
+    # both blocked selects name a winning block on placed creates; no
+    # top-K entry may name a node outside the real cluster (pad columns)
+    node = np.asarray(flat.decisions.node)
+    placed = node >= 0
+    assert placed.any()
+    for out in (blocked, shard):
+        assert (np.asarray(out.decisions.block)[placed] >= 0).all()
+        tkn = np.asarray(out.decisions.topk_node)
+        assert (tkn < 28).all() and (tkn >= -1).all()
+
+
+@pytest.mark.slow
+def test_decisions_survive_kill_resume(tmp_path):
+    """The decision stream rides the checkpoint beside event_node/
+    event_dev: a killed-and-resumed chunked run reproduces the
+    uninterrupted run's stream bit-identically (nothing double- or
+    under-recorded). slow-marked: compiles the chunked engine variants;
+    runs under `make resume-smoke` / plain pytest."""
+    import tpusim.io.storage as storage
+
+    nodes, pods = _driver_inputs()
+    _, r0 = _run_driver(nodes, pods)
+    d0 = jax.tree.map(np.asarray, r0.decisions)
+
+    # chunked-but-uninterrupted first: segmentation alone must be inert
+    _, r1 = _run_driver(nodes, pods, every=10, ckdir=str(tmp_path))
+    _assert_records_equal(d0, r1.decisions)
+
+    real_save = storage.save_checkpoint
+
+    def killing_save(*a, **k):
+        real_save(*a, **k)
+        raise KeyboardInterrupt("simulated preemption")
+
+    storage.save_checkpoint = killing_save
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            _run_driver(nodes, pods, every=10, ckdir=str(tmp_path))
+    finally:
+        storage.save_checkpoint = real_save
+    assert os.listdir(tmp_path)
+
+    sim, r2 = _run_driver(nodes, pods, every=10, ckdir=str(tmp_path))
+    assert any("[Checkpoint] resumed replay" in l for l in sim.log.lines)
+    _assert_records_equal(d0, r2.decisions)
+
+
+@pytest.mark.slow
+def test_decisions_fault_segment_continuity():
+    """Fault segmentation concatenates the per-segment streams: the
+    pre-fault prefix is bit-identical to an unfaulted run's, and the
+    whole stream is reproducible under the same fault schedule.
+    slow-marked with the other fault-suite compile costs; runs under
+    `make resume-smoke` / plain pytest."""
+    from tpusim.sim.engine import EV_NODE_FAIL
+    from tpusim.sim.faults import FaultEvent
+
+    nodes, pods = _driver_inputs()
+
+    def fault_run(faults):
+        sim = Simulator(nodes, SimulatorConfig(
+            policies=(("FGDScore", WEIGHTS[0]),
+                      ("BestFitScore", WEIGHTS[1])),
+            gpu_sel_method="FGDScore", report_per_event=False, seed=42,
+            record_decisions=True,
+        ))
+        sim.set_workload_pods(pods)
+        return sim.schedule_pods_with_faults(pods, faults=faults)
+
+    base = fault_run([])
+    faulted = fault_run([FaultEvent(pos=10, kind=EV_NODE_FAIL, node=0)])
+    faulted2 = fault_run([FaultEvent(pos=10, kind=EV_NODE_FAIL, node=0)])
+    assert base.decisions is not None and faulted.decisions is not None
+    # continuity: the stream before the fault is the unfaulted stream
+    for f in INVARIANT_FIELDS:
+        assert np.array_equal(
+            np.asarray(getattr(faulted.decisions.records, f))[:10],
+            np.asarray(getattr(base.decisions.records, f))[:10],
+        ), f
+    # determinism: same schedule, same stream — retries included
+    _assert_records_equal(faulted.decisions.records,
+                          faulted2.decisions.records)
+    assert np.asarray(faulted.decisions.ev_kind).shape[0] >= len(pods)
+
+
+@pytest.mark.slow
+def test_explain_diff_golden_openb(tmp_path):
+    """The acceptance criterion on real trace data: FGD vs BestFit over
+    an openb prefix yields a DETERMINISTIC first-divergence event from
+    `tpusim diff`, and `tpusim explain` at that event shows a per-policy
+    table whose weighted sum equals the recorded winner total. Golden:
+    two same-seed runs produce byte-identical decision files and
+    byte-identical explain/diff text."""
+    from tpusim.io.trace import load_node_csv, load_pod_csv
+
+    node_csv = os.path.join(REPO, "data/csv/openb_node_list_gpu_node.csv")
+    pod_csv = os.path.join(REPO, "data/csv/openb_pod_list_default.csv")
+    if not (os.path.isfile(node_csv) and os.path.isfile(pod_csv)):
+        pytest.skip("openb traces not present")
+    nodes = load_node_csv(node_csv)[:200]
+    pods = load_pod_csv(pod_csv)[:120]
+
+    def run(policy, gpu_sel, tag):
+        sim = Simulator(nodes, SimulatorConfig(
+            policies=((policy, 1000),), gpu_sel_method=gpu_sel,
+            report_per_event=False, record_decisions=True, seed=42,
+        ))
+        sim.set_workload_pods(pods)
+        res = sim.run()
+        path = str(tmp_path / f"{tag}.jsonl")
+        write_decisions(
+            path, res.decisions, policies=list(sim.cfg.policies),
+            meta=sim._telemetry_meta(), pod_names=[p.name for p in res.pods],
+        )
+        return path
+
+    pa = run("FGDScore", "FGDScore", "fgd")
+    pb = run("BestFitScore", "best", "bestfit")
+    pa2 = run("FGDScore", "FGDScore", "fgd2")
+    # golden: same-seed reruns are byte-identical files
+    assert open(pa).read() == open(pa2).read()
+
+    ha, ra = read_decisions(pa)
+    hb, rb = read_decisions(pb)
+    first = first_divergence(ra, rb)
+    assert first is not None  # FGD and BestFit DO place differently
+    # deterministic: recomputing from the re-run file finds the same event
+    assert first_divergence(read_decisions(pa2)[1], rb)["event"] == \
+        first["event"]
+
+    ev = first["event"]
+    text = format_explain(ha, ra, ev)
+    r = ra[ev]
+    contrib = sum(w * n for (_, w), n in zip(ha["policies"], r["norm"]))
+    assert contrib == r["total"]
+    assert f"== recorded total {r['total']}" in text
+    text2 = format_explain(ha, read_decisions(pa2)[1], ev)
+    assert text == text2
+    dtext = format_diff(ha, ra, hb, rb, "A", "B")
+    assert f"first divergence at event {ev}" in dtext
+    hist = divergence_histogram(ra, rb)
+    assert hist["diverged"] > 0 and sum(hist["counts"]) == hist["diverged"]
+
+    # the CLI verbs drive the same surfaces (exit codes: diff(1) style)
+    from tpusim.cli import main as cli_main
+
+    assert cli_main(["explain", pa, "--event", str(ev)]) == 0
+    assert cli_main(["diff", pa, pb]) == 1
+    assert cli_main(["diff", pa, pa2]) == 0
+
+
+def test_apply_decisions_out_and_explain(tmp_path):
+    """`tpusim apply --decisions-out` writes the run's decision JSONL and
+    `tpusim explain` reads it back — the full CLI loop on a 2-pod
+    cluster (sequential engine: the small-batch path records too)."""
+    import io
+
+    import yaml
+
+    from tpusim.apply import Applier, ApplyOptions
+
+    cluster = tmp_path / "cluster"
+    (cluster / "node").mkdir(parents=True)
+    (cluster / "pod").mkdir(parents=True)
+    (tmp_path / "cc.yaml").write_text(
+        "apiVersion: simon/v1alpha1\nkind: Config\n"
+        "metadata:\n  name: dec\n"
+        f"spec:\n  cluster:\n    customConfig: {cluster}\n"
+    )
+    (cluster / "node" / "n0.yaml").write_text(yaml.dump({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "n0", "labels": {
+            "alibabacloud.com/gpu-card-model": "V100M16"}},
+        "status": {"allocatable": {
+            "cpu": "64", "memory": "256Gi",
+            "alibabacloud.com/gpu-count": "8"}},
+    }))
+    for i in range(2):
+        (cluster / "pod" / f"p{i}.yaml").write_text(yaml.dump({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"gpu-pod-{i}", "annotations": {
+                "alibabacloud.com/gpu-count": "1",
+                "alibabacloud.com/gpu-milli": "500",
+                "alibabacloud.com/gpu-card-model": "V100M16"}},
+            "spec": {"containers": [
+                {"resources": {"requests": {"cpu": "4"}}}]},
+        }))
+    dec_path = str(tmp_path / "run_decisions.jsonl")
+    out = io.StringIO()
+    Applier(ApplyOptions(
+        simon_config=str(tmp_path / "cc.yaml"), decisions_out=dec_path,
+    )).run(out=out)
+    assert f"[obs] wrote {dec_path}" in out.getvalue()
+    header, rows = read_decisions(dec_path)
+    assert len(rows) == 2 and rows[0]["node"] == 0
+    assert rows[0]["name"] == "gpu-pod-0"
+
+    from tpusim.cli import main as cli_main
+
+    assert cli_main(["explain", dec_path, "--event", "0"]) == 0
+
+
+def test_engine_guards():
+    """Unsupported combinations fail loudly at construction: pallas has
+    no provenance surface; extenders splice scores the recorder cannot
+    see; the batched sweep has no per-seed surface."""
+    nodes, pods = _driver_inputs()
+    with pytest.raises(ValueError, match="pallas"):
+        Simulator(nodes, SimulatorConfig(
+            policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+            engine="pallas", record_decisions=True,
+        ))
+    from tpusim.sim.extender import ExtenderConfig
+
+    with pytest.raises(ValueError, match="extenders"):
+        Simulator(nodes, SimulatorConfig(
+            policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+            record_decisions=True,
+            extenders=(ExtenderConfig(url_prefix="http://x"),),
+        ))
+    from tpusim.sim.driver import dispatch_pods_batch
+
+    sim = Simulator(nodes, SimulatorConfig(
+        policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+        record_decisions=True,
+    ))
+    sim.set_workload_pods(pods)
+    with pytest.raises(ValueError, match="record decisions"):
+        dispatch_pods_batch([sim], [pods])

@@ -370,6 +370,143 @@ def test_gate_parse_and_compare(tmp_path):
     assert not ok  # same-backend 60% regression fails
 
 
+def test_prometheus_type_declared_once_per_metric(tmp_path):
+    """Strict promtext parsers reject duplicate `# TYPE` declarations:
+    two samples of one metric name — labeled span series, or two record
+    keys sanitizing to the same name — must share ONE declaration."""
+    from tpusim.obs import Recorder, emitters
+
+    rec = Recorder(enabled=True)
+    # two spans of the same name -> labeled samples under one metric
+    for _ in range(2):
+        with rec.span("scan", engine="table") as h:
+            h.dispatched()
+    # two count keys that sanitize to the SAME metric name
+    rec.count("cache hit")
+    rec.count("cache_hit", 2)
+    record = rec.snapshot(meta={}).to_record()
+    lines = emitters.prometheus_lines(record)
+    types = [l.split()[2] for l in lines if l.startswith("# TYPE ")]
+    assert len(types) == len(set(types)), types
+    # ... and one SAMPLE per (name, labelset): the colliding count keys
+    # collapse to a single line instead of an invalid duplicate pair
+    samples = [l for l in lines if not l.startswith("#")]
+    keys = [l.rsplit(" ", 1)[0] for l in samples]
+    assert len(keys) == len(set(keys)), keys
+    assert sum(k == "tpusim_count_cache_hit" for k in keys) == 1
+    # the span series still carries both labeled samples
+    span_samples = [
+        l for l in lines if l.startswith("tpusim_span_seconds_total{")
+    ]
+    assert len(span_samples) >= 2
+
+
+def test_heartbeat_final_tick():
+    """complete() always emits one 100% line (total wall + mean ev/s)
+    even when the run finished inside the rate limit, then disarms —
+    repeated calls and unarmed calls are no-ops."""
+    from tpusim.obs import heartbeat
+
+    lines = []
+    heartbeat.configure(40, "scan", sink=lines.append)
+    # run finished before any periodic tick fired
+    heartbeat.complete()
+    assert len(lines) == 1
+    assert "40/40" in lines[0] and "ev/s mean" in lines[0]
+    assert heartbeat.tick_count() == 1
+    heartbeat.complete()  # disarmed: no second line
+    assert len(lines) == 1
+    # armed with a bucket-PADDED size, completed with the true count:
+    # the final line reports the pre-padding total
+    heartbeat.configure(512, "scan", sink=lines.append)
+    heartbeat.complete(40)
+    assert len(lines) == 2 and "40/40" in lines[1]
+
+
+@pytest.mark.slow
+def test_heartbeat_final_tick_from_driver(monkeypatch):
+    """A heartbeat-configured driver replay always fires complete() with
+    the heartbeat still armed — i.e. a run too short for any periodic
+    tick (rate limit / large `every`) still reports its final line.
+    slow-marked (tier-1 budget): heartbeat_every is part of the engine
+    cache key, so this pays a fresh engine compile; the complete() host
+    logic itself is tier-1-covered by test_heartbeat_final_tick."""
+    from tpusim.obs import heartbeat
+
+    calls = []
+    real_complete = heartbeat.complete
+
+    def spy(true_total=0):
+        calls.append(heartbeat._STATE["total"])  # armed total at fire time
+        calls.append(true_total)  # the driver's PRE-padding event count
+        lines = []
+        heartbeat._STATE["sink"] = lines.append
+        real_complete(true_total)
+        calls.append(lines[0] if lines else None)
+
+    monkeypatch.setattr(heartbeat, "complete", spy)
+    nodes, pods = _driver_inputs()
+    sim = Simulator(nodes, SimulatorConfig(
+        policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+        report_per_event=False, heartbeat_every=10_000,
+    ))
+    sim.set_workload_pods(pods)
+    sim.set_typical_pods()
+    specs = pods_to_specs(pods)
+    out = sim.run_events(
+        sim.init_state, specs, jnp.zeros(len(pods), jnp.int32),
+        jnp.arange(len(pods), dtype=jnp.int32), jax.random.PRNGKey(2),
+    )
+    assert out.placed_node.shape[0] == len(pods)
+    armed_total, true_total, line = calls
+    assert armed_total > 0  # still armed: no periodic tick had disarmed it
+    # the final line reports the PRE-padding count, not the padded
+    # stream size the heartbeat was armed with
+    assert true_total == len(pods) and armed_total >= true_total
+    assert f"{true_total}/{true_total}" in line and "ev/s mean" in line
+
+
+def test_chrome_counter_tracks(tmp_path):
+    """write_chrome_trace emits `"ph": "C"` counter events for per-event
+    series, laid across the scan spans' wall window, dense series
+    strided down but always charting the final value."""
+    import json as _json
+
+    from tpusim.obs import Recorder, emitters
+
+    rec = Recorder(enabled=True)
+    with rec.span("typical_pods") as h:
+        h.dispatched()
+    with rec.span("scan", engine="table") as h:
+        h.dispatched()
+    tel = rec.snapshot(meta={})
+    series = {
+        "frag_gpu_milli": [float(i) for i in range(5000)],
+        "used_gpu_milli": [1, 2, 3],
+    }
+    path = str(tmp_path / "trace.json")
+    emitters.write_chrome_trace(path, tel.spans, series)
+    data = _json.loads(open(path).read())
+    counters = [e for e in data["traceEvents"] if e["ph"] == "C"]
+    assert counters, "no counter events"
+    frag = [e for e in counters if e["name"] == "frag_gpu_milli"]
+    assert 0 < len(frag) <= emitters.MAX_COUNTER_POINTS + 1
+    assert frag[-1]["args"]["frag_gpu_milli"] == 4999.0  # final value kept
+    used = [e for e in counters if e["name"] == "used_gpu_milli"]
+    assert [e["args"]["used_gpu_milli"] for e in used] == [1, 2, 3]
+    # counter tracks sit inside the span window
+    xs = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    t_lo = min(e["ts"] for e in xs)
+    t_hi = max(e["ts"] + e["dur"] for e in xs)
+    assert all(t_lo <= e["ts"] <= t_hi + 1 for e in counters)
+    # emit_all threads the series through
+    paths = emitters.emit_all(
+        tel, trace=str(tmp_path / "t2.json"), counter_series=series
+    )
+    data2 = _json.loads(open(paths[0]).read())
+    assert any(e["ph"] == "C" for e in data2["traceEvents"])
+
+
 def test_bench_measure_protocol():
     """obs.bench.measure: one cold + N warm calls, min over warm."""
     from tpusim.obs import bench

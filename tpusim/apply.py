@@ -65,6 +65,11 @@ class ApplyOptions:
     metrics_out: str = ""  # Prometheus textfile (atomic rewrite)
     trace_out: str = ""  # Chrome-trace timeline
     heartbeat_every: int = 0  # in-scan progress ticks (0 = off)
+    # decision-provenance flight recorder (ISSUE 4; README "Explain a
+    # placement"): a non-empty path turns record_decisions on and writes
+    # the run's decision JSONL there — the input of `tpusim explain` /
+    # `tpusim diff`.
+    decisions_out: str = ""
 
 
 class Applier:
@@ -109,6 +114,7 @@ class Applier:
                 or self.options.trace_out
             ),
             heartbeat_every=self.options.heartbeat_every,
+            record_decisions=bool(self.options.decisions_out),
         )
 
     def _fault_config(self):
@@ -225,6 +231,7 @@ class Applier:
         result = sim.last_result
         sim.finish()
         self._emit_telemetry(sim, out)
+        self._emit_decisions(sim, out)
         self._verdict(result, out)
         if self.options.report_tables:
             from tpusim.sim.report_tables import full_report
@@ -256,9 +263,37 @@ class Applier:
             jsonl=o.profile_out,
             metrics=o.metrics_out,
             trace=o.trace_out,
+            # only the Chrome-trace emitter consumes the counter series;
+            # building it walks every per-event report row (O(E))
+            counter_series=(
+                sim.event_counter_series() if o.trace_out else None
+            ),
         )
         for p in paths:
             print(f"[obs] wrote {p}", file=out)
+
+    def _emit_decisions(self, sim: Simulator, out):
+        """Persist the run's decision-provenance stream (--decisions-out)
+        — the `tpusim explain` / `tpusim diff` input (ISSUE 4)."""
+        path = self.options.decisions_out
+        if not path:
+            return
+        from tpusim.obs import decisions as obs_decisions
+
+        res = sim.last_result
+        if res.decisions is None:
+            print(
+                "[obs] no decision stream recorded (engine without "
+                "provenance support?)", file=out,
+            )
+            return
+        written = obs_decisions.write_decisions(
+            path, res.decisions,
+            policies=list(sim.cfg.policies),
+            meta=sim._telemetry_meta(),
+            pod_names=[p.name for p in res.pods],
+        )
+        print(f"[obs] wrote {written}", file=out)
 
     def _export_snapshots(self, sim: Simulator, tag: str):
         exp = self.cr.custom_config.export

@@ -1,8 +1,13 @@
 """`tpusim` command-line interface (ref: cmd/, the cobra `simon` tree).
 
-Subcommands mirror the reference binary:
+Subcommands mirror the reference binary, plus the decision-provenance
+verbs (ISSUE 4):
   apply    run a simulation from a Simon-CR cluster config
            (ref: cmd/apply/apply.go:14-40)
+  explain  why a node won one scheduling decision: per-policy score
+           table + runner-ups, from a `--decisions-out` JSONL
+  diff     first-divergence finder + divergence histogram between two
+           decision JSONLs (e.g. FGD vs BestFit over the same trace)
   version  print version/commit (ref: cmd/version/version.go)
   gen-doc  emit markdown docs for the CLI tree (ref: cmd/doc/)
   debug    scaffold, intentionally empty (ref: cmd/debug/debug.go)
@@ -14,6 +19,7 @@ cmd/simon/simon.go:52-72.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -114,6 +120,35 @@ def _build_parser() -> argparse.ArgumentParser:
         help="emit an in-scan progress line (events/s, ETA) every N "
         "processed events of long table-engine scans (0 = off)",
     )
+    p_apply.add_argument(
+        "--decisions-out", default="", metavar="PATH",
+        help="record per-event decision provenance (winner, per-policy "
+        "score contributions, top-K runner-ups) and write it as JSONL — "
+        "the input of `tpusim explain` / `tpusim diff`",
+    )
+
+    p_explain = sub.add_parser(
+        "explain",
+        help="why a node won one scheduling decision (per-policy score "
+        "table from a --decisions-out JSONL)",
+    )
+    p_explain.add_argument("decisions", help="decision JSONL file")
+    p_explain.add_argument(
+        "-e", "--event", type=int, required=True,
+        help="event index to explain",
+    )
+
+    p_diff = sub.add_parser(
+        "diff",
+        help="first-divergence finder + divergence histogram between two "
+        "decision JSONLs (two runs/policies over the same trace)",
+    )
+    p_diff.add_argument("run_a", help="decision JSONL of run A")
+    p_diff.add_argument("run_b", help="decision JSONL of run B")
+    p_diff.add_argument(
+        "--buckets", type=int, default=10,
+        help="event-range buckets of the divergence histogram",
+    )
 
     sub.add_parser("version", help="print version")
 
@@ -148,9 +183,47 @@ def cmd_apply(args) -> int:
         metrics_out=args.metrics_out,
         trace_out=args.trace_out,
         heartbeat_every=args.heartbeat_every,
+        decisions_out=args.decisions_out,
     )
     Applier(opts).run()
     return 0
+
+
+def cmd_explain(args) -> int:
+    from tpusim.obs import decisions as obs_decisions
+
+    # diff(1)-style exit codes: 0 ok, 2 on unusable input (missing /
+    # torn / digest-mismatched file, event out of range) — a one-line
+    # error, not a traceback
+    try:
+        header, rows = obs_decisions.read_decisions(args.decisions)
+        print(obs_decisions.format_explain(header, rows, args.event))
+    except (OSError, ValueError, json.JSONDecodeError) as err:
+        print(f"tpusim explain: {err}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def cmd_diff(args) -> int:
+    from tpusim.obs import decisions as obs_decisions
+
+    try:
+        ha, ra = obs_decisions.read_decisions(args.run_a)
+        hb, rb = obs_decisions.read_decisions(args.run_b)
+        # run_diff also rejects files from DIFFERENT traces (per-row
+        # kind/pod mismatch) — a ValueError, not a bogus divergence
+        d = obs_decisions.run_diff(
+            ha, ra, hb, rb,
+            label_a=os.path.basename(args.run_a),
+            label_b=os.path.basename(args.run_b),
+            buckets=args.buckets,
+        )
+    except (OSError, ValueError, json.JSONDecodeError) as err:
+        print(f"tpusim diff: {err}", file=sys.stderr)
+        return 2
+    print(d["text"])
+    # like diff(1): exit 0 on identical placements, 1 on divergence
+    return 1 if d["first"] else 0
 
 
 def cmd_gen_doc(parser: argparse.ArgumentParser, args) -> int:
@@ -167,6 +240,10 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.command == "apply":
         return cmd_apply(args)
+    if args.command == "explain":
+        return cmd_explain(args)
+    if args.command == "diff":
+        return cmd_diff(args)
     if args.command == "version":
         print(f"tpusim version {VERSION} (commit {COMMIT})")
         return 0

@@ -189,17 +189,23 @@ def cluster_vg_totals(storages: Sequence[Optional[NodeStorage]]) -> Tuple[int, i
 #
 # A checkpoint is the engine's complete scan carry (table_engine.Flat/
 # BlockedTableCarry, or the shard engine's gathered snapshot) plus the
-# telemetry accumulated so far, written after every completed segment of a
+# telemetry accumulated so far — including, on decision-recording runs
+# (ISSUE 4), the per-event DecisionRecord stream as `dec_<field>` arrays
+# beside event_node/event_dev, so a resumed run's provenance is continuous —
+# written after every completed segment of a
 # chunked replay (driver.SimulatorConfig.checkpoint_every). Files are
 # content-addressed like the Bellman series cache (driver._bellman_cache_path):
 # the name is the sha256 of everything that determines the run — a source-code
 # version salt, the initial state, the pod specs, the event stream, the PRNG
-# key, the tie-break rank, and a config string — so a resumed process can only
+# key, the tie-break rank, and a config string (record_decisions included:
+# the two layouts must never mix) — so a resumed process can only
 # ever pick up a checkpoint of the *identical* run, and any code or input
 # change silently starts fresh instead of resuming into divergence. All carry
 # leaves are exact dtypes (i32/bool/u32), so a save/load round-trip is
 # bit-transparent and resume reproduces the uninterrupted scan exactly
-# (pinned by tests/test_checkpoint.py).
+# (pinned by tests/test_checkpoint.py). The same checkpoint_digest helper
+# also signs the decision JSONL payload (obs.decisions.write_decisions),
+# so torn/edited provenance files fail loudly on read.
 
 CHECKPOINT_SUFFIX = ".ckpt.npz"
 

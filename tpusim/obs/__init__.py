@@ -4,10 +4,15 @@ The observability plane the replay engines report through (ISSUE 3):
 
   counters   exact in-scan event counters riding the engines' lax.scan
              carries — bit-reproducible, checkpoint/fault-transparent
+  decisions  per-event decision provenance (ISSUE 4): winner, per-policy
+             score contributions, top-K runner-ups, tie-break ranks —
+             engine-invariant, JSONL-persisted, behind `tpusim
+             explain`/`diff`
   spans      phase timers with a dispatch(compile)/block(execute) wall
              split; Recorder/RunTelemetry accumulate them per run
   heartbeat  jax.debug.callback progress ticks from inside long scans
   emitters   JSONL run records, Prometheus textfiles, Chrome traces
+             (incl. frag/alloc counter tracks)
   bench      the shared cold+warm-minimum timing protocol + JSON writer
              the bench scripts build on
   gate       `python -m tpusim.obs.gate` — smoke profile diffed against
@@ -25,6 +30,12 @@ from tpusim.obs.counters import (  # noqa: F401
     counters_from_telemetry,
     counters_to_dict,
     zero_counters,
+)
+from tpusim.obs.decisions import (  # noqa: F401
+    DECISION_SCHEMA,
+    DECISION_TOPK,
+    DecisionLog,
+    DecisionRecord,
 )
 from tpusim.obs.spans import (  # noqa: F401
     SCHEMA,

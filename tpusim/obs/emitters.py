@@ -10,7 +10,9 @@ Three consumers, three formats:
            textfile collector contract so a scraper never sees a torn
            file
   Chrome   chrome://tracing / Perfetto "X" (complete) events from the
-           span list (`--trace-out PATH`) — the phase timeline view
+           span list (`--trace-out PATH`) — the phase timeline view —
+           plus "C" counter tracks (per-event frag/alloc series from the
+           metrics postpass) charting fragmentation under the spans
 
 All writers are atomic (tmp + os.replace) except the JSONL append, whose
 unit of atomicity is the single O_APPEND write of one line.
@@ -62,12 +64,29 @@ def _metric_name(*parts: str) -> str:
 def prometheus_lines(record: dict, prefix: str = "tpusim") -> List[str]:
     """Flatten a run record into `# TYPE ... gauge` + sample lines. Only
     the numeric leaves ship; span walls become
-    `tpusim_span_seconds{name="...",phase="dispatch|block"}`."""
+    `tpusim_span_seconds{name="...",phase="dispatch|block"}`.
+
+    Each `# TYPE` declaration is emitted ONCE per metric name: two
+    samples of the same metric (different labels, or two record keys
+    sanitizing to the same name) must share one declaration — strict
+    promtext parsers (and node_exporter's textfile collector) reject a
+    file with duplicate TYPE lines for a metric. The same strictness
+    applies to SAMPLES: only one line per (name, labelset) is legal, so
+    when two record keys sanitize to one collision-free name the first
+    (sorted-order) writer wins and later duplicates are dropped — an
+    invalid file would lose the whole snapshot, not just one sample."""
     det = record.get("deterministic", {})
     lines: List[str] = []
+    typed: set = set()
+    emitted: set = set()
 
     def gauge(name: str, value, labels: str = ""):
-        lines.append(f"# TYPE {name} gauge")
+        if (name, labels) in emitted:
+            return
+        emitted.add((name, labels))
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name}{labels} {value}")
 
     gauge(_metric_name(prefix, "events_total"), det.get("events", 0))
@@ -94,15 +113,14 @@ def prometheus_lines(record: dict, prefix: str = "tpusim") -> List[str]:
             agg[key] = agg.get(key, 0.0) + float(s.get(f"{phase}_s", 0))
     if agg:
         span_metric = _metric_name(prefix, "span_seconds_total")
-        lines.append(f"# TYPE {span_metric} gauge")
         for (name, phase), v in sorted(agg.items()):
-            lines.append(
-                f'{span_metric}{{name="{name}",phase="{phase}"}} {round(v, 6)}'
+            gauge(
+                span_metric, round(v, 6),
+                f'{{name="{name}",phase="{phase}"}}',
             )
         count_metric = _metric_name(prefix, "span_count")
-        lines.append(f"# TYPE {count_metric} gauge")
         for name, n in sorted(counts.items()):
-            lines.append(f'{count_metric}{{name="{name}"}} {n}')
+            gauge(count_metric, n, f'{{name="{name}"}}')
     return lines
 
 
@@ -137,19 +155,71 @@ def chrome_trace_events(spans: Iterable, pid: int = 1) -> List[dict]:
     return events
 
 
-def write_chrome_trace(path: str, spans: Iterable) -> str:
+# counter tracks denser than this are strided down — Perfetto renders a
+# multi-thousand-point counter no better, and the trace file stays small
+MAX_COUNTER_POINTS = 2000
+
+
+def chrome_counter_events(
+    counter_series: dict, spans: Iterable, pid: int = 1,
+    max_points: int = MAX_COUNTER_POINTS,
+) -> List[dict]:
+    """Per-event series -> Chrome counter-track events (`"ph": "C"`), so
+    the timeline shows fragmentation/allocation evolving UNDER the phase
+    spans. `counter_series` maps track name -> one value per event (the
+    frag/alloc series the metrics postpass already computes,
+    sim/metrics.compute_event_metrics). Events carry no wall timestamps
+    — the scan spans do — so the E points are laid out linearly across
+    the union of the `scan` spans' wall window (falling back to the full
+    span window), which is exactly the stretch of the timeline the
+    events executed in."""
+    spans = list(spans)
+    dicts = [s.to_dict() if hasattr(s, "to_dict") else dict(s) for s in spans]
+    windows = [d for d in dicts if d.get("name") == "scan"] or dicts
+    if windows:
+        t0 = min(d["start_s"] for d in windows) * 1e6
+        t1 = max(d["start_s"] + d.get("total_s", 0) for d in windows) * 1e6
+    else:
+        t0, t1 = 0.0, 1e6
+    events: List[dict] = []
+    for track, values in sorted(counter_series.items()):
+        values = list(values)
+        n = len(values)
+        if not n:
+            continue
+        stride = max(1, -(-n // max_points))
+        idx = list(range(0, n, stride))
+        if idx[-1] != n - 1:
+            idx.append(n - 1)  # always chart the final value
+        span_us = max(t1 - t0, 1.0)
+        for i in idx:
+            ts = t0 + span_us * (i / max(n - 1, 1))
+            events.append({
+                "pid": pid, "tid": 0, "ph": "C", "cat": "tpusim",
+                "name": track, "ts": ts, "args": {track: values[i]},
+            })
+    return events
+
+
+def write_chrome_trace(path: str, spans: Iterable,
+                       counter_series: dict = None) -> str:
+    spans = list(spans)
+    events = chrome_trace_events(spans)
+    if counter_series:
+        events.extend(chrome_counter_events(counter_series, spans))
     _atomic_write(
         path,
-        json.dumps({"traceEvents": chrome_trace_events(spans),
-                    "displayTimeUnit": "ms"}),
+        json.dumps({"traceEvents": events, "displayTimeUnit": "ms"}),
     )
     return path
 
 
 def emit_all(telemetry, jsonl: str = "", metrics: str = "", trace: str = "",
-             meta: dict = None) -> List[str]:
+             meta: dict = None, counter_series: dict = None) -> List[str]:
     """Write every requested emitter output for one RunTelemetry; returns
-    the paths written."""
+    the paths written. `counter_series` (track name -> per-event values,
+    e.g. Simulator.event_counter_series()) adds counter tracks to the
+    Chrome trace."""
     record = telemetry.to_record()
     if meta:
         record["deterministic"]["meta"].update(meta)
@@ -159,5 +229,6 @@ def emit_all(telemetry, jsonl: str = "", metrics: str = "", trace: str = "",
     if metrics:
         written.append(write_prometheus(metrics, record))
     if trace:
-        written.append(write_chrome_trace(trace, telemetry.spans))
+        written.append(write_chrome_trace(trace, telemetry.spans,
+                                          counter_series))
     return written

@@ -23,6 +23,11 @@ back. The gate closes that loop:
 Placements are backend-independent by the engine-equality contracts
 (ENGINES.md; the f32 divergence channel is report-only), so the
 quality half of the gate is exact everywhere.
+
+The gate also smoke-checks the decision-provenance surface (ISSUE 4):
+a small decision-recording replay writes its decision JSONL under
+--out and the digest-verified read-back must round-trip exactly —
+`tpusim explain`/`diff` depend on that file format.
 """
 
 from __future__ import annotations
@@ -124,6 +129,47 @@ def compare(base: dict, cur: dict, tol: float, alloc_tol: float
     return ok, msgs
 
 
+def decisions_roundtrip(nodes, pods, out_dir: str) -> Tuple[bool, str]:
+    """ISSUE 4 satellite: run a small decision-recording replay (openb
+    prefix of the bench trace), write its decision JSONL, read it back
+    through the digest-verified loader, and require the rows to
+    round-trip exactly. A failure here means the provenance surface the
+    explain/diff verbs depend on is broken — gate-worthy, so ANY
+    exception on the record/write/read path becomes a FAIL verdict (the
+    exit-1-with-messages contract of main()), not a traceback that also
+    skips the baseline compare."""
+    from tpusim.obs import decisions as obs_decisions
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+
+    try:
+        sim = Simulator(nodes[:200], SimulatorConfig(
+            policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+            report_per_event=False, record_decisions=True, seed=42,
+        ))
+        sim.set_workload_pods(pods[:120])
+        res = sim.run()
+        if res.decisions is None:
+            return False, "[gate] decisions: no stream recorded (FAIL)"
+        names = [p.name for p in res.pods]
+        path = os.path.join(out_dir, "gate_decisions.jsonl")
+        obs_decisions.write_decisions(
+            path, res.decisions, policies=list(sim.cfg.policies),
+            meta=sim._telemetry_meta(), pod_names=names,
+        )
+        header, rows = obs_decisions.read_decisions(path)
+    except Exception as err:
+        return False, f"[gate] decisions: FAIL ({type(err).__name__}: {err})"
+    expect = obs_decisions.decision_rows(res.decisions, names)
+    if rows != expect:
+        return False, (
+            f"[gate] decisions: JSONL round-trip MISMATCH ({path})"
+        )
+    return True, (
+        f"[gate] decisions: JSONL round-trip ok — {path} "
+        f"({len(rows)} events, digest {header['digest'][:12]}…)"
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -180,12 +226,20 @@ def main(argv=None) -> int:
         )
         print(f"[gate] smoke profile: {', '.join(paths)}")
 
+    # decision-provenance smoke: the JSONL the explain/diff verbs consume
+    # must round-trip (ISSUE 4 satellite) — checked regardless of
+    # whether a throughput baseline exists
+    dec_ok, dec_msg = decisions_roundtrip(nodes, pods, args.out)
+    print(dec_msg)
+
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "
-              "profile recorded, nothing to diff (PASS)")
-        return 0
+              "profile recorded, nothing to diff "
+              f"({'PASS' if dec_ok else 'FAIL'})")
+        return 0 if dec_ok else 1
 
     ok, msgs = compare(base, cur, args.tol, args.alloc_tol)
+    ok = ok and dec_ok
     print(f"[gate] baseline {os.path.basename(base['path'])} "
           f"(round {base['n']}, backend {base['backend']!r}):")
     print("\n".join(msgs))

@@ -11,7 +11,9 @@ The device side only ships the processed-event count; everything rate-
 or time-shaped lives here on the host, so the heartbeat cannot perturb
 the replay trajectory (pure side output). Ticks are rate-limited to one
 line per MIN_INTERVAL_S of wall time — a warm small run stays silent-ish
-no matter how small `every` is.
+no matter how small `every` is — but the driver always fires a final
+100% tick (complete(): total wall + mean ev/s) when the scan's result
+lands, so even a run that finished inside the rate limit reports once.
 """
 
 from __future__ import annotations
@@ -72,6 +74,38 @@ def tick(done):
 def tick_count() -> int:
     """Ticks received since the last configure() (test hook)."""
     return _STATE["ticks"]
+
+
+def complete(true_total: int = 0):
+    """Final 100% tick, emitted by the driver when the scan's result is
+    ready: total wall and MEAN events/s over the whole scan, bypassing
+    the rate limit — so a short run that finished inside MIN_INTERVAL_S
+    (and therefore never printed a periodic tick) still reports one
+    line. `true_total` is the PRE-padding event count: the heartbeat is
+    armed with the bucket-padded stream size (what the scan body can
+    count), but the pad EV_SKIPs are near-free, so reporting them would
+    overstate both the total and the mean ev/s of a small run. Disarms
+    the heartbeat afterwards; a second call (or a call with nothing
+    armed) is a no-op."""
+    total = _STATE["total"]
+    if not total:
+        return
+    if true_total:
+        total = min(total, int(true_total))
+    now = time.perf_counter()
+    dt = max(now - _STATE["t0"], 1e-9)
+    line = (
+        f"[obs] {_STATE['label']}: {total}/{total} events done in "
+        f"{dt:,.1f}s ({total / dt:,.0f} ev/s mean)"
+    )
+    _STATE["ticks"] += 1
+    _STATE["last_emit"] = now
+    _STATE["total"] = 0  # disarm
+    sink = _STATE["sink"]
+    if sink is not None:
+        sink(line)
+    else:
+        print(line, file=sys.stderr, flush=True)
 
 
 def emit_from_scan(processed, every: int):

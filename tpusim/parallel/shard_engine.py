@@ -45,9 +45,15 @@ from jax.sharding import PartitionSpec as P
 
 from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
 from tpusim.obs.counters import counter_delta, zero_counters
+from tpusim.obs.decisions import DECISION_TOPK, DecisionRecord, no_decision
 from tpusim.policies.base import feasible_min_max, minmax_scale_i32
 from tpusim.sim.engine import ReplayResult
-from tpusim.sim.step import block_reduce, choose_devices, packed_argmax
+from tpusim.sim.step import (
+    block_reduce,
+    choose_devices,
+    packed_argmax,
+    packed_topk,
+)
 from tpusim.sim.table_engine import (
     PodTypes,
     _pad_rank,
@@ -93,7 +99,8 @@ class ShardTableCarry(NamedTuple):
 
 
 def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
-                               report: bool = False, block_size: int = 0):
+                               report: bool = False, block_size: int = 0,
+                               decisions: bool = False):
     """Build the explicit-collective sharded replayer. The node count must
     already be padded to a multiple of the mesh size (parallel.pad_nodes)
     and `state`/`tiebreak_rank` sharded over it (parallel.shard_state).
@@ -111,7 +118,20 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     8-lane mask) and is unchanged — the block maxima shrink what each
     device reduces before contributing its scalar. Normalized policies
     (minmax/pwr need global extrema collectives per event) keep the flat
-    local path regardless of block_size."""
+    local path regardless of block_size.
+
+    decisions=True (ISSUE 4) additionally emits the per-event
+    DecisionRecord stream. The top-K summaries CROSS the collective: each
+    shard reduces its local score rows to its top-DECISION_TOPK
+    (total, rank, global node id) candidates, an all_gather collects the
+    D×K summaries, and the replicated merge reruns the SAME packed-key
+    top-K over them — exact because the global k-th best always lies
+    within its own shard's local top-K, and the (max total, min rank)
+    combine is the one every engine selects with. The winner's
+    per-policy raw/normalized columns and the feasible count cross as
+    owner-masked psums. Per-event collective payload grows by
+    3×DECISION_TOPK i32 lanes + (2×num_policies + 1) scalars — still
+    independent of N and D."""
     if report:
         raise ValueError(
             "the shard_map engine replays metric-free; build the report "
@@ -290,14 +310,31 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                         rank_l,
                     )
                     am_l = jnp.where(pinned, pin_c, am_l)
+                    if decisions:
+                        # full local rows for the provenance capture
+                        # (none-normalize configs only: norm == raw)
+                        rows_t = jax.lax.dynamic_index_in_dim(
+                            packed_tbl, t_id, 0, False
+                        )  # [nloc_p, C]
+                        nloc_p = rows_t.shape[0]
+                        gids_p = offset + jnp.arange(nloc_p, dtype=jnp.int32)
+                        d_raws = rows_t[:, :npol].T
+                        d_norms = d_raws
+                        d_feas = (rows_t[:, npol + 1] != 0) & (
+                            (pod.pinned < 0) | (gids_p == pod.pinned)
+                        )
+                        d_tot = _local_totals(rows_t)
+                        d_rank = rank_p
                 else:
                     row = packed_tbl[t_id]  # [nloc, C]
                     feasible = (row[:, npol + 1] != 0) & (
                         (pod.pinned < 0) | (gids == pod.pinned)
                     )
                     total = jnp.zeros(nloc, jnp.int32)
+                    d_raw_rows, d_norm_rows = [], []
                     for i, (fn, weight) in enumerate(policies):
                         raw = row[:, i]
+                        nrm = raw
                         if fn.normalize in ("minmax", "pwr"):
                             # local extrema + pmin/pmax = the global
                             # reduction; the scaling core is the same code
@@ -305,12 +342,15 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                             lo_l, hi_l = feasible_min_max(raw, feasible)
                             lo = jax.lax.pmin(lo_l, NODE_AXIS)
                             hi = jax.lax.pmax(hi_l, NODE_AXIS)
-                            raw = minmax_scale_i32(
+                            nrm = minmax_scale_i32(
                                 raw, feasible, lo, hi,
                                 0 if fn.normalize == "minmax"
                                 else MAX_NODE_SCORE,
                             )
-                        total = total + jnp.int32(weight) * raw
+                        if decisions:
+                            d_raw_rows.append(raw)
+                            d_norm_rows.append(nrm)
+                        total = total + jnp.int32(weight) * nrm
 
                     # selectHost: local argmax + 3 scalar collectives
                     best_l = jnp.max(jnp.where(feasible, total, -_INT_MAX))
@@ -319,6 +359,12 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                     )
                     am_l = jnp.argmax(wkey).astype(jnp.int32)
                     rank_l = -wkey[am_l]  # INT_MAX when no candidate
+                    if decisions:
+                        d_raws = jnp.stack(d_raw_rows)
+                        d_norms = jnp.stack(d_norm_rows)
+                        d_feas = feasible
+                        d_tot = total
+                        d_rank = rank
                 g_best = jax.lax.pmax(best_l, NODE_AXIS)
                 g_rank = jax.lax.pmin(
                     jnp.where(best_l == g_best, rank_l, _INT_MAX), NODE_AXIS
@@ -350,15 +396,71 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                     )
                     > 0
                 )
-                return jnp.where(ok, gnode, -1), dev_mask
+                node_f = jnp.where(ok, gnode, -1).astype(jnp.int32)
+                if not decisions:
+                    return node_f, dev_mask
+                # ---- decision provenance (replicated) ----
+                # local top-K candidates -> (total, rank, global id)
+                # summaries across the collective -> replicated merge with
+                # the same packed-key top-K every engine orders by. Exact:
+                # the global k-th best is inside its shard's local top-K.
+                lpos, ltot, lrnk, lok = packed_topk(
+                    d_tot, d_feas, d_rank, DECISION_TOPK
+                )
+                lgid = jnp.where(lok, offset + lpos, -1).astype(jnp.int32)
+                ag = jax.lax.all_gather(
+                    jnp.stack([ltot, lrnk, lgid]), NODE_AXIS
+                )  # [D, 3, K]
+                gtot = ag[:, 0, :].reshape(-1)
+                grnk = ag[:, 1, :].reshape(-1)
+                ggid = ag[:, 2, :].reshape(-1)
+                mpos, mtot, mrnk, mok = packed_topk(
+                    gtot, ggid >= 0, grnk, DECISION_TOPK
+                )
+                mnode = jnp.where(
+                    mok, ggid[jnp.maximum(mpos, 0)], -1
+                ).astype(jnp.int32)
+                # winner columns + feasible count: owner-masked psums
+                win_raw = jax.lax.psum(
+                    jnp.where(owner & ok, d_raws[:, ln], 0), NODE_AXIS
+                ).astype(jnp.int32)
+                win_norm = jax.lax.psum(
+                    jnp.where(owner & ok, d_norms[:, ln], 0), NODE_AXIS
+                ).astype(jnp.int32)
+                feas_cnt = jax.lax.psum(
+                    d_feas.sum().astype(jnp.int32), NODE_AXIS
+                )
+                if bsz:
+                    nbl = lt.shape[1]
+                    blk_g = jax.lax.psum(
+                        jnp.where(owner & ok, me * nbl + ln // bsz, 0),
+                        NODE_AXIS,
+                    ).astype(jnp.int32)
+                    win_blk = jnp.where(ok, blk_g, -1).astype(jnp.int32)
+                else:
+                    win_blk = jnp.int32(-1)
+                dec = DecisionRecord(
+                    node=node_f,
+                    total=jnp.where(ok, g_best, 0).astype(jnp.int32),
+                    raw=win_raw,
+                    norm=win_norm,
+                    topk_node=mnode,
+                    topk_total=mtot,
+                    topk_rank=mrnk,
+                    feasible=feas_cnt,
+                    block=win_blk,
+                )
+                return node_f, dev_mask, dec
 
             def do_delete():
-                return placed[idx], masks[idx]
+                base = placed[idx], masks[idx]
+                return base + ((no_decision(npol),) if decisions else ())
 
             def do_skip():
-                return (
+                base = (
                     jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_)
                 )
+                return base + ((no_decision(npol),) if decisions else ())
 
             # the switch returns only the replicated (node, dev_mask)
             # decision: a carried buffer returned from a switch branch
@@ -366,7 +468,11 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             # of state/placed/masks dominated the loop at large nloc
             # (same restructure as the single-device table engine)
             kc = jnp.clip(kind, 0, 2)
-            node, dev = jax.lax.switch(kc, [do_create, do_delete, do_skip])
+            outs = jax.lax.switch(kc, [do_create, do_delete, do_skip])
+            if decisions:
+                node, dev, dec = outs
+            else:
+                node, dev = outs
             is_create = kc == 0
             is_delete = kc == 1
             lbind = jnp.clip(node - offset, 0, nloc - 1)
@@ -410,10 +516,10 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             return ShardTableCarry(
                 state, packed_tbl, lt, lr, lwn, dirty, placed, masks,
                 failed, arr_cpu, arr_gpu, key, ctr,
-            ), (node, dev)
+            ), ((node, dev, dec) if decisions else (node, dev))
 
-        carry, (nodes, devs) = jax.lax.scan(body, carry, (ev_kind, ev_pod))
-        return carry, nodes, devs
+        carry, ys = jax.lax.scan(body, carry, (ev_kind, ev_pod))
+        return (carry,) + tuple(ys)
 
     state_specs = NodeState(*([P(NODE_AXIS)] * len(NodeState._fields)))
     spec_r = PodSpec(*([P()] * 6))
@@ -445,6 +551,9 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             check_rep=False,
         )
 
+    # decision records are replicated outputs (collective-merged topk +
+    # owner psums), like the (node, dev) telemetry
+    dec_specs = DecisionRecord(*([P()] * len(DecisionRecord._fields)))
     mapped_init = _wrap(
         _init_shard,
         (state_specs, P(NODE_AXIS), spec_r, types_specs, tp_specs, P()),
@@ -453,7 +562,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     mapped_chunk = _wrap(
         _chunk_shard,
         (carry_specs, P(NODE_AXIS), spec_r, types_specs, P(), P(), tp_specs),
-        (carry_specs, P(), P()),
+        (carry_specs, P(), P()) + ((dec_specs,) if decisions else ()),
     )
 
     @jax.jit
@@ -462,10 +571,10 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
 
     @jax.jit
     def run_chunk(carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank):
-        carry, nodes, devs = mapped_chunk(
+        outs = mapped_chunk(
             carry, tiebreak_rank, pods, types, ev_kind, ev_pod, tp
         )
-        return carry, (nodes, devs)
+        return outs[0], tuple(outs[1:])
 
     @jax.jit
     def finish(carry):
@@ -478,12 +587,16 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     def _replay_impl(state, pods, types, ev_kind, ev_pod, tp, key,
                      tiebreak_rank) -> ReplayResult:
         carry = init_carry(state, pods, types, tp, key, tiebreak_rank)
-        carry, (nodes, devs) = run_chunk(
+        carry, ys = run_chunk(
             carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank
         )
+        if decisions:
+            nodes, devs, decs = ys
+        else:
+            (nodes, devs), decs = ys, None
         return ReplayResult(
             carry.state, carry.placed, carry.masks, carry.failed, None,
-            nodes, devs, carry.ctr,
+            nodes, devs, carry.ctr, decs,
         )
 
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,

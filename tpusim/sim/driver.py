@@ -128,6 +128,19 @@ class SimulatorConfig:
     # the hit/miss. Empty + unset env = disabled. Single-device table
     # engine only (the shard engine builds its tables sharded).
     table_cache_dir: str = ""
+    # Decision-provenance flight recorder (ISSUE 4; tpusim.obs.decisions):
+    # True makes every replay additionally emit a per-event
+    # DecisionRecord stream — winner + per-policy raw/normalized score
+    # contributions, top-K runner-ups with tie-break ranks, feasible
+    # count, winning block — surfaced as ReplayResult.decisions →
+    # SimulateResult.decisions (a DecisionLog) and persisted by `tpusim
+    # apply --decisions-out`. Bit-reproducible and engine-invariant
+    # (decisions.INVARIANT_FIELDS) across the sequential/flat/blocked/
+    # shard engines, and transparent to checkpoint kill/resume and fault
+    # segmentation. Unsupported by the fused Pallas kernel (auto falls
+    # back to the table engine; a forced engine: pallas raises) and by
+    # extender configs / the seed-batched sweep path.
+    record_decisions: bool = False
     # Device-mesh width: 0 = single device; N > 1 shards the node axis
     # over an N-device jax.sharding.Mesh and replays on the
     # explicit-collective shard_map engine (tpusim.parallel.shard_engine;
@@ -167,6 +180,11 @@ class SimulateResult:
     # counts, table-cache outcome. Always populated (the recorder is
     # always on); walls are only phase-attributed under cfg.profile.
     telemetry: object = None
+    # tpusim.obs.decisions.DecisionLog for this run (records + the event
+    # stream they describe), host-side. None unless
+    # SimulatorConfig.record_decisions; fault runs concatenate their
+    # segment streams, schedule_additional appends.
+    decisions: object = None
 
 
 _BELLMAN_SRC_DIGEST = None
@@ -196,6 +214,9 @@ def _engine_source_digest() -> bytes:
                 # old checkpoints and cached tables rather than resume into
                 # a layout mismatch
                 "obs/counters.py",
+                # the decision vocabulary shapes the checkpointed decision
+                # stream (ISSUE 4) — same invalidation discipline
+                "obs/decisions.py",
             )
         ]
         files += glob.glob(os.path.join(base, "policies", "*.py"))
@@ -307,10 +328,17 @@ class Simulator:
         # metric-free: the per-event report series is reconstructed from
         # replay telemetry by the shared post-pass (tpusim.sim.metrics) —
         # identical across engines by construction
+        if self.cfg.record_decisions and self.cfg.extenders:
+            raise ValueError(
+                "record_decisions cannot combine with extenders (the "
+                "host-loop extender engine splices HTTP scores the "
+                "flight recorder does not capture)"
+            )
         self.replay_fn = make_replay(
             self._policy_fns,
             gpu_sel=self.cfg.gpu_sel_method,
             report=False,
+            decisions=self.cfg.record_decisions,
         )
         # device-phase wall of the last schedule_pods_batch call this sim
         # led (dispatch + fetch, excluding host spec prep/result slicing);
@@ -339,6 +367,7 @@ class Simulator:
             report=False,
             block_size=self.cfg.block_size,
             heartbeat_every=self.cfg.heartbeat_every,
+            decisions=self.cfg.record_decisions,
         )
         # fused whole-replay Pallas engine (tpusim.sim.pallas_engine): one
         # kernel for the entire event loop, ~4x the table engine on chip;
@@ -396,6 +425,13 @@ class Simulator:
                 self._policy_fns, self._mesh,
                 gpu_sel=self.cfg.gpu_sel_method,
                 block_size=self.cfg.block_size,
+                decisions=self.cfg.record_decisions,
+            )
+        if self.cfg.record_decisions and self.cfg.engine == "pallas":
+            raise ValueError(
+                "engine: pallas cannot record decisions (the fused kernel "
+                "emits no per-event provenance); use the table, "
+                "sequential, or shard engine"
             )
         if self._pallas_ok and self.cfg.engine in ("auto", "pallas"):
             # Mosaic lowers on TPU backends only; anywhere else (cpu, gpu)
@@ -416,6 +452,15 @@ class Simulator:
         log the engine the dispatch used. `n_events` = true (pre-padding)
         event count for the log line."""
         true_e = int(ev_kind.shape[0]) if n_events is None else int(n_events)
+        if self.cfg.heartbeat_every:
+            # final 100% heartbeat tick (obs.heartbeat.complete): short
+            # runs beat the 1/s rate limit and would otherwise finish
+            # silently. The block is a no-op cost-wise — every consumer
+            # of this result syncs on it right after anyway.
+            from tpusim.obs import heartbeat as obs_heartbeat
+
+            jax.block_until_ready(out.event_node)
+            obs_heartbeat.complete(true_e)
         ctr = out.counters
         if ctr is None and self.obs.enabled:
             # engines whose loop does not count (fused pallas, extender):
@@ -602,11 +647,17 @@ class Simulator:
                 # the fused Pallas engine wins whenever it applies; its
                 # Mosaic path needs a real accelerator (auto never picks
                 # the CPU interpreter — that is only for a forced
-                # `engine: pallas` under the test lane)
-                use_pallas = self._pallas_fn is not None and (
-                    self.cfg.engine == "pallas"
-                    or (self.cfg.engine == "auto" and big
-                        and jax.default_backend() == "tpu")
+                # `engine: pallas` under the test lane). Decision-recording
+                # runs never take it (the fused kernel emits no per-event
+                # provenance; a forced engine: pallas raised at init)
+                use_pallas = (
+                    self._pallas_fn is not None
+                    and not self.cfg.record_decisions
+                    and (
+                        self.cfg.engine == "pallas"
+                        or (self.cfg.engine == "auto" and big
+                            and jax.default_backend() == "tpu")
+                    )
                 )
                 if use_pallas:
                     # graceful degradation: a replay that would overflow
@@ -852,9 +903,13 @@ class Simulator:
 
         def chunks():
             yield _engine_source_digest()
+            # record_decisions participates: a decision-recording run's
+            # checkpoints carry the accumulated decision stream, which a
+            # non-recording run's do not — the layouts must never mix
             yield repr((
                 tuple(cfg.policies), cfg.gpu_sel_method, cfg.dim_ext_method,
                 cfg.norm_method, cfg.block_size, cfg.mesh,
+                cfg.record_decisions,
             )).encode()
             for leaf in (
                 jax.tree.leaves(state) + jax.tree.leaves(specs)
@@ -878,6 +933,7 @@ class Simulator:
         killed-and-resumed run reproduces the uninterrupted run's
         placements, telemetry, metrics, and final tables exactly."""
         from tpusim.io import storage as ckpt
+        from tpusim.obs.decisions import DecisionRecord
         from tpusim.sim.engine import ReplayResult
 
         e = int(ev_kind.shape[0])
@@ -888,11 +944,14 @@ class Simulator:
             fn.init_carry, state, specs, types, self.typical, key, rank
         )
         tleaves, tdef = jax.tree.flatten(template)
+        record_dec = self.cfg.record_decisions
+        dec_fields = DecisionRecord._fields
 
         carry = None
         cursor = 0
         node_parts: list = []
         dev_parts: list = []
+        dec_parts: list = []  # DecisionRecord-of-np per segment (ISSUE 4)
         found = ckpt.find_checkpoint(cache_dir, digest)
         if found is not None:
             try:
@@ -908,6 +967,14 @@ class Simulator:
                 )
                 node_parts = [arrays["event_node"]]
                 dev_parts = [arrays["event_dev"]]
+                if record_dec:
+                    # the decision stream accumulated so far rides the
+                    # checkpoint beside event_node/event_dev, so a resumed
+                    # run's stream is continuous (missing keys -> the
+                    # usual drop-and-start-fresh path)
+                    dec_parts = [DecisionRecord(
+                        *(arrays[f"dec_{f}"] for f in dec_fields)
+                    )]
                 cursor = cursor0
                 self.log.info(
                     f"[Checkpoint] resumed replay at event {cursor}/{e} "
@@ -927,7 +994,8 @@ class Simulator:
                     os.unlink(found[1])
                 except OSError:
                     pass
-                carry, cursor, node_parts, dev_parts = None, 0, [], []
+                carry, cursor = None, 0
+                node_parts, dev_parts, dec_parts = [], [], []
         if carry is None:
             # only now resolve the table cache (table engine only): a
             # resumed run never reaches here and must not pay the build
@@ -943,10 +1011,15 @@ class Simulator:
 
         while cursor < e:
             end = min(cursor + every, e)
-            carry, (nseg, dseg) = fn.run_chunk(
+            carry, ys = fn.run_chunk(
                 carry, specs, types, ev_kind[cursor:end],
                 ev_pod[cursor:end], self.typical, rank,
             )
+            if record_dec:
+                nseg, dseg, decseg = ys
+                dec_parts.append(jax.tree.map(np.asarray, decseg))
+            else:
+                nseg, dseg = ys
             node_parts.append(np.asarray(nseg))
             dev_parts.append(np.asarray(dseg))
             cursor = end
@@ -960,6 +1033,11 @@ class Simulator:
                 }
                 arrays["event_node"] = np.concatenate(node_parts)
                 arrays["event_dev"] = np.concatenate(dev_parts)
+                if record_dec:
+                    for f in dec_fields:
+                        arrays[f"dec_{f}"] = np.concatenate(
+                            [np.asarray(getattr(p, f)) for p in dec_parts]
+                        )
                 ckpt.save_checkpoint(cache_dir, digest, cursor, arrays)
                 ckpt.prune_checkpoints(cache_dir, digest, cursor)
 
@@ -973,12 +1051,18 @@ class Simulator:
             np.concatenate(dev_parts) if dev_parts
             else np.zeros((0, 8), bool)
         )
+        decs = None
+        if record_dec and dec_parts:
+            decs = DecisionRecord(*(
+                np.concatenate([np.asarray(getattr(p, f)) for p in dec_parts])
+                for f in dec_fields
+            ))
         # the carry's counter leaf accumulated across every segment AND
         # any resumed-from checkpoint — telemetry continuity through
         # kill/resume comes for free from the carry being the checkpoint
         return ReplayResult(
             state_f, placed, masks, failed, None,
-            jnp.asarray(nodes), jnp.asarray(devs), carry.ctr,
+            jnp.asarray(nodes), jnp.asarray(devs), carry.ctr, decs,
         )
 
     # ---- workload prep (core.go:103-142) ----
@@ -1094,6 +1178,15 @@ class Simulator:
     def _finish_replay(self, out, pods, ev_kind, ev_pod, state):
         """Host-side tail of a replay: per-event report lines, unscheduled
         list, creation ranks. `out` must already be on host."""
+        if out.decisions is not None:
+            # pair the decision stream with the events it describes — the
+            # DecisionLog the emitter/explain/diff surface consumes
+            from tpusim.obs.decisions import DecisionLog
+
+            out = out._replace(decisions=DecisionLog(
+                jax.tree.map(np.asarray, out.decisions),
+                np.asarray(ev_kind), np.asarray(ev_pod),
+            ))
         self._emit_event_reports(out, pods, ev_kind, ev_pod, state)
         skipped = np.array([p.unscheduled for p in pods], bool)
         failed_mask = np.asarray(out.ever_failed) | skipped
@@ -1147,6 +1240,31 @@ class Simulator:
         counts) — also attached to every SimulateResult."""
         return self.obs.snapshot(meta=self._telemetry_meta())
 
+    def event_counter_series(self) -> dict:
+        """Per-event counter-track series for the Chrome-trace emitter
+        (obs.emitters counter tracks): the cluster frag gpu-milli and
+        used gpu-milli the metrics postpass already computed, one value
+        per reported event, concatenated across this run's reporting
+        replays. Empty when per-event reporting is off — the trace then
+        simply carries no counter tracks."""
+        frag: list = []
+        used: list = []
+        for rep in self.event_reports:
+            s = rep.get("series", {})
+            if "_frag_milli_f" in s:  # numeric twin of origin_milli
+                frag.extend(
+                    np.asarray(s["_frag_milli_f"], np.float64).tolist()
+                )
+            used.extend(
+                np.asarray(rep["used_gpu_milli"]).astype(np.int64).tolist()
+            )
+        out = {}
+        if frag:
+            out["frag_gpu_milli"] = frag
+        if used:
+            out["used_gpu_milli"] = used
+        return out
+
     def _record_result(self, result, pods, events, unscheduled, rank, wall):
         self.last_result = SimulateResult(
             unscheduled_pods=unscheduled,
@@ -1159,6 +1277,7 @@ class Simulator:
             events=events,
             creation_rank=rank,
             telemetry=self.run_telemetry(),
+            decisions=getattr(result, "decisions", None),
         )
         return self.last_result
 
@@ -1184,6 +1303,16 @@ class Simulator:
         res.dev_mask = np.concatenate([res.dev_mask, np.asarray(out.dev_mask)])
         res.unscheduled_pods = list(res.unscheduled_pods) + failed
         res.events += events
+        if out.decisions is not None:
+            from tpusim.obs.decisions import concat_logs
+
+            # the appended replay's events index ITS pod list; shift to
+            # the run's concatenated indexing before appending the log
+            shifted = out.decisions._replace(
+                ev_pod=np.asarray(out.decisions.ev_pod)
+                + (len(res.pods) - len(pods))
+            )
+            res.decisions = concat_logs([res.decisions, shifted])
         base = int(res.creation_rank.max(initial=-1)) + 1
         res.creation_rank = np.concatenate(
             [res.creation_rank, np.where(rank >= 0, rank + base, -1)]
@@ -1384,6 +1513,18 @@ class Simulator:
         self._emit_event_reports(
             out, [res.pods[int(i)] for i in v], ev_kind, ev_pod, state
         )
+        if out.decisions is not None:
+            from tpusim.obs.decisions import DecisionLog, concat_logs
+
+            # the victim replay's events index vspecs; remap to the run's
+            # global pod indices so the appended log names the right pods
+            res.decisions = concat_logs([
+                res.decisions,
+                DecisionLog(
+                    jax.tree.map(np.asarray, out.decisions),
+                    np.asarray(ev_kind), v[np.asarray(ev_pod)],
+                ),
+            ])
         placed_v = np.asarray(out.placed_node)
         mask_v = np.asarray(out.dev_mask)
         res.placed_node[v] = placed_v
@@ -1479,6 +1620,7 @@ class Simulator:
             fcfg.backoff_base, fcfg.backoff_cap, fcfg.max_retries
         )
         dm = DisruptionMetrics()
+        dec_logs: list = []  # per-segment DecisionLogs (ISSUE 4)
         attempts: dict = {}  # pod -> consecutive failed retries so far
         evicted_at: dict = {}  # pod -> eviction position (latency clock)
         down_at: dict = {}  # node -> failure position
@@ -1504,6 +1646,16 @@ class Simulator:
                 jnp.asarray(seg_pod), seg_key, types=types, pod_rows=pods,
             ))
             self._emit_event_reports(out, pods, seg_kind, seg_pod, pre_state)
+            if out.decisions is not None:
+                # the fault replay's provenance is the concatenation of
+                # its segments' streams, in replay order — continuous
+                # across the segmentation like the counters
+                from tpusim.obs.decisions import DecisionLog
+
+                dec_logs.append(DecisionLog(
+                    jax.tree.map(np.asarray, out.decisions),
+                    seg_kind, seg_pod,
+                ))
             state_box["state"] = jax.tree.map(jnp.asarray, out.state)
             created = seg_pod[seg_kind == EV_CREATE]
             placed[created] = np.asarray(out.placed_node)[created]
@@ -1651,6 +1803,8 @@ class Simulator:
                 ))
             elif placed[i] < 0 and bool(ever_failed[i]):
                 unscheduled.append(UnscheduledPod(pods[i]))
+        from tpusim.obs.decisions import concat_logs
+
         self.last_result = SimulateResult(
             unscheduled_pods=unscheduled,
             placed_node=placed,
@@ -1662,6 +1816,7 @@ class Simulator:
             events=state_box["events"],
             creation_rank=creation_rank,
             telemetry=self.run_telemetry(),
+            decisions=concat_logs(dec_logs),
         )
         return self.last_result
 
@@ -1973,6 +2128,11 @@ def _slice_result(out, p: int, e: int):
             if out.metrics is None
             else jax.tree.map(lambda a: a[:e], out.metrics)
         ),
+        decisions=(
+            None
+            if out.decisions is None
+            else jax.tree.map(lambda a: a[:e], out.decisions)
+        ),
     )
 
 
@@ -2063,6 +2223,15 @@ def dispatch_pods_batch(
         raise ValueError(
             "schedule_pods_batch cannot run mesh configs (the shard_map "
             "engine owns the device axis); run each sim's run() instead"
+        )
+    if any(s.cfg.record_decisions for s in sims):
+        # ANY recording sim (not just the lead): the batch replays on the
+        # lead's engine, so a non-lead recorder would silently get
+        # decisions=None instead of its stream
+        raise ValueError(
+            "schedule_pods_batch cannot record decisions (the vmapped "
+            "replay has no per-seed provenance surface); run each sim's "
+            "run() instead"
         )
     for s in sims[1:]:
         same = (

@@ -19,9 +19,15 @@ import jax.numpy as jnp
 
 from tpusim.constants import MAX_GPUS_PER_NODE, MILLI
 from tpusim.obs.counters import counter_delta, zero_counters
+from tpusim.obs.decisions import no_decision
 from tpusim.ops.energy import node_power
 from tpusim.ops.frag import cluster_frag_amounts, frag_sum_except_q3, frag_sum_q1q2q4
-from tpusim.sim.step import Placement, schedule_one, unschedule
+from tpusim.sim.step import (
+    Placement,
+    schedule_one,
+    schedule_one_recorded,
+    unschedule,
+)
 from tpusim.types import NodeState, PodSpec
 
 EV_CREATE = 0
@@ -80,6 +86,11 @@ class ReplayResult(NamedTuple):
     # engines whose loop does not count (fused pallas, extender) — the
     # driver derives the invariant prefix from telemetry there.
     counters: jnp.ndarray = None
+    # tpusim.obs.decisions.DecisionRecord stacked over the event axis —
+    # the per-event decision-provenance stream (ISSUE 4). None unless the
+    # engine was built with decisions=True; engine-invariant on
+    # decisions.INVARIANT_FIELDS and bit-reproducible like the counters.
+    decisions: object = None
 
 
 def cluster_usage(state: NodeState):
@@ -130,19 +141,27 @@ def _metrics_row(state, tp, arr_cpu, arr_gpu):
 _REPLAY_CACHE = {}
 
 
-def make_replay(policies, gpu_sel: str = "best", report: bool = True):
+def make_replay(policies, gpu_sel: str = "best", report: bool = True,
+                decisions: bool = False):
     """Build a jitted trace replayer for a static policy configuration.
 
     policies: [(policy_fn, weight)]; gpu_sel: Reserve-phase gpuSelMethod.
     report=False skips per-event metric rows (pure-throughput mode).
+    decisions=True additionally emits the per-event DecisionRecord stream
+    (tpusim.obs.decisions; ISSUE 4) as an extra scan output — the
+    trajectory itself is untouched (same kernels, same key splits; the
+    record is built from gathers on values the cycle already computed).
 
-    Replayers are cached per (policy kernels, gpu_sel, report) so that a
-    sweep constructing many Simulators (experiments/sweep.py) reuses one
-    compiled engine per configuration instead of re-jitting per experiment.
+    Replayers are cached per (policy kernels, gpu_sel, report, decisions)
+    so that a sweep constructing many Simulators (experiments/sweep.py)
+    reuses one compiled engine per configuration instead of re-jitting
+    per experiment.
     """
-    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report)
+    cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
+                 decisions)
     if cache_key in _REPLAY_CACHE:
         return _REPLAY_CACHE[cache_key]
+    num_pol = len(policies)
 
     @jax.jit
     def replay(
@@ -168,9 +187,15 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True):
             def do_create(_):
                 # arrived counters accumulate per creation event regardless
                 # of outcome (simulator.go:406-408).
-                new_state, pl = schedule_one(
-                    state, pod, sub, policies, gpu_sel, tp, tiebreak_rank
-                )
+                if decisions:
+                    new_state, pl, dec = schedule_one_recorded(
+                        state, pod, sub, policies, gpu_sel, tp, tiebreak_rank
+                    )
+                else:
+                    new_state, pl = schedule_one(
+                        state, pod, sub, policies, gpu_sel, tp, tiebreak_rank
+                    )
+                    dec = ()
                 return (
                     new_state,
                     placed.at[idx].set(pl.node),
@@ -180,6 +205,7 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True):
                     arr_gpu + pod.total_gpu_milli(),
                     pl.node,
                     pl.dev_mask,
+                    dec if decisions else (),
                 )
 
             def do_delete(_):
@@ -194,17 +220,19 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True):
                     arr_gpu,
                     pl.node,
                     pl.dev_mask,
+                    no_decision(num_pol) if decisions else (),
                 )
 
             def do_skip(_):
                 return (
                     state, placed, masks, failed, arr_cpu, arr_gpu,
                     jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
+                    no_decision(num_pol) if decisions else (),
                 )
 
             kc = jnp.clip(kind, 0, 2)
             (state2, placed2, masks2, failed2, arr_cpu2, arr_gpu2, node,
-             dev) = jax.lax.switch(
+             dev, dec) = jax.lax.switch(
                 kc, [do_create, do_delete, do_skip], None
             )
             # exact in-scan counters (obs vocabulary) — the same
@@ -221,18 +249,20 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True):
                 row,
                 node,
                 dev,
+                dec,
             )
 
         init = (
             state, placed, masks, failed, jnp.int32(0), jnp.int32(0),
             zero_counters(), key,
         )
-        (state, placed, masks, failed, _, _, ctr, _), (rows, nodes, devs) = (
-            jax.lax.scan(body, init, (ev_kind, ev_pod))
-        )
+        (state, placed, masks, failed, _, _, ctr, _), (
+            rows, nodes, devs, decs
+        ) = jax.lax.scan(body, init, (ev_kind, ev_pod))
         metrics = EventMetrics(*rows) if report else None
         return ReplayResult(
-            state, placed, masks, failed, metrics, nodes, devs, ctr
+            state, placed, masks, failed, metrics, nodes, devs, ctr,
+            decs if decisions else None,
         )
 
     _REPLAY_CACHE[cache_key] = replay

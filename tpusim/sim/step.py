@@ -128,6 +128,70 @@ def packed_argmax(
     return idx, best, ok
 
 
+def packed_topk(
+    total: jnp.ndarray,  # i32[M] scores (nodes, blocks, or merge candidates)
+    valid: jnp.ndarray,  # bool[M]
+    rank: jnp.ndarray,  # i32[M] tie-break rank (smaller wins)
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """K-extension of packed_argmax for the decision flight recorder
+    (ISSUE 4): the first k entries of selectHost's (max score, min
+    tie-break rank) selection order — entry 0 IS the packed_argmax
+    winner, entries 1.. are the runner-ups. Returns (pos i32[k],
+    total i32[k], rank i32[k], ok bool[k]); invalid tail entries carry
+    pos/rank -1, total 0. Exact by construction: k iterated
+    packed_argmax reductions, each masking the previous winner out, so
+    the ordering cannot drift from the single-winner combine any engine
+    selects with."""
+    m = total.shape[0]
+    iota = jnp.arange(m, dtype=jnp.int32)
+    pos, tot, rnk, oks = [], [], [], []
+    v = valid
+    for _ in range(k):
+        idx, best, ok = packed_argmax(total, v, rank)
+        pos.append(jnp.where(ok, idx, -1).astype(jnp.int32))
+        tot.append(jnp.where(ok, best, 0).astype(jnp.int32))
+        rnk.append(jnp.where(ok, rank[idx], -1).astype(jnp.int32))
+        oks.append(ok)
+        v = v & (iota != idx)
+    return jnp.stack(pos), jnp.stack(tot), jnp.stack(rnk), jnp.stack(oks)
+
+
+def build_decision(
+    node: jnp.ndarray,  # i32 committed winner (-1 = no feasible node)
+    raws: jnp.ndarray,  # i32[num_pol, M] per-policy raw score rows
+    norms: jnp.ndarray,  # i32[num_pol, M] per-policy NORMALIZED rows
+    total: jnp.ndarray,  # i32[M] weighted totals (what selectHost reduced)
+    feasible: jnp.ndarray,  # bool[M] Filter mask incl. pinning
+    rank: jnp.ndarray,  # i32[M] tie-break rank
+):
+    """DecisionRecord for one create event from full per-policy score
+    rows — the ONE record builder shared by the sequential oracle and the
+    flat/blocked table engines (the shard engine reproduces the same
+    record through its collective merge), so the captured provenance is
+    engine-invariant by construction. Positions in the row arrays must be
+    global node ids (the blocked path's sentinel pad columns are
+    infeasible and rank-INT_MAX, so they can never enter the top-K).
+    `block` is left at -1; blocked selects overwrite it with the winning
+    block id (an engine-specific slot, like the counters' `rebuilds`)."""
+    from tpusim.obs.decisions import DECISION_TOPK, DecisionRecord
+
+    ok = node >= 0
+    sel = jnp.maximum(node, 0)
+    pos, tot, rnk, oks = packed_topk(total, feasible, rank, DECISION_TOPK)
+    return DecisionRecord(
+        node=node.astype(jnp.int32),
+        total=jnp.where(ok, total[sel], 0).astype(jnp.int32),
+        raw=jnp.where(ok, raws[:, sel], 0).astype(jnp.int32),
+        norm=jnp.where(ok, norms[:, sel], 0).astype(jnp.int32),
+        topk_node=pos,
+        topk_total=tot,
+        topk_rank=rnk,
+        feasible=feasible.sum().astype(jnp.int32),
+        block=jnp.int32(-1),
+    )
+
+
 def block_reduce(tot: jnp.ndarray, rank: jnp.ndarray):
     """Per-block (max total, min tie-break rank among the maxima, argmax)
     over the trailing axis — the in-block half of the blocked two-level
@@ -298,6 +362,45 @@ def select_and_bind(
     return bind_selected(state, pod, node, ok, policy_dev[node], gpu_sel, key)
 
 
+def score_pod_rows(
+    state: NodeState,
+    pod: PodSpec,
+    k_rand,
+    policies: Sequence[Tuple[object, int]],
+    gpu_sel: str = "best",
+    tp=None,
+):
+    """score_pod with the per-policy breakdown kept: returns
+    (feasible bool[N], total i32[N], policy_share_dev i32[N],
+    raws i32[num_pol, N], norms i32[num_pol, N]) where `norms` are the
+    normalized rows the weighted sum consumed (== raws for
+    normalize-'none' policies). The decision flight recorder gathers the
+    winner's columns out of raws/norms; callers that only need the total
+    (score_pod) let XLA dead-code the stacks."""
+    n = state.num_nodes
+    feasible = filter_nodes(state, pod)
+    ctx = ScoreContext(tp=tp, feasible=feasible, rng=k_rand)
+
+    total = jnp.zeros(n, jnp.int32)
+    policy_share_dev = jnp.full(n, -1, jnp.int32)
+    raws, norms = [], []
+    for fn, weight in policies:
+        res = fn(state, pod, ctx)
+        raw = res.raw_scores
+        if fn.normalize == "minmax":
+            nrm = minmax_normalize_i32(raw, feasible)
+        elif fn.normalize == "pwr":
+            nrm = pwr_normalize_i32(raw, feasible)
+        else:
+            nrm = raw
+        raws.append(raw)
+        norms.append(nrm)
+        total = total + jnp.int32(weight) * nrm
+        if gpu_sel == fn.policy_name and fn.policy_name in SELF_SELECT_POLICIES:
+            policy_share_dev = res.share_dev
+    return feasible, total, policy_share_dev, jnp.stack(raws), jnp.stack(norms)
+
+
 def score_pod(
     state: NodeState,
     pod: PodSpec,
@@ -313,22 +416,9 @@ def score_pod(
     its extenders, generic_scheduler.go:143-210 + 520-560). Returns
     (feasible bool[N], total i32[N] weighted scores, policy_share_dev
     i32[N])."""
-    n = state.num_nodes
-    feasible = filter_nodes(state, pod)
-    ctx = ScoreContext(tp=tp, feasible=feasible, rng=k_rand)
-
-    total = jnp.zeros(n, jnp.int32)
-    policy_share_dev = jnp.full(n, -1, jnp.int32)
-    for fn, weight in policies:
-        res = fn(state, pod, ctx)
-        raw = res.raw_scores
-        if fn.normalize == "minmax":
-            raw = minmax_normalize_i32(raw, feasible)
-        elif fn.normalize == "pwr":
-            raw = pwr_normalize_i32(raw, feasible)
-        total = total + jnp.int32(weight) * raw
-        if gpu_sel == fn.policy_name and fn.policy_name in SELF_SELECT_POLICIES:
-            policy_share_dev = res.share_dev
+    feasible, total, policy_share_dev, _, _ = score_pod_rows(
+        state, pod, k_rand, policies, gpu_sel, tp
+    )
     return feasible, total, policy_share_dev
 
 
@@ -365,6 +455,37 @@ def schedule_one(
         state, pod, feasible, total, policy_share_dev, gpu_sel, k_sel,
         tiebreak_rank,
     )
+
+
+def schedule_one_recorded(
+    state: NodeState,
+    pod: PodSpec,
+    key,
+    policies: Sequence[Tuple[object, int]],
+    gpu_sel: str = "best",
+    tp=None,
+    tiebreak_rank=None,
+):
+    """schedule_one plus its DecisionRecord — identical trajectory (same
+    key splits, same score/select/bind kernels in the same order; the
+    extra gathers feed only the record), so a recording replay's
+    placements are bit-identical to an unrecorded one. Returns
+    (new_state, Placement, DecisionRecord)."""
+    n = state.num_nodes
+    k_rand, k_sel = jax.random.split(key)
+    if tiebreak_rank is None:
+        tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
+    feasible, total, policy_share_dev, raws, norms = score_pod_rows(
+        state, pod, k_rand, policies, gpu_sel, tp
+    )
+    new_state, placement = select_and_bind(
+        state, pod, feasible, total, policy_share_dev, gpu_sel, k_sel,
+        tiebreak_rank,
+    )
+    dec = build_decision(
+        placement.node, raws, norms, total, feasible, tiebreak_rank
+    )
+    return new_state, placement, dec
 
 
 def unschedule(state: NodeState, pod: PodSpec, placement: Placement) -> NodeState:

@@ -40,6 +40,7 @@ import numpy as np
 from tpusim.constants import MAX_GPUS_PER_NODE
 from tpusim.obs import heartbeat as obs_heartbeat
 from tpusim.obs.counters import counter_delta, zero_counters
+from tpusim.obs.decisions import no_decision
 from tpusim.policies import (
     NORMALIZE_DEGENERATE,
     ScoreContext,
@@ -53,6 +54,7 @@ from tpusim.sim.step import (
     PendingCommit,
     apply_commit,
     block_reduce,
+    build_decision,
     choose_devices,
     filter_nodes,
     make_pending_commit,
@@ -362,6 +364,7 @@ def make_table_builders(policies, sel_idx: int):
 def make_table_replay(
     policies, gpu_sel: str = "best", report: bool = False,
     block_size: int = 0, heartbeat_every: int = 0,
+    decisions: bool = False,
 ):
     """Build the jitted incremental replayer for a static policy config.
 
@@ -420,6 +423,18 @@ def make_table_replay(
     jitted builder whose output that cache persists. Results are
     bit-identical either way (the aggregates are pure functions of the
     tables).
+
+    decisions=True (ISSUE 4) makes the scan additionally emit a
+    DecisionRecord per event (tpusim.obs.decisions): run_chunk/replay
+    ys become (node, dev, dec). The trajectory is untouched — the flat
+    path records out of the score rows the select already computed; the
+    blocked path reconstructs the event type's full totals row from the
+    score/feas tables with direct normalization (the same
+    minmax/pwr_normalize_i32 the flat path and the oracle apply), which
+    is exactly what its two-level select is bit-identical to — so the
+    records are engine-invariant by construction. Recording costs O(N)
+    gathers per create event (plus DECISION_TOPK extra packed_argmax
+    reductions), which is why it is a static build flag, not always on.
     """
     if report:
         raise ValueError(
@@ -427,7 +442,7 @@ def make_table_replay(
             "with tpusim.sim.metrics.compute_event_metrics"
         )
     cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
-                 int(block_size), int(heartbeat_every))
+                 int(block_size), int(heartbeat_every), bool(decisions))
     if cache_key in _TABLE_REPLAY_CACHE:
         return _TABLE_REPLAY_CACHE[cache_key]
     num_pol = len(policies)
@@ -651,18 +666,67 @@ def make_table_replay(
                 dmask = choose_devices(
                     state.gpu_left[sel], pod, dev_scalar, gpu_sel, k_sel
                 ) & ok
-                return jnp.where(ok, sel, -1).astype(jnp.int32), dmask
+                node_f = jnp.where(ok, sel, -1).astype(jnp.int32)
+                if not decisions:
+                    return node_f, dmask
+                # provenance: rebuild this type's full totals row with
+                # DIRECT normalization over the pin-masked feasibility —
+                # exactly the computation the flat path selects with (and
+                # what the blocked two-level select is bit-identical to),
+                # so the record cannot depend on the engine. Sentinel pad
+                # columns are infeasible + rank INT_MAX: never in the topk.
+                raws_row = jax.lax.dynamic_index_in_dim(
+                    score_tbl, t_id, 1, False
+                )  # [num_pol, n_pad]
+                feas_row = jax.lax.dynamic_index_in_dim(
+                    feas_tbl, t_id, 0, False
+                )
+                n_pad_l = feas_row.shape[0]
+                pin_m = (pod.pinned < 0) | (
+                    jnp.arange(n_pad_l, dtype=jnp.int32) == pod.pinned
+                )
+                feas_d = feas_row & pin_m
+                norm_rows = []
+                tot_d = jnp.zeros(n_pad_l, jnp.int32)
+                for i, (fn, weight) in enumerate(policies):
+                    raw = raws_row[i]
+                    if fn.normalize == "minmax":
+                        nrm = minmax_normalize_i32(raw, feas_d)
+                    elif fn.normalize == "pwr":
+                        nrm = pwr_normalize_i32(raw, feas_d)
+                    else:
+                        nrm = raw
+                    norm_rows.append(nrm)
+                    tot_d = tot_d + jnp.int32(weight) * nrm
+                dec = build_decision(
+                    node_f, raws_row, jnp.stack(norm_rows), tot_d, feas_d,
+                    rank_p,
+                )
+                # the engine-specific slot: which block won the two-level
+                # select (a pinned pod bypasses blocks — its node's block)
+                win_blk = jnp.where(
+                    ok,
+                    jnp.where(pod.pinned >= 0, pin // bsz, blk_i),
+                    -1,
+                ).astype(jnp.int32)
+                return node_f, dmask, dec._replace(block=win_blk)
 
             def do_delete():
-                return placed[idx], masks[idx]
+                base = placed[idx], masks[idx]
+                return base + ((no_decision(num_pol),) if decisions else ())
 
             def do_skip():
-                return (
+                base = (
                     jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_)
                 )
+                return base + ((no_decision(num_pol),) if decisions else ())
 
             kc = jnp.clip(kind, 0, 2)
-            node, dev = jax.lax.switch(kc, [do_create, do_delete, do_skip])
+            outs = jax.lax.switch(kc, [do_create, do_delete, do_skip])
+            if decisions:
+                node, dev, dec = outs
+            else:
+                node, dev = outs
             # defer this event's scatters to the next iteration
             pend = make_pending_commit(kc, idx, node, dev, pod, num_pods)
             arr_cpu = arr_cpu + jnp.where(kc == 0, pod.cpu, 0)
@@ -677,7 +741,7 @@ def make_table_replay(
                 state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
                 brmin, brmax, slo, shi, pend, dirty,
                 placed, masks, failed, arr_cpu, arr_gpu, key, ctr,
-            ), (node, dev)
+            ), ((node, dev, dec) if decisions else (node, dev))
 
         return body
 
@@ -724,6 +788,7 @@ def make_table_replay(
                     (pod.pinned < 0) | (jnp.arange(n, dtype=jnp.int32) == pod.pinned)
                 )
                 total = jnp.zeros(n, jnp.int32)
+                raw_rows, norm_rows = [], []
                 for i, (fn, weight) in enumerate(policies):
                     if fn.policy_name == "RandomScore":
                         # per-event draw, recomputed instead of table-read —
@@ -735,10 +800,15 @@ def make_table_replay(
                     else:
                         raw = score_tbl[i, t_id]
                     if fn.normalize == "minmax":
-                        raw = minmax_normalize_i32(raw, feasible)
+                        nrm = minmax_normalize_i32(raw, feasible)
                     elif fn.normalize == "pwr":
-                        raw = pwr_normalize_i32(raw, feasible)
-                    total = total + jnp.int32(weight) * raw
+                        nrm = pwr_normalize_i32(raw, feasible)
+                    else:
+                        nrm = raw
+                    if decisions:
+                        raw_rows.append(raw)
+                        norm_rows.append(nrm)
+                    total = total + jnp.int32(weight) * nrm
                 # the oracle's selectHost + Reserve halves; the Bind
                 # scatter is deferred via PendingCommit, outside the switch
                 sel, _, ok = packed_argmax(total, feasible, tiebreak_rank)
@@ -746,18 +816,32 @@ def make_table_replay(
                     state.gpu_left[sel], pod, sdev_tbl[t_id, sel], gpu_sel,
                     k_sel,
                 ) & ok
-                return jnp.where(ok, sel, -1).astype(jnp.int32), dmask
+                node_f = jnp.where(ok, sel, -1).astype(jnp.int32)
+                if not decisions:
+                    return node_f, dmask
+                # provenance off the very rows the select consumed
+                dec = build_decision(
+                    node_f, jnp.stack(raw_rows), jnp.stack(norm_rows),
+                    total, feasible, tiebreak_rank,
+                )
+                return node_f, dmask, dec
 
             def do_delete():
-                return placed[idx], masks[idx]
+                base = placed[idx], masks[idx]
+                return base + ((no_decision(num_pol),) if decisions else ())
 
             def do_skip():
-                return (
+                base = (
                     jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_)
                 )
+                return base + ((no_decision(num_pol),) if decisions else ())
 
             kc = jnp.clip(kind, 0, 2)
-            node, dev = jax.lax.switch(kc, [do_create, do_delete, do_skip])
+            outs = jax.lax.switch(kc, [do_create, do_delete, do_skip])
+            if decisions:
+                node, dev, dec = outs
+            else:
+                node, dev = outs
             # defer this event's scatters to the next iteration; arrived
             # counters accumulate per creation event regardless of outcome
             # (simulator.go:406-408)
@@ -773,7 +857,7 @@ def make_table_replay(
             return FlatTableCarry(
                 state, score_tbl, sdev_tbl, feas_tbl, pend, dirty,
                 placed, masks, failed, arr_cpu, arr_gpu, key, ctr,
-            ), (node, dev)
+            ), ((node, dev, dec) if decisions else (node, dev))
 
         return body
 
@@ -861,7 +945,9 @@ def make_table_replay(
     def run_chunk(carry, pods, types, ev_kind, ev_pod, tp,
                   tiebreak_rank=None):
         """Advance `carry` over a segment of the event stream; returns
-        (carry', (event_node, event_dev)) for the segment. Chaining
+        (carry', (event_node, event_dev)) for the segment — with a third
+        per-event DecisionRecord element when the engine was built with
+        decisions=True. Chaining
         run_chunk calls over any partition of the stream is bit-identical
         to one replay() over the whole stream — the scan body is a pure
         function of (carry, event), and every carry leaf is an exact dtype
@@ -913,12 +999,17 @@ def make_table_replay(
         tables=None,
     ) -> ReplayResult:
         carry = init_carry(state, pods, types, tp, key, tiebreak_rank, tables)
-        carry, (nodes, devs) = run_chunk(
+        carry, ys = run_chunk(
             carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank
         )
         state, placed, masks, failed = finish(carry)
+        if decisions:
+            nodes, devs, decs = ys
+        else:
+            (nodes, devs), decs = ys, None
         return ReplayResult(
-            state, placed, masks, failed, None, nodes, devs, carry.ctr
+            state, placed, masks, failed, None, nodes, devs, carry.ctr,
+            decs,
         )
 
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,
