@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -35,28 +35,44 @@ bench-scale-smoke:
 # determinism suite, the obs telemetry-continuity/counter-invariance
 # suite, and the decision-provenance suite (cross-engine record
 # invariance incl. the shard top-K collective, decision-stream
-# kill/resume + fault-segment continuity, openb explain/diff goldens).
-# Runs the full files including slow-marked cases (the synthetic
-# kill/resume + telemetry subsets are already wired into tier-1).
+# kill/resume + fault-segment continuity, openb explain/diff goldens),
+# and the live-telemetry suite (in-scan series cross-engine invariance,
+# series kill/resume + fault-segment continuity, /metrics-vs-textfile
+# equality, serve smoke). Runs the full files including slow-marked
+# cases (the synthetic kill/resume + telemetry subsets are already
+# wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py tests/test_decisions.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py tests/test_decisions.py tests/test_series.py -q
 
-# observability smoke (ENGINES.md "Round 8"): a small profiled scale run
-# emitting the full artifact set — JSONL run record (spans with the
-# compile/execute split + exact scan counters), Prometheus textfile,
-# Chrome-trace timeline — under .tpusim_obs/
+# observability smoke (ENGINES.md "Round 8"/"Round 10"): a small
+# profiled scale run emitting the full artifact set — JSONL run record
+# (spans with the compile/execute split + exact scan counters + the
+# in-scan series block), Prometheus textfile, Chrome-trace timeline
+# (with series counter tracks) — under the ignored .tpusim_obs/ scratch
+# dir, never the repo root
 profile-smoke:
 	JAX_PLATFORMS=cpu python bench_scale.py --nodes 2000 --pods 2000 \
-		--chunk 1000 --heartbeat 500 \
+		--chunk 1000 --heartbeat 500 --series-every 100 \
 		--profile .tpusim_obs/scale_profile.jsonl \
 		--metrics-out .tpusim_obs/scale_metrics.prom \
 		--trace-out .tpusim_obs/scale_trace.json
+
+# live-monitoring smoke (ENGINES.md "Round 10"): regenerate the profile
+# artifacts, then point `tpusim serve --once` at the scratch dir — one
+# poll, a real HTTP self-scrape, exit 0 iff /metrics parses as
+# exposition text. The long-running form (`tpusim serve .tpusim_obs`)
+# is the second-terminal view of a live checkpointed run.
+serve-smoke: profile-smoke
+	JAX_PLATFORMS=cpu python -m tpusim serve .tpusim_obs --once --listen :0
 
 # bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
 # measurement under profiling and diff it against the newest committed
 # BENCH_r*.json baseline — exact on events/placements/gpu_alloc
 # (machine-independent), tolerance-gated on same-backend throughput,
-# advisory on cross-backend throughput. Exit 1 on regression.
+# advisory on cross-backend throughput. Also smoke-checks the decision
+# JSONL round-trip (ISSUE 4) and that a live /metrics scrape of the
+# smoke record parses and is byte-equal to the emitted textfile
+# (ISSUE 5). Exit 1 on regression; artifacts land in .tpusim_obs/.
 bench-gate:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate
 

@@ -123,6 +123,17 @@ def main():
         help="in-scan progress line (events/s, ETA) every N events — "
         "long scans are no longer silent (0 = off)",
     )
+    ap.add_argument(
+        "--series-every", type=int, default=0, metavar="EVENTS",
+        help="sample the in-scan cluster time-series plane every N "
+        "processed events (0 = off); lands in the --profile JSONL and "
+        "as --trace-out counter tracks (README \"Live monitoring\")",
+    )
+    ap.add_argument(
+        "--listen", default="", metavar="[HOST]:PORT",
+        help="serve /metrics, /healthz, /progress over HTTP for the "
+        "run's lifetime (tpusim.obs.server; bare :PORT binds loopback)",
+    )
     args = ap.parse_args()
     if args.chunk <= 0:
         ap.error("--chunk must be positive")
@@ -148,6 +159,7 @@ def main():
         block_size=args.block_size,
         profile=profiling,
         heartbeat_every=args.heartbeat,
+        series_every=args.series_every,
         table_cache_dir=args.table_cache,
         typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
     )
@@ -169,11 +181,25 @@ def main():
 
     from tpusim.obs import bench as obs_bench
 
+    # live monitoring endpoint (--listen): up before the first dispatch
+    # so a scraper watches the whole run, /progress fed by the heartbeat
+    monitor = None
+    if args.listen:
+        from tpusim.obs.server import MonitorServer
+
+        monitor = MonitorServer(args.listen).start()
+        monitor.attach_heartbeat()
+        monitor.publish_progress(phase="starting", nodes=args.nodes,
+                                 pods=args.pods)
+        print(f"[obs] monitoring at {monitor.url} "
+              "(/metrics /healthz /progress)", file=sys.stderr)
+
     box = {}
 
     def run_chunked():
         state = sim.init_state
         failed_chunks = []
+        ser_logs = []
         for lo in range(0, int(ev_kind.shape[0]), args.chunk):
             hi = min(lo + args.chunk, int(ev_kind.shape[0]))
             res = sim.run_events(
@@ -183,10 +209,18 @@ def main():
             state = res.state
             # keep the reduction on device; pull once after the run
             failed_chunks.append(res.ever_failed.sum())
+            if res.series is not None:
+                # each chunk's scan restarts its stride clock at 0 —
+                # rebase onto the run-global event position like the
+                # driver's fault loop does
+                from tpusim.obs.series import log_from_stacked
+
+                ser_logs.append(log_from_stacked(res.series, base_pos=lo))
         jax.block_until_ready(state)
         box["out"] = (
             state, int(sum(int(np.asarray(f)) for f in failed_chunks))
         )
+        box["series"] = ser_logs  # last run's logs (cold run overwritten)
 
     # shared cold + warm protocol (tpusim.obs.bench): one compile run,
     # one warm run — the historical bench_scale shape
@@ -210,21 +244,46 @@ def main():
         + (f" table_cache={sim.obs.table_cache}" if args.table_cache else "")
     )
 
-    if profiling:
+    series_block = None
+    if args.series_every and box.get("series"):
+        from tpusim.obs.series import concat_series, series_to_record
+
+        series_block = series_to_record(
+            concat_series(box["series"]), args.series_every,
+            [name for name, _ in cfg.policies],
+        )
+
+    if profiling or monitor is not None:
         from tpusim.obs import emitters
 
-        for p in emitters.emit_all(
-            sim.run_telemetry(),
+        telemetry = sim.run_telemetry()
+        record = emitters.build_record(
+            telemetry,
+            meta={"bench": "bench_scale", "nodes": args.nodes,
+                  "pods": args.pods, "block": eff_block},
+            series=series_block,
+        )
+        counter_series = None
+        if args.trace_out:
+            counter_series = sim.event_counter_series()
+            if series_block is not None:
+                from tpusim.obs.series import series_from_record, series_tracks
+
+                counter_series.update(
+                    series_tracks(series_from_record(series_block))
+                )
+        for p in emitters.emit_record(
+            record, telemetry.spans,
             jsonl=args.profile,
             metrics=args.metrics_out,
             trace=args.trace_out,
-            meta={"bench": "bench_scale", "nodes": args.nodes,
-                  "pods": args.pods, "block": eff_block},
-            counter_series=(
-                sim.event_counter_series() if args.trace_out else None
-            ),
+            counter_series=counter_series,
         ):
             print(f"[obs] wrote {p}", file=sys.stderr)
+        if monitor is not None:
+            monitor.publish_record(record)
+            monitor.publish_progress(phase="done", events_done=args.pods,
+                                     events_total=args.pods)
 
 
 if __name__ == "__main__":

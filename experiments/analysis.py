@@ -481,6 +481,81 @@ def diff_decision_runs(path_a: str, path_b: str, buckets: int = 10) -> dict:
     )
 
 
+def plot_series(run_jsonl: str, out_png: str = "") -> str:
+    """Plot the in-scan series block of a run-record JSONL (ISSUE 5; a
+    `tpusim apply --profile --series-every` output): four panels over the
+    event axis — node-utilization histogram occupancy bands, frag by FGD
+    category, feasible/DOWN/retry counts, per-policy normalized score
+    hi/lo envelope. Renders straight from the record (no simulator, no
+    recomputation — the `tpusim report` contract, as a figure). Returns
+    the PNG path written (default: beside the JSONL)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    from tpusim.obs.emitters import read_jsonl
+    from tpusim.obs.series import series_from_record
+
+    records = [r for r in read_jsonl(run_jsonl) if r.get("series")]
+    if not records:
+        raise ValueError(
+            f"{run_jsonl}: no record carries a series block (was the run "
+            "made with --series-every and --profile?)"
+        )
+    series = records[-1]["series"]
+    log = series_from_record(series)
+    pos = np.asarray(log.pos)
+
+    fig, axes = plt.subplots(4, 1, figsize=(9, 11), sharex=True)
+    ax = axes[0]
+    hist = np.asarray(log.util_hist)
+    nb = hist.shape[1]
+    ax.stackplot(
+        pos, hist.T,
+        labels=[f"{100 * b // nb}-{100 * (b + 1) // nb}%"
+                for b in range(nb)],
+        cmap="viridis",
+    )
+    ax.set_ylabel("GPU nodes by occupancy")
+    ax.legend(fontsize=6, ncol=5, loc="upper left")
+
+    ax = axes[1]
+    frag = np.asarray(log.frag)
+    for j, name in enumerate(series.get("frag_categories", [])):
+        col = frag[:, j]
+        if col.any():
+            ax.plot(pos, col / 1000.0, label=name)
+    ax.set_ylabel("frag (GPUs)")
+    ax.legend(fontsize=7)
+
+    ax = axes[2]
+    ax.plot(pos, np.asarray(log.feasible), label="feasible nodes")
+    ax.plot(pos, np.asarray(log.nodes_down), label="nodes DOWN")
+    ax.plot(pos, np.asarray(log.retry_depth), label="retry queue")
+    ax.set_ylabel("count")
+    ax.legend(fontsize=7)
+
+    ax = axes[3]
+    hi = np.asarray(log.score_hi)
+    lo = np.asarray(log.score_lo)
+    for i, pol in enumerate(series.get("policies", [])):
+        (line,) = ax.plot(pos, hi[:, i], label=pol)
+        ax.fill_between(pos, lo[:, i], hi[:, i], alpha=0.15,
+                        color=line.get_color())
+    ax.set_ylabel("normalized score hi/lo")
+    ax.set_xlabel(f"event (stride {series.get('every')})")
+    ax.legend(fontsize=7)
+
+    fig.suptitle(os.path.basename(run_jsonl))
+    fig.tight_layout()
+    out_png = out_png or (os.path.splitext(run_jsonl)[0] + "_series.png")
+    fig.savefig(out_png, dpi=120)
+    plt.close(fig)
+    return out_png
+
+
 def main():
     ap = argparse.ArgumentParser(description="simulator log → analysis CSVs")
     ap.add_argument("-g", "--log-dir", help="experiment directory")
@@ -495,7 +570,26 @@ def main():
         help="diff two decision JSONLs (tpusim apply --decisions-out) "
         "instead of parsing logs: first divergence + histogram",
     )
+    ap.add_argument(
+        "--plot-series", metavar="RUN_JSONL",
+        help="plot the in-scan series block of a run-record JSONL "
+        "(tpusim apply --profile --series-every) to PNG — utilization "
+        "bands, frag by category, feasible/DOWN/retry, score envelopes",
+    )
+    ap.add_argument(
+        "-o", "--out", default="",
+        help="output PNG path for --plot-series (default: beside the "
+        "JSONL, *_series.png)",
+    )
     args = ap.parse_args()
+    if args.plot_series:
+        try:
+            path = plot_series(args.plot_series, args.out)
+        except (OSError, ValueError) as err:
+            print(f"analysis --plot-series: {err}", file=sys.stderr)
+            return 2
+        print(f"[analysis] wrote {path}")
+        return 0
     if args.diff_decisions:
         # exit codes mirror `tpusim diff`: 0 identical, 1 divergence,
         # 2 unusable input (missing/torn file, runs from different
@@ -508,7 +602,8 @@ def main():
         print(d["text"])
         return 1 if d["first"] else 0
     if not args.log_dir:
-        ap.error("-g/--log-dir is required (unless --diff-decisions)")
+        ap.error("-g/--log-dir is required (unless --diff-decisions / "
+                 "--plot-series)")
     result = analyze_dir(args.log_dir)
     s = result["summary"]
     print(

@@ -1,0 +1,602 @@
+"""tpusim.obs series + server — the live-telemetry plane (ISSUE 5).
+
+The contracts under test:
+  (1) the in-scan SeriesSample stream is bit-identical across the flat,
+      blocked, sequential, and shard_map engines (every field is an
+      integer reduction over state the engines maintain identically);
+  (2) the series is continuous across checkpoint kill/resume (the
+      stride clock rides the carry's counter) and across fault-path
+      segmentation (pos rebased onto the run clock, retry depth
+      stamped per segment), and bit-reproducible under a fixed seed;
+  (3) a /metrics scrape of a published record is byte-equal to the
+      write_prometheus textfile and parses as strict exposition text;
+  (4) `tpusim serve` observes a run from its artifact directory alone;
+  (5) Prometheus label values escape/unescape hostile characters
+      (backslash, quote, newline) round-trip exactly;
+  (6) the JSONL series block round-trips and `tpusim report` renders it
+      without recomputation.
+
+Compile-heavy cases (extra engine builds) are slow-marked into the
+`make resume-smoke` lane to hold the tier-1 time budget; the tier-1
+subset pins the table-engine driver path plus the host-side surfaces.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.fixtures import random_cluster, random_pods
+from tpusim.io.trace import NodeRow, PodRow
+from tpusim.obs import emitters, series
+from tpusim.policies import make_policy
+from tpusim.sim.driver import Simulator, SimulatorConfig
+from tpusim.sim.engine import EV_CREATE, EV_DELETE, make_replay
+from tpusim.sim.table_engine import build_pod_types, make_table_replay
+
+EVERY = 4
+
+
+def _mixed_events(num_pods, rng):
+    kinds, idxs, seen = [], [], set()
+    for i in range(num_pods):
+        kinds.append(EV_CREATE)
+        idxs.append(i)
+        if rng.random() < 0.3 and i > 0:
+            victim = int(rng.integers(0, i + 1))
+            if victim not in seen:
+                seen.add(victim)
+                kinds.append(EV_DELETE)
+                idxs.append(victim)
+    return jnp.asarray(kinds, jnp.int32), jnp.asarray(idxs, jnp.int32)
+
+
+@pytest.mark.slow
+def test_series_engine_invariant():
+    """The same create/delete mix yields a bit-identical SeriesSample
+    stream — sentinels included — on the flat, blocked, sequential, and
+    shard_map engines, at a multi-policy config that exercises the
+    minmax normalization path of score_stats.
+
+    slow-marked (tier-1 budget): four engine compiles; the tier-1 lane
+    still pins the table-engine series through the driver tests below."""
+    from tpusim.parallel import make_mesh, pad_nodes, shard_state
+    from tpusim.parallel.shard_engine import make_shardmap_table_replay
+
+    rng = np.random.default_rng(7)
+    state, tp = random_cluster(rng, num_nodes=24)
+    pods = random_pods(rng, num_pods=40)
+    ev_kind, ev_pod = _mixed_events(40, rng)
+    policies = [
+        (make_policy("FGDScore"), 1000),
+        (make_policy("BestFitScore"), 500),
+    ]
+    key = jax.random.PRNGKey(3)
+    rank = jnp.asarray(rng.permutation(24).astype(np.int32))
+    types = build_pod_types(pods)
+
+    flat = make_table_replay(
+        policies, gpu_sel="FGDScore", block_size=-1, series_every=EVERY
+    )(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+    blocked = make_table_replay(
+        policies, gpu_sel="FGDScore", block_size=8, series_every=EVERY
+    )(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+    seq = make_replay(
+        policies, gpu_sel="FGDScore", report=False, series_every=EVERY
+    )(state, pods, ev_kind, ev_pod, tp, key, rank)
+    mesh = make_mesh(4)
+    st_p, rank_p = pad_nodes(state, rank, 4)
+    shard = make_shardmap_table_replay(
+        policies, mesh, gpu_sel="FGDScore", series_every=EVERY
+    )(shard_state(st_p, mesh), pods, types, ev_kind, ev_pod, tp, key,
+      rank_p)
+
+    assert flat.series is not None
+    for name, out in (("blocked", blocked), ("seq", seq),
+                      ("shard", shard)):
+        for f in series.SeriesSample._fields:
+            assert np.array_equal(
+                np.asarray(getattr(flat.series, f)),
+                np.asarray(getattr(out.series, f)),
+            ), (name, f)
+        assert np.array_equal(
+            np.asarray(out.placed_node), np.asarray(flat.placed_node)
+        ), name
+    # the mix actually produced real samples on the stride grid
+    pos = np.asarray(flat.series.pos)
+    real = pos[pos >= 0]
+    assert len(real) > 2 and np.array_equal(real % EVERY, np.zeros_like(real))
+    # trajectory untouched by sampling: same placements as a series-free
+    # build of the same engine
+    bare = make_table_replay(policies, gpu_sel="FGDScore", block_size=-1)(
+        state, pods, types, ev_kind, ev_pod, tp, key, rank
+    )
+    assert np.array_equal(
+        np.asarray(bare.placed_node), np.asarray(flat.placed_node)
+    )
+
+
+# ---------------------------------------------------------------------------
+# driver surface (table engine — the tier-1 subset)
+# ---------------------------------------------------------------------------
+
+
+def _driver_inputs():
+    rng = np.random.default_rng(31)
+    nodes = [
+        NodeRow(f"n{i}", 32000, 131072, int(g), "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], 12))
+    ]
+    pods = [
+        PodRow(f"p{i}", int(rng.choice([1000, 4000])), 1024,
+               int(rng.choice([0, 1])), 500)
+        for i in range(30)
+    ]
+    return nodes, pods
+
+
+def _make_sim(nodes, pods, every=0, ckdir=""):
+    sim = Simulator(nodes, SimulatorConfig(
+        policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+        report_per_event=False, series_every=EVERY, seed=42,
+        checkpoint_every=every, checkpoint_dir=ckdir,
+    ))
+    sim.set_workload_pods(pods)
+    return sim
+
+
+def test_driver_series_to_report(tmp_path, capsys):
+    """run() surfaces a filtered SeriesLog on the run-global event grid;
+    the JSONL record block round-trips bit-exactly and `tpusim report`
+    renders it straight from the file."""
+    from tpusim.cli import main as cli_main
+
+    nodes, pods = _driver_inputs()
+    sim = _make_sim(nodes, pods)
+    res = sim.run()
+    log = res.series
+    assert log is not None
+    pos = np.asarray(log.pos)
+    assert len(pos) > 2
+    assert np.array_equal(pos % EVERY, np.zeros_like(pos))
+    assert np.array_equal(pos, np.sort(pos))
+    assert np.asarray(log.util_hist).shape == (len(pos), series.UTIL_BUCKETS)
+    assert np.asarray(log.frag).shape == (len(pos), 7)
+    # no faults in this run: DOWN and retry columns are all zero
+    assert not np.asarray(log.nodes_down).any()
+    assert not np.asarray(log.retry_depth).any()
+
+    block = series.series_to_record(
+        log, EVERY, [n for n, _ in sim.cfg.policies]
+    )
+    back = series.series_from_record(block)
+    for f in series.SeriesLog._fields:
+        assert np.array_equal(
+            np.asarray(getattr(log, f)), np.asarray(getattr(back, f))
+        ), f
+    with pytest.raises(ValueError):
+        series.series_from_record({"schema": "bogus"})
+
+    # record → JSONL → tpusim report, no recomputation
+    record = emitters.build_record(sim.run_telemetry(), series=block)
+    path = str(tmp_path / "run.jsonl")
+    emitters.append_jsonl(path, record)
+    assert cli_main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert f"stride {EVERY} events" in out
+    assert "feasible_nodes" in out and "frag_q3_satisfied" in out
+    # a record without a series block exits 2 with a one-line error
+    bare = str(tmp_path / "bare.jsonl")
+    emitters.append_jsonl(bare, emitters.build_record(sim.run_telemetry()))
+    assert cli_main(["report", bare]) == 2
+
+    # Chrome counter tracks share the vocabulary
+    tracks = series.series_tracks(log)
+    assert set(tracks) >= {
+        "series_feasible_nodes", "series_nodes_down", "series_retry_depth",
+    } | {f"series_frag_{n}" for n in series.FRAG_CATEGORY_NAMES}
+
+
+def test_series_config_validation():
+    nodes, pods = _driver_inputs()
+    with pytest.raises(ValueError, match="series_every must be >= 0"):
+        Simulator(nodes, SimulatorConfig(series_every=-1))
+    with pytest.raises(ValueError, match="pallas"):
+        Simulator(nodes, SimulatorConfig(series_every=2, engine="pallas"))
+
+
+@pytest.mark.slow
+def test_series_survive_kill_resume(tmp_path):
+    """Series continuity across checkpoint kill/resume: the stride clock
+    is the carry's event counter, so the resumed run's SeriesLog is
+    bit-identical to the uninterrupted run's. slow-marked: the chunked
+    replay re-traces the scan per segment length."""
+    import tpusim.io.storage as storage
+
+    nodes, pods = _driver_inputs()
+    r0 = _make_sim(nodes, pods).run()
+
+    real_save = storage.save_checkpoint
+
+    def killing_save(*a, **k):
+        real_save(*a, **k)
+        raise KeyboardInterrupt("simulated preemption")
+
+    storage.save_checkpoint = killing_save
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            _make_sim(nodes, pods, every=10, ckdir=str(tmp_path)).run()
+    finally:
+        storage.save_checkpoint = real_save
+    assert os.listdir(tmp_path)
+
+    sim2 = _make_sim(nodes, pods, every=10, ckdir=str(tmp_path))
+    r2 = sim2.run()
+    assert any("[Checkpoint] resumed replay" in l for l in sim2.log.lines)
+    for f in series.SeriesLog._fields:
+        assert np.array_equal(
+            np.asarray(getattr(r0.series, f)),
+            np.asarray(getattr(r2.series, f)),
+        ), f
+
+
+@pytest.mark.slow
+def test_series_fault_segments():
+    """Fault runs: every segment opens with a sample of the post-fault
+    cluster rebased onto the run-global clock, the host stamps the
+    retry-queue depth, DOWN nodes show up in nodes_down — and the whole
+    log is bit-reproducible under a fixed seed. slow-marked: the fault
+    loop re-traces the scan per distinct segment length."""
+    from tpusim.sim.faults import FaultConfig
+
+    nodes, pods = _driver_inputs()
+    fcfg = dict(mtbf_events=5, mttr_events=7, evict_every_events=11, seed=9)
+    res = _make_sim(nodes, pods).run_with_faults(FaultConfig(**fcfg))
+    log = res.series
+    assert log is not None
+    pos = np.asarray(log.pos)
+    assert len(pos) > 2 and np.array_equal(pos, np.sort(pos))
+    # faults actually happened and the series saw them
+    assert np.asarray(log.nodes_down).max() > 0
+    assert np.asarray(log.retry_depth).max() > 0
+    res2 = _make_sim(nodes, pods).run_with_faults(FaultConfig(**fcfg))
+    for f in series.SeriesLog._fields:
+        assert np.array_equal(
+            np.asarray(getattr(log, f)),
+            np.asarray(getattr(res2.series, f)),
+        ), f
+
+
+@pytest.mark.slow
+def test_series_openb_acceptance(tmp_path):
+    """The ISSUE 5 acceptance criterion on real trace data: a
+    fault-injected openb-prefix run with series sampling yields (a) a
+    bit-identical series across the table, blocked, sequential, and
+    shard_map engines and across a checkpoint kill/resume, and (b/c) a
+    /metrics scrape over real HTTP that parses as exposition text and is
+    byte-equal to the write_prometheus textfile of the same record."""
+    from tpusim.io.trace import load_node_csv, load_pod_csv
+    from tpusim.obs.server import MonitorServer
+    from tpusim.sim.faults import FaultConfig
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    node_csv = os.path.join(repo, "data/csv/openb_node_list_gpu_node.csv")
+    pod_csv = os.path.join(repo, "data/csv/openb_pod_list_default.csv")
+    if not (os.path.isfile(node_csv) and os.path.isfile(pod_csv)):
+        pytest.skip("openb traces not present")
+    nodes = load_node_csv(node_csv)[:150]
+    pods = load_pod_csv(pod_csv)[:80]
+    fcfg = dict(mtbf_events=25, mttr_events=30, seed=9)
+
+    def run(**cfg_kw):
+        sim = Simulator(nodes, SimulatorConfig(
+            policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+            report_per_event=False, series_every=8, seed=42, **cfg_kw,
+        ))
+        sim.set_workload_pods(pods)
+        return sim, sim.run_with_faults(FaultConfig(**fcfg))
+
+    sim_t, table = run()
+    _, blocked = run(block_size=16)
+    _, seq = run(engine="sequential")
+    _, shard = run(mesh=4)
+    for name, res in (("blocked", blocked), ("sequential", seq),
+                      ("shard", shard)):
+        for f in series.SeriesLog._fields:
+            assert np.array_equal(
+                np.asarray(getattr(table.series, f)),
+                np.asarray(getattr(res.series, f)),
+            ), (name, f)
+    assert len(np.asarray(table.series.pos)) > 2
+
+    # kill/resume continuity on the same prefix (unfaulted run: the
+    # chunked dispatch owns the checkpoint layout)
+    import tpusim.io.storage as storage
+
+    sim_p = Simulator(nodes, SimulatorConfig(
+        policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+        report_per_event=False, series_every=8, seed=42,
+    ))
+    sim_p.set_workload_pods(pods)
+    r0 = sim_p.run()
+    ckdir = str(tmp_path / "ck")
+    real_save = storage.save_checkpoint
+
+    def killing_save(*a, **k):
+        real_save(*a, **k)
+        raise KeyboardInterrupt("simulated preemption")
+
+    storage.save_checkpoint = killing_save
+    try:
+        sim_k = Simulator(nodes, SimulatorConfig(
+            policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+            report_per_event=False, series_every=8, seed=42,
+            checkpoint_every=30, checkpoint_dir=ckdir,
+        ))
+        sim_k.set_workload_pods(pods)
+        with pytest.raises(KeyboardInterrupt):
+            sim_k.run()
+    finally:
+        storage.save_checkpoint = real_save
+    sim_r = Simulator(nodes, SimulatorConfig(
+        policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+        report_per_event=False, series_every=8, seed=42,
+        checkpoint_every=30, checkpoint_dir=ckdir,
+    ))
+    sim_r.set_workload_pods(pods)
+    r2 = sim_r.run()
+    assert any("[Checkpoint] resumed replay" in l for l in sim_r.log.lines)
+    for f in series.SeriesLog._fields:
+        assert np.array_equal(
+            np.asarray(getattr(r0.series, f)),
+            np.asarray(getattr(r2.series, f)),
+        ), f
+
+    # live endpoint: publish the fault run's record, scrape, compare
+    block = series.series_to_record(
+        table.series, 8, [n for n, _ in sim_t.cfg.policies]
+    )
+    record = emitters.build_record(sim_t.run_telemetry(), series=block)
+    path = str(tmp_path / "m.prom")
+    emitters.write_prometheus(path, record)
+    srv = MonitorServer(":0").start()
+    try:
+        srv.publish_record(record)
+        scrape = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=10).read().decode()
+    finally:
+        srv.stop()
+    assert scrape == open(path).read()
+    assert emitters.parse_prometheus_text(scrape)
+    # the record renders without recomputation
+    assert "feasible_nodes" in series.format_report(block)
+
+
+# ---------------------------------------------------------------------------
+# host-side surfaces: escaping, record parsing, server (no engine compiles)
+# ---------------------------------------------------------------------------
+
+HOSTILE = 'sp\\an "quoted"\nnew\\nline'
+
+
+def test_prometheus_escape_roundtrip():
+    assert emitters.escape_label_value(HOSTILE) == (
+        r'sp\\an \"quoted\"\nnew\\nline'
+    )
+    assert emitters.unescape_label_value(
+        emitters.escape_label_value(HOSTILE)
+    ) == HOSTILE
+    # the subtle case chained replaces get wrong: literal backslash-n
+    assert emitters.escape_label_value("a\\nb") == r"a\\nb"
+    assert emitters.unescape_label_value(r"a\\nb") == "a\\nb"
+    assert emitters.unescape_label_value(r"a\nb") == "a\nb"
+
+
+def _hostile_record():
+    """A telemetry record whose span name carries every escaped char."""
+    from tpusim.obs import Recorder
+
+    rec = Recorder(enabled=True)
+    with rec.span(HOSTILE, engine="table") as h:
+        h.dispatched()
+    rec.note_scan("table", counters=np.array([3, 3, 0, 0, 0, 0]),
+                  pad_skips=0, events=3)
+    return rec.snapshot(meta={"seed": 1}).to_record()
+
+
+def test_prometheus_hostile_label_roundtrip(tmp_path):
+    """A span named with backslash/quote/newline survives the textfile →
+    strict parse round trip with its exact name (ISSUE 5 satellite)."""
+    record = _hostile_record()
+    path = str(tmp_path / "m.prom")
+    emitters.write_prometheus(path, record)
+    text = open(path).read()
+    # single-line samples only: the newline in the name must be escaped
+    parsed = emitters.parse_prometheus_text(text)
+    names = {
+        dict(labels).get("name")
+        for (metric, labels) in parsed
+        if metric.endswith("span_count")
+    }
+    assert HOSTILE in names
+    # parser rejects torn/duplicate exposition text
+    with pytest.raises(ValueError, match="duplicate"):
+        emitters.parse_prometheus_text("a 1\na 1\n")
+    with pytest.raises(ValueError, match="not a valid sample"):
+        emitters.parse_prometheus_text('a{b="unterminated 1\n')
+
+
+def test_monitor_scrape_equals_textfile(tmp_path):
+    """MonitorServer /metrics is byte-equal to write_prometheus of the
+    same record; /healthz and /progress serve JSON; unknown paths 404;
+    an unpublished server answers 503 on /metrics."""
+    from tpusim.obs.server import MonitorServer, parse_listen
+
+    assert parse_listen(":0") == ("127.0.0.1", 0)
+    assert parse_listen("8080") == ("127.0.0.1", 8080)
+    assert parse_listen("0.0.0.0:9") == ("0.0.0.0", 9)
+    with pytest.raises(ValueError, match="port"):
+        parse_listen("host:nope")
+
+    record = _hostile_record()
+    # a series block rides along, hostile policy name included
+    log = series.SeriesLog(
+        pos=np.array([0, 4], np.int64),
+        util_hist=np.zeros((2, series.UTIL_BUCKETS), np.int64),
+        nodes_down=np.array([0, 1], np.int64),
+        feasible=np.array([5, 4], np.int64),
+        frag=np.zeros((2, 7), np.int64),
+        score_hi=np.array([[7], [9]], np.int64),
+        score_lo=np.array([[1], [2]], np.int64),
+        retry_depth=np.array([0, 2], np.int64),
+    )
+    record["series"] = series.series_to_record(log, 4, [HOSTILE])
+
+    srv = MonitorServer(":0").start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(srv.url + "/metrics", timeout=10)
+        assert err.value.code == 503
+        srv.publish_record(record)
+        srv.publish_progress(phase="scan", events_done=4, events_total=8)
+        scrape = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=10).read().decode()
+        path = str(tmp_path / "m.prom")
+        emitters.write_prometheus(path, record)
+        assert scrape == open(path).read()
+        parsed = emitters.parse_prometheus_text(scrape)
+        assert parsed[("tpusim_series_retry_depth", ())] == 2.0
+        assert parsed[("tpusim_series_score_hi",
+                       (("policy", HOSTILE),))] == 9.0
+        health = json.loads(urllib.request.urlopen(
+            srv.url + "/healthz", timeout=10).read().decode())
+        assert health["ok"] and health["records"] == 1
+        prog = json.loads(urllib.request.urlopen(
+            srv.url + "/progress", timeout=10).read().decode())
+        assert prog["phase"] == "scan" and prog["events_done"] == 4
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(srv.url + "/nope", timeout=10)
+        assert err.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_serve_dir_watches_artifacts(tmp_path):
+    """`tpusim serve` republishes the newest run record and reads run
+    progress out of checkpoint filenames — a killed or running
+    checkpointed run is observable from its artifact directory alone."""
+    from tpusim.io.storage import CHECKPOINT_SUFFIX
+    from tpusim.obs.server import serve_dir, watch_dir
+
+    record = _hostile_record()
+    emitters.append_jsonl(str(tmp_path / "run.jsonl"), record)
+    open(str(tmp_path / f"ab12.e{25:010d}{CHECKPOINT_SUFFIX}"), "wb").close()
+    open(str(tmp_path / f"ab12.e{10:010d}{CHECKPOINT_SUFFIX}"), "wb").close()
+
+    rec, prog = watch_dir(str(tmp_path))
+    assert rec is not None and rec["schema"] == record["schema"]
+    assert prog["phase"] == "checkpointed" and prog["events_done"] == 25
+
+    srv = serve_dir(str(tmp_path), listen=":0", once=True)
+    try:
+        scrape = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=10).read().decode()
+        assert emitters.parse_prometheus_text(scrape)
+        prog = json.loads(urllib.request.urlopen(
+            srv.url + "/progress", timeout=10).read().decode())
+        assert prog["events_done"] == 25
+        assert prog["record_file"] == "run.jsonl"
+    finally:
+        srv.stop()
+    # missing dir: healthy server, honest phase
+    _, prog = watch_dir(str(tmp_path / "gone"))
+    assert prog["phase"] == "missing-dir"
+
+
+def test_serve_once_cli(tmp_path, capsys):
+    """`tpusim serve DIR --once` exits 0 and prints the scrape verdict
+    (the `make serve-smoke` entry)."""
+    from tpusim.cli import main as cli_main
+
+    emitters.append_jsonl(str(tmp_path / "run.jsonl"), _hostile_record())
+    assert cli_main(
+        ["serve", str(tmp_path), "--once", "--listen", ":0"]
+    ) == 0
+    err = capsys.readouterr().err
+    assert "/metrics parses" in err
+    # an empty dir is still healthy — nothing to scrape is not an error
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli_main(
+        ["serve", str(empty), "--once", "--listen", ":0"]
+    ) == 0
+    assert "no run record yet" in capsys.readouterr().err
+
+
+def test_heartbeat_run_level_progress():
+    """The heartbeat listener hook feeds run-level numbers: `base` lifts
+    segment-local counts onto the run clock, note_resume() keeps the
+    rate honest, complete() fires a final tick that disarms."""
+    from tpusim.obs import heartbeat
+
+    seen = []
+    heartbeat.add_listener(seen.append)
+    try:
+        heartbeat.configure(100, "test", sink=lambda line: None, base=40)
+        heartbeat.note_resume(10)
+        heartbeat.tick(20)  # segment-local 20 → run-level 60
+        assert seen and seen[-1]["done"] == 60
+        assert seen[-1]["total"] == 100 and not seen[-1]["final"]
+        assert seen[-1]["eta"] >= 0.0
+        heartbeat.complete()
+        assert seen[-1]["final"] and seen[-1]["done"] == seen[-1]["total"]
+        n = len(seen)
+        heartbeat.complete()  # disarmed: no further notifications
+        assert len(seen) == n
+        # a fault SEGMENT's final tick stays on the run clock: armed
+        # run-level (base + padded segment), completed with the
+        # segment-local true count — never a backwards jump to
+        # segment-local numbers
+        heartbeat.configure(100, "test", sink=lambda line: None, base=40)
+        heartbeat.complete(true_total=30)
+        assert seen[-1]["done"] == 70 and seen[-1]["total"] == 70
+    finally:
+        heartbeat.remove_listener(seen.append)
+    # a broken listener never kills the replay
+    def boom(info):
+        raise RuntimeError("broken listener")
+
+    heartbeat.add_listener(boom)
+    try:
+        heartbeat.configure(10, "test", sink=lambda line: None)
+        heartbeat.tick(5)
+    finally:
+        heartbeat.remove_listener(boom)
+
+
+def test_sparkline_and_stats():
+    assert series.sparkline([]) == ""
+    assert series.sparkline([1, 1, 1]) == "▁▁▁"
+    line = series.sparkline(list(range(100)), width=10)
+    assert 0 < len(line) <= 11 and line[-1] == "█"
+    # concat + rebase: the fault path's segment merge
+    a = series.log_from_stacked(series.SeriesSample(
+        pos=np.array([-1, 0, -1, 4]),
+        util_hist=np.zeros((4, series.UTIL_BUCKETS), np.int32),
+        nodes_down=np.zeros(4, np.int32),
+        feasible=np.arange(4, dtype=np.int32),
+        frag=np.zeros((4, 7), np.int32),
+        score_hi=np.zeros((4, 2), np.int32),
+        score_lo=np.zeros((4, 2), np.int32),
+    ), base_pos=100, retry_depth=3)
+    assert np.array_equal(np.asarray(a.pos), [100, 104])
+    assert np.array_equal(np.asarray(a.feasible), [1, 3])
+    assert np.array_equal(np.asarray(a.retry_depth), [3, 3])
+    assert series.concat_series([]) is None
+    both = series.concat_series([a, None, a])
+    assert np.array_equal(np.asarray(both.pos), [100, 104, 100, 104])

@@ -70,6 +70,18 @@ class ApplyOptions:
     # the run's decision JSONL there — the input of `tpusim explain` /
     # `tpusim diff`.
     decisions_out: str = ""
+    # in-scan cluster time-series plane (ISSUE 5; README "Live
+    # monitoring"): > 0 samples utilization/frag/score distributions
+    # every N processed events from inside the scan
+    # (SimulatorConfig.series_every); the series lands in the JSONL run
+    # record, the Chrome counter tracks, and `tpusim report`.
+    series_every: int = 0
+    # live monitoring endpoint: "HOST:PORT" / ":PORT" / "PORT" starts a
+    # threaded HTTP server (tpusim.obs.server.MonitorServer) for the
+    # run's lifetime — /metrics (Prometheus text; the final publish is
+    # byte-equal to --metrics-out), /healthz, /progress (heartbeat-fed
+    # phase/ev-per-s/ETA). Empty = off; bare ":PORT" binds loopback.
+    listen: str = ""
 
 
 class Applier:
@@ -115,6 +127,7 @@ class Applier:
             ),
             heartbeat_every=self.options.heartbeat_every,
             record_decisions=bool(self.options.decisions_out),
+            series_every=self.options.series_every,
         )
 
     def _fault_config(self):
@@ -182,6 +195,22 @@ class Applier:
             raise ValueError(f"no Node manifests under {self.cr.custom_cluster}")
         cc = self.cr.custom_config
 
+        # live monitoring endpoint (--listen): up BEFORE the replay so a
+        # scraper sees the run from its first phase; lives for the
+        # process (a daemon thread — `tpusim serve` covers post-hoc
+        # watching of checkpoint/record directories)
+        self.monitor = None
+        if self.options.listen:
+            from tpusim.obs.server import MonitorServer
+
+            self.monitor = MonitorServer(self.options.listen).start()
+            self.monitor.attach_heartbeat()
+            self.monitor.publish_progress(phase="loading")
+            print(
+                f"[obs] monitoring at {self.monitor.url} "
+                "(/metrics /healthz /progress)", file=out,
+            )
+
         sim = Simulator(cluster.nodes, self._simulator_config())
         sim.log.stream = out
         self.sim = sim
@@ -191,6 +220,11 @@ class Applier:
         ds_pods = cluster.daemonset_pods()
         sim.set_workload_pods(workload + ds_pods)
         fault_cfg = self._fault_config()
+        if self.monitor is not None:
+            self.monitor.publish_progress(
+                phase="scheduling", nodes=len(cluster.nodes),
+                pods=len(workload) + len(ds_pods),
+            )
         if fault_cfg is not None:
             sim.run_with_faults(fault_cfg)
         else:
@@ -231,6 +265,11 @@ class Applier:
         result = sim.last_result
         sim.finish()
         self._emit_telemetry(sim, out)
+        if self.monitor is not None:
+            self.monitor.publish_progress(
+                phase="done", events_done=result.events,
+                events_total=result.events,
+            )
         self._emit_decisions(sim, out)
         self._verdict(result, out)
         if self.options.report_tables:
@@ -248,27 +287,58 @@ class Applier:
             )
         return result
 
+    def _series_block(self, sim: Simulator):
+        """The run's in-scan series as a JSONL record block, or None when
+        series sampling was off (no key then — old records stay
+        byte-identical)."""
+        res = getattr(sim, "last_result", None)
+        if res is None or res.series is None:
+            return None
+        from tpusim.obs.series import series_to_record
+
+        return series_to_record(
+            res.series, sim.cfg.series_every,
+            [name for name, _ in sim.cfg.policies],
+        )
+
     def _emit_telemetry(self, sim: Simulator, out):
         """Write the requested obs artifacts (--profile / --metrics-out /
         --trace-out) from the full experiment's telemetry — every stage
         (main schedule, inflation, deschedule, apps) contributed spans
-        and counters to the one recorder."""
+        and counters to the one recorder. The record is built ONCE and
+        shared with the live /metrics endpoint, so the final scrape of a
+        --listen run is byte-equal to the --metrics-out textfile."""
         o = self.options
-        if not (o.profile_out or o.metrics_out or o.trace_out):
+        if not (o.profile_out or o.metrics_out or o.trace_out
+                or self.monitor is not None):
             return
         from tpusim.obs import emitters
 
-        paths = emitters.emit_all(
-            sim.run_telemetry(),
-            jsonl=o.profile_out,
-            metrics=o.metrics_out,
-            trace=o.trace_out,
-            # only the Chrome-trace emitter consumes the counter series;
-            # building it walks every per-event report row (O(E))
-            counter_series=(
-                sim.event_counter_series() if o.trace_out else None
-            ),
+        telemetry = sim.run_telemetry()
+        record = emitters.build_record(
+            telemetry, series=self._series_block(sim)
         )
+        counter_series = None
+        if o.trace_out:
+            # only the Chrome-trace emitter consumes the counter series;
+            # building it walks every per-event report row (O(E)). The
+            # in-scan series adds its own counter tracks (per sample, not
+            # per event — each track is laid across the wall window
+            # independently).
+            counter_series = sim.event_counter_series()
+            if sim.last_result.series is not None:
+                from tpusim.obs.series import series_tracks
+
+                counter_series.update(
+                    series_tracks(sim.last_result.series)
+                )
+        paths = emitters.emit_record(
+            record, telemetry.spans,
+            jsonl=o.profile_out, metrics=o.metrics_out, trace=o.trace_out,
+            counter_series=counter_series,
+        )
+        if self.monitor is not None:
+            self.monitor.publish_record(record)
         for p in paths:
             print(f"[obs] wrote {p}", file=out)
 

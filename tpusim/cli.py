@@ -1,13 +1,18 @@
 """`tpusim` command-line interface (ref: cmd/, the cobra `simon` tree).
 
 Subcommands mirror the reference binary, plus the decision-provenance
-verbs (ISSUE 4):
+verbs (ISSUE 4) and the live-telemetry verbs (ISSUE 5):
   apply    run a simulation from a Simon-CR cluster config
            (ref: cmd/apply/apply.go:14-40)
   explain  why a node won one scheduling decision: per-policy score
            table + runner-ups, from a `--decisions-out` JSONL
   diff     first-divergence finder + divergence histogram between two
            decision JSONLs (e.g. FGD vs BestFit over the same trace)
+  report   terminal summary of a run record's in-scan series (min /
+           median / max + sparkline per series), from a `--profile`
+           JSONL of a `--series-every` run
+  serve    watch a directory of run records / checkpoints and expose
+           /metrics, /healthz, /progress over HTTP
   version  print version/commit (ref: cmd/version/version.go)
   gen-doc  emit markdown docs for the CLI tree (ref: cmd/doc/)
   debug    scaffold, intentionally empty (ref: cmd/debug/debug.go)
@@ -99,11 +104,13 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     # observability (README "Profiling & telemetry"; tpusim.obs)
     p_apply.add_argument(
-        "--profile", nargs="?", const="tpusim_profile.jsonl", default="",
-        metavar="PATH",
+        "--profile", nargs="?",
+        const=os.path.join(".tpusim_obs", "tpusim_profile.jsonl"),
+        default="", metavar="PATH",
         help="profile the run and append a JSONL run record (spans with "
         "compile/execute split, exact scan counters, degrade/fault "
-        "counts); default path tpusim_profile.jsonl",
+        "counts); default path .tpusim_obs/tpusim_profile.jsonl (the "
+        "ignored obs scratch dir — smoke artifacts stay out of the tree)",
     )
     p_apply.add_argument(
         "--metrics-out", default="", metavar="PATH",
@@ -125,6 +132,20 @@ def _build_parser() -> argparse.ArgumentParser:
         help="record per-event decision provenance (winner, per-policy "
         "score contributions, top-K runner-ups) and write it as JSONL — "
         "the input of `tpusim explain` / `tpusim diff`",
+    )
+    # live cluster telemetry (README "Live monitoring"; ISSUE 5)
+    p_apply.add_argument(
+        "--series-every", type=int, default=0, metavar="EVENTS",
+        help="sample the in-scan cluster time-series plane (utilization "
+        "histogram, per-category frag, feasible count, per-policy score "
+        "extrema) every N processed events (0 = off); lands in the "
+        "--profile JSONL, the Chrome counter tracks, and `tpusim report`",
+    )
+    p_apply.add_argument(
+        "--listen", default="", metavar="[HOST]:PORT",
+        help="serve /metrics, /healthz, /progress over HTTP for the "
+        "run's lifetime (the final /metrics scrape is byte-equal to "
+        "--metrics-out); bare :PORT binds loopback only",
     )
 
     p_explain = sub.add_parser(
@@ -148,6 +169,41 @@ def _build_parser() -> argparse.ArgumentParser:
     p_diff.add_argument(
         "--buckets", type=int, default=10,
         help="event-range buckets of the divergence histogram",
+    )
+
+    p_report = sub.add_parser(
+        "report",
+        help="terminal summary of a run record's in-scan series "
+        "(min/median/max + sparkline, straight from the JSONL — no "
+        "recomputation)",
+    )
+    p_report.add_argument(
+        "run", help="run-record JSONL (a --profile output of a "
+        "--series-every run)",
+    )
+
+    p_serve = sub.add_parser(
+        "serve",
+        help="watch a directory of run records / checkpoints and expose "
+        "/metrics, /healthz, /progress over HTTP",
+    )
+    p_serve.add_argument(
+        "dir", help="directory to watch (run-record JSONLs and "
+        "io.storage checkpoint files)",
+    )
+    p_serve.add_argument(
+        "--listen", default="", metavar="[HOST]:PORT",
+        help="bind address (default loopback on port 8642); bare :PORT "
+        "binds loopback only",
+    )
+    p_serve.add_argument(
+        "--poll", type=float, default=2.0, metavar="SECONDS",
+        help="directory poll interval",
+    )
+    p_serve.add_argument(
+        "--once", action="store_true",
+        help="publish a single poll, self-scrape /metrics and /healthz, "
+        "print the verdict, and exit (the `make serve-smoke` mode)",
     )
 
     sub.add_parser("version", help="print version")
@@ -184,6 +240,8 @@ def cmd_apply(args) -> int:
         trace_out=args.trace_out,
         heartbeat_every=args.heartbeat_every,
         decisions_out=args.decisions_out,
+        series_every=args.series_every,
+        listen=args.listen,
     )
     Applier(opts).run()
     return 0
@@ -226,6 +284,70 @@ def cmd_diff(args) -> int:
     return 1 if d["first"] else 0
 
 
+def cmd_report(args) -> int:
+    from tpusim.obs.emitters import read_jsonl
+    from tpusim.obs.series import format_report
+
+    # same exit discipline as explain/diff: 2 on unusable input, with a
+    # one-line error instead of a traceback
+    try:
+        records = read_jsonl(args.run)
+        with_series = [r for r in records if r.get("series")]
+        if not with_series:
+            raise ValueError(
+                f"{args.run}: no record carries a series block (was the "
+                "run made with --series-every and --profile?)"
+            )
+        print(format_report(with_series[-1]["series"]))
+    except (OSError, ValueError, json.JSONDecodeError) as err:
+        print(f"tpusim report: {err}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from tpusim.obs.server import serve_dir
+
+    try:
+        if args.once:
+            # smoke mode: one poll, a real self-scrape over HTTP, exit.
+            # Exit 2 when the scrape fails or the /metrics text does not
+            # parse — the `make serve-smoke` verdict.
+            import urllib.request
+
+            from tpusim.obs.emitters import parse_prometheus_text
+
+            srv = serve_dir(args.dir, listen=args.listen,
+                            poll_s=args.poll, once=True, out=sys.stderr)
+            try:
+                with urllib.request.urlopen(srv.url + "/healthz",
+                                            timeout=10) as r:
+                    health = json.loads(r.read().decode())
+                try:
+                    with urllib.request.urlopen(srv.url + "/metrics",
+                                                timeout=10) as r:
+                        text = r.read().decode()
+                except urllib.error.HTTPError as err:
+                    # 503 = no run record in the directory yet — the
+                    # server is healthy, there is just nothing to scrape
+                    print(f"[serve] once: healthz ok={health.get('ok')}, "
+                          f"no run record yet (/metrics {err.code})",
+                          file=sys.stderr)
+                else:
+                    n = len(parse_prometheus_text(text))
+                    print(f"[serve] once: healthz ok={health.get('ok')}, "
+                          f"/metrics parses ({n} series)", file=sys.stderr)
+            finally:
+                srv.stop()
+            return 0
+        serve_dir(args.dir, listen=args.listen, poll_s=args.poll,
+                  out=sys.stderr)
+    except (OSError, ValueError) as err:
+        print(f"tpusim serve: {err}", file=sys.stderr)
+        return 2
+    return 0
+
+
 def cmd_gen_doc(parser: argparse.ArgumentParser, args) -> int:
     os.makedirs(args.dir, exist_ok=True)
     path = os.path.join(args.dir, "tpusim.md")
@@ -244,6 +366,10 @@ def main(argv=None) -> int:
         return cmd_explain(args)
     if args.command == "diff":
         return cmd_diff(args)
+    if args.command == "report":
+        return cmd_report(args)
+    if args.command == "serve":
+        return cmd_serve(args)
     if args.command == "version":
         print(f"tpusim version {VERSION} (commit {COMMIT})")
         return 0

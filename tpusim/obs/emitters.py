@@ -61,6 +61,83 @@ def _metric_name(*parts: str) -> str:
     return _METRIC_RE.sub("_", "_".join(p for p in parts if p)).lower()
 
 
+def escape_label_value(value: str) -> str:
+    """Escape a label VALUE per the Prometheus exposition format (text
+    version 0.0.4): backslash, double-quote, and line-feed are the three
+    characters with escape sequences — everything else passes through.
+    Order matters: backslashes first, or the other escapes' own
+    backslashes would be doubled."""
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
+
+
+def unescape_label_value(value: str) -> str:
+    """Inverse of escape_label_value (the round-trip contract tests pin).
+    A manual scan, not chained replaces — `\\n` must decode to
+    backslash+n, which replace-ordering cannot express."""
+    out = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            elif nxt == "n":
+                out.append("\n")
+            else:  # unknown escape: keep verbatim (prom parsers do too)
+                out.append(c + nxt)
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+# one sample line: name, optional {labels}, value. Label values may hold
+# any escaped character, including escaped quotes.
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'
+    r' (\S+)$'
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus_text(text: str):
+    """Strict-ish parse of an exposition-format snapshot into
+    {(name, ((label, value), ...)): float}. Raises ValueError on any
+    line that is neither a comment nor a well-formed sample, and on
+    duplicate series — the checks the textfile collector applies, used
+    by the bench gate's scrape assertion and the round-trip tests."""
+    out = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {ln}: not a valid sample: {line!r}")
+        name, labels_raw, value = m.group(1), m.group(2), m.group(3)
+        labels = tuple(
+            (k, unescape_label_value(v))
+            for k, v in _LABEL_RE.findall(labels_raw or "")
+        )
+        key = (name, labels)
+        if key in out:
+            raise ValueError(f"line {ln}: duplicate series {key}")
+        try:
+            out[key] = float(value)
+        except ValueError:
+            raise ValueError(f"line {ln}: bad sample value {value!r}")
+    return out
+
+
 def prometheus_lines(record: dict, prefix: str = "tpusim") -> List[str]:
     """Flatten a run record into `# TYPE ... gauge` + sample lines. Only
     the numeric leaves ship; span walls become
@@ -96,6 +173,43 @@ def prometheus_lines(record: dict, prefix: str = "tpusim") -> List[str]:
                                else group, k), v)
     cache = det.get("table_cache", "off")
     gauge(_metric_name(prefix, "table_cache_hit"), int(cache == "hit"))
+    # ---- the in-scan time-series plane (ISSUE 5): the LAST sample of
+    # every series ships as a gauge — what "live cluster telemetry"
+    # means to a scraper — plus the sample count so dashboards can rate
+    series = record.get("series") or {}
+    if series.get("pos"):
+        sname = _metric_name(prefix, "series")
+        gauge(f"{sname}_samples", len(series["pos"]))
+        gauge(f"{sname}_last_pos", series["pos"][-1])
+        for scalar in ("feasible", "nodes_down", "retry_depth"):
+            if series.get(scalar):
+                gauge(f"{sname}_{scalar}", series[scalar][-1])
+        cats = series.get("frag_categories", [])
+        if series.get("frag"):
+            last = series["frag"][-1]
+            for j, cat in enumerate(cats[: len(last)]):
+                gauge(
+                    f"{sname}_frag_gpu_milli",
+                    last[j],
+                    f'{{category="{escape_label_value(cat)}"}}',
+                )
+        if series.get("util_hist"):
+            last = series["util_hist"][-1]
+            nb = max(len(last), 1)
+            for b, v in enumerate(last):
+                gauge(
+                    f"{sname}_util_nodes", v,
+                    f'{{bucket="{100 * b // nb:02d}"}}',
+                )
+        pols = series.get("policies", [])
+        for field in ("score_hi", "score_lo"):
+            if series.get(field):
+                last = series[field][-1]
+                for i, pol in enumerate(pols[: len(last)]):
+                    gauge(
+                        f"{sname}_{field}", last[i],
+                        f'{{policy="{escape_label_value(pol)}"}}',
+                    )
     timing = record.get("timing", {})
     if "wall_s" in timing:
         gauge(_metric_name(prefix, "wall_seconds"), timing["wall_s"])
@@ -106,7 +220,10 @@ def prometheus_lines(record: dict, prefix: str = "tpusim") -> List[str]:
     agg: dict = {}
     counts: dict = {}
     for s in timing.get("spans", []):
-        name = str(s.get("name", "")).replace('"', "")
+        # label values are ESCAPED, never stripped: a span named with a
+        # quote/backslash/newline must round-trip through a strict
+        # exposition-format parser (escape_label_value)
+        name = str(s.get("name", ""))
         counts[name] = counts.get(name, 0) + 1
         for phase in ("dispatch", "block"):
             key = (name, phase)
@@ -116,11 +233,12 @@ def prometheus_lines(record: dict, prefix: str = "tpusim") -> List[str]:
         for (name, phase), v in sorted(agg.items()):
             gauge(
                 span_metric, round(v, 6),
-                f'{{name="{name}",phase="{phase}"}}',
+                f'{{name="{escape_label_value(name)}",phase="{phase}"}}',
             )
         count_metric = _metric_name(prefix, "span_count")
         for name, n in sorted(counts.items()):
-            gauge(count_metric, n, f'{{name="{name}"}}')
+            gauge(count_metric, n,
+                  f'{{name="{escape_label_value(name)}"}}')
     return lines
 
 
@@ -214,21 +332,43 @@ def write_chrome_trace(path: str, spans: Iterable,
     return path
 
 
-def emit_all(telemetry, jsonl: str = "", metrics: str = "", trace: str = "",
-             meta: dict = None, counter_series: dict = None) -> List[str]:
-    """Write every requested emitter output for one RunTelemetry; returns
-    the paths written. `counter_series` (track name -> per-event values,
-    e.g. Simulator.event_counter_series()) adds counter tracks to the
-    Chrome trace."""
+def build_record(telemetry, meta: dict = None, series: dict = None) -> dict:
+    """One run's JSONL record from its RunTelemetry, plus the caller's
+    meta and the in-scan series block (obs.series.series_to_record) —
+    built ONCE so every consumer (JSONL append, Prometheus textfile, the
+    live /metrics endpoint) renders the same record and the
+    final-scrape-equals-textfile contract holds byte-for-byte."""
     record = telemetry.to_record()
     if meta:
         record["deterministic"]["meta"].update(meta)
+    if series:
+        record["series"] = series
+    return record
+
+
+def emit_record(record: dict, spans, jsonl: str = "", metrics: str = "",
+                trace: str = "", counter_series: dict = None) -> List[str]:
+    """Write the requested emitter outputs for a prebuilt record; returns
+    the paths written. `spans` feeds the Chrome-trace timeline;
+    `counter_series` (track name -> per-event values) adds counter
+    tracks to it."""
     written = []
     if jsonl:
         written.append(append_jsonl(jsonl, record))
     if metrics:
         written.append(write_prometheus(metrics, record))
     if trace:
-        written.append(write_chrome_trace(trace, telemetry.spans,
-                                          counter_series))
+        written.append(write_chrome_trace(trace, spans, counter_series))
     return written
+
+
+def emit_all(telemetry, jsonl: str = "", metrics: str = "", trace: str = "",
+             meta: dict = None, counter_series: dict = None,
+             series: dict = None) -> List[str]:
+    """build_record + emit_record for one RunTelemetry (the historical
+    one-call surface)."""
+    record = build_record(telemetry, meta=meta, series=series)
+    return emit_record(
+        record, telemetry.spans, jsonl=jsonl, metrics=metrics, trace=trace,
+        counter_series=counter_series,
+    )

@@ -28,6 +28,12 @@ The gate also smoke-checks the decision-provenance surface (ISSUE 4):
 a small decision-recording replay writes its decision JSONL under
 --out and the digest-verified read-back must round-trip exactly —
 `tpusim explain`/`diff` depend on that file format.
+
+And the live-telemetry surface (ISSUE 5): the smoke run's record is
+published to an ephemeral MonitorServer and scraped over HTTP — the
+scrape must parse as valid Prometheus exposition text and be byte-equal
+to the gate_metrics.prom textfile, the same
+final-scrape-equals-textfile contract `tpusim apply --listen` promises.
 """
 
 from __future__ import annotations
@@ -170,6 +176,42 @@ def decisions_roundtrip(nodes, pods, out_dir: str) -> Tuple[bool, str]:
     )
 
 
+def metrics_scrape_check(record: dict, prom_path: str) -> Tuple[bool, str]:
+    """ISSUE 5 satellite: publish the smoke record to an ephemeral
+    MonitorServer, scrape /metrics over real HTTP, and require (a) the
+    scrape to parse as exposition-format text (parse_prometheus_text —
+    the strict checks a textfile collector applies) and (b) the scrape
+    to be byte-equal to the emitted textfile. Any exception on the
+    serve/scrape path is a FAIL verdict, not a traceback."""
+    import urllib.request
+
+    from tpusim.obs.emitters import parse_prometheus_text
+    from tpusim.obs.server import MonitorServer
+
+    try:
+        srv = MonitorServer(":0").start()
+        try:
+            srv.publish_record(record)
+            with urllib.request.urlopen(srv.url + "/metrics",
+                                        timeout=10) as resp:
+                scrape = resp.read().decode()
+        finally:
+            srv.stop()
+        parsed = parse_prometheus_text(scrape)
+        with open(prom_path) as f:
+            disk = f.read()
+    except Exception as err:
+        return False, f"[gate] scrape: FAIL ({type(err).__name__}: {err})"
+    if scrape != disk:
+        return False, (
+            f"[gate] scrape: /metrics differs from {prom_path} (FAIL)"
+        )
+    return True, (
+        f"[gate] scrape: /metrics parses ({len(parsed)} series) and is "
+        f"byte-equal to {os.path.basename(prom_path)}"
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -215,31 +257,40 @@ def main(argv=None) -> int:
         "backend": jax.default_backend(),
     }
 
+    scrape_ok, scrape_msg = True, ""
     if telemetry is not None:
         from tpusim.obs import emitters
 
-        paths = emitters.emit_all(
-            telemetry,
+        prom_path = os.path.join(args.out, "gate_metrics.prom")
+        record = emitters.build_record(
+            telemetry, meta={"gate": "bench-gate", "row": row}
+        )
+        paths = emitters.emit_record(
+            record, telemetry.spans,
             jsonl=os.path.join(args.out, "gate_profile.jsonl"),
-            metrics=os.path.join(args.out, "gate_metrics.prom"),
-            meta={"gate": "bench-gate", "row": row},
+            metrics=prom_path,
         )
         print(f"[gate] smoke profile: {', '.join(paths)}")
+        # live-telemetry smoke: a /metrics scrape of the same record must
+        # parse and match the textfile byte-for-byte (ISSUE 5 satellite)
+        scrape_ok, scrape_msg = metrics_scrape_check(record, prom_path)
+        print(scrape_msg)
 
     # decision-provenance smoke: the JSONL the explain/diff verbs consume
     # must round-trip (ISSUE 4 satellite) — checked regardless of
     # whether a throughput baseline exists
     dec_ok, dec_msg = decisions_roundtrip(nodes, pods, args.out)
     print(dec_msg)
+    smoke_ok = dec_ok and scrape_ok
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "
               "profile recorded, nothing to diff "
-              f"({'PASS' if dec_ok else 'FAIL'})")
-        return 0 if dec_ok else 1
+              f"({'PASS' if smoke_ok else 'FAIL'})")
+        return 0 if smoke_ok else 1
 
     ok, msgs = compare(base, cur, args.tol, args.alloc_tol)
-    ok = ok and dec_ok
+    ok = ok and smoke_ok
     print(f"[gate] baseline {os.path.basename(base['path'])} "
           f"(round {base['n']}, backend {base['backend']!r}):")
     print("\n".join(msgs))

@@ -31,19 +31,65 @@ _STATE = {
     "last_emit": 0.0,
     "ticks": 0,
     "sink": None,  # test hook: callable(line) instead of stderr
+    # run-level event window (ISSUE 5): `base` is added to the device's
+    # raw processed count (fault segments restart their scan counter at 0
+    # but sit `base` events into the run); `resumed` is the count already
+    # inside the raw number that THIS process never executed (a
+    # checkpoint-resumed carry) — subtracted from the rate so a resumed
+    # run's ev/s and ETA describe real progress, not cursor/dt
+    "base": 0,
+    "resumed": 0,
 }
 
 MIN_INTERVAL_S = 1.0
 
+# progress listeners (tpusim.obs.server feeds /progress from these):
+# called on EVERY tick — including rate-limited ones — with a dict
+# {done, total, rate, eta, label, final}. Must be cheap and non-raising.
+_LISTENERS = []
 
-def configure(total_events: int, label: str = "scan", sink=None):
+
+def add_listener(fn):
+    if fn not in _LISTENERS:
+        _LISTENERS.append(fn)
+
+
+def remove_listener(fn):
+    if fn in _LISTENERS:
+        _LISTENERS.remove(fn)
+
+
+def _notify(done: int, total: int, rate: float, eta: float,
+            final: bool = False):
+    info = {
+        "done": int(done), "total": int(total), "rate": float(rate),
+        "eta": float(eta), "label": _STATE["label"], "final": bool(final),
+    }
+    for fn in list(_LISTENERS):
+        try:
+            fn(info)
+        except Exception:  # a broken listener must never kill a replay
+            pass
+
+
+def configure(total_events: int, label: str = "scan", sink=None,
+              base: int = 0):
     """Arm the heartbeat for the next scan: total event count for the ETA
     and a label for the line. Called by the driver right before each
-    dispatch whose engine was built with a heartbeat."""
+    dispatch whose engine was built with a heartbeat. `base` = events of
+    the RUN already replayed by earlier scans (the fault path's segment
+    offset), so chunk/segment ticks report run-level progress."""
     _STATE.update(
         total=int(total_events), label=label, t0=time.perf_counter(),
-        last_emit=0.0, ticks=0, sink=sink,
+        last_emit=0.0, ticks=0, sink=sink, base=int(base), resumed=0,
     )
+
+
+def note_resume(done0: int):
+    """Mark the armed scan as resumed from a checkpoint at `done0`
+    processed events: the carry's counter already includes them, so the
+    rate denominator must not credit this process with their work."""
+    _STATE["resumed"] = int(done0)
 
 
 def tick(done):
@@ -52,14 +98,16 @@ def tick(done):
     count)."""
     now = time.perf_counter()
     _STATE["ticks"] += 1
+    done = _STATE["base"] + int(done)
+    total = _STATE["total"]
+    dt = max(now - _STATE["t0"], 1e-9)
+    fresh = max(done - _STATE["base"] - _STATE["resumed"], 0)
+    rate = fresh / dt
+    eta = (total - done) / rate if (total > done and rate > 0) else 0.0
+    _notify(done, total, rate, eta)
     if now - _STATE["last_emit"] < MIN_INTERVAL_S:
         return
     _STATE["last_emit"] = now
-    done = int(done)
-    total = _STATE["total"]
-    dt = max(now - _STATE["t0"], 1e-9)
-    rate = done / dt
-    eta = (total - done) / rate if (total > done and rate > 0) else 0.0
     line = (
         f"[obs] {_STATE['label']}: {done}/{total or '?'} events "
         f"({rate:,.0f} ev/s, eta {eta:,.0f}s)"
@@ -90,17 +138,26 @@ def complete(true_total: int = 0):
     total = _STATE["total"]
     if not total:
         return
+    base = _STATE["base"]
     if true_total:
-        total = min(total, int(true_total))
+        # `true_total` is the SCAN's pre-padding event count, but the
+        # armed total is run-level (base + this scan's padded events) —
+        # clamp on the same clock, or a fault segment's final tick would
+        # jump the /progress counter backwards to segment-local numbers
+        total = min(total, base + int(true_total))
     now = time.perf_counter()
     dt = max(now - _STATE["t0"], 1e-9)
+    # mean rate over the events THIS process actually executed in this
+    # scan — the base/resumed discipline of tick()
+    fresh = max(total - base - _STATE["resumed"], 0)
     line = (
         f"[obs] {_STATE['label']}: {total}/{total} events done in "
-        f"{dt:,.1f}s ({total / dt:,.0f} ev/s mean)"
+        f"{dt:,.1f}s ({fresh / dt:,.0f} ev/s mean)"
     )
     _STATE["ticks"] += 1
     _STATE["last_emit"] = now
     _STATE["total"] = 0  # disarm
+    _notify(total, total, fresh / dt, 0.0, final=True)
     sink = _STATE["sink"]
     if sink is not None:
         sink(line)

@@ -1,0 +1,419 @@
+"""In-scan cluster time-series — the live-state half of obs (ISSUE 5).
+
+The paper's output is two end-state numbers; PR 3/4 added exact counters
+and per-decision provenance, but the only *per-event* view of cluster
+state is still the metrics postpass, which runs after the scan finished.
+This module gives every engine a fixed-stride sampling plane: when a
+replay is built with `series_every = s > 0`, its scan body emits one
+bounded-shape `SeriesSample` per event — a real sample whenever the
+processed-event count crosses a multiple of `s`, an inert sentinel row
+otherwise — so a long run's utilization/fragmentation/score
+distributions are recorded AS THE SCAN RUNS and can be scraped live
+(`tpusim apply --listen`, tpusim.obs.server).
+
+Vocabulary (every leaf i32; like the counters, append-only):
+
+    pos         processed-event count when the sample was taken (the
+                stride clock = creates+deletes+skips applied so far,
+                including the driver's bucket-padding skips); -1 marks
+                the sentinel rows the host filters out
+    util_hist   [UTIL_BUCKETS] UP GPU nodes bucketed by GPU-milli
+                occupancy (bucket = used*B//cap, integer math — exact)
+    nodes_down  nodes carrying the DOWN sentinel (mem_left < 0;
+                tpusim.sim.faults) — 0 outside fault runs
+    feasible    Filter-feasible node count for the sampled event's pod
+                type (pinning excluded: a type-level property, so the
+                value is comparable across events)
+    frag        [7] cluster frag by FGD failure category (the
+                `frag_amounts` row the end-state report sums away),
+                in whole GPU-milli: each node's f32 row is rounded to
+                integer milli BEFORE the cluster sum, so the total is an
+                associative integer sum — bit-identical for any node
+                partition, including the shard engine's psum. DOWN
+                nodes are excluded (their capacity is dark, accounted
+                by DisruptionMetrics instead). i32 bounds the exact
+                range to ~250k nodes — beyond the current scale lane.
+    score_hi    [num_policies] max NORMALIZED per-policy score over the
+                feasible set (the value selectHost weights) — the
+                "winning score" of each policy's lens
+    score_lo    [num_policies] min normalized score over the feasible
+                set; hi - lo is the per-policy score spread the
+                policy-tuning line (PAPERS.md "Learning to Score") needs
+    (retry_depth — the fault path's queue depth — is host-side state
+    the driver fills per segment; it lives on SeriesLog, not the sample)
+
+Engine invariance: every field is an integer reduction over (cluster
+state after the previously-committed event, the event's pod-type score
+rows) — inputs all four engines maintain identically — so the sampled
+values are bit-identical across flat/blocked/sequential/shard, and,
+because the stride clock rides the carry's `ctr` leaf, bit-identical
+across checkpoint kill/resume. Fault runs restart the stride at each
+segment (each segment is a fresh scan): every segment therefore OPENS
+with a sample of the post-fault cluster, and the driver rebases `pos`
+to the global event clock when it concatenates the segment logs.
+
+Layering: like the rest of obs this module imports nothing from sim/ —
+state-level stats come from tpusim.ops/tpusim.policies; engine-specific
+inputs (score rows, feasibility) are handed in by the engines.
+
+RandomScore's slot is always zero (its score row is a per-event PRNG
+draw; sampling it would burn key splits and perturb the trajectory).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+SERIES_SCHEMA = "tpusim-series-v1"
+
+# occupancy buckets of the node-utilization histogram: bucket i covers
+# [i*100/B, (i+1)*100/B) percent of the node's GPU-milli capacity, with
+# the top bucket closed at 100%. A fixed constant, NOT a knob — every
+# engine must emit the same shape for array_equal-checkable invariance.
+UTIL_BUCKETS = 10
+
+# frag category names, in tpusim.constants class-id order (Q1..NO_ACCESS)
+FRAG_CATEGORY_NAMES = (
+    "q1_lack_both", "q2_lack_gpu", "q3_satisfied", "q4_lack_cpu",
+    "xl_satisfied", "xr_lack_cpu", "no_access",
+)
+
+
+class SeriesSample(NamedTuple):
+    """One stride sample (field semantics in the module docstring).
+    Engines stack these over the event axis as lax.scan outputs; every
+    leaf is i32."""
+
+    pos: object
+    util_hist: object  # [UTIL_BUCKETS]
+    nodes_down: object
+    feasible: object
+    frag: object  # [7] whole GPU-milli
+    score_hi: object  # [num_policies]
+    score_lo: object  # [num_policies]
+
+
+# every field is engine-invariant (there is no engine-specific slot)
+INVARIANT_FIELDS = SeriesSample._fields
+
+
+def no_sample(num_policies: int) -> SeriesSample:
+    """The inert sentinel row emitted between stride points (and the
+    not-taken branch of the sampling cond) — fixed shape, pos == -1."""
+    import jax.numpy as jnp
+
+    z = jnp.int32(0)
+    return SeriesSample(
+        pos=jnp.int32(-1),
+        util_hist=jnp.zeros(UTIL_BUCKETS, jnp.int32),
+        nodes_down=z,
+        feasible=z,
+        frag=jnp.zeros(len(FRAG_CATEGORY_NAMES), jnp.int32),
+        score_hi=jnp.zeros(num_policies, jnp.int32),
+        score_lo=jnp.zeros(num_policies, jnp.int32),
+    )
+
+
+def cluster_stats(state, tp, node_mask=None):
+    """(util_hist i32[B], nodes_down i32, frag i32[7]) for one cluster
+    state — the per-node half of a sample. `node_mask` masks node-axis
+    padding rows out (the shard engine's mesh pad rows carry the same
+    mem_left == -1 sentinel as DOWN nodes and must count as neither);
+    single-device engines pass None (every row is real). All outputs are
+    integer sums over nodes, so a sharded caller psums the per-shard
+    partials exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpusim.constants import MILLI
+    from tpusim.ops.frag import node_frag_amounts
+
+    n = state.num_nodes
+    mask = (
+        jnp.ones(n, jnp.bool_) if node_mask is None
+        else jnp.asarray(node_mask)
+    )
+    down = (state.mem_left < 0) & mask
+    up = mask & ~down
+    cap = state.gpu_cnt * MILLI
+    used = cap - state.gpu_left.sum(-1)
+    gpu_up = up & (state.gpu_cnt > 0)
+    bucket = jnp.clip(
+        used * UTIL_BUCKETS // jnp.maximum(cap, 1), 0, UTIL_BUCKETS - 1
+    )
+    hist = (
+        jax.nn.one_hot(bucket, UTIL_BUCKETS, dtype=jnp.int32)
+        * gpu_up[:, None].astype(jnp.int32)
+    ).sum(0)
+    rows = jax.vmap(node_frag_amounts, in_axes=(0, 0, 0, None))(
+        state.cpu_left, state.gpu_left, state.gpu_type, tp
+    )  # f32[N, 7]
+    # round each NODE's row to whole milli before summing: integer sums
+    # are associative, so the cluster total cannot depend on how the node
+    # axis is partitioned (the shard-psum exactness contract)
+    frag = jnp.where(
+        up[:, None], jnp.round(rows).astype(jnp.int32), 0
+    ).sum(0)
+    return hist, down.sum().astype(jnp.int32), frag
+
+
+def score_stats(raws, feasible, policies):
+    """(score_hi i32[pi], score_lo i32[pi]) over the feasible set from
+    per-policy RAW score rows — normalization applied exactly as the
+    select consumes it (minmax/pwr over the feasible mask, none =
+    identity, RandomScore = zeros). With no feasible node both come out
+    0. Used by the single-device engines; the shard engine reproduces
+    the same values through pmin/pmax collectives (min/max are exact in
+    any combine order)."""
+    import jax.numpy as jnp
+
+    from tpusim.policies import minmax_normalize_i32, pwr_normalize_i32
+
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
+    any_f = feasible.any()
+    his, los = [], []
+    for i, (fn, _) in enumerate(policies):
+        raw = raws[i]
+        if fn.policy_name == "RandomScore":
+            nrm = jnp.zeros_like(raw)
+        elif fn.normalize == "minmax":
+            nrm = minmax_normalize_i32(raw, feasible)
+        elif fn.normalize == "pwr":
+            nrm = pwr_normalize_i32(raw, feasible)
+        else:
+            nrm = raw
+        hi = jnp.max(jnp.where(feasible, nrm, -big))
+        lo = jnp.min(jnp.where(feasible, nrm, big))
+        his.append(jnp.where(any_f, hi, 0))
+        los.append(jnp.where(any_f, lo, 0))
+    return (
+        jnp.stack(his).astype(jnp.int32),
+        jnp.stack(los).astype(jnp.int32),
+    )
+
+
+def build_sample(state, tp, raws, feasible, policies, processed
+                 ) -> SeriesSample:
+    """Assemble one sample from the inputs every single-device engine
+    has at the top of its scan body: the committed state, the sampled
+    event's per-policy raw score rows ([pi, N] — pad columns must be
+    infeasible) and type-level feasibility row. `processed` becomes
+    `pos`."""
+    import jax.numpy as jnp
+
+    hist, down, frag = cluster_stats(state, tp)
+    hi, lo = score_stats(raws, feasible, policies)
+    return SeriesSample(
+        pos=jnp.asarray(processed).astype(jnp.int32),
+        util_hist=hist,
+        nodes_down=down,
+        feasible=feasible.sum().astype(jnp.int32),
+        frag=frag,
+        score_hi=hi,
+        score_lo=lo,
+    )
+
+
+def emit_from_scan(every: int, processed, build_fn, num_policies: int
+                   ) -> SeriesSample:
+    """The sampling hook engines inline into their scan body: run
+    `build_fn` (the O(N) sample assembly) only when the processed-event
+    count sits on the stride, else emit the sentinel. `every` is static
+    (baked into the jaxpr — part of the engine cache key); the cond
+    bounds the amortized cost to O(N/every) extra work per event."""
+    import jax
+
+    return jax.lax.cond(
+        (processed % every) == 0,
+        build_fn,
+        lambda: no_sample(num_policies),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side log + JSONL record + rendering
+# ---------------------------------------------------------------------------
+
+
+class SeriesLog(NamedTuple):
+    """A run's filtered sample stream on host: numpy arrays with a
+    leading sample axis, plus the host-filled retry-queue depth (the
+    fault driver knows the queue; the scan does not)."""
+
+    pos: object  # i64[S] global event positions
+    util_hist: object  # i32[S, UTIL_BUCKETS]
+    nodes_down: object  # i32[S]
+    feasible: object  # i32[S]
+    frag: object  # i64[S, 7]
+    score_hi: object  # i32[S, pi]
+    score_lo: object  # i32[S, pi]
+    retry_depth: object  # i64[S]
+
+
+def log_from_stacked(stacked: SeriesSample, base_pos: int = 0,
+                     retry_depth: int = 0) -> SeriesLog:
+    """Filter a scan's stacked per-event SeriesSample down to the real
+    samples (pos >= 0) and rebase their positions onto the run-global
+    event clock (`base_pos` = events replayed before this scan — the
+    fault path's segment offset). `retry_depth` fills the host column
+    for every sample of this scan (constant within a segment)."""
+    pos = np.asarray(stacked.pos)
+    keep = pos >= 0
+    s = int(keep.sum())
+    return SeriesLog(
+        pos=pos[keep].astype(np.int64) + int(base_pos),
+        util_hist=np.asarray(stacked.util_hist)[keep],
+        nodes_down=np.asarray(stacked.nodes_down)[keep],
+        feasible=np.asarray(stacked.feasible)[keep],
+        frag=np.asarray(stacked.frag)[keep].astype(np.int64),
+        score_hi=np.asarray(stacked.score_hi)[keep],
+        score_lo=np.asarray(stacked.score_lo)[keep],
+        retry_depth=np.full(s, int(retry_depth), np.int64),
+    )
+
+
+def concat_series(logs: Sequence[Optional[SeriesLog]]
+                  ) -> Optional[SeriesLog]:
+    """Concatenate segment logs along the sample axis (fault segments,
+    schedule_additional appends)."""
+    logs = [l for l in logs if l is not None]
+    if not logs:
+        return None
+    return SeriesLog(*(
+        np.concatenate([np.asarray(getattr(l, f)) for l in logs])
+        for f in SeriesLog._fields
+    ))
+
+
+def series_to_record(log: SeriesLog, every: int,
+                     policy_names: Sequence[str]) -> dict:
+    """The JSONL `series` block: pure-integer columns (deterministic —
+    part of the record's bit-identity contract), plus the vocabulary
+    needed to render without recomputation."""
+    return {
+        "schema": SERIES_SCHEMA,
+        "every": int(every),
+        "util_buckets": UTIL_BUCKETS,
+        "frag_categories": list(FRAG_CATEGORY_NAMES),
+        "policies": [str(p) for p in policy_names],
+        "pos": np.asarray(log.pos).astype(int).tolist(),
+        "util_hist": np.asarray(log.util_hist).astype(int).tolist(),
+        "nodes_down": np.asarray(log.nodes_down).astype(int).tolist(),
+        "feasible": np.asarray(log.feasible).astype(int).tolist(),
+        "frag": np.asarray(log.frag).astype(int).tolist(),
+        "score_hi": np.asarray(log.score_hi).astype(int).tolist(),
+        "score_lo": np.asarray(log.score_lo).astype(int).tolist(),
+        "retry_depth": np.asarray(log.retry_depth).astype(int).tolist(),
+    }
+
+
+def series_from_record(d: dict) -> SeriesLog:
+    """Inverse of series_to_record (the `tpusim report` / plot input)."""
+    if d.get("schema") != SERIES_SCHEMA:
+        raise ValueError(
+            f"not a {SERIES_SCHEMA} series block "
+            f"(schema={d.get('schema')!r})"
+        )
+    s = len(d["pos"])
+    pi = len(d.get("policies", []))
+    return SeriesLog(
+        pos=np.asarray(d["pos"], np.int64),
+        util_hist=np.asarray(d["util_hist"], np.int64).reshape(
+            s, d.get("util_buckets", UTIL_BUCKETS)),
+        nodes_down=np.asarray(d["nodes_down"], np.int64),
+        feasible=np.asarray(d["feasible"], np.int64),
+        frag=np.asarray(d["frag"], np.int64).reshape(
+            s, len(d.get("frag_categories", FRAG_CATEGORY_NAMES))),
+        score_hi=np.asarray(d["score_hi"], np.int64).reshape(s, pi),
+        score_lo=np.asarray(d["score_lo"], np.int64).reshape(s, pi),
+        retry_depth=np.asarray(
+            d.get("retry_depth", [0] * s), np.int64
+        ),
+    )
+
+
+def series_tracks(log: SeriesLog) -> dict:
+    """Chrome-trace counter-track dict (track name -> one value per
+    sample; obs.emitters.chrome_counter_events) — the series' timeline
+    view, sharing the emitter the frag/alloc postpass tracks use."""
+    out = {
+        "series_feasible_nodes": np.asarray(log.feasible).tolist(),
+        "series_nodes_down": np.asarray(log.nodes_down).tolist(),
+        "series_retry_depth": np.asarray(log.retry_depth).tolist(),
+    }
+    frag = np.asarray(log.frag)
+    for j, name in enumerate(FRAG_CATEGORY_NAMES):
+        out[f"series_frag_{name}"] = frag[:, j].tolist()
+    return out
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 40) -> str:
+    """Coarse unicode sparkline (strided to `width` points, final value
+    always kept — the terminal twin of the Chrome counter tracks)."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        stride = -(-len(vals) // width)
+        idx = list(range(0, len(vals), stride))
+        if idx[-1] != len(vals) - 1:
+            idx.append(len(vals) - 1)
+        vals = [vals[i] for i in idx]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK[min(int((v - lo) / span * len(_SPARK)), len(_SPARK) - 1)]
+        for v in vals
+    )
+
+
+def _stat_line(name: str, vals) -> str:
+    a = np.asarray(vals, np.float64)
+    if a.size == 0:
+        return f"  {name:<28} (no samples)"
+    return (
+        f"  {name:<28}{a.min():>12.0f}{np.median(a):>12.0f}"
+        f"{a.max():>12.0f}  {sparkline(a)}"
+    )
+
+
+def format_report(series: dict) -> str:
+    """Terminal summary of a run record's series block: one line per
+    scalar series (min / median / max + sparkline), expanded per
+    category/bucket/policy for the vector series. Renders straight from
+    the JSONL — no recomputation, no simulator."""
+    log = series_from_record(series)
+    n = len(np.asarray(log.pos))
+    out = [
+        f"[series] {n} samples, stride {series.get('every')} events "
+        f"(pos {log.pos[0] if n else '-'}..{log.pos[-1] if n else '-'})",
+        f"  {'series':<28}{'min':>12}{'median':>12}{'max':>12}",
+        _stat_line("feasible_nodes", log.feasible),
+        _stat_line("nodes_down", log.nodes_down),
+        _stat_line("retry_depth", log.retry_depth),
+    ]
+    frag = np.asarray(log.frag)
+    for j, name in enumerate(series.get(
+            "frag_categories", FRAG_CATEGORY_NAMES)):
+        out.append(_stat_line(f"frag_{name} (milli)", frag[:, j]))
+    hist = np.asarray(log.util_hist)
+    buckets = hist.shape[1] if hist.ndim == 2 else UTIL_BUCKETS
+    for b in range(buckets):
+        lo_pct = 100 * b // buckets
+        hi_pct = 100 * (b + 1) // buckets
+        out.append(_stat_line(
+            f"util[{lo_pct}-{hi_pct}%) nodes", hist[:, b]
+        ))
+    hi = np.asarray(log.score_hi)
+    lo = np.asarray(log.score_lo)
+    for i, pname in enumerate(series.get("policies", [])):
+        out.append(_stat_line(f"score_hi[{pname}]", hi[:, i]))
+        out.append(_stat_line(
+            f"score_spread[{pname}]", hi[:, i] - lo[:, i]
+        ))
+    return "\n".join(out)

@@ -1,0 +1,263 @@
+"""Live monitoring endpoint — the scrape surface of obs (ISSUE 5).
+
+A stdlib-threaded HTTP server with three endpoints:
+
+  /metrics   Prometheus exposition text of the newest published run
+             record (the same `prometheus_lines` rendering the
+             --metrics-out textfile uses, so the final scrape of a
+             finished run is byte-equal to the emitted file)
+  /healthz   JSON liveness: {"ok": true, "phase": ..., "records": N}
+  /progress  JSON run progress: phase, events done/total, ev/s, ETA —
+             fed by the obs.heartbeat listener hook (in-scan ticks) and
+             by the driver's per-chunk checkpoint boundaries
+
+Two lifecycles share the implementation:
+
+  MonitorServer   in-process: `tpusim apply --listen :PORT` starts one
+                  before the run; the driver/heartbeat publish into it,
+                  and a scraper sees live numbers mid-run. Publishing is
+                  push-based — a scrape never touches the simulator (no
+                  device syncs on the request path).
+  watch + serve   standalone: `tpusim serve DIR` polls a directory for
+                  the newest obs run record (*.jsonl) and checkpoint
+                  files (io.storage naming) and republishes them — watch
+                  a long checkpointed run from a second terminal without
+                  touching its process.
+
+Binding defaults to 127.0.0.1 (a monitoring endpoint must be opted into
+the network: pass an explicit host as HOST:PORT to expose it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from tpusim.obs.emitters import prometheus_lines
+
+DEFAULT_PORT = 8642
+
+
+def parse_listen(listen: str) -> Tuple[str, int]:
+    """'HOST:PORT' | ':PORT' | 'PORT' -> (host, port); empty host binds
+    loopback only."""
+    listen = str(listen or "").strip()
+    host, sep, port = listen.rpartition(":")
+    if not sep:
+        host, port = "", listen
+    try:
+        port_i = int(port) if port else DEFAULT_PORT
+    except ValueError:
+        raise ValueError(f"--listen {listen!r}: port must be an integer")
+    return host or "127.0.0.1", port_i
+
+
+class MonitorServer:
+    """Threaded HTTP monitor. publish_record()/publish_progress() are the
+    write surface (thread-safe; renders the Prometheus text at publish
+    time so the scrape path is a buffer copy); start()/stop() own the
+    server thread."""
+
+    def __init__(self, listen: str = "", prefix: str = "tpusim"):
+        self.host, self.port = parse_listen(listen)
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._metrics_text: Optional[str] = None
+        self._progress: dict = {"phase": "starting"}
+        self._records = 0
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._hb_listener = None
+
+    # ---- write surface ----
+
+    def publish_record(self, record: dict):
+        """Render + swap in a new /metrics snapshot (the same lines
+        write_prometheus would emit for this record)."""
+        text = "\n".join(prometheus_lines(record, self.prefix)) + "\n"
+        with self._lock:
+            self._metrics_text = text
+            self._records += 1
+
+    def publish_progress(self, **fields):
+        with self._lock:
+            self._progress.update(fields)
+            self._progress["updated_unix"] = time.time()
+
+    def attach_heartbeat(self):
+        """Feed /progress from the in-scan heartbeat ticks
+        (obs.heartbeat listener hook)."""
+        from tpusim.obs import heartbeat
+
+        def on_tick(info):
+            # final means THIS SCAN finished — a fault segment or chunk,
+            # not necessarily the run; the driver/CLI publishes
+            # phase="done" itself when the whole run's result lands
+            self.publish_progress(
+                phase="scan" if not info["final"] else "scan-done",
+                events_done=info["done"], events_total=info["total"],
+                ev_per_s=round(info["rate"], 1),
+                eta_s=round(info["eta"], 1),
+            )
+
+        self._hb_listener = on_tick
+        heartbeat.add_listener(on_tick)
+
+    # ---- lifecycle ----
+
+    def start(self) -> "MonitorServer":
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet: scrapes are not news
+                pass
+
+            def _send(self, code, ctype, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    with srv._lock:
+                        text = srv._metrics_text
+                    if text is None:
+                        self._send(503, "text/plain",
+                                   b"no run record published yet\n")
+                        return
+                    self._send(
+                        200, "text/plain; version=0.0.4; charset=utf-8",
+                        text.encode(),
+                    )
+                elif path == "/healthz":
+                    with srv._lock:
+                        body = json.dumps({
+                            "ok": True,
+                            "phase": srv._progress.get("phase"),
+                            "records": srv._records,
+                        }, sort_keys=True)
+                    self._send(200, "application/json",
+                               (body + "\n").encode())
+                elif path == "/progress":
+                    with srv._lock:
+                        body = json.dumps(srv._progress, sort_keys=True)
+                    self._send(200, "application/json",
+                               (body + "\n").encode())
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tpusim-monitor",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._hb_listener is not None:
+            from tpusim.obs import heartbeat
+
+            heartbeat.remove_listener(self._hb_listener)
+            self._hb_listener = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+# ---------------------------------------------------------------------------
+# Standalone watcher: `tpusim serve DIR`
+# ---------------------------------------------------------------------------
+
+
+def watch_dir(path: str) -> Tuple[Optional[dict], dict]:
+    """One poll of a watched directory: (newest obs run record or None,
+    progress dict). Records are the newest-mtime `*.jsonl` whose LAST
+    line is an obs record; progress reads the newest checkpoint file's
+    cursor out of the io.storage name (`<digest>.e<cursor>.ckpt.npz`) —
+    a killed or running checkpointed replay is observable from its
+    artifact directory alone."""
+    from tpusim.io.storage import CHECKPOINT_SUFFIX
+    from tpusim.obs.emitters import read_jsonl
+
+    record = None
+    progress: dict = {"phase": "watching", "dir": os.path.abspath(path)}
+    if not os.path.isdir(path):
+        progress["phase"] = "missing-dir"
+        return None, progress
+
+    jsonls = sorted(
+        (f for f in os.listdir(path) if f.endswith(".jsonl")),
+        key=lambda f: os.path.getmtime(os.path.join(path, f)),
+    )
+    for fname in reversed(jsonls):
+        try:
+            recs = read_jsonl(os.path.join(path, fname))
+        except (OSError, json.JSONDecodeError):
+            continue
+        obs_recs = [r for r in recs if "deterministic" in r]
+        if obs_recs:
+            record = obs_recs[-1]
+            progress["record_file"] = fname
+            break
+
+    best = None
+    for fname in os.listdir(path):
+        if not fname.endswith(CHECKPOINT_SUFFIX):
+            continue
+        stem = fname[: -len(CHECKPOINT_SUFFIX)]
+        digest, sep, cursor = stem.rpartition(".e")
+        if not sep or not cursor.isdigit():
+            continue
+        cur = int(cursor)
+        if best is None or cur > best[0]:
+            best = (cur, fname)
+    if best is not None:
+        progress["phase"] = "checkpointed"
+        progress["events_done"] = best[0]
+        progress["checkpoint_file"] = best[1]
+    return record, progress
+
+
+def serve_dir(path: str, listen: str = "", poll_s: float = 2.0,
+              once: bool = False, out=None) -> MonitorServer:
+    """Start a MonitorServer republishing `path`'s newest artifacts every
+    `poll_s`. once=True publishes a single poll and returns (the test /
+    embedding surface); otherwise blocks until KeyboardInterrupt."""
+    srv = MonitorServer(listen).start()
+    if out is not None:
+        print(f"[serve] watching {os.path.abspath(path)} at {srv.url} "
+              f"(/metrics /healthz /progress)", file=out)
+
+    def poll_once():
+        record, progress = watch_dir(path)
+        if record is not None:
+            srv.publish_record(record)
+        srv.publish_progress(**progress)
+
+    poll_once()
+    if once:
+        return srv
+    try:
+        while True:
+            time.sleep(max(poll_s, 0.2))
+            poll_once()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return srv

@@ -44,9 +44,14 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from tpusim.constants import MAX_GPUS_PER_NODE, MAX_NODE_SCORE
+from tpusim.obs import series as obs_series
 from tpusim.obs.counters import counter_delta, zero_counters
 from tpusim.obs.decisions import DECISION_TOPK, DecisionRecord, no_decision
-from tpusim.policies.base import feasible_min_max, minmax_scale_i32
+from tpusim.policies.base import (
+    NORMALIZE_DEGENERATE,
+    feasible_min_max,
+    minmax_scale_i32,
+)
 from tpusim.sim.engine import ReplayResult
 from tpusim.sim.step import (
     block_reduce,
@@ -100,7 +105,8 @@ class ShardTableCarry(NamedTuple):
 
 def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                                report: bool = False, block_size: int = 0,
-                               decisions: bool = False):
+                               decisions: bool = False,
+                               series_every: int = 0):
     """Build the explicit-collective sharded replayer. The node count must
     already be padded to a multiple of the mesh size (parallel.pad_nodes)
     and `state`/`tiebreak_rank` sharded over it (parallel.shard_state).
@@ -131,7 +137,22 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     per-policy raw/normalized columns and the feasible count cross as
     owner-masked psums. Per-event collective payload grows by
     3×DECISION_TOPK i32 lanes + (2×num_policies + 1) scalars — still
-    independent of N and D."""
+    independent of N and D.
+
+    series_every > 0 (ISSUE 5) additionally emits the in-scan
+    SeriesSample stream (tpusim.obs.series). Every sample field is an
+    integer reduction, so the shard decomposition is exact: util
+    histogram / DOWN count / per-category frag cross as psums of
+    per-shard integer partials (cluster_stats rounds each NODE's frag
+    row to whole milli BEFORE summing, so the total cannot depend on the
+    node partition); normalized score extrema cross as the same
+    pmin/pmax pair the flat select path normalizes with, then the
+    per-policy hi/lo cross as one pmax/pmin each. Mesh pad rows are
+    masked by their rank == INT_MAX sentinel (they carry the DOWN
+    nodes' mem_left == -1 and must count as neither). Samples land only
+    at stride points (a replicated cond), so the extra collective
+    payload amortizes to O(1/series_every) per event. ys become
+    (node, dev[, dec][, ser]) in that order, like the table engine."""
     if report:
         raise ValueError(
             "the shard_map engine replays metric-free; build the report "
@@ -273,6 +294,72 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                 lwn = jax.lax.dynamic_update_slice(
                     lwn, (j0 + bar)[:, None], (0, blk)
                 )
+
+            if series_every:
+                # in-scan series sample (ISSUE 5): replicated stride
+                # clock; every field crosses the mesh as an exact integer
+                # collective (module docstring). All shards take the same
+                # cond branch (processed is replicated), so the
+                # collectives inside it always pair up.
+                processed = ctr[0] + ctr[3] + ctr[4]
+
+                def _build_sample():
+                    real = rank < _INT_MAX  # mesh pad rows: rank sentinel
+                    hist_l, down_l, frag_l = obs_series.cluster_stats(
+                        state, tp, node_mask=real
+                    )
+                    hist = jax.lax.psum(hist_l, NODE_AXIS)
+                    down = jax.lax.psum(down_l, NODE_AXIS)
+                    frag = jax.lax.psum(frag_l, NODE_AXIS)
+                    rows_t = jax.lax.dynamic_index_in_dim(
+                        packed_tbl, t_id, 0, False
+                    )  # [nloc(_p), C]; block pad columns are infeasible
+                    feas_l = rows_t[:, npol + 1] != 0
+                    feas_cnt = jax.lax.psum(
+                        feas_l.sum().astype(jnp.int32), NODE_AXIS
+                    )
+                    any_f = feas_cnt > 0
+                    his, los = [], []
+                    for i, (fn, _) in enumerate(policies):
+                        raw = rows_t[:, i]
+                        if fn.normalize in ("minmax", "pwr"):
+                            # local extrema + pmin/pmax = the global
+                            # reduction, scaled by the same core the
+                            # unsharded engines normalize with
+                            lo_l, hi_l = feasible_min_max(raw, feas_l)
+                            nrm = minmax_scale_i32(
+                                raw, feas_l,
+                                jax.lax.pmin(lo_l, NODE_AXIS),
+                                jax.lax.pmax(hi_l, NODE_AXIS),
+                                NORMALIZE_DEGENERATE[fn.normalize],
+                            )
+                        else:  # RandomScore cannot reach the shard engine
+                            nrm = raw
+                        hi_i = jax.lax.pmax(
+                            jnp.max(jnp.where(feas_l, nrm, -_INT_MAX)),
+                            NODE_AXIS,
+                        )
+                        lo_i = jax.lax.pmin(
+                            jnp.min(jnp.where(feas_l, nrm, _INT_MAX)),
+                            NODE_AXIS,
+                        )
+                        his.append(jnp.where(any_f, hi_i, 0))
+                        los.append(jnp.where(any_f, lo_i, 0))
+                    return obs_series.SeriesSample(
+                        pos=processed.astype(jnp.int32),
+                        util_hist=hist,
+                        nodes_down=down,
+                        feasible=feas_cnt,
+                        frag=frag,
+                        score_hi=jnp.stack(his).astype(jnp.int32),
+                        score_lo=jnp.stack(los).astype(jnp.int32),
+                    )
+
+                ser = obs_series.emit_from_scan(
+                    series_every, processed, _build_sample, npol
+                )
+            else:
+                ser = ()
 
             def do_create():
                 if bsz:
@@ -516,7 +603,11 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             return ShardTableCarry(
                 state, packed_tbl, lt, lr, lwn, dirty, placed, masks,
                 failed, arr_cpu, arr_gpu, key, ctr,
-            ), ((node, dev, dec) if decisions else (node, dev))
+            ), (
+                (node, dev)
+                + ((dec,) if decisions else ())
+                + ((ser,) if series_every else ())
+            )
 
         carry, ys = jax.lax.scan(body, carry, (ev_kind, ev_pod))
         return (carry,) + tuple(ys)
@@ -551,9 +642,13 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             check_rep=False,
         )
 
-    # decision records are replicated outputs (collective-merged topk +
-    # owner psums), like the (node, dev) telemetry
+    # decision records and series samples are replicated outputs
+    # (collective-merged topk / psummed integer reductions), like the
+    # (node, dev) telemetry
     dec_specs = DecisionRecord(*([P()] * len(DecisionRecord._fields)))
+    ser_specs = obs_series.SeriesSample(
+        *([P()] * len(obs_series.SeriesSample._fields))
+    )
     mapped_init = _wrap(
         _init_shard,
         (state_specs, P(NODE_AXIS), spec_r, types_specs, tp_specs, P()),
@@ -562,7 +657,9 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     mapped_chunk = _wrap(
         _chunk_shard,
         (carry_specs, P(NODE_AXIS), spec_r, types_specs, P(), P(), tp_specs),
-        (carry_specs, P(), P()) + ((dec_specs,) if decisions else ()),
+        (carry_specs, P(), P())
+        + ((dec_specs,) if decisions else ())
+        + ((ser_specs,) if series_every else ()),
     )
 
     @jax.jit
@@ -590,13 +687,13 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         carry, ys = run_chunk(
             carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank
         )
-        if decisions:
-            nodes, devs, decs = ys
-        else:
-            (nodes, devs), decs = ys, None
+        nodes, devs = ys[0], ys[1]
+        rest = list(ys[2:])
+        decs = rest.pop(0) if decisions else None
+        sers = rest.pop(0) if series_every else None
         return ReplayResult(
             carry.state, carry.placed, carry.masks, carry.failed, None,
-            nodes, devs, carry.ctr, decs,
+            nodes, devs, carry.ctr, decs, sers,
         )
 
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,

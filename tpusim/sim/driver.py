@@ -141,6 +141,22 @@ class SimulatorConfig:
     # back to the table engine; a forced engine: pallas raises) and by
     # extender configs / the seed-batched sweep path.
     record_decisions: bool = False
+    # In-scan cluster time-series plane (ISSUE 5; tpusim.obs.series):
+    # > 0 makes every replay emit one bounded-shape SeriesSample each
+    # `series_every` processed events FROM INSIDE the scan — node-
+    # utilization histogram, per-FGD-category frag, feasible-node count,
+    # per-policy normalized score extrema, DOWN-node count — surfaced as
+    # ReplayResult.series → SimulateResult.series (a SeriesLog) and
+    # persisted in the JSONL run record / Chrome counter tracks /
+    # `tpusim apply --listen` live endpoint. Bit-identical across the
+    # sequential/flat/blocked/shard engines and continuous across
+    # checkpoint kill/resume and fault segmentation (the stride clock is
+    # the carry's event counter). A static build flag (the sampling cond
+    # bakes into the jaxpr): 0 = off, scan bodies compile identical to
+    # pre-series builds. Unsupported by the fused Pallas kernel (auto
+    # falls back to the table engine; a forced engine: pallas raises)
+    # and by extender configs / the seed-batched sweep path.
+    series_every: int = 0
     # Device-mesh width: 0 = single device; N > 1 shards the node axis
     # over an N-device jax.sharding.Mesh and replays on the
     # explicit-collective shard_map engine (tpusim.parallel.shard_engine;
@@ -185,6 +201,12 @@ class SimulateResult:
     # SimulatorConfig.record_decisions; fault runs concatenate their
     # segment streams, schedule_additional appends.
     decisions: object = None
+    # tpusim.obs.series.SeriesLog for this run (filtered samples on the
+    # run-global event clock, host-side). None unless
+    # SimulatorConfig.series_every > 0; fault runs concatenate their
+    # segment logs (pos rebased, retry_depth filled per segment),
+    # schedule_additional appends.
+    series: object = None
 
 
 _BELLMAN_SRC_DIGEST = None
@@ -217,6 +239,9 @@ def _engine_source_digest() -> bytes:
                 # the decision vocabulary shapes the checkpointed decision
                 # stream (ISSUE 4) — same invalidation discipline
                 "obs/decisions.py",
+                # the series vocabulary shapes the checkpointed sample
+                # stream (ISSUE 5) — same invalidation discipline
+                "obs/series.py",
             )
         ]
         files += glob.glob(os.path.join(base, "policies", "*.py"))
@@ -334,11 +359,22 @@ class Simulator:
                 "host-loop extender engine splices HTTP scores the "
                 "flight recorder does not capture)"
             )
+        if self.cfg.series_every and self.cfg.extenders:
+            raise ValueError(
+                "series_every cannot combine with extenders (the "
+                "host-loop extender engine has no in-scan sampling "
+                "plane)"
+            )
+        if self.cfg.series_every < 0:
+            raise ValueError(
+                f"series_every must be >= 0 (got {self.cfg.series_every})"
+            )
         self.replay_fn = make_replay(
             self._policy_fns,
             gpu_sel=self.cfg.gpu_sel_method,
             report=False,
             decisions=self.cfg.record_decisions,
+            series_every=self.cfg.series_every,
         )
         # device-phase wall of the last schedule_pods_batch call this sim
         # led (dispatch + fetch, excluding host spec prep/result slicing);
@@ -347,6 +383,9 @@ class Simulator:
         # which engine the last run_events call dispatched to
         # (pallas | table | sequential) — bench/log labeling
         self._last_engine = None
+        # run-level event offset the next heartbeat arm reports from
+        # (the fault loop sets it per segment; plain runs leave it 0)
+        self._hb_base = 0
         # direct-CSV-path stashes (experiments/analysis.py analyze_sim):
         # per-event structured report data (one entry per reporting replay,
         # main schedule + inflation/deschedule stages, in log order) + the
@@ -368,6 +407,7 @@ class Simulator:
             block_size=self.cfg.block_size,
             heartbeat_every=self.cfg.heartbeat_every,
             decisions=self.cfg.record_decisions,
+            series_every=self.cfg.series_every,
         )
         # fused whole-replay Pallas engine (tpusim.sim.pallas_engine): one
         # kernel for the entire event loop, ~4x the table engine on chip;
@@ -426,11 +466,18 @@ class Simulator:
                 gpu_sel=self.cfg.gpu_sel_method,
                 block_size=self.cfg.block_size,
                 decisions=self.cfg.record_decisions,
+                series_every=self.cfg.series_every,
             )
         if self.cfg.record_decisions and self.cfg.engine == "pallas":
             raise ValueError(
                 "engine: pallas cannot record decisions (the fused kernel "
                 "emits no per-event provenance); use the table, "
+                "sequential, or shard engine"
+            )
+        if self.cfg.series_every and self.cfg.engine == "pallas":
+            raise ValueError(
+                "engine: pallas cannot emit the in-scan series (the fused "
+                "kernel has no per-event sampling plane); use the table, "
                 "sequential, or shard engine"
             )
         if self._pallas_ok and self.cfg.engine in ("auto", "pallas"):
@@ -577,7 +624,12 @@ class Simulator:
             # counts, so progress can never read > 100%
             from tpusim.obs import heartbeat as obs_heartbeat
 
-            obs_heartbeat.configure(e2, "replay")
+            # base = events of the RUN already replayed by earlier
+            # segments (the fault loop sets it; 0 otherwise), so chunked
+            # and fault-segmented ticks report run-level progress/ETA
+            obs_heartbeat.configure(
+                self._hb_base + e2, "replay", base=self._hb_base
+            )
         # dedup types from the UNPADDED specs (no spurious zero type); the
         # type_id axis is padded alongside the pod axis (padded events only
         # ever reference pod 0)
@@ -653,6 +705,7 @@ class Simulator:
                 use_pallas = (
                     self._pallas_fn is not None
                     and not self.cfg.record_decisions
+                    and not self.cfg.series_every
                     and (
                         self.cfg.engine == "pallas"
                         or (self.cfg.engine == "auto" and big
@@ -903,13 +956,14 @@ class Simulator:
 
         def chunks():
             yield _engine_source_digest()
-            # record_decisions participates: a decision-recording run's
-            # checkpoints carry the accumulated decision stream, which a
-            # non-recording run's do not — the layouts must never mix
+            # record_decisions/series_every participate: a recording run's
+            # checkpoints carry the accumulated decision/sample streams,
+            # which a non-recording run's do not — the layouts must never
+            # mix (and the sample stream's stride is series_every itself)
             yield repr((
                 tuple(cfg.policies), cfg.gpu_sel_method, cfg.dim_ext_method,
                 cfg.norm_method, cfg.block_size, cfg.mesh,
-                cfg.record_decisions,
+                cfg.record_decisions, cfg.series_every,
             )).encode()
             for leaf in (
                 jax.tree.leaves(state) + jax.tree.leaves(specs)
@@ -933,7 +987,9 @@ class Simulator:
         killed-and-resumed run reproduces the uninterrupted run's
         placements, telemetry, metrics, and final tables exactly."""
         from tpusim.io import storage as ckpt
+        from tpusim.obs import heartbeat as obs_heartbeat
         from tpusim.obs.decisions import DecisionRecord
+        from tpusim.obs.series import SeriesSample
         from tpusim.sim.engine import ReplayResult
 
         e = int(ev_kind.shape[0])
@@ -946,12 +1002,15 @@ class Simulator:
         tleaves, tdef = jax.tree.flatten(template)
         record_dec = self.cfg.record_decisions
         dec_fields = DecisionRecord._fields
+        record_ser = bool(self.cfg.series_every)
+        ser_fields = SeriesSample._fields
 
         carry = None
         cursor = 0
         node_parts: list = []
         dev_parts: list = []
         dec_parts: list = []  # DecisionRecord-of-np per segment (ISSUE 4)
+        ser_parts: list = []  # SeriesSample-of-np per segment (ISSUE 5)
         found = ckpt.find_checkpoint(cache_dir, digest)
         if found is not None:
             try:
@@ -975,7 +1034,19 @@ class Simulator:
                     dec_parts = [DecisionRecord(
                         *(arrays[f"dec_{f}"] for f in dec_fields)
                     )]
+                if record_ser:
+                    # likewise the per-event sample stream (ISSUE 5): the
+                    # stride clock itself is the carry's ctr leaf, so the
+                    # resumed scan keeps sampling on the same grid
+                    ser_parts = [SeriesSample(
+                        *(arrays[f"ser_{f}"] for f in ser_fields)
+                    )]
                 cursor = cursor0
+                if self.cfg.heartbeat_every:
+                    # the resumed carry's event counter already includes
+                    # `cursor` events this process never executed — keep
+                    # the tick line / /progress ev-per-s honest
+                    obs_heartbeat.note_resume(cursor)
                 self.log.info(
                     f"[Checkpoint] resumed replay at event {cursor}/{e} "
                     f"from {os.path.basename(found[1])}"
@@ -995,7 +1066,8 @@ class Simulator:
                 except OSError:
                     pass
                 carry, cursor = None, 0
-                node_parts, dev_parts, dec_parts = [], [], []
+                node_parts, dev_parts = [], []
+                dec_parts, ser_parts = [], []
         if carry is None:
             # only now resolve the table cache (table engine only): a
             # resumed run never reaches here and must not pay the build
@@ -1015,11 +1087,12 @@ class Simulator:
                 carry, specs, types, ev_kind[cursor:end],
                 ev_pod[cursor:end], self.typical, rank,
             )
+            nseg, dseg = ys[0], ys[1]
+            rest = list(ys[2:])
             if record_dec:
-                nseg, dseg, decseg = ys
-                dec_parts.append(jax.tree.map(np.asarray, decseg))
-            else:
-                nseg, dseg = ys
+                dec_parts.append(jax.tree.map(np.asarray, rest.pop(0)))
+            if record_ser:
+                ser_parts.append(jax.tree.map(np.asarray, rest.pop(0)))
             node_parts.append(np.asarray(nseg))
             dev_parts.append(np.asarray(dseg))
             cursor = end
@@ -1037,6 +1110,11 @@ class Simulator:
                     for f in dec_fields:
                         arrays[f"dec_{f}"] = np.concatenate(
                             [np.asarray(getattr(p, f)) for p in dec_parts]
+                        )
+                if record_ser:
+                    for f in ser_fields:
+                        arrays[f"ser_{f}"] = np.concatenate(
+                            [np.asarray(getattr(p, f)) for p in ser_parts]
                         )
                 ckpt.save_checkpoint(cache_dir, digest, cursor, arrays)
                 ckpt.prune_checkpoints(cache_dir, digest, cursor)
@@ -1057,12 +1135,20 @@ class Simulator:
                 np.concatenate([np.asarray(getattr(p, f)) for p in dec_parts])
                 for f in dec_fields
             ))
+        sers = None
+        if record_ser and ser_parts:
+            # the concatenation of segment sample streams IS the
+            # unsegmented scan's stream (per-event ys, sentinels included)
+            sers = SeriesSample(*(
+                np.concatenate([np.asarray(getattr(p, f)) for p in ser_parts])
+                for f in ser_fields
+            ))
         # the carry's counter leaf accumulated across every segment AND
         # any resumed-from checkpoint — telemetry continuity through
         # kill/resume comes for free from the carry being the checkpoint
         return ReplayResult(
             state_f, placed, masks, failed, None,
-            jnp.asarray(nodes), jnp.asarray(devs), carry.ctr, decs,
+            jnp.asarray(nodes), jnp.asarray(devs), carry.ctr, decs, sers,
         )
 
     # ---- workload prep (core.go:103-142) ----
@@ -1187,6 +1273,13 @@ class Simulator:
                 jax.tree.map(np.asarray, out.decisions),
                 np.asarray(ev_kind), np.asarray(ev_pod),
             ))
+        if out.series is not None:
+            # filter the stacked per-event samples down to the real
+            # stride points (the host-side SeriesLog); standalone replays
+            # start the event clock at 0 with an empty retry queue
+            from tpusim.obs.series import log_from_stacked
+
+            out = out._replace(series=log_from_stacked(out.series))
         self._emit_event_reports(out, pods, ev_kind, ev_pod, state)
         skipped = np.array([p.unscheduled for p in pods], bool)
         failed_mask = np.asarray(out.ever_failed) | skipped
@@ -1242,27 +1335,47 @@ class Simulator:
 
     def event_counter_series(self) -> dict:
         """Per-event counter-track series for the Chrome-trace emitter
-        (obs.emitters counter tracks): the cluster frag gpu-milli and
-        used gpu-milli the metrics postpass already computed, one value
-        per reported event, concatenated across this run's reporting
-        replays. Empty when per-event reporting is off — the trace then
-        simply carries no counter tracks."""
+        (obs.emitters counter tracks): the cluster frag gpu-milli (total
+        AND decomposed by the 7 FGD failure categories — the
+        `frag_amounts` columns the postpass already computed), used
+        gpu-milli, and used cpu-milli, one value per reported event,
+        concatenated across this run's reporting replays. Category
+        columns share the in-scan series plane's vocabulary
+        (obs.series.FRAG_CATEGORY_NAMES). Empty when per-event reporting
+        is off — the trace then simply carries no counter tracks."""
+        from tpusim.obs.series import FRAG_CATEGORY_NAMES
+
         frag: list = []
         used: list = []
+        used_cpu: list = []
+        cats: list = [[] for _ in FRAG_CATEGORY_NAMES]
         for rep in self.event_reports:
             s = rep.get("series", {})
             if "_frag_milli_f" in s:  # numeric twin of origin_milli
                 frag.extend(
                     np.asarray(s["_frag_milli_f"], np.float64).tolist()
                 )
+            amounts = rep.get("frag_amounts")
+            if amounts is not None:
+                a = np.asarray(amounts, np.float64)
+                for j in range(min(a.shape[1], len(cats))):
+                    cats[j].extend(a[:, j].tolist())
             used.extend(
                 np.asarray(rep["used_gpu_milli"]).astype(np.int64).tolist()
+            )
+            used_cpu.extend(
+                np.asarray(rep["used_cpu_milli"]).astype(np.int64).tolist()
             )
         out = {}
         if frag:
             out["frag_gpu_milli"] = frag
         if used:
             out["used_gpu_milli"] = used
+        if used_cpu:
+            out["used_cpu_milli"] = used_cpu
+        for name, vals in zip(FRAG_CATEGORY_NAMES, cats):
+            if vals:
+                out[f"frag_{name}_milli"] = vals
         return out
 
     def _record_result(self, result, pods, events, unscheduled, rank, wall):
@@ -1278,6 +1391,7 @@ class Simulator:
             creation_rank=rank,
             telemetry=self.run_telemetry(),
             decisions=getattr(result, "decisions", None),
+            series=getattr(result, "series", None),
         )
         return self.last_result
 
@@ -1302,7 +1416,19 @@ class Simulator:
         )
         res.dev_mask = np.concatenate([res.dev_mask, np.asarray(out.dev_mask)])
         res.unscheduled_pods = list(res.unscheduled_pods) + failed
+        prior_events = res.events
         res.events += events
+        if out.series is not None:
+            from tpusim.obs.series import concat_series
+
+            # the appended replay's sample clock starts at 0; rebase onto
+            # the run's global event clock before appending
+            res.series = concat_series([
+                res.series,
+                out.series._replace(
+                    pos=np.asarray(out.series.pos) + prior_events
+                ),
+            ])
         if out.decisions is not None:
             from tpusim.obs.decisions import concat_logs
 
@@ -1525,6 +1651,16 @@ class Simulator:
                     np.asarray(ev_kind), v[np.asarray(ev_pod)],
                 ),
             ])
+        if out.series is not None:
+            from tpusim.obs.series import concat_series, log_from_stacked
+
+            # victim reschedules append their samples past the run's
+            # event clock (deschedule events are host-level, not trace
+            # events, so res.events itself is unchanged)
+            res.series = concat_series([
+                res.series,
+                log_from_stacked(out.series, base_pos=res.events),
+            ])
         placed_v = np.asarray(out.placed_node)
         mask_v = np.asarray(out.dev_mask)
         res.placed_node[v] = placed_v
@@ -1621,6 +1757,7 @@ class Simulator:
         )
         dm = DisruptionMetrics()
         dec_logs: list = []  # per-segment DecisionLogs (ISSUE 4)
+        ser_logs: list = []  # per-segment SeriesLogs (ISSUE 5)
         attempts: dict = {}  # pod -> consecutive failed retries so far
         evicted_at: dict = {}  # pod -> eviction position (latency clock)
         down_at: dict = {}  # node -> failure position
@@ -1641,11 +1778,25 @@ class Simulator:
             seg_key = jax.random.fold_in(base_key, state_box["segs"])
             state_box["segs"] += 1
             pre_state = state_box["state"]
+            # run-level heartbeat window: this segment's ticks report
+            # `events-so-far + segment progress` out of the run total
+            self._hb_base = state_box["events"]
             out = device_fetch(self.run_events(
                 pre_state, specs, jnp.asarray(seg_kind),
                 jnp.asarray(seg_pod), seg_key, types=types, pod_rows=pods,
             ))
             self._emit_event_reports(out, pods, seg_kind, seg_pod, pre_state)
+            if out.series is not None:
+                from tpusim.obs.series import log_from_stacked
+
+                # every segment is a fresh scan, so it OPENS with a sample
+                # of the post-fault cluster at stride position 0; rebase
+                # onto the run's global event clock and stamp the current
+                # retry-queue depth (host state the scan cannot see)
+                ser_logs.append(log_from_stacked(
+                    out.series, base_pos=state_box["events"],
+                    retry_depth=len(rq),
+                ))
             if out.decisions is not None:
                 # the fault replay's provenance is the concatenation of
                 # its segments' streams, in replay order — continuous
@@ -1804,7 +1955,9 @@ class Simulator:
             elif placed[i] < 0 and bool(ever_failed[i]):
                 unscheduled.append(UnscheduledPod(pods[i]))
         from tpusim.obs.decisions import concat_logs
+        from tpusim.obs.series import concat_series
 
+        self._hb_base = 0  # later replays report from a fresh clock
         self.last_result = SimulateResult(
             unscheduled_pods=unscheduled,
             placed_node=placed,
@@ -1817,6 +1970,7 @@ class Simulator:
             creation_rank=creation_rank,
             telemetry=self.run_telemetry(),
             decisions=concat_logs(dec_logs),
+            series=concat_series(ser_logs),
         )
         return self.last_result
 
@@ -1981,6 +2135,7 @@ class Simulator:
         # are byte-identical by construction)
         self.event_reports.append({
             "series": series,
+            "frag_amounts": amounts,  # f32[E, 7], FGD category order
             "kinds": kinds,
             "pod_names": pod_names,
             "failed": ev_failed,
@@ -2133,6 +2288,11 @@ def _slice_result(out, p: int, e: int):
             if out.decisions is None
             else jax.tree.map(lambda a: a[:e], out.decisions)
         ),
+        series=(
+            None
+            if out.series is None
+            else jax.tree.map(lambda a: a[:e], out.series)
+        ),
     )
 
 
@@ -2232,6 +2392,12 @@ def dispatch_pods_batch(
             "schedule_pods_batch cannot record decisions (the vmapped "
             "replay has no per-seed provenance surface); run each sim's "
             "run() instead"
+        )
+    if any(s.cfg.series_every for s in sims):
+        raise ValueError(
+            "schedule_pods_batch cannot emit the in-scan series (the "
+            "vmapped replay has no per-seed sampling surface); run each "
+            "sim's run() instead"
         )
     for s in sims[1:]:
         same = (

@@ -18,12 +18,15 @@ import jax
 import jax.numpy as jnp
 
 from tpusim.constants import MAX_GPUS_PER_NODE, MILLI
+from tpusim.obs import series as obs_series
 from tpusim.obs.counters import counter_delta, zero_counters
 from tpusim.obs.decisions import no_decision
 from tpusim.ops.energy import node_power
 from tpusim.ops.frag import cluster_frag_amounts, frag_sum_except_q3, frag_sum_q1q2q4
+from tpusim.policies import ScoreContext
 from tpusim.sim.step import (
     Placement,
+    filter_nodes,
     schedule_one,
     schedule_one_recorded,
     unschedule,
@@ -91,6 +94,12 @@ class ReplayResult(NamedTuple):
     # engine was built with decisions=True; engine-invariant on
     # decisions.INVARIANT_FIELDS and bit-reproducible like the counters.
     decisions: object = None
+    # tpusim.obs.series.SeriesSample stacked over the event axis — the
+    # in-scan cluster time-series plane (ISSUE 5): a real sample at every
+    # series_every-th processed event, sentinel rows (pos == -1)
+    # elsewhere. None unless the engine was built with series_every > 0;
+    # fully engine-invariant and bit-reproducible like the counters.
+    series: object = None
 
 
 def cluster_usage(state: NodeState):
@@ -142,7 +151,7 @@ _REPLAY_CACHE = {}
 
 
 def make_replay(policies, gpu_sel: str = "best", report: bool = True,
-                decisions: bool = False):
+                decisions: bool = False, series_every: int = 0):
     """Build a jitted trace replayer for a static policy configuration.
 
     policies: [(policy_fn, weight)]; gpu_sel: Reserve-phase gpuSelMethod.
@@ -151,14 +160,20 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True,
     (tpusim.obs.decisions; ISSUE 4) as an extra scan output — the
     trajectory itself is untouched (same kernels, same key splits; the
     record is built from gathers on values the cycle already computed).
+    series_every > 0 likewise adds the in-scan time-series plane
+    (tpusim.obs.series; ISSUE 5): one SeriesSample per event, real at
+    stride points, sentinel elsewhere. The sample consumes NO PRNG
+    (RandomScore's slot is zeros) and reads the pre-event state, so the
+    trajectory is untouched; it is a static build flag because the
+    sampling cond bakes into the jaxpr.
 
-    Replayers are cached per (policy kernels, gpu_sel, report, decisions)
-    so that a sweep constructing many Simulators (experiments/sweep.py)
-    reuses one compiled engine per configuration instead of re-jitting
-    per experiment.
+    Replayers are cached per (policy kernels, gpu_sel, report, decisions,
+    series_every) so that a sweep constructing many Simulators
+    (experiments/sweep.py) reuses one compiled engine per configuration
+    instead of re-jitting per experiment.
     """
     cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
-                 decisions)
+                 decisions, int(series_every))
     if cache_key in _REPLAY_CACHE:
         return _REPLAY_CACHE[cache_key]
     num_pol = len(policies)
@@ -183,6 +198,41 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True,
             kind, idx = ev
             pod = jax.tree.map(lambda a: a[idx], pods)
             key, sub = jax.random.split(key)
+
+            if series_every:
+                # sample of the committed state BEFORE this event (every
+                # engine agrees on it); consumes no PRNG — RandomScore's
+                # slot stays zeros, matching its inert table row
+                processed = ctr[0] + ctr[3] + ctr[4]
+
+                def _build_sample():
+                    n = state.num_nodes
+                    unpinned = pod._replace(
+                        pinned=jnp.full_like(pod.pinned, -1)
+                    )
+                    feas = filter_nodes(state, unpinned)
+                    # raw rows exactly as the table build computes them:
+                    # all-ones ctx feasibility, constant rng
+                    ctx = ScoreContext(
+                        tp=tp, feasible=jnp.ones(n, jnp.bool_),
+                        rng=jax.random.PRNGKey(0),
+                    )
+                    raws = [
+                        jnp.zeros(n, jnp.int32)
+                        if fn.policy_name == "RandomScore"
+                        else fn(state, pod, ctx).raw_scores
+                        for fn, _ in policies
+                    ]
+                    return obs_series.build_sample(
+                        state, tp, jnp.stack(raws), feas, policies,
+                        processed,
+                    )
+
+                ser = obs_series.emit_from_scan(
+                    series_every, processed, _build_sample, num_pol
+                )
+            else:
+                ser = ()
 
             def do_create(_):
                 # arrived counters accumulate per creation event regardless
@@ -250,6 +300,7 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True,
                 node,
                 dev,
                 dec,
+                ser,
             )
 
         init = (
@@ -257,12 +308,13 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True,
             zero_counters(), key,
         )
         (state, placed, masks, failed, _, _, ctr, _), (
-            rows, nodes, devs, decs
+            rows, nodes, devs, decs, sers
         ) = jax.lax.scan(body, init, (ev_kind, ev_pod))
         metrics = EventMetrics(*rows) if report else None
         return ReplayResult(
             state, placed, masks, failed, metrics, nodes, devs, ctr,
             decs if decisions else None,
+            sers if series_every else None,
         )
 
     _REPLAY_CACHE[cache_key] = replay

@@ -39,6 +39,7 @@ import numpy as np
 
 from tpusim.constants import MAX_GPUS_PER_NODE
 from tpusim.obs import heartbeat as obs_heartbeat
+from tpusim.obs import series as obs_series
 from tpusim.obs.counters import counter_delta, zero_counters
 from tpusim.obs.decisions import no_decision
 from tpusim.policies import (
@@ -364,7 +365,7 @@ def make_table_builders(policies, sel_idx: int):
 def make_table_replay(
     policies, gpu_sel: str = "best", report: bool = False,
     block_size: int = 0, heartbeat_every: int = 0,
-    decisions: bool = False,
+    decisions: bool = False, series_every: int = 0,
 ):
     """Build the jitted incremental replayer for a static policy config.
 
@@ -435,6 +436,16 @@ def make_table_replay(
     records are engine-invariant by construction. Recording costs O(N)
     gathers per create event (plus DECISION_TOPK extra packed_argmax
     reductions), which is why it is a static build flag, not always on.
+
+    series_every > 0 (ISSUE 5) makes the scan additionally emit one
+    tpusim.obs.series.SeriesSample per event — a real sample of the
+    committed pre-event cluster state whenever the processed-event count
+    sits on the stride, a pos == -1 sentinel elsewhere. The sample is
+    assembled from the score/feas tables the dirty refresh just brought
+    current (== fn(state, ·) for every node by the table invariant), so
+    it is bit-identical to the sequential engine's recomputed sample; it
+    rides the ys, not the carry, so the checkpoint layout is unchanged.
+    ys become (node, dev[, dec][, ser]) in that order.
     """
     if report:
         raise ValueError(
@@ -442,7 +453,8 @@ def make_table_replay(
             "with tpusim.sim.metrics.compute_event_metrics"
         )
     cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
-                 int(block_size), int(heartbeat_every), bool(decisions))
+                 int(block_size), int(heartbeat_every), bool(decisions),
+                 int(series_every))
     if cache_key in _TABLE_REPLAY_CACHE:
         return _TABLE_REPLAY_CACHE[cache_key]
     num_pol = len(policies)
@@ -458,6 +470,28 @@ def make_table_replay(
     norm_deg = [
         NORMALIZE_DEGENERATE[policies[i][0].normalize] for i in norm_idx
     ]
+
+    def _sample_from_tables(state, score_tbl, feas_tbl, t_id, tp, ctr):
+        """One in-scan SeriesSample off the just-refreshed tables — the
+        flat and blocked bodies share it. The dirty refresh has already
+        made score_tbl/feas_tbl equal to a full rebuild on the committed
+        state, so gathering the event type's row is bit-identical to the
+        sequential engine recomputing it; blocked pad columns are
+        infeasible, so the normalized extrema cannot see them. The
+        RandomScore slot is a zero table row and score_stats zeroes it
+        anyway — the sample never consumes PRNG."""
+        processed = ctr[0] + ctr[3] + ctr[4]
+
+        def build():
+            raws = jax.lax.dynamic_index_in_dim(score_tbl, t_id, 1, False)
+            feas = jax.lax.dynamic_index_in_dim(feas_tbl, t_id, 0, False)
+            return obs_series.build_sample(
+                state, tp, raws, feas, policies, processed
+            )
+
+        return obs_series.emit_from_scan(
+            series_every, processed, build, num_pol
+        )
 
     def _totals(raws, feas, slo, shi):
         """Weighted normalized totals with a -INT_MAX sentinel at
@@ -535,6 +569,14 @@ def make_table_replay(
             )
             feas_tbl = jax.lax.dynamic_update_slice(
                 feas_tbl, col_feas[:, None], (0, dirty)
+            )
+
+            # in-scan series sample (ISSUE 5): committed state + current
+            # tables, on the processed-event stride
+            ser = (
+                _sample_from_tables(state, score_tbl, feas_tbl, t_id, tp,
+                                    ctr)
+                if series_every else ()
             )
 
             # dirty-block aggregate refresh for ALL K types: O(K*B)
@@ -741,7 +783,11 @@ def make_table_replay(
                 state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
                 brmin, brmax, slo, shi, pend, dirty,
                 placed, masks, failed, arr_cpu, arr_gpu, key, ctr,
-            ), ((node, dev, dec) if decisions else (node, dev))
+            ), (
+                (node, dev)
+                + ((dec,) if decisions else ())
+                + ((ser,) if series_every else ())
+            )
 
         return body
 
@@ -781,6 +827,14 @@ def make_table_replay(
             )
             feas_tbl = jax.lax.dynamic_update_slice(
                 feas_tbl, col_feas[:, None], (0, dirty)
+            )
+
+            # in-scan series sample (ISSUE 5): committed state + current
+            # tables, on the processed-event stride
+            ser = (
+                _sample_from_tables(state, score_tbl, feas_tbl, t_id, tp,
+                                    ctr)
+                if series_every else ()
             )
 
             def do_create():
@@ -857,7 +911,11 @@ def make_table_replay(
             return FlatTableCarry(
                 state, score_tbl, sdev_tbl, feas_tbl, pend, dirty,
                 placed, masks, failed, arr_cpu, arr_gpu, key, ctr,
-            ), ((node, dev, dec) if decisions else (node, dev))
+            ), (
+                (node, dev)
+                + ((dec,) if decisions else ())
+                + ((ser,) if series_every else ())
+            )
 
         return body
 
@@ -945,9 +1003,10 @@ def make_table_replay(
     def run_chunk(carry, pods, types, ev_kind, ev_pod, tp,
                   tiebreak_rank=None):
         """Advance `carry` over a segment of the event stream; returns
-        (carry', (event_node, event_dev)) for the segment — with a third
-        per-event DecisionRecord element when the engine was built with
-        decisions=True. Chaining
+        (carry', (event_node, event_dev)) for the segment — extended with
+        a per-event DecisionRecord element when the engine was built with
+        decisions=True, then a per-event SeriesSample element when built
+        with series_every > 0. Chaining
         run_chunk calls over any partition of the stream is bit-identical
         to one replay() over the whole stream — the scan body is a pure
         function of (carry, event), and every carry leaf is an exact dtype
@@ -1003,13 +1062,13 @@ def make_table_replay(
             carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank
         )
         state, placed, masks, failed = finish(carry)
-        if decisions:
-            nodes, devs, decs = ys
-        else:
-            (nodes, devs), decs = ys, None
+        nodes, devs = ys[0], ys[1]
+        rest = list(ys[2:])
+        decs = rest.pop(0) if decisions else None
+        sers = rest.pop(0) if series_every else None
         return ReplayResult(
             state, placed, masks, failed, None, nodes, devs, carry.ctr,
-            decs,
+            decs, sers,
         )
 
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,
