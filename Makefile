@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -38,11 +38,22 @@ bench-scale-smoke:
 # kill/resume + fault-segment continuity, openb explain/diff goldens),
 # and the live-telemetry suite (in-scan series cross-engine invariance,
 # series kill/resume + fault-segment continuity, /metrics-vs-textfile
-# equality, serve smoke). Runs the full files including slow-marked
-# cases (the synthetic kill/resume + telemetry subsets are already
-# wired into tier-1).
+# equality, serve smoke), and the config-axis sweep suite (weight-operand
+# cross-engine bit-identity, the B=16 openb acceptance). Runs the full
+# files including slow-marked cases (the synthetic kill/resume +
+# telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py tests/test_decisions.py tests/test_series.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py -q
+
+# config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
+# vmapped-sweep suite (cross-engine bit-identity under traced weights,
+# the B=16 openb acceptance incl. the one-compile and marginal-cost
+# bounds), then a small end-to-end `bench_scale --sweep` row through the
+# persistent compilation cache. Runs the slow-marked cases tier-1 skips.
+sweep-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_sweep.py -q
+	JAX_PLATFORMS=cpu TPUSIM_COMPILE_CACHE_DIR=.tpusim_obs/compile_cache \
+		python bench_scale.py --nodes 1500 --pods 2000 --sweep 4
 
 # observability smoke (ENGINES.md "Round 8"/"Round 10"): a small
 # profiled scale run emitting the full artifact set — JSONL run record

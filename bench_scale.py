@@ -71,6 +71,112 @@ def synth_pods(num_pods: int, seed: int = 1):
     return rows
 
 
+def run_sweep_bench(args, sim, cache_dir):
+    """`--sweep B[,B...]` (ISSUE 6): measure the config-axis sweep — one
+    row per batch size B with the cold wall (first dispatch, incl. the
+    ONE scan compile the whole weight grid shares), the warm wall, and
+    the marginal per-config cost against a standalone warm replay of the
+    same workload. The weight rows are distinct (base - i per config) so
+    every lane is a real what-if, yet all of them run one jaxpr — the
+    one-compile-per-job-family contract `replay.engine` carries."""
+    import jax
+    import numpy as np
+
+    from tpusim.io.trace import build_events, pods_to_specs
+    from tpusim.obs import bench as obs_bench
+    from tpusim.sim.driver import schedule_pods_sweep
+
+    bs = sorted({int(x) for x in str(args.sweep).split(",") if x.strip()})
+    if not bs or min(bs) < 1:
+        raise SystemExit(f"--sweep wants positive batch sizes, got {args.sweep!r}")
+
+    trace = sim.prepare_pods()
+    specs = pods_to_specs(trace)
+    ev_kind, ev_pod = build_events(trace)
+    events = len(ev_kind)
+    cfg = sim.cfg
+    base_w = np.asarray([w for _, w in cfg.policies], np.int32)
+
+    # standalone warm baseline: the regular single-config replay the
+    # marginal per-config cost is judged against (same protocol as
+    # bench.py: one compile run, then a warm minimum)
+    import jax.numpy as jnp
+
+    ev_kind_d, ev_pod_d = jnp.asarray(ev_kind), jnp.asarray(ev_pod)
+    key = jax.random.PRNGKey(cfg.seed)
+
+    def standalone():
+        # same bucket as schedule_pods_sweep's default so both sides pad
+        # the event stream identically — the per-config ratio compares
+        # equal replay lengths
+        res = sim.run_events(
+            sim.init_state, specs, ev_kind_d, ev_pod_d, key, bucket=512
+        )
+        jax.block_until_ready(res.state)
+
+    m0 = obs_bench.measure(standalone, warm_runs=2)
+    standalone_warm = m0["min_s"]
+    print(
+        f"[sweep] standalone nodes={args.nodes} pods={args.pods} "
+        f"events={events} engine={sim._last_engine} "
+        f"warm={standalone_warm:.3f}s (first incl. compile "
+        f"{m0['first_s']:.1f}s)"
+    )
+
+    rows = []
+    for b in bs:
+        # distinct rows: every lane is a genuine what-if configuration
+        grid = np.stack([base_w - i for i in range(b)]).astype(np.int32)
+        box = {}
+
+        def run_b(grid=grid, box=box):
+            box["lanes"] = schedule_pods_sweep(sim, trace, grid)
+
+        m = obs_bench.measure(run_b, warm_runs=2)
+        per_cfg = m["min_s"] / b
+        ratio = per_cfg / standalone_warm if standalone_warm else 0.0
+        row = obs_bench.round_row({
+            "b": b,
+            "events": events,
+            "engine": sim._last_engine,
+            "cold_s": m["first_s"],
+            "warm_s": m["min_s"],
+            "per_config_s": per_cfg,
+            "ratio_vs_standalone": round(ratio, 3),
+            "placed_lane0": box["lanes"][0].placed,
+        })
+        rows.append(row)
+        print(
+            f"[sweep] B={b} cold={row['cold_s']:.1f}s "
+            f"warm={row['warm_s']:.3f}s per_config={row['per_config_s']:.3f}s "
+            f"ratio_vs_standalone={row['ratio_vs_standalone']:.3f} "
+            f"engine={row['engine']}"
+        )
+
+    if args.sweep_out:
+        payload = {
+            # BENCH_rNN.json-shape capture WITHOUT a `parsed` key: the
+            # gate must never mistake sweep rows for the headline
+            # throughput baseline — it reads the `sweep` block instead
+            "cmd": "python bench_scale.py --sweep "
+            + ",".join(str(b) for b in bs)
+            + f" --nodes {args.nodes} --pods {args.pods}",
+            "rc": 0,
+            "sweep": {
+                "nodes": args.nodes,
+                "pods": args.pods,
+                "events": events,
+                "policies": [name for name, _ in cfg.policies],
+                "backend": jax.default_backend(),
+                "compile_cache": bool(cache_dir),
+                "standalone_warm_s": round(standalone_warm, 3),
+                "standalone_cold_s": round(m0["first_s"], 3),
+                "rows": rows,
+            },
+        }
+        obs_bench.write_json(args.sweep_out, payload)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=100_000)
@@ -134,6 +240,29 @@ def main():
         help="serve /metrics, /healthz, /progress over HTTP for the "
         "run's lifetime (tpusim.obs.server; bare :PORT binds loopback)",
     )
+    # config-axis sweep bench (ISSUE 6; ENGINES.md "Round 11"): replace
+    # the scale run with the vmapped weight-sweep measurement
+    ap.add_argument(
+        "--sweep", default="", metavar="B[,B...]",
+        help="measure the config-axis sweep instead of the scale run: "
+        "for each batch size B, one row with cold (incl. compile) and "
+        "warm wall of a B-config vmapped weight sweep plus the marginal "
+        "per-config cost against a standalone warm replay "
+        "(e.g. --sweep 1,4,16)",
+    )
+    ap.add_argument(
+        "--sweep-out", default="", metavar="PATH",
+        help="write the sweep rows as a BENCH_rNN.json-style capture "
+        "(a `sweep` block; `make bench-gate` reads the newest committed "
+        "one for its advisory sweep comparison)",
+    )
+    ap.add_argument(
+        "--compile-cache-dir", default="", metavar="DIR",
+        help="JAX persistent compilation cache "
+        "(SimulatorConfig.compile_cache_dir / $TPUSIM_COMPILE_CACHE_DIR): "
+        "re-runs of the same job family load the compiled scan from disk "
+        "instead of re-compiling",
+    )
     args = ap.parse_args()
     if args.chunk <= 0:
         ap.error("--chunk must be positive")
@@ -144,8 +273,18 @@ def main():
 
     from tpusim.constants import MILLI
     from tpusim.io.trace import build_events, pods_to_specs
-    from tpusim.sim.driver import Simulator, SimulatorConfig
+    from tpusim.sim.driver import (
+        Simulator,
+        SimulatorConfig,
+        enable_compile_cache,
+    )
     from tpusim.sim.typical import TypicalPodsConfig
+
+    # persistent compilation cache (ISSUE 6 satellite): wired BEFORE the
+    # first jitted dispatch so the scan compile lands in / loads from it
+    cache_dir = enable_compile_cache(args.compile_cache_dir)
+    if cache_dir:
+        print(f"[obs] compile cache at {cache_dir}", file=sys.stderr)
 
     nodes = synth_cluster(args.nodes, args.seed)
     pods = synth_pods(args.pods, args.seed + 1)
@@ -166,6 +305,10 @@ def main():
     sim = Simulator(nodes, cfg)
     sim.set_workload_pods(pods)
     sim.set_typical_pods()
+
+    if args.sweep:
+        run_sweep_bench(args, sim, cache_dir)
+        return
 
     specs = pods_to_specs(pods)
     ev_kind, ev_pod = build_events(pods)
@@ -254,8 +397,11 @@ def main():
         )
 
     if profiling or monitor is not None:
-        from tpusim.obs import emitters
+        from tpusim.obs import emitters, note_compile_cache
 
+        note_compile_cache(
+            sim.obs, enabled=bool(cache_dir), cache_dir=cache_dir or ""
+        )
         telemetry = sim.run_telemetry()
         record = emitters.build_record(
             telemetry,

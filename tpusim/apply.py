@@ -82,6 +82,18 @@ class ApplyOptions:
     # byte-equal to --metrics-out), /healthz, /progress (heartbeat-fed
     # phase/ev-per-s/ETA). Empty = off; bare ":PORT" binds loopback.
     listen: str = ""
+    # config-axis sweep (ISSUE 6; README "Sweep many configs in one
+    # compile"): a weights JSON here replaces the main schedule with ONE
+    # vmapped replay over its [B, num_pol] weight grid (+ optional
+    # per-config seeds) and prints the per-config summary table. The
+    # file is either a bare [[w...], ...] list or
+    # {"weights": [[...]], "seeds": [...]}.
+    sweep_weights: str = ""
+    # JAX persistent compilation cache dir (ISSUE 6 satellite;
+    # SimulatorConfig.compile_cache_dir / $TPUSIM_COMPILE_CACHE_DIR):
+    # wired before the first dispatch so re-runs skip the scan compile;
+    # the obs record notes the probable hit/miss.
+    compile_cache_dir: str = ""
 
 
 class Applier:
@@ -128,6 +140,7 @@ class Applier:
             heartbeat_every=self.options.heartbeat_every,
             record_decisions=bool(self.options.decisions_out),
             series_every=self.options.series_every,
+            compile_cache_dir=self.options.compile_cache_dir,
         )
 
     def _fault_config(self):
@@ -174,6 +187,20 @@ class Applier:
         return apps
 
     def run(self, out=sys.stdout) -> SimulateResult:
+        # persistent compilation cache (ISSUE 6 satellite): wired BEFORE
+        # any jitted dispatch so the scan compile itself lands in / loads
+        # from the cache; the post-run telemetry notes the probable
+        # hit/miss via the dispatch-wall heuristic
+        from tpusim.sim.driver import enable_compile_cache
+
+        self._compile_cache_dir = enable_compile_cache(
+            self.options.compile_cache_dir
+        )
+        if self._compile_cache_dir:
+            print(
+                f"[obs] compile cache at {self._compile_cache_dir}",
+                file=out,
+            )
         if self.cr.kube_config:
             from tpusim.io.k8s_yaml import load_cluster_from_dump
             from tpusim.io.kube_client import (
@@ -220,6 +247,18 @@ class Applier:
         ds_pods = cluster.daemonset_pods()
         sim.set_workload_pods(workload + ds_pods)
         fault_cfg = self._fault_config()
+        if self.options.sweep_weights:
+            # config-axis sweep replaces the main schedule: one vmapped
+            # replay over the weight grid, a summary table, telemetry —
+            # no snapshot/inflation/deschedule stages (they describe one
+            # placement run, not B of them)
+            if fault_cfg is not None:
+                raise ValueError(
+                    "--sweep-weights cannot combine with fault injection "
+                    "(the vmapped sweep replays a single uninterrupted "
+                    "event stream per config)"
+                )
+            return self._run_sweep(sim, out)
         if self.monitor is not None:
             self.monitor.publish_progress(
                 phase="scheduling", nodes=len(cluster.nodes),
@@ -264,6 +303,7 @@ class Applier:
 
         result = sim.last_result
         sim.finish()
+        self._note_compile_cache(sim)
         self._emit_telemetry(sim, out)
         if self.monitor is not None:
             self.monitor.publish_progress(
@@ -286,6 +326,54 @@ class Applier:
                 file=out,
             )
         return result
+
+    def _note_compile_cache(self, sim: Simulator):
+        """Record the persistent-compilation-cache outcome on the run's
+        telemetry (the `timing.compile_cache` block of the JSONL record;
+        dispatch-wall heuristic, obs.spans.note_compile_cache)."""
+        from tpusim.obs import note_compile_cache
+
+        note_compile_cache(
+            sim.obs, enabled=bool(self._compile_cache_dir),
+            cache_dir=self._compile_cache_dir or "",
+        )
+
+    def _run_sweep(self, sim: Simulator, out):
+        """`apply --sweep-weights`: load the weight grid, run the
+        config-axis sweep (one compiled scan for all B configs), print
+        the per-config summary table (README "Sweep many configs in one
+        compile")."""
+        import json
+
+        from tpusim.sim.driver import format_sweep_table
+
+        with open(self.options.sweep_weights) as f:
+            payload = json.load(f)
+        if isinstance(payload, dict):
+            weights = payload.get("weights")
+            seeds = payload.get("seeds")
+        else:
+            weights, seeds = payload, None
+        if not weights:
+            raise ValueError(
+                f"{self.options.sweep_weights}: no weight rows (want "
+                '[[w, ...], ...] or {"weights": [[...]], "seeds": [...]})'
+            )
+        lanes = sim.run_sweep(weights, seeds=seeds)
+        print(
+            f"[Sweep] {len(lanes)} configs x {lanes[0].events} events "
+            f"in one compiled scan ({sim._last_engine})",
+            file=out,
+        )
+        print(format_sweep_table(lanes, sim.cfg.policies), file=out)
+        self._note_compile_cache(sim)
+        self._emit_telemetry(sim, out)
+        if self.monitor is not None:
+            self.monitor.publish_progress(
+                phase="done", events_done=lanes[0].events * len(lanes),
+                events_total=lanes[0].events * len(lanes),
+            )
+        return None
 
     def _series_block(self, sim: Simulator):
         """The run's in-scan series as a JSONL record block, or None when
@@ -326,12 +414,11 @@ class Applier:
             # per event — each track is laid across the wall window
             # independently).
             counter_series = sim.event_counter_series()
-            if sim.last_result.series is not None:
+            last = getattr(sim, "last_result", None)
+            if last is not None and last.series is not None:
                 from tpusim.obs.series import series_tracks
 
-                counter_series.update(
-                    series_tracks(sim.last_result.series)
-                )
+                counter_series.update(series_tracks(last.series))
         paths = emitters.emit_record(
             record, telemetry.spans,
             jsonl=o.profile_out, metrics=o.metrics_out, trace=o.trace_out,

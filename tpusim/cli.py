@@ -147,6 +147,23 @@ def _build_parser() -> argparse.ArgumentParser:
         "run's lifetime (the final /metrics scrape is byte-equal to "
         "--metrics-out); bare :PORT binds loopback only",
     )
+    # config-axis sweep (ISSUE 6; README "Sweep many configs in one
+    # compile")
+    p_apply.add_argument(
+        "--sweep-weights", default="", metavar="WEIGHTS.json",
+        help="replace the main schedule with ONE vmapped what-if sweep "
+        "over this [B, num_policies] weight grid (bare list-of-rows or "
+        '{"weights": [[...]], "seeds": [...]}) and print the per-config '
+        "summary table (gpu_alloc, frag, placed) — B configs, one "
+        "compiled scan",
+    )
+    p_apply.add_argument(
+        "--compile-cache-dir", default="", metavar="DIR",
+        help="JAX persistent compilation cache (default "
+        "$TPUSIM_COMPILE_CACHE_DIR): re-runs of the same job family "
+        "load the compiled scan from disk instead of re-compiling; the "
+        "obs record notes the probable hit/miss",
+    )
 
     p_explain = sub.add_parser(
         "explain",
@@ -242,6 +259,8 @@ def cmd_apply(args) -> int:
         decisions_out=args.decisions_out,
         series_every=args.series_every,
         listen=args.listen,
+        sweep_weights=args.sweep_weights,
+        compile_cache_dir=args.compile_cache_dir,
     )
     Applier(opts).run()
     return 0

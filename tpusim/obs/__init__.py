@@ -57,4 +57,5 @@ from tpusim.obs.spans import (  # noqa: F401
     Recorder,
     RunTelemetry,
     Span,
+    note_compile_cache,
 )

@@ -34,6 +34,12 @@ published to an ephemeral MonitorServer and scraped over HTTP — the
 scrape must parse as valid Prometheus exposition text and be byte-equal
 to the gate_metrics.prom textfile, the same
 final-scrape-equals-textfile contract `tpusim apply --listen` promises.
+
+And the config-axis sweep surface (ISSUE 6): a small vmapped weight
+sweep must run, reuse ONE compiled executable across weight grids (the
+weights-are-operands contract), and its marginal per-config cost is
+printed next to the newest committed `bench_scale.py --sweep` capture's
+numbers — advisory only, since sweep walls are machine-shaped.
 """
 
 from __future__ import annotations
@@ -55,11 +61,11 @@ _TAIL_ALLOC = re.compile(r"gpu_alloc=([0-9.]+)%")
 _TAIL_BACKEND = re.compile(r"Platform '(\w+)'")
 
 
-def latest_baseline(repo: str = REPO) -> Optional[dict]:
-    """Newest committed BENCH_rNN.json with a clean run, parsed into
-    {path, n, throughput, events, placed, gpu_alloc, backend} (quality
-    fields None when the tail did not carry them)."""
-    best = None
+def _iter_captures(repo: str):
+    """Yield (path, round_number, data) for every readable committed
+    BENCH_rNN.json with rc == 0. Malformed files — unreadable, bad JSON,
+    a non-numeric `n` — are skipped, never raised: one torn capture must
+    not take the whole gate down."""
     for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
         if not m:
@@ -67,11 +73,22 @@ def latest_baseline(repo: str = REPO) -> Optional[dict]:
         try:
             with open(path) as f:
                 data = json.load(f)
-        except (OSError, json.JSONDecodeError):
+            if data.get("rc") != 0:
+                continue
+            n = int(data.get("n") or m.group(1))
+        except (OSError, json.JSONDecodeError, TypeError, ValueError):
             continue
-        if data.get("rc") != 0 or not data.get("parsed"):
+        yield path, n, data
+
+
+def latest_baseline(repo: str = REPO) -> Optional[dict]:
+    """Newest committed BENCH_rNN.json with a clean run, parsed into
+    {path, n, throughput, events, placed, gpu_alloc, backend} (quality
+    fields None when the tail did not carry them)."""
+    best = None
+    for path, n, data in _iter_captures(repo):
+        if not data.get("parsed"):
             continue
-        n = int(data.get("n", m.group(1)))
         if best is None or n > best["n"]:
             tail = data.get("tail", "")
             ev = _TAIL_EVENTS.search(tail)
@@ -88,6 +105,124 @@ def latest_baseline(repo: str = REPO) -> Optional[dict]:
                 "backend": be.group(1) if be else "cpu",
             }
     return best
+
+
+def latest_sweep(repo: str = REPO) -> Optional[dict]:
+    """Newest committed BENCH_rNN.json carrying a `sweep` block (written
+    by `bench_scale.py --sweep ... --sweep-out`), parsed into the block
+    plus {path, n}. Sweep captures deliberately ship WITHOUT a `parsed`
+    key so latest_baseline never mistakes them for the headline
+    throughput baseline."""
+    best = None
+    for path, n, data in _iter_captures(repo):
+        if not isinstance(data.get("sweep"), dict):
+            continue
+        if best is None or n > best["n"]:
+            best = {"path": path, "n": n, **data["sweep"]}
+    return best
+
+
+def sweep_advisory(nodes, pods, base: Optional[dict],
+                   b: int = 4) -> Tuple[bool, List[str]]:
+    """ISSUE 6 satellite: smoke the config-axis sweep surface and print
+    an advisory throughput comparison against the newest committed sweep
+    capture. Measures a B-config weight sweep over an openb prefix —
+    warm wall, marginal per-config cost, and the marginal/standalone
+    ratio (the number ENGINES.md Round 11 budgets; ratios travel across
+    machines of one backend far better than raw walls). The comparison
+    NEVER gates — cross-machine walls aren't comparable — but an
+    exception on the sweep path is a FAIL: a broken sweep surface is
+    exactly what the gate exists to catch. Also hard-checks the
+    one-compile contract: a second sweep with different weights must not
+    grow the compiled-executable count."""
+    import time
+
+    import numpy as np
+
+    from tpusim.sim.driver import (
+        Simulator,
+        SimulatorConfig,
+        _sweep_engine,
+        schedule_pods_sweep,
+    )
+
+    try:
+        import jax
+
+        sim = Simulator(nodes, SimulatorConfig(
+            policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+            report_per_event=False, seed=42,
+        ))
+        sim.set_workload_pods(pods[:200])
+        sim.set_typical_pods()
+        trace = sim.prepare_pods()
+
+        def run(grid):
+            t0 = time.perf_counter()
+            lanes = schedule_pods_sweep(sim, trace, grid)
+            return lanes, time.perf_counter() - t0
+
+        grid = np.stack(
+            [np.asarray([1000 - i], np.int32) for i in range(b)]
+        )
+        run(grid)  # compile run
+        lanes, warm = run(grid)
+        grid1 = grid[:1]
+        run(grid1)
+        _, warm1 = run(grid1)
+        # one jaxpr per job family: a different weight grid must reuse
+        # the compiled sweep executable, not add one — inspect the
+        # engine the sweep ACTUALLY dispatched (the small smoke workload
+        # may select the sequential path)
+        used_table = sim._last_engine.startswith("table")
+        fn = _sweep_engine(
+            sim._table_fn.engine.replay if used_table
+            else sim.replay_fn.engine,
+            table=used_table,
+        )
+        before = fn._cache_size()
+        if before < 1:
+            return False, [
+                f"[gate] sweep: {sim._last_engine!r} dispatched but its "
+                "vmapped executable cache is empty — engine bookkeeping "
+                "broken (FAIL)"
+            ]
+        run(np.stack(
+            [np.asarray([500 + i], np.int32) for i in range(b)]
+        ))
+        if fn._cache_size() != before:
+            return False, [
+                "[gate] sweep: weight change RECOMPILED the sweep "
+                f"engine ({before} -> {fn._cache_size()} executables) "
+                "(FAIL)"
+            ]
+        marginal = max(warm - warm1, 0.0) / max(b - 1, 1)
+    except Exception as err:
+        return False, [
+            f"[gate] sweep: FAIL ({type(err).__name__}: {err})"
+        ]
+    msgs = [
+        f"[gate] sweep: B={b} x {lanes[0].events} events warm "
+        f"{warm:.3f}s, marginal {marginal * 1000:.0f} ms/config, "
+        f"placed[0]={lanes[0].placed} — weight change reused the "
+        "compiled sweep executable (0 recompiles)"
+    ]
+    if base is not None and base.get("rows"):
+        brow = max(base["rows"], key=lambda r: r.get("b", 0))
+        msgs.append(
+            f"[gate] sweep baseline {os.path.basename(base['path'])} "
+            f"(round {base['n']}, backend {base.get('backend')!r}, "
+            f"nodes={base.get('nodes')}, B={brow.get('b')}): "
+            f"per_config {brow.get('per_config_s')}s, "
+            f"ratio_vs_standalone {brow.get('ratio_vs_standalone')} — "
+            "advisory only (different workload shape)"
+        )
+    else:
+        msgs.append(
+            "[gate] sweep: no committed sweep capture to compare "
+            "(bench_scale.py --sweep 1,4,16 --sweep-out BENCH_rNN.json)"
+        )
+    return True, msgs
 
 
 def compare(base: dict, cur: dict, tol: float, alloc_tol: float
@@ -281,7 +416,11 @@ def main(argv=None) -> int:
     # whether a throughput baseline exists
     dec_ok, dec_msg = decisions_roundtrip(nodes, pods, args.out)
     print(dec_msg)
-    smoke_ok = dec_ok and scrape_ok
+    # config-axis sweep smoke + advisory throughput comparison (ISSUE 6
+    # satellite): the one-compile contract gates, the walls never do
+    swp_ok, swp_msgs = sweep_advisory(nodes, pods, latest_sweep())
+    print("\n".join(swp_msgs))
+    smoke_ok = dec_ok and scrape_ok and swp_ok
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

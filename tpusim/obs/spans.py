@@ -106,6 +106,9 @@ class Recorder:
         self.engines: List[str] = []
         self.disruption: Dict[str, int] = {}
         self.table_cache = "off"  # off | miss | hit
+        # persistent-compilation-cache note (ISSUE 6 satellite): set by
+        # note_compile_cache after the run; None = never assessed
+        self.compile_cache: Optional[dict] = None
 
     @contextmanager
     def span(self, name: str, **meta):
@@ -168,7 +171,38 @@ class Recorder:
             events=self.scan_events,
             table_cache=self.table_cache,
             meta=dict(meta or {}),
+            compile_cache=(
+                dict(self.compile_cache) if self.compile_cache else None
+            ),
         )
+
+
+def note_compile_cache(recorder: Recorder, enabled: bool, cache_dir: str = "",
+                       hit_threshold_s: float = 2.0) -> dict:
+    """Stamp the run's persistent-compilation-cache outcome onto the
+    recorder (ISSUE 6 satellite). The verdict is a DISPATCH-WALL
+    HEURISTIC, not ground truth: jax exposes no portable per-executable
+    hit signal, but a cold scan compile costs several seconds of
+    dispatch wall while a persistent-cache load costs well under the
+    threshold — so `probable_hit` = (cache enabled AND the first scan
+    span's dispatch wall stayed under hit_threshold_s). Lands in the
+    run record's `timing` block (machine-dependent walls, never the
+    deterministic block)."""
+    scans = [s for s in recorder.spans if s.name == "scan"]
+    first = scans[0] if scans else None
+    info = {
+        "enabled": bool(enabled),
+        "dir": cache_dir,
+        "first_scan_dispatch_s": (
+            round(first.dispatch_s, 6) if first is not None else None
+        ),
+        "probable_hit": bool(
+            enabled and first is not None
+            and first.dispatch_s < hit_threshold_s
+        ),
+    }
+    recorder.compile_cache = info
+    return info
 
 
 @dataclass
@@ -184,6 +218,10 @@ class RunTelemetry:
     events: int
     table_cache: str
     meta: Dict[str, object]
+    # persistent-compilation-cache note (note_compile_cache): enabled /
+    # dir / first-scan dispatch wall / probable_hit heuristic. None when
+    # never assessed; machine-dependent, so it reports under `timing`.
+    compile_cache: Optional[dict] = None
 
     def to_record(self) -> dict:
         """The JSONL run record. `deterministic` is bit-identical across
@@ -213,6 +251,10 @@ class RunTelemetry:
                     max((s.start_s + s.total_s for s in self.spans),
                         default=0.0),
                     6,
+                ),
+                **(
+                    {"compile_cache": self.compile_cache}
+                    if self.compile_cache is not None else {}
                 ),
             },
         }

@@ -165,12 +165,13 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     n_dev = mesh.shape[NODE_AXIS]
     all_none_norm = all(fn.normalize == "none" for fn, _ in policies)
 
-    def _local_totals(rows):
+    def _local_totals(rows, wts):
         """Weighted totals with -INT_MAX at infeasible entries from a
-        packed-layout slice [..., C] (none-normalize configs only)."""
+        packed-layout slice [..., C] (none-normalize configs only).
+        `wts` is the traced i32[num_pol] weight operand (ISSUE 6)."""
         tot = jnp.zeros(rows.shape[:-1], jnp.int32)
-        for i, (_, weight) in enumerate(policies):
-            tot = tot + jnp.int32(weight) * rows[..., i]
+        for i in range(npol):
+            tot = tot + wts[i] * rows[..., i]
         return jnp.where(rows[..., npol + 1] != 0, tot, -_INT_MAX)
 
     def _resolve_bsz(nloc: int, k_types: int) -> int:
@@ -179,10 +180,10 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             if all_none_norm else 0
         )
 
-    def _init_shard(state, rank, pods, types, tp, key):
+    def _init_shard(state, rank, pods, types, tp, key, wts):
         """Per-shard carry at event 0: local table shards + blocked local
         summaries + replicated bookkeeping (state/rank are the LOCAL node
-        rows)."""
+        rows; wts is the replicated weight operand)."""
         nloc = state.num_nodes
         num_pods = pods.cpu.shape[0]
 
@@ -208,7 +209,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             rank_p = _pad_rank(rank, nloc_p)
             loffs = jnp.arange(nbl, dtype=jnp.int32) * bsz
             lt, lr, la = block_reduce(
-                _local_totals(packed_tbl).reshape(k_types, nbl, bsz),
+                _local_totals(packed_tbl, wts).reshape(k_types, nbl, bsz),
                 rank_p.reshape(nbl, bsz),
             )
             lwn = loffs[None, :] + la  # [K, nbl] local winner node indices
@@ -224,9 +225,11 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             z, z, key, zero_counters(),
         )
 
-    def _chunk_shard(carry, rank, pods, types, ev_kind, ev_pod, tp):
+    def _chunk_shard(carry, rank, pods, types, ev_kind, ev_pod, tp, wts):
         """Advance a per-shard carry over one event segment (the scan the
-        one-shot replay runs over the whole stream)."""
+        one-shot replay runs over the whole stream). `wts` must be the
+        weight vector the carry was initialized under (the blocked local
+        summaries embed it)."""
         nloc = carry.state.num_nodes
         me = jax.lax.axis_index(NODE_AXIS)
         offset = (me * nloc).astype(jnp.int32)
@@ -287,7 +290,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                 )
                 rank_blk = jax.lax.dynamic_slice(rank_p, (j0,), (bsz,))
                 bm, brk, bar = block_reduce(
-                    _local_totals(rows_blk), rank_blk
+                    _local_totals(rows_blk, wts), rank_blk
                 )
                 lt = jax.lax.dynamic_update_slice(lt, bm[:, None], (0, blk))
                 lr = jax.lax.dynamic_update_slice(lr, brk[:, None], (0, blk))
@@ -386,8 +389,8 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                     )[0, 0]
                     pin_ok = owns_pin & (pin_row[npol + 1] != 0)
                     pin_tot = jnp.zeros((), jnp.int32)
-                    for i, (_, weight) in enumerate(policies):
-                        pin_tot = pin_tot + jnp.int32(weight) * pin_row[i]
+                    for i in range(npol):
+                        pin_tot = pin_tot + wts[i] * pin_row[i]
                     pinned = pod.pinned >= 0
                     best_l = jnp.where(
                         pinned, jnp.where(pin_ok, pin_tot, -_INT_MAX), best_l
@@ -410,7 +413,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                         d_feas = (rows_t[:, npol + 1] != 0) & (
                             (pod.pinned < 0) | (gids_p == pod.pinned)
                         )
-                        d_tot = _local_totals(rows_t)
+                        d_tot = _local_totals(rows_t, wts)
                         d_rank = rank_p
                 else:
                     row = packed_tbl[t_id]  # [nloc, C]
@@ -419,7 +422,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                     )
                     total = jnp.zeros(nloc, jnp.int32)
                     d_raw_rows, d_norm_rows = [], []
-                    for i, (fn, weight) in enumerate(policies):
+                    for i, (fn, _) in enumerate(policies):
                         raw = row[:, i]
                         nrm = raw
                         if fn.normalize in ("minmax", "pwr"):
@@ -437,7 +440,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                         if decisions:
                             d_raw_rows.append(raw)
                             d_norm_rows.append(nrm)
-                        total = total + jnp.int32(weight) * nrm
+                        total = total + wts[i] * nrm
 
                     # selectHost: local argmax + 3 scalar collectives
                     best_l = jnp.max(jnp.where(feasible, total, -_INT_MAX))
@@ -651,27 +654,49 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     )
     mapped_init = _wrap(
         _init_shard,
-        (state_specs, P(NODE_AXIS), spec_r, types_specs, tp_specs, P()),
+        (state_specs, P(NODE_AXIS), spec_r, types_specs, tp_specs, P(),
+         P()),
         carry_specs,
     )
     mapped_chunk = _wrap(
         _chunk_shard,
-        (carry_specs, P(NODE_AXIS), spec_r, types_specs, P(), P(), tp_specs),
+        (carry_specs, P(NODE_AXIS), spec_r, types_specs, P(), P(), tp_specs,
+         P()),
         (carry_specs, P(), P())
         + ((dec_specs,) if decisions else ())
         + ((ser_specs,) if series_every else ()),
     )
 
-    @jax.jit
-    def init_carry(state, pods, types, tp, key, tiebreak_rank):
-        return mapped_init(state, tiebreak_rank, pods, types, tp, key)
+    from tpusim.sim.step import resolve_weights
 
     @jax.jit
-    def run_chunk(carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank):
+    def _init_carry_j(state, pods, types, tp, key, tiebreak_rank, wts):
+        return mapped_init(state, tiebreak_rank, pods, types, tp, key, wts)
+
+    @jax.jit
+    def _run_chunk_j(carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank,
+                     wts):
         outs = mapped_chunk(
-            carry, tiebreak_rank, pods, types, ev_kind, ev_pod, tp
+            carry, tiebreak_rank, pods, types, ev_kind, ev_pod, tp, wts
         )
         return outs[0], tuple(outs[1:])
+
+    # weights resolve OUTSIDE the jitted functions (ISSUE 6): the weight
+    # vector is always a traced operand, never a baked constant, so one
+    # compiled shard_map scan serves every weight vector of the family
+    def init_carry(state, pods, types, tp, key, tiebreak_rank,
+                   weights=None):
+        return _init_carry_j(
+            state, pods, types, tp, key, tiebreak_rank,
+            resolve_weights(policies, weights),
+        )
+
+    def run_chunk(carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank,
+                  weights=None):
+        return _run_chunk_j(
+            carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank,
+            resolve_weights(policies, weights),
+        )
 
     @jax.jit
     def finish(carry):
@@ -682,10 +707,11 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
 
     @jax.jit
     def _replay_impl(state, pods, types, ev_kind, ev_pod, tp, key,
-                     tiebreak_rank) -> ReplayResult:
-        carry = init_carry(state, pods, types, tp, key, tiebreak_rank)
-        carry, ys = run_chunk(
-            carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank
+                     tiebreak_rank, wts) -> ReplayResult:
+        carry = _init_carry_j(state, pods, types, tp, key, tiebreak_rank,
+                              wts)
+        carry, ys = _run_chunk_j(
+            carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank, wts
         )
         nodes, devs = ys[0], ys[1]
         rest = list(ys[2:])
@@ -697,9 +723,10 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         )
 
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,
-               tiebreak_rank) -> ReplayResult:
+               tiebreak_rank, weights=None) -> ReplayResult:
         return _replay_impl(
-            state, pods, types, ev_kind, ev_pod, tp, key, tiebreak_rank
+            state, pods, types, ev_kind, ev_pod, tp, key, tiebreak_rank,
+            resolve_weights(policies, weights),
         )
 
     # checkpoint/resume surface (driver chunked dispatch): a host gather of
@@ -708,4 +735,5 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     replay.init_carry = init_carry
     replay.run_chunk = run_chunk
     replay.finish = finish
+    replay.engine = _replay_impl  # the weight-operand jitted impl
     return replay

@@ -157,6 +157,15 @@ class SimulatorConfig:
     # falls back to the table engine; a forced engine: pallas raises)
     # and by extender configs / the seed-batched sweep path.
     series_every: int = 0
+    # JAX persistent compilation cache (ISSUE 6 satellite): a directory
+    # here (or $TPUSIM_COMPILE_CACHE_DIR when empty) makes apply /
+    # bench_scale wire jax_compilation_cache_dir before the first
+    # dispatch, so a re-run of the same job family loads its compiled
+    # scan from disk instead of paying the ~5 s XLA compile. Empty +
+    # unset env = disabled. The obs run record notes whether the first
+    # scan compile looked like a cache hit (dispatch-wall heuristic —
+    # obs.spans.note_compile_cache).
+    compile_cache_dir: str = ""
     # Device-mesh width: 0 = single device; N > 1 shards the node axis
     # over an N-device jax.sharding.Mesh and replays on the
     # explicit-collective shard_map engine (tpusim.parallel.shard_engine;
@@ -252,6 +261,43 @@ def _engine_source_digest() -> bytes:
                     h.update(f.read())
         _ENGINE_SRC_DIGEST = h.digest()
     return _ENGINE_SRC_DIGEST
+
+
+def enable_compile_cache(cache_dir: str = "") -> Optional[str]:
+    """Wire the JAX persistent compilation cache (ISSUE 6 satellite).
+
+    Resolution order: `cache_dir` (SimulatorConfig.compile_cache_dir)
+    if non-empty, else $TPUSIM_COMPILE_CACHE_DIR, else disabled (returns
+    None). Must run before the first jitted dispatch to cover the scan
+    compile; apply/bench_scale call it right after argument parsing.
+    The min-compile-time/entry-size floors are dropped so even the
+    smoke-sized scans populate the cache (knob names vary across jax
+    versions — absent ones are skipped)."""
+    d = cache_dir or os.environ.get("TPUSIM_COMPILE_CACHE_DIR", "")
+    if not d:
+        return None
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    for opt, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            pass
+    try:
+        # jax latches "is the cache used" ONCE per process, at the first
+        # compile — and importing tpusim compiles a few tiny jits before
+        # any caller can wire the dir, pinning the cache off for the
+        # whole run. Clear the latch so the next compile re-checks the
+        # (now set) cache dir.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+    return d
 
 
 def validate_events(ev_kind, ev_pod, num_pods: int) -> None:
@@ -867,8 +913,11 @@ class Simulator:
         """Content key of one table build: the engine-source salt + the
         scoring config + every input init_tables reads (initial state,
         pod types, typical pods). Deliberately NOT the event stream, PRNG
-        key, or tie-break rank — the build never consumes them, so every
-        seed/trace over the same cluster + type set shares one entry."""
+        key, tie-break rank, or the per-policy WEIGHTS — the build never
+        consumes them (tables hold raw per-policy scores; weights joined
+        the run inputs when they became a traced operand, ISSUE 6), so
+        every seed/trace/weight-vector over the same cluster + type set
+        shares one entry — a whole weight sweep reuses one table build."""
         from tpusim.io.storage import checkpoint_digest
 
         cfg = self.cfg
@@ -876,7 +925,8 @@ class Simulator:
         def chunks():
             yield _engine_source_digest()
             yield repr((
-                tuple(cfg.policies), cfg.gpu_sel_method, cfg.dim_ext_method,
+                tuple(name for name, _ in cfg.policies),
+                cfg.gpu_sel_method, cfg.dim_ext_method,
                 cfg.norm_method,
             )).encode()
             for leaf in (
@@ -946,10 +996,16 @@ class Simulator:
     def _run_digest(self, state, specs, ev_kind, ev_pod, key, rank) -> str:
         """Content key of one replay run: the engine-source version salt +
         every input that determines the trajectory (initial state, pod
-        specs, typical pods, event stream, PRNG key, tie-break rank) + the
-        scheduling config. checkpoint_every deliberately does NOT
-        participate — chunk boundaries are an arbitrary partition, so a
-        resume may use a different segment length."""
+        specs, typical pods, event stream, PRNG key, tie-break rank, and
+        — since the weight vector became a traced operand, ISSUE 6 — the
+        per-policy weights, hashed as a RUN INPUT leaf rather than part
+        of the static config vocabulary) + the scheduling config.
+        checkpoint_every deliberately does NOT participate — chunk
+        boundaries are an arbitrary partition, so a resume may use a
+        different segment length. A weight change still invalidates
+        (different operand bytes ⇒ different digest): the blocked
+        summaries inside a checkpointed carry embed the weights, so
+        resuming one under different weights would silently diverge."""
         from tpusim.io.storage import checkpoint_digest
 
         cfg = self.cfg
@@ -961,14 +1017,16 @@ class Simulator:
             # which a non-recording run's do not — the layouts must never
             # mix (and the sample stream's stride is series_every itself)
             yield repr((
-                tuple(cfg.policies), cfg.gpu_sel_method, cfg.dim_ext_method,
+                tuple(name for name, _ in cfg.policies),
+                cfg.gpu_sel_method, cfg.dim_ext_method,
                 cfg.norm_method, cfg.block_size, cfg.mesh,
                 cfg.record_decisions, cfg.series_every,
             )).encode()
             for leaf in (
                 jax.tree.leaves(state) + jax.tree.leaves(specs)
                 + jax.tree.leaves(self.typical)
-                + [ev_kind, ev_pod, key, rank]
+                + [ev_kind, ev_pod, key, rank,
+                   np.asarray([w for _, w in cfg.policies], np.int32)]
             ):
                 yield np.asarray(leaf).tobytes()
 
@@ -1485,6 +1543,21 @@ class Simulator:
         self.report_failed([u.pod for u in res.unscheduled_pods])
         self.cluster_analysis("InitSchedule")
         return res
+
+    def run_sweep(self, weights, seeds=None, bucket: int = 512):
+        """run()'s workload prep + ONE vmapped config-axis sweep replay
+        (ISSUE 6): evaluate B (weight-vector, seed) what-if configs of
+        this Simulator's policy family in a single compiled scan. See
+        schedule_pods_sweep for the contract; returns [SweepLane]."""
+        self._reset_run_state()
+        self.set_typical_pods()
+        pods = self.prepare_pods()
+        self.log.info(
+            f"Number of original workload pods: {len(self.workload_pods)}"
+        )
+        return schedule_pods_sweep(
+            self, pods, weights, seeds=seeds, bucket=bucket
+        )
 
     def run_with_faults(self, fault_cfg=None, faults=None) -> SimulateResult:
         """run() under fault injection: same experiment orchestration, the
@@ -2639,3 +2712,314 @@ def finish_run_batch(handle: dict) -> List[SimulateResult]:
         sim.report_failed([u.pod for u in res.unscheduled_pods])
         sim.cluster_analysis("InitSchedule", _amounts=amounts[i])
     return results
+
+
+# ---------------------------------------------------------------------------
+# Config-axis sweep: one compiled scan, B what-if configurations (ISSUE 6)
+# ---------------------------------------------------------------------------
+#
+# schedule_pods_batch vmaps S same-config experiments whose SEEDS differ
+# (per-seed specs/events/keys/ranks). The config-axis sweep generalizes it
+# along the axis the reference grids with a process per experiment
+# (1020 policy × weight × seed replays): the per-policy WEIGHT VECTOR is
+# now a traced engine operand (sim.step.resolve_weights), so a [B, num_pol]
+# weight matrix plus per-config seeds vmaps over ONE workload and ONE
+# compiled replay — the jaxpr is the policy family's, the weights are
+# data. The weight-independent score tables are built once and shared
+# across every lane (in_axes None), so the marginal what-if costs only
+# its share of the vmapped scan, never a table build or a compile.
+
+_SWEEP_WRAP_CACHE = {}
+_SWEEP_METRICS_FN = None
+
+
+@dataclass
+class SweepLane:
+    """One configuration's result out of a config-axis sweep — the
+    per-lane slice of the vmapped replay plus the summary scalars the
+    CLI table prints. Placements are bit-identical to a standalone run
+    with `weights` baked into the config and `seed` as cfg.seed
+    (tests/test_sweep.py pins this per engine)."""
+
+    weights: np.ndarray  # i32[num_pol] this lane's weight vector
+    seed: int
+    placed_node: np.ndarray  # i32[P]
+    dev_mask: np.ndarray  # bool[P, 8]
+    ever_failed: np.ndarray  # bool[P]
+    counters: Optional[np.ndarray]  # i32[obs.NUM_COUNTERS], pad-corrected
+    metrics: object  # EventMetrics (per-event rows) or None
+    state: object  # final NodeState (host arrays)
+    events: int
+    placed: int  # pods placed at end of trace
+    failed: int  # creation attempts rejected
+    gpu_alloc_pct: float
+    frag_gpu_milli: float
+
+
+def _sweep_engine(engine, table: bool):
+    """jit(vmap(engine)) over (key, weights, tiebreak_rank); everything
+    else — cluster state, pod specs, types, events, typical pods, and
+    the shared score tables — broadcasts (in_axes None). Cached per
+    underlying weight-operand engine, which is itself shared across
+    weight configs (one jaxpr per job family)."""
+    if engine not in _SWEEP_WRAP_CACHE:
+        if table:
+            # (state, pods, types, ev_kind, ev_pod, tp, key, wts, rank,
+            #  tables)
+            in_axes = (None, None, None, None, None, None, 0, 0, 0, None)
+        else:
+            # (state, pods, ev_kind, ev_pod, tp, key, wts, rank)
+            in_axes = (None, None, None, None, None, 0, 0, 0)
+        _SWEEP_WRAP_CACHE[engine] = jax.jit(jax.vmap(engine, in_axes=in_axes))
+    return _SWEEP_WRAP_CACHE[engine]
+
+
+def _sweep_metrics_fn():
+    """compute_event_metrics vmapped over the config axis: ONE cluster,
+    ONE workload, per-lane telemetry."""
+    global _SWEEP_METRICS_FN
+    if _SWEEP_METRICS_FN is None:
+        from tpusim.sim.metrics import compute_event_metrics
+
+        _SWEEP_METRICS_FN = jax.jit(
+            jax.vmap(
+                compute_event_metrics,
+                in_axes=(None, None, None, None, 0, 0, None),
+            )
+        )
+    return _SWEEP_METRICS_FN
+
+
+def schedule_pods_sweep(
+    sim: "Simulator", pods, weights, seeds=None, bucket: int = 512,
+) -> List[SweepLane]:
+    """Evaluate B what-if configurations of one workload in ONE vmapped
+    replay: `weights` is a [B, num_pol] i32 matrix (one row per config,
+    columns in cfg.policies order), `seeds` an optional length-B list of
+    per-config seeds (default: cfg.seed for every lane; a lane's seed
+    drives its PRNG key AND its tie-break permutation, exactly like a
+    standalone run's cfg.seed). Each lane's placements/counters/metrics
+    are bit-identical to a standalone run with that weight vector in the
+    config — same kernels, same key splits, vmapped — and the whole
+    batch shares one compiled scan and one (weight-independent) table
+    build. Engine selection mirrors schedule_pods_batch: the table
+    engine unless forced sequential or the workload is too small to
+    amortize the table init; pallas has no batched form; extenders /
+    mesh / decision-recording / series configs are rejected."""
+    from tpusim.ops.frag import cluster_frag_amounts, frag_sum_except_q3
+    from tpusim.sim.table_engine import (
+        build_pod_types,
+        num_pod_types,
+        pad_pod_types,
+    )
+    from tpusim.types import PodSpec
+
+    cfg = sim.cfg
+    if cfg.extenders:
+        raise ValueError(
+            "schedule_pods_sweep cannot run extender configs (per-cycle "
+            "HTTP round-trips do not batch)"
+        )
+    if cfg.mesh:
+        raise ValueError(
+            "schedule_pods_sweep cannot run mesh configs (the shard_map "
+            "engine owns the device axis)"
+        )
+    if cfg.record_decisions:
+        raise ValueError(
+            "schedule_pods_sweep cannot record decisions (the vmapped "
+            "replay has no per-config provenance surface)"
+        )
+    if cfg.series_every:
+        raise ValueError(
+            "schedule_pods_sweep cannot emit the in-scan series (the "
+            "vmapped replay has no per-config sampling surface)"
+        )
+    w = np.asarray(weights, np.int32)
+    if w.ndim != 2 or w.shape[1] != len(cfg.policies):
+        raise ValueError(
+            f"weights must be a [B, {len(cfg.policies)}] matrix (one row "
+            f"per config, columns in cfg.policies order); got shape "
+            f"{w.shape}"
+        )
+    b = int(w.shape[0])
+    if b < 1:
+        raise ValueError("weights needs at least one config row")
+    if seeds is None:
+        seeds = [cfg.seed] * b
+    seeds = [int(s) for s in seeds]
+    if len(seeds) != b:
+        raise ValueError(
+            f"seeds has {len(seeds)} entries for {b} weight rows"
+        )
+    if sim.typical is None:
+        sim.set_typical_pods()
+
+    specs = pods_to_specs(pods, sim.node_index, device=False)
+    ev_kind_l, ev_pod_l = build_events(pods, cfg.use_timestamps)
+    validate_events(ev_kind_l, ev_pod_l, int(specs.cpu.shape[0]))
+    p, e = int(specs.cpu.shape[0]), len(ev_kind_l)
+    p2, e2 = _bucket_sizes(p, e, bucket)
+
+    types = build_pod_types(specs)
+    k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+    use_table = (
+        cfg.engine != "sequential"
+        and k > 0
+        and (cfg.engine == "table" or e >= 2 * num_pod_types(specs))
+    )
+
+    specs_h, tid = _pad_specs(
+        specs, p2, types.type_id if use_table else None, xp=np
+    )
+    ev_kind_h, ev_pod_h = _pad_events(
+        np.asarray(ev_kind_l, np.int32), np.asarray(ev_pod_l, np.int32),
+        e2, xp=np,
+    )
+    specs_d = PodSpec(
+        *(jnp.asarray(np.asarray(getattr(specs_h, f)))
+          for f in PodSpec._fields)
+    )
+    ev_kind_d, ev_pod_d = jnp.asarray(ev_kind_h), jnp.asarray(ev_pod_h)
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
+    ranks = jnp.stack(
+        [jnp.asarray(tiebreak_rank(len(sim.nodes), s)) for s in seeds]
+    )
+    weights_d = jnp.asarray(w)
+    state = sim.init_state
+
+    if use_table:
+        types = types._replace(type_id=jnp.asarray(tid))
+        if p2 != p or e2 != e:  # bucketed run: stabilize K too
+            types = pad_pod_types(types)
+        # ONE table build for the whole sweep: the tables hold raw
+        # per-policy scores (weight-independent), so every lane shares
+        # them bit-identically — through the content-keyed disk cache
+        # when configured, else built here once instead of B times
+        # under the vmap
+        key0 = jax.random.PRNGKey(seeds[0])
+        table_fn = sim._table_fn
+        if cfg.heartbeat_every:
+            # the in-scan heartbeat cond doesn't survive vmap (a batched
+            # predicate executes both branches, firing the host tick
+            # callback every event per lane) — the sweep replays on the
+            # heartbeat-free build of the same family instead
+            from tpusim.sim.table_engine import make_table_replay
+
+            sim.log.info(
+                "[Sweep] in-scan heartbeat has no batched form; "
+                "disabled for the sweep replay"
+            )
+            table_fn = make_table_replay(
+                sim._policy_fns, gpu_sel=cfg.gpu_sel_method, report=False,
+                block_size=cfg.block_size,
+            )
+        tables = sim._cached_tables(state, types, key0)
+        if tables is None:
+            with sim.obs.span("init_tables", cache="sweep-shared") as h:
+                tables = table_fn.build_tables(
+                    state, types, sim.typical, key0
+                )
+                h.dispatched()
+        fn = _sweep_engine(table_fn.engine.replay, table=True)
+        sim._last_engine = f"table ({b}-config vmap sweep)"
+        out = sim._dispatch_span(
+            lambda: fn(
+                state, specs_d, types, ev_kind_d, ev_pod_d, sim.typical,
+                keys, weights_d, ranks, tables,
+            ),
+            engine=sim._last_engine, events=e,
+        )
+    else:
+        fn = _sweep_engine(sim.replay_fn.engine, table=False)
+        sim._last_engine = f"sequential ({b}-config vmap sweep)"
+        out = sim._dispatch_span(
+            lambda: fn(
+                state, specs_d, ev_kind_d, ev_pod_d, sim.typical, keys,
+                weights_d, ranks,
+            ),
+            engine=sim._last_engine, events=e,
+        )
+    sim.obs.note_scan(sim._last_engine, counters=None, events=e * b)
+    sim.log.info(
+        f"[Engine] sweep of {b} configs x {e} events ran on: "
+        f"{sim._last_engine}"
+    )
+    if cfg.report_per_event:
+        out = out._replace(
+            metrics=_sweep_metrics_fn()(
+                state, specs_d, ev_kind_d, ev_pod_d,
+                out.event_node, out.event_dev, sim.typical,
+            )
+        )
+    # per-lane frag of the final states in one vmapped call (the same
+    # reduction cluster_analysis reports), before the single fetch
+    amounts = jax.jit(
+        jax.vmap(
+            lambda s, tp: cluster_frag_amounts(s, tp).sum(0),
+            in_axes=(0, None),
+        )
+    )(out.state, sim.typical)
+    with sim.obs.span("fetch", events=e * b):
+        out = device_fetch(out)
+        amounts = np.asarray(amounts)
+
+    lanes: List[SweepLane] = []
+    pad_skips = e2 - e
+    for i in range(b):
+        pn = np.asarray(out.placed_node[i][:p])
+        failed_i = np.asarray(out.ever_failed[i][:p])
+        ctr = None
+        if out.counters is not None:
+            ctr = np.asarray(out.counters[i]).astype(np.int64).copy()
+            ctr[4] = max(int(ctr[4]) - pad_skips, 0)  # bucket-padding skips
+        st = jax.tree.map(lambda a, i=i: np.asarray(a[i]), out.state)
+        slot = (
+            np.arange(st.gpu_left.shape[1])[None, :] < st.gpu_cnt[:, None]
+        )
+        denom = max(int(st.gpu_cnt.sum()) * MILLI, 1)
+        alloc = 100.0 * float(
+            np.where(slot, MILLI - st.gpu_left, 0).sum()
+        ) / denom
+        metrics_i = None
+        if out.metrics is not None:
+            metrics_i = jax.tree.map(
+                lambda a, i=i: np.asarray(a[i][:e]), out.metrics
+            )
+        lanes.append(SweepLane(
+            weights=w[i].copy(),
+            seed=seeds[i],
+            placed_node=pn,
+            dev_mask=np.asarray(out.dev_mask[i][:p]),
+            ever_failed=failed_i,
+            counters=ctr,
+            metrics=metrics_i,
+            state=st,
+            events=e,
+            placed=int((pn >= 0).sum()),
+            failed=int(failed_i.sum()),
+            gpu_alloc_pct=alloc,
+            frag_gpu_milli=float(frag_sum_except_q3(amounts[i])),
+        ))
+    return lanes
+
+
+def format_sweep_table(lanes: Sequence[SweepLane], policies) -> str:
+    """Per-config summary table of a sweep — the `tpusim apply
+    --sweep-weights` output: one row per lane with its weight vector,
+    seed, placed/failed counts, GPU allocation, and frag gpu-milli."""
+    names = [n for n, _ in policies]
+    head = (
+        f"{'cfg':>4} {'weights(' + ','.join(names) + ')':<32} "
+        f"{'seed':>6} {'placed':>7} {'failed':>7} "
+        f"{'gpu_alloc%':>10} {'frag_gpu_milli':>15}"
+    )
+    rows = [head, "-" * len(head)]
+    for i, ln in enumerate(lanes):
+        wstr = ",".join(str(int(x)) for x in ln.weights)
+        rows.append(
+            f"{i:>4} {wstr:<32} {ln.seed:>6} {ln.placed:>7} "
+            f"{ln.failed:>7} {ln.gpu_alloc_pct:>10.2f} "
+            f"{ln.frag_gpu_milli:>15.0f}"
+        )
+    return "\n".join(rows)

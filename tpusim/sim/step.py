@@ -29,6 +29,29 @@ from tpusim.types import NodeState, PodSpec
 
 _INT_MAX = jnp.int32(jnp.iinfo(jnp.int32).max)
 
+
+def resolve_weights(policies, weights=None) -> jnp.ndarray:
+    """The per-policy weight vector as an i32[num_pol] OPERAND (ISSUE 6).
+
+    Weights used to be trace-time Python constants (`jnp.int32(weight)`
+    baked into every engine's jaxpr), so each what-if weight change paid
+    a full recompile. Every engine now multiplies by this traced vector
+    instead; None resolves to the static weights carried in `policies`,
+    which is bit-identical to the former baked form (the same i32
+    multiply on the same values — only the jaxpr's operand/constant
+    split moves). The config-axis sweep vmaps over a [B, num_pol] stack
+    of these."""
+    if weights is None:
+        return jnp.asarray([w for _, w in policies], jnp.int32)
+    w = jnp.asarray(weights, jnp.int32)
+    if w.shape != (len(policies),):
+        raise ValueError(
+            f"weights shape {w.shape} does not match the {len(policies)} "
+            "configured policies"
+        )
+    return w
+
+
 # Score policies whose kernel hands its own Reserve-phase GPU choice to the
 # gpuSelMethod machinery (ref: the allocateGpuIdFunc registry,
 # plugin/open_gpu_share.go:39 + fgd_score.go:36 / pwr_score.go:41 /
@@ -369,6 +392,7 @@ def score_pod_rows(
     policies: Sequence[Tuple[object, int]],
     gpu_sel: str = "best",
     tp=None,
+    weights=None,
 ):
     """score_pod with the per-policy breakdown kept: returns
     (feasible bool[N], total i32[N], policy_share_dev i32[N],
@@ -376,15 +400,20 @@ def score_pod_rows(
     normalized rows the weighted sum consumed (== raws for
     normalize-'none' policies). The decision flight recorder gathers the
     winner's columns out of raws/norms; callers that only need the total
-    (score_pod) let XLA dead-code the stacks."""
+    (score_pod) let XLA dead-code the stacks.
+
+    `weights` is the traced i32[num_pol] weight operand (resolve_weights;
+    None = the static config weights) — engines pass it through so one
+    jaxpr serves every weight vector of a policy family."""
     n = state.num_nodes
     feasible = filter_nodes(state, pod)
     ctx = ScoreContext(tp=tp, feasible=feasible, rng=k_rand)
+    wts = resolve_weights(policies, weights)
 
     total = jnp.zeros(n, jnp.int32)
     policy_share_dev = jnp.full(n, -1, jnp.int32)
     raws, norms = [], []
-    for fn, weight in policies:
+    for i, (fn, _) in enumerate(policies):
         res = fn(state, pod, ctx)
         raw = res.raw_scores
         if fn.normalize == "minmax":
@@ -395,7 +424,7 @@ def score_pod_rows(
             nrm = raw
         raws.append(raw)
         norms.append(nrm)
-        total = total + jnp.int32(weight) * nrm
+        total = total + wts[i] * nrm
         if gpu_sel == fn.policy_name and fn.policy_name in SELF_SELECT_POLICIES:
             policy_share_dev = res.share_dev
     return feasible, total, policy_share_dev, jnp.stack(raws), jnp.stack(norms)
@@ -408,6 +437,7 @@ def score_pod(
     policies: Sequence[Tuple[object, int]],
     gpu_sel: str = "best",
     tp=None,
+    weights=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Filter + Score + Normalize for one pod — the pre-selection half of
     the cycle, shared by schedule_one and the extender host loop (which
@@ -417,7 +447,7 @@ def score_pod(
     (feasible bool[N], total i32[N] weighted scores, policy_share_dev
     i32[N])."""
     feasible, total, policy_share_dev, _, _ = score_pod_rows(
-        state, pod, k_rand, policies, gpu_sel, tp
+        state, pod, k_rand, policies, gpu_sel, tp, weights
     )
     return feasible, total, policy_share_dev
 
@@ -430,6 +460,7 @@ def schedule_one(
     gpu_sel: str = "best",
     tp=None,
     tiebreak_rank=None,
+    weights=None,
 ) -> Tuple[NodeState, Placement]:
     """Run one full scheduling cycle for `pod` and commit the binding.
 
@@ -449,7 +480,7 @@ def schedule_one(
     if tiebreak_rank is None:
         tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
     feasible, total, policy_share_dev = score_pod(
-        state, pod, k_rand, policies, gpu_sel, tp
+        state, pod, k_rand, policies, gpu_sel, tp, weights
     )
     return select_and_bind(
         state, pod, feasible, total, policy_share_dev, gpu_sel, k_sel,
@@ -465,6 +496,7 @@ def schedule_one_recorded(
     gpu_sel: str = "best",
     tp=None,
     tiebreak_rank=None,
+    weights=None,
 ):
     """schedule_one plus its DecisionRecord — identical trajectory (same
     key splits, same score/select/bind kernels in the same order; the
@@ -476,7 +508,7 @@ def schedule_one_recorded(
     if tiebreak_rank is None:
         tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
     feasible, total, policy_share_dev, raws, norms = score_pod_rows(
-        state, pod, k_rand, policies, gpu_sel, tp
+        state, pod, k_rand, policies, gpu_sel, tp, weights
     )
     new_state, placement = select_and_bind(
         state, pod, feasible, total, policy_share_dev, gpu_sel, k_sel,

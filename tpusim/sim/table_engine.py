@@ -267,6 +267,12 @@ class BlockedTableCarry(NamedTuple):
 
 
 _TABLE_REPLAY_CACHE = {}
+# heavy jitted machinery keyed WITHOUT weights (ISSUE 6): the per-policy
+# weight vector is a traced i32[num_pol] operand, so every weight config
+# of a (kernels, gpu_sel, layout, obs-flags) family shares one jaxpr —
+# the marginal what-if weight change is a device call, not a ~5 s
+# recompile, and the config-axis sweep vmaps straight over the operand
+_TABLE_ENGINE_CACHE = {}
 
 
 def reject_randomized(policies, gpu_sel: str):
@@ -446,6 +452,19 @@ def make_table_replay(
     it is bit-identical to the sequential engine's recomputed sample; it
     rides the ys, not the carry, so the checkpoint layout is unchanged.
     ys become (node, dev[, dec][, ser]) in that order.
+
+    Weights as operands (ISSUE 6): replay / init_carry / run_chunk all
+    accept `weights=` — the i32[num_pol] traced weight vector
+    (sim.step.resolve_weights; None = the static config weights, which
+    is bit-identical to the former baked `jnp.int32(weight)` constants).
+    The underlying jitted machinery is cached WITHOUT the weight values
+    (`replay.engine`), so replayers of one policy family share one
+    jaxpr across every weight vector; the tables themselves are
+    weight-independent (raw per-policy scores), and the blocked
+    summaries `bt/br/bn` are built in-scan FROM the weight operand —
+    which is why the whole blocked path works off traced weights with
+    zero layout change. A carry initialized under weight vector W must
+    be resumed with the same W (the driver's run digest covers that).
     """
     if report:
         raise ValueError(
@@ -457,6 +476,78 @@ def make_table_replay(
                  int(series_every))
     if cache_key in _TABLE_REPLAY_CACHE:
         return _TABLE_REPLAY_CACHE[cache_key]
+    engine_key = (tuple(fn for fn, _ in policies), gpu_sel,
+                  int(block_size), int(heartbeat_every), bool(decisions),
+                  int(series_every))
+    eng = _TABLE_ENGINE_CACHE.get(engine_key)
+    if eng is None:
+        eng = _make_table_engine(
+            policies, gpu_sel, block_size, heartbeat_every, decisions,
+            series_every,
+        )
+        _TABLE_ENGINE_CACHE[engine_key] = eng
+
+    from tpusim.sim.step import resolve_weights
+
+    def replay(state, pods, types, ev_kind, ev_pod, tp, key,
+               tiebreak_rank=None, tables=None, weights=None) -> ReplayResult:
+        return eng.replay(
+            state, pods, types, ev_kind, ev_pod, tp, key,
+            resolve_weights(policies, weights), tiebreak_rank, tables,
+        )
+
+    def init_carry(state, pods, types, tp, key, tiebreak_rank=None,
+                   tables=None, weights=None):
+        return eng.init_carry(
+            state, pods, types, tp, key,
+            resolve_weights(policies, weights), tiebreak_rank, tables,
+        )
+
+    def run_chunk(carry, pods, types, ev_kind, ev_pod, tp,
+                  tiebreak_rank=None, weights=None):
+        return eng.run_chunk(
+            carry, pods, types, ev_kind, ev_pod, tp,
+            resolve_weights(policies, weights), tiebreak_rank,
+        )
+
+    # the chunk-resume surface (driver checkpointing, ENGINES.md
+    # "Checkpoint/resume"): replay == finish ∘ run_chunk* ∘ init_carry
+    replay.init_carry = init_carry
+    replay.run_chunk = run_chunk
+    replay.finish = eng.finish
+    # the standalone table builder the driver's content-keyed cache
+    # persists (io.storage.save_tables); feeding its output back through
+    # `tables=` skips the K-node-sweep init bit-identically. The build
+    # never reads weights, so one cached table set serves every weight
+    # vector of the family.
+    replay.build_tables = eng.build_tables
+    # the shared weight-operand machinery (the config-axis sweep vmaps
+    # eng.replay over stacked weights/keys/ranks)
+    replay.engine = eng
+    _TABLE_REPLAY_CACHE[cache_key] = replay
+    return replay
+
+
+class _TableEngine(NamedTuple):
+    """The weight-operand jitted surface one policy family shares:
+    every callable takes the i32[num_pol] weight vector as a traced
+    argument (never baked), so the family compiles once."""
+
+    replay: object  # (state, pods, types, evk, evp, tp, key, wts, rank, tables)
+    init_carry: object  # (state, pods, types, tp, key, wts, rank, tables)
+    run_chunk: object  # (carry, pods, types, evk, evp, tp, wts, rank)
+    finish: object  # (carry)
+    build_tables: object  # (state, types, tp, key) — weight-independent
+
+
+def _make_table_engine(
+    policies, gpu_sel: str, block_size: int, heartbeat_every: int,
+    decisions: bool, series_every: int,
+) -> _TableEngine:
+    """Build the jitted weight-operand machinery make_table_replay wraps.
+    The closed-over `policies` weights are deliberately never read — only
+    the kernel objects and their normalize/name metadata are static; the
+    numeric weights always arrive as the `wts` operand."""
     num_pol = len(policies)
     sel_idx = selector_index(policies, gpu_sel)
     _columns, _init_tables = make_table_builders(policies, sel_idx)
@@ -493,15 +584,16 @@ def make_table_replay(
             series_every, processed, build, num_pol
         )
 
-    def _totals(raws, feas, slo, shi):
+    def _totals(raws, feas, slo, shi, wts):
         """Weighted normalized totals with a -INT_MAX sentinel at
         infeasible entries. raws: i32[num_pol, ..., X]; feas: bool[..., X];
         slo/shi: i32[len(norm_idx), ...] stored extrema per normalized
-        policy. The apply half is the shared minmax_scale_i32, so feasible
-        entries match the oracle's minmax/pwr_normalize_i32 bit-for-bit
-        whenever slo/shi equal the current feasible extrema."""
+        policy; wts: the i32[num_pol] weight operand. The apply half is
+        the shared minmax_scale_i32, so feasible entries match the
+        oracle's minmax/pwr_normalize_i32 bit-for-bit whenever slo/shi
+        equal the current feasible extrema."""
         tot = jnp.zeros(feas.shape, jnp.int32)
-        for i, (fn, weight) in enumerate(policies):
+        for i, (fn, _) in enumerate(policies):
             raw = raws[i]
             if fn.normalize in ("minmax", "pwr"):
                 j = norm_idx.index(i)
@@ -509,12 +601,12 @@ def make_table_replay(
                     raw, feas, slo[j][..., None], shi[j][..., None],
                     norm_deg[j],
                 )
-            tot = tot + jnp.int32(weight) * raw
+            tot = tot + wts[i] * raw
         return jnp.where(feas, tot, -_INT_MAX)
 
     def make_blocked_body(
         pods, type_id, types, tp, rank_p, n, num_pods, bsz, k_types, nblk,
-        offs,
+        offs, wts,
     ):
         """Scan body of the blocked O(B + N/B) select path: tables padded
         to a whole number of B-node blocks (sentinel columns: infeasible,
@@ -601,7 +693,7 @@ def make_table_replay(
                 )
             # block totals use the STORED extrema — consistent with every
             # other block of each type's summary row by construction
-            tot_blk = _totals(raw_blk, feas_blk, slo, shi)
+            tot_blk = _totals(raw_blk, feas_blk, slo, shi, wts)
             bm, brk, bar = block_reduce(tot_blk, rank_blk)
             bt = jax.lax.dynamic_update_slice(bt, bm[:, None], (0, blk))
             br = jax.lax.dynamic_update_slice(br, brk[:, None], (0, blk))
@@ -638,7 +730,7 @@ def make_table_replay(
                     )
                     tot = _totals(
                         raws[:, None, :], fr[None, :],
-                        lo_cur[:, None], hi_cur[:, None],
+                        lo_cur[:, None], hi_cur[:, None], wts,
                     )[0]
                     m2, r2, a2 = block_reduce(
                         tot.reshape(nblk, bsz), rank_p.reshape(nblk, bsz)
@@ -730,7 +822,7 @@ def make_table_replay(
                 feas_d = feas_row & pin_m
                 norm_rows = []
                 tot_d = jnp.zeros(n_pad_l, jnp.int32)
-                for i, (fn, weight) in enumerate(policies):
+                for i, (fn, _) in enumerate(policies):
                     raw = raws_row[i]
                     if fn.normalize == "minmax":
                         nrm = minmax_normalize_i32(raw, feas_d)
@@ -739,7 +831,7 @@ def make_table_replay(
                     else:
                         nrm = raw
                     norm_rows.append(nrm)
-                    tot_d = tot_d + jnp.int32(weight) * nrm
+                    tot_d = tot_d + wts[i] * nrm
                 dec = build_decision(
                     node_f, raws_row, jnp.stack(norm_rows), tot_d, feas_d,
                     rank_p,
@@ -791,7 +883,8 @@ def make_table_replay(
 
         return body
 
-    def make_flat_body(pods, type_id, types, tp, tiebreak_rank, n, num_pods):
+    def make_flat_body(pods, type_id, types, tp, tiebreak_rank, n, num_pods,
+                       wts):
         """Scan body of the flat O(N) select path."""
 
         def body(carry, ev):
@@ -843,7 +936,7 @@ def make_table_replay(
                 )
                 total = jnp.zeros(n, jnp.int32)
                 raw_rows, norm_rows = [], []
-                for i, (fn, weight) in enumerate(policies):
+                for i, (fn, _) in enumerate(policies):
                     if fn.policy_name == "RandomScore":
                         # per-event draw, recomputed instead of table-read —
                         # through the ONE canonical kernel (the oracle's
@@ -862,7 +955,7 @@ def make_table_replay(
                     if decisions:
                         raw_rows.append(raw)
                         norm_rows.append(nrm)
-                    total = total + jnp.int32(weight) * nrm
+                    total = total + wts[i] * nrm
                 # the oracle's selectHost + Reserve halves; the Bind
                 # scatter is deferred via PendingCommit, outside the switch
                 sel, _, ok = packed_argmax(total, feasible, tiebreak_rank)
@@ -920,11 +1013,12 @@ def make_table_replay(
         return body
 
     @jax.jit
-    def init_carry(state, pods, types, tp, key, tiebreak_rank=None,
+    def init_carry(state, pods, types, tp, key, wts, tiebreak_rank=None,
                    tables=None):
         """Engine state at event 0: score/sdev/feas tables from the
         committed state + an inert pipeline register (and, on the blocked
-        path, the per-(policy, type, block) aggregates).
+        path, the per-(policy, type, block) aggregates built from the
+        `wts` weight operand).
 
         `tables` short-circuits the K-node-sweep build with precomputed
         (score_tbl, sdev_tbl, feas_tbl) — the driver's content-keyed
@@ -988,7 +1082,7 @@ def make_table_replay(
             slo = jnp.zeros((0, k_types), jnp.int32)
             shi = jnp.zeros((0, k_types), jnp.int32)
 
-        tot0 = _totals(score_tbl, feas_tbl, slo, shi)  # [K, n_pad]
+        tot0 = _totals(score_tbl, feas_tbl, slo, shi, wts)  # [K, n_pad]
         bt, br, ba = block_reduce(
             tot0.reshape(k_types, nblk, bsz), rank_p.reshape(nblk, bsz)
         )
@@ -1000,7 +1094,7 @@ def make_table_replay(
         )
 
     @jax.jit
-    def run_chunk(carry, pods, types, ev_kind, ev_pod, tp,
+    def run_chunk(carry, pods, types, ev_kind, ev_pod, tp, wts,
                   tiebreak_rank=None):
         """Advance `carry` over a segment of the event stream; returns
         (carry', (event_node, event_dev)) for the segment — extended with
@@ -1011,7 +1105,8 @@ def make_table_replay(
         to one replay() over the whole stream — the scan body is a pure
         function of (carry, event), and every carry leaf is an exact dtype
         (i32/bool/u32), so even a host/disk round-trip between chunks
-        cannot perturb the trajectory."""
+        cannot perturb the trajectory. `wts` must be the weight vector
+        the carry was initialized under (the blocked summaries embed it)."""
         n = carry.state.num_nodes
         num_pods = pods.cpu.shape[0]
         if tiebreak_rank is None:
@@ -1024,11 +1119,11 @@ def make_table_replay(
             offs = jnp.arange(nblk, dtype=jnp.int32) * bsz
             body = make_blocked_body(
                 pods, type_id, types, tp, rank_p, n, num_pods, bsz,
-                k_types, nblk, offs,
+                k_types, nblk, offs, wts,
             )
         else:
             body = make_flat_body(
-                pods, type_id, types, tp, tiebreak_rank, n, num_pods
+                pods, type_id, types, tp, tiebreak_rank, n, num_pods, wts
             )
         # unroll amortizes per-iteration fixed costs (~20% wall on the openb
         # replay); higher factors showed no further gain
@@ -1054,12 +1149,15 @@ def make_table_replay(
         ev_pod: jnp.ndarray,  # i32[E]
         tp,
         key,
+        wts,  # i32[num_pol] traced weight operand
         tiebreak_rank=None,
         tables=None,
     ) -> ReplayResult:
-        carry = init_carry(state, pods, types, tp, key, tiebreak_rank, tables)
+        carry = init_carry(
+            state, pods, types, tp, key, wts, tiebreak_rank, tables
+        )
         carry, ys = run_chunk(
-            carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank
+            carry, pods, types, ev_kind, ev_pod, tp, wts, tiebreak_rank
         )
         state, placed, masks, failed = finish(carry)
         nodes, devs = ys[0], ys[1]
@@ -1071,23 +1169,12 @@ def make_table_replay(
             decs, sers,
         )
 
-    def replay(state, pods, types, ev_kind, ev_pod, tp, key,
-               tiebreak_rank=None, tables=None) -> ReplayResult:
-        return _replay_impl(
-            state, pods, types, ev_kind, ev_pod, tp, key, tiebreak_rank,
-            tables,
-        )
-
-    # the chunk-resume surface (driver checkpointing, ENGINES.md
-    # "Checkpoint/resume"): replay == finish ∘ run_chunk* ∘ init_carry
-    replay.init_carry = init_carry
-    replay.run_chunk = run_chunk
-    replay.finish = finish
-    # the standalone table builder the driver's content-keyed cache
-    # persists (io.storage.save_tables); feeding its output back through
-    # `tables=` skips the K-node-sweep init bit-identically
-    replay.build_tables = jax.jit(
-        lambda state, types, tp, key: _init_tables(state, types, tp, key)
+    return _TableEngine(
+        replay=_replay_impl,
+        init_carry=init_carry,
+        run_chunk=run_chunk,
+        finish=finish,
+        build_tables=jax.jit(
+            lambda state, types, tp, key: _init_tables(state, types, tp, key)
+        ),
     )
-    _TABLE_REPLAY_CACHE[cache_key] = replay
-    return replay
