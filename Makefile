@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -76,14 +76,25 @@ profile-smoke:
 serve-smoke: profile-smoke
 	JAX_PLATFORMS=cpu python -m tpusim serve .tpusim_obs --once --listen :0
 
+# replay-service smoke (ENGINES.md "Round 12"): boot `serve --jobs` on
+# an ephemeral port, POST a 4-job grid (weights + tune-factor variants
+# plus an exact duplicate) over real HTTP, poll to done, and assert the
+# service contracts — the duplicate answered from the digest cache, the
+# fresh jobs batched onto ONE compiled sweep, and a second weights+tune
+# wave adding ZERO executables (jit._cache_size() stable).
+svc-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --svc-only
+
 # bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
 # measurement under profiling and diff it against the newest committed
 # BENCH_r*.json baseline — exact on events/placements/gpu_alloc
 # (machine-independent), tolerance-gated on same-backend throughput,
 # advisory on cross-backend throughput. Also smoke-checks the decision
-# JSONL round-trip (ISSUE 4) and that a live /metrics scrape of the
-# smoke record parses and is byte-equal to the emitted textfile
-# (ISSUE 5). Exit 1 on regression; artifacts land in .tpusim_obs/.
+# JSONL round-trip (ISSUE 4), that a live /metrics scrape of the smoke
+# record parses and is byte-equal to the emitted textfile (ISSUE 5),
+# the one-compile sweep contract (ISSUE 6), and the replay-service POST
+# path — dedup + zero recompiles (ISSUE 7, the svc-smoke check). Exit 1
+# on regression; artifacts land in .tpusim_obs/.
 bench-gate:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate
 

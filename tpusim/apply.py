@@ -340,26 +340,16 @@ class Applier:
 
     def _run_sweep(self, sim: Simulator, out):
         """`apply --sweep-weights`: load the weight grid, run the
-        config-axis sweep (one compiled scan for all B configs), print
-        the per-config summary table (README "Sweep many configs in one
+        config-axis sweep (one compiled scan for all B configs; per-lane
+        `tunes` ride the multi-trace sweep, ISSUE 7), print the
+        per-config summary table (README "Sweep many configs in one
         compile")."""
-        import json
-
         from tpusim.sim.driver import format_sweep_table
 
-        with open(self.options.sweep_weights) as f:
-            payload = json.load(f)
-        if isinstance(payload, dict):
-            weights = payload.get("weights")
-            seeds = payload.get("seeds")
-        else:
-            weights, seeds = payload, None
-        if not weights:
-            raise ValueError(
-                f"{self.options.sweep_weights}: no weight rows (want "
-                '[[w, ...], ...] or {"weights": [[...]], "seeds": [...]})'
-            )
-        lanes = sim.run_sweep(weights, seeds=seeds)
+        weights, seeds, tunes = load_weights_payload(
+            self.options.sweep_weights
+        )
+        lanes = sim.run_sweep(weights, seeds=seeds, tunes=tunes)
         print(
             f"[Sweep] {len(lanes)} configs x {lanes[0].events} events "
             f"in one compiled scan ({sim._last_engine})",
@@ -529,6 +519,31 @@ class Applier:
                     f"beyond the env setting({max_vg}%)\n"
                 )
         return True, ""
+
+
+def load_weights_payload(path: str):
+    """Weights-grid JSON -> (weights, seeds, tunes): a bare
+    [[w, ...], ...] list of rows, or {"weights": [[...]], "seeds":
+    [...], "tunes": [...]} with the optional per-row seed/tune vectors.
+    Shared vocabulary of `apply --sweep-weights` and the `tpusim submit`
+    grid form (tpusim.svc.jobs.jobs_from_grid expands the same shape
+    into job documents)."""
+    import json
+
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, dict):
+        weights = payload.get("weights")
+        seeds = payload.get("seeds")
+        tunes = payload.get("tunes")
+    else:
+        weights, seeds, tunes = payload, None, None
+    if not weights:
+        raise ValueError(
+            f"{path}: no weight rows (want [[w, ...], ...] or "
+            '{"weights": [[...]], "seeds": [...], "tunes": [...]})'
+        )
+    return weights, seeds, tunes
 
 
 def _interactive_select(apps):

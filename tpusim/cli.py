@@ -12,7 +12,11 @@ verbs (ISSUE 4) and the live-telemetry verbs (ISSUE 5):
            median / max + sparkline per series), from a `--profile`
            JSONL of a `--series-every` run
   serve    watch a directory of run records / checkpoints and expose
-           /metrics, /healthz, /progress over HTTP
+           /metrics, /healthz, /progress over HTTP; --jobs additionally
+           grows the POST side — a queueing what-if replay service
+           (ISSUE 7: POST /jobs, GET /jobs/<id>[/result], GET /queue)
+  submit   POST what-if jobs to a `serve --jobs` service, wait, and
+           print the per-job results
   version  print version/commit (ref: cmd/version/version.go)
   gen-doc  emit markdown docs for the CLI tree (ref: cmd/doc/)
   debug    scaffold, intentionally empty (ref: cmd/debug/debug.go)
@@ -220,7 +224,63 @@ def _build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument(
         "--once", action="store_true",
         help="publish a single poll, self-scrape /metrics and /healthz, "
-        "print the verdict, and exit (the `make serve-smoke` mode)",
+        "print the verdict, and exit (the `make serve-smoke` mode; with "
+        "--jobs it additionally self-checks /queue)",
+    )
+    # the queueing what-if replay service (ISSUE 7; README "Simulation
+    # as a service"): POST /jobs onto the one-compile sweep axis
+    p_serve.add_argument(
+        "--jobs", action="store_true",
+        help="grow the POST side: accept what-if replay jobs (policy "
+        "weights x seed x tune factor over the hosted trace), batch "
+        "compatible jobs onto ONE vmapped compiled scan, dedup "
+        "identical jobs by content digest, and persist signed results "
+        "into DIR; needs --nodes/--pods",
+    )
+    p_serve.add_argument(
+        "--nodes", default="", metavar="CSV",
+        help="node CSV of the hosted trace (--jobs mode)",
+    )
+    p_serve.add_argument(
+        "--pods", default="", metavar="CSV",
+        help="pod CSV of the hosted trace (--jobs mode)",
+    )
+    p_serve.add_argument(
+        "--max-pods", type=int, default=0, metavar="N",
+        help="truncate the hosted workload to its first N pods (0 = all)",
+    )
+    p_serve.add_argument(
+        "--lane-width", type=int, default=8, metavar="B",
+        help="sweep lanes per batch: up to B compatible jobs share one "
+        "compiled scan (short batches pad to B so the executable count "
+        "stays at one per job family)",
+    )
+    p_serve.add_argument(
+        "--queue-size", type=int, default=64, metavar="N",
+        help="bounded job queue depth; a full queue answers POST /jobs "
+        "with 429 + Retry-After",
+    )
+
+    p_submit = sub.add_parser(
+        "submit",
+        help="POST what-if jobs to a `tpusim serve --jobs` replay "
+        "service, wait for completion, and print the per-job results",
+    )
+    p_submit.add_argument(
+        "jobs",
+        help="job JSON: one job object, {\"jobs\": [...]}, or an "
+        "apply-style weights grid ([[w, ...], ...] or {\"weights\": "
+        "[[...]], \"seeds\": [...], \"tunes\": [...], \"policies\": "
+        "[[name, w], ...]})",
+    )
+    p_submit.add_argument(
+        "--url", required=True, metavar="URL",
+        help="service base URL (the address `serve --jobs` printed, "
+        "e.g. http://127.0.0.1:8642)",
+    )
+    p_submit.add_argument(
+        "--timeout", type=float, default=300.0, metavar="SECONDS",
+        help="overall wait budget for results",
     )
 
     sub.add_parser("version", help="print version")
@@ -328,6 +388,8 @@ def cmd_serve(args) -> int:
     from tpusim.obs.server import serve_dir
 
     try:
+        if args.jobs:
+            return _serve_jobs(args)
         if args.once:
             # smoke mode: one poll, a real self-scrape over HTTP, exit.
             # Exit 2 when the scrape fails or the /metrics text does not
@@ -367,6 +429,94 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _serve_jobs(args) -> int:
+    """`tpusim serve DIR --jobs`: the queueing what-if replay service
+    (ISSUE 7) — the monitor plane plus POST /jobs over a hosted trace;
+    signed results land in DIR, which is also watched/republished like
+    plain serve."""
+    import time
+    import urllib.request
+
+    from tpusim.obs.server import watch_dir
+    from tpusim.svc import load_trace, start_job_server
+
+    if not (args.nodes and args.pods):
+        raise ValueError(
+            "serve --jobs hosts a trace: pass --nodes NODES.csv and "
+            "--pods PODS.csv"
+        )
+    trace = load_trace(
+        "default", args.nodes, args.pods, max_pods=args.max_pods
+    )
+    srv, service, worker = start_job_server(
+        args.dir, {"default": trace}, listen=args.listen,
+        lane_width=args.lane_width, queue_size=args.queue_size,
+    )
+    print(
+        f"[serve] job plane at {srv.url} (POST /jobs, GET "
+        f"/jobs/<id>[/result], /queue, /metrics, /healthz, /progress); "
+        f"trace 'default' = {len(trace.nodes)} nodes x "
+        f"{len(trace.pods)} pods; results -> "
+        f"{os.path.abspath(args.dir)}", file=sys.stderr,
+    )
+    try:
+        if args.once:
+            # smoke mode: a real self-check of both planes over HTTP
+            with urllib.request.urlopen(srv.url + "/healthz",
+                                        timeout=10) as r:
+                health = json.loads(r.read().decode())
+            with urllib.request.urlopen(srv.url + "/queue",
+                                        timeout=10) as r:
+                queue = json.loads(r.read().decode())
+            print(
+                f"[serve] once: healthz ok={health.get('ok')}, /queue "
+                f"depth={queue.get('depth')} capacity="
+                f"{queue.get('capacity')} lanes={queue.get('lane_width')}",
+                file=sys.stderr,
+            )
+            return 0
+        while True:
+            record, progress = watch_dir(args.dir)
+            if record is not None:
+                srv.publish_record(record)
+            time.sleep(max(args.poll, 0.2))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        worker.stop()
+        srv.stop()
+    return 0
+
+
+def cmd_submit(args) -> int:
+    from tpusim.svc.client import (
+        ServiceError,
+        format_results_table,
+        submit_and_wait,
+    )
+    from tpusim.svc.jobs import docs_from_payload
+
+    # same exit discipline as explain/diff/report: 2 on unusable input
+    # or a failed service round-trip, with a one-line error
+    try:
+        with open(args.jobs) as f:
+            payload = json.load(f)
+        # shape-routed: grid files expand per row, single job documents
+        # (incl. ones carrying a flat `weights` vector) pass through
+        docs = docs_from_payload(payload)
+        results = submit_and_wait(
+            args.url, docs, timeout=args.timeout, out=sys.stderr
+        )
+    except (OSError, ValueError, json.JSONDecodeError,
+            ServiceError) as err:
+        print(f"tpusim submit: {err}", file=sys.stderr)
+        return 2
+    print(f"[submit] {len(results)} job(s) done via {args.url}",
+          file=sys.stderr)
+    print(format_results_table(results))
+    return 0
+
+
 def cmd_gen_doc(parser: argparse.ArgumentParser, args) -> int:
     os.makedirs(args.dir, exist_ok=True)
     path = os.path.join(args.dir, "tpusim.md")
@@ -389,6 +539,8 @@ def main(argv=None) -> int:
         return cmd_report(args)
     if args.command == "serve":
         return cmd_serve(args)
+    if args.command == "submit":
+        return cmd_submit(args)
     if args.command == "version":
         print(f"tpusim version {VERSION} (commit {COMMIT})")
         return 0

@@ -326,6 +326,72 @@ def load_tables(path: str) -> Dict[str, "object"]:
         return {k: z[k] for k in z.files}
 
 
+# ---------------------------------------------------------------------------
+# Digest-signed JSONL — the shared persistence primitive of decision files
+# (obs.decisions, ISSUE 4) and service results (tpusim.svc, ISSUE 7)
+# ---------------------------------------------------------------------------
+#
+# Format: one header line (a JSON object carrying at least `schema` and
+# `digest` = sha256 over the payload lines) followed by the payload, one
+# JSON document per line. The digest makes torn/truncated/hand-edited
+# files fail loudly on read instead of producing silently wrong answers;
+# writes are atomic (tmp + os.replace — the checkpoint discipline), so a
+# killed writer leaves no half-file behind.
+
+
+def payload_digest(lines) -> str:
+    """sha256 hex over payload lines, newline-terminated each — the
+    torn-file detector of the signed-JSONL format."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for line in lines:
+        h.update(line.encode())
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+def write_signed_jsonl(path: str, header: dict, lines) -> str:
+    """Write header + payload lines atomically; the header gains a
+    `digest` key over the payload. Returns the file path."""
+    lines = list(lines)
+    header = dict(header)
+    header["digest"] = payload_digest(lines)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(header, sort_keys=True, separators=(",", ":")))
+        f.write("\n")
+        for line in lines:
+            f.write(line + "\n")
+    os.replace(tmp, path)
+    return path
+
+
+def read_signed_jsonl(path: str, schema: str = ""):
+    """(header, payload lines) from a signed-JSONL file; verifies the
+    schema (when given) and the payload digest so a torn/edited file
+    raises ValueError instead of reading back wrong."""
+    with open(path) as f:
+        raw = [l.rstrip("\n") for l in f if l.strip()]
+    if not raw:
+        raise ValueError(f"{path}: empty signed-JSONL file")
+    header = json.loads(raw[0])
+    if schema and header.get("schema") != schema:
+        raise ValueError(
+            f"{path}: not a {schema} file (schema={header.get('schema')!r})"
+        )
+    payload = raw[1:]
+    digest = payload_digest(payload)
+    if digest != header.get("digest"):
+        raise ValueError(
+            f"{path}: payload digest mismatch (torn or edited file): "
+            f"header {header.get('digest')} != computed {digest}"
+        )
+    return header, payload
+
+
 def prune_checkpoints(cache_dir: str, digest: str, keep_cursor: int) -> None:
     """Drop a run's checkpoints below `keep_cursor` (each save supersedes
     its predecessors; only the newest is ever resumed from). Missing files

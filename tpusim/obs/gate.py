@@ -40,6 +40,14 @@ sweep must run, reuse ONE compiled executable across weight grids (the
 weights-are-operands contract), and its marginal per-config cost is
 printed next to the newest committed `bench_scale.py --sweep` capture's
 numbers — advisory only, since sweep walls are machine-shaped.
+
+And the replay-service surface (ISSUE 7): a 4-job grid POSTed to an
+ephemeral `serve --jobs` instance must come back dedup'd (the duplicate
+answered from the digest cache) and batched onto ONE compiled sweep,
+with a second weights+tune wave adding zero executables
+(jit._cache_size() stable — the zero-recompile contract end-to-end
+through the POST path). `--svc-only` runs just this check (the `make
+svc-smoke` mode).
 """
 
 from __future__ import annotations
@@ -311,6 +319,94 @@ def decisions_roundtrip(nodes, pods, out_dir: str) -> Tuple[bool, str]:
     )
 
 
+def svc_smoke(nodes, pods, out_dir: str, b: int = 4) -> Tuple[bool, List[str]]:
+    """ISSUE 7 satellite: boot the queueing replay service (the `serve
+    --jobs` machinery) on an ephemeral port, POST a b-job grid over real
+    HTTP (weights + tune-factor variants plus one exact duplicate), poll
+    to done, and hard-check the service contracts: the duplicate is
+    answered from the digest cache (dedup_hits, bit-identical result),
+    the fresh jobs ride ONE batch, and a second wave differing only in
+    weights+tune adds NO compiled sweep executable — the PR 6
+    jit._cache_size() zero-recompile check, now end-to-end through the
+    POST path. Any exception on the serve/submit path is a FAIL verdict,
+    not a traceback."""
+    msgs: List[str] = []
+    try:
+        import shutil
+
+        from tpusim.svc import TraceRef, start_job_server
+        from tpusim.svc.client import _request, submit_and_wait
+        from tpusim.svc.jobs import trace_digest
+
+        # a fresh artifact dir per run: stale signed results would turn
+        # the batching/dedup checks into no-ops (every job a disk hit)
+        art = os.path.join(out_dir, "svc_smoke")
+        if os.path.isdir(art):
+            shutil.rmtree(art)
+        os.makedirs(art)
+        sub_nodes, sub_pods = nodes[:200], pods[:120]
+        trace = TraceRef(
+            "default", sub_nodes, sub_pods,
+            trace_digest(sub_nodes, sub_pods),
+        )
+        srv, service, worker = start_job_server(
+            art, {"default": trace}, listen=":0", lane_width=b,
+            queue_size=4 * b,
+        )
+        try:
+            fam = [["FGDScore", 1000]]
+            docs = [
+                {"policies": fam, "weights": [1000], "seed": 42},
+                {"policies": fam, "weights": [500], "seed": 43,
+                 "tune": 0.5},
+                {"policies": fam, "weights": [250], "seed": 42},
+                {"policies": fam, "weights": [1000], "seed": 42},  # dup
+            ]
+            results = submit_and_wait(srv.url, docs, timeout=600)
+            _, _, q = _request(srv.url + "/queue")
+            if (results[0]["placements_sha256"]
+                    != results[3]["placements_sha256"]):
+                return False, [
+                    "[gate] svc: duplicate job's result diverged (FAIL)"
+                ]
+            if q.get("dedup_hits", 0) < 1:
+                return False, [
+                    f"[gate] svc: duplicate submission not dedup'd "
+                    f"({q}) (FAIL)"
+                ]
+            execs = q.get("sweep_executables", -1)
+            if execs != 1:
+                return False, [
+                    f"[gate] svc: expected ONE compiled sweep executable "
+                    f"after the first wave, found {execs} (FAIL)"
+                ]
+            submit_and_wait(
+                srv.url,
+                [{"policies": fam, "weights": [123], "tune": 0.3,
+                  "seed": 5}],
+                timeout=600,
+            )
+            _, _, q2 = _request(srv.url + "/queue")
+            if q2.get("sweep_executables") != execs:
+                return False, [
+                    f"[gate] svc: a weights+tune wave RECOMPILED "
+                    f"({execs} -> {q2.get('sweep_executables')} "
+                    f"executables) (FAIL)"
+                ]
+            msgs.append(
+                f"[gate] svc: {len(results)} jobs + a weights+tune wave "
+                f"via {q2['batches_run']} batches, dedup_hits="
+                f"{q2['dedup_hits']}, sweep executables stable at "
+                f"{execs} (zero recompiles)"
+            )
+        finally:
+            worker.stop()
+            srv.stop()
+    except Exception as err:
+        return False, [f"[gate] svc: FAIL ({type(err).__name__}: {err})"]
+    return True, msgs
+
+
 def metrics_scrape_check(record: dict, prom_path: str) -> Tuple[bool, str]:
     """ISSUE 5 satellite: publish the smoke record to an ephemeral
     MonitorServer, scrape /metrics over real HTTP, and require (a) the
@@ -369,6 +465,11 @@ def main(argv=None) -> int:
         "--out", default=os.path.join(REPO, ".tpusim_obs"),
         help="smoke-profile output dir (JSONL + Prometheus textfile)",
     )
+    ap.add_argument(
+        "--svc-only", action="store_true",
+        help="run only the replay-service smoke (ISSUE 7) — the "
+        "`make svc-smoke` mode",
+    )
     args = ap.parse_args(argv)
 
     base = latest_baseline()
@@ -378,6 +479,12 @@ def main(argv=None) -> int:
     import jax
 
     nodes, pods = bench.load_trace()
+
+    if args.svc_only:
+        ok, msgs = svc_smoke(nodes, pods, args.out)
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
     row = bench.measure_policy(
         nodes, pods,
         *next(r for r in bench.POLICY_ROWS if r[0] == "FGD"),
@@ -420,7 +527,12 @@ def main(argv=None) -> int:
     # satellite): the one-compile contract gates, the walls never do
     swp_ok, swp_msgs = sweep_advisory(nodes, pods, latest_sweep())
     print("\n".join(swp_msgs))
-    smoke_ok = dec_ok and scrape_ok and swp_ok
+    # replay-service smoke (ISSUE 7 satellite): POST path end-to-end —
+    # dedup via the digest cache, one batch per wave, zero recompiles
+    # across a weights+tune wave
+    svc_ok, svc_msgs = svc_smoke(nodes, pods, args.out)
+    print("\n".join(svc_msgs))
+    smoke_ok = dec_ok and scrape_ok and swp_ok and svc_ok
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

@@ -39,6 +39,11 @@ _STATE = {
     # run's ev/s and ETA describe real progress, not cursor/dt
     "base": 0,
     "resumed": 0,
+    # run/job id the armed scan's ticks carry (ISSUE 7): with queued
+    # what-if jobs sharing one process, the global listener would
+    # otherwise interleave consecutive scans' ticks into one anonymous
+    # stream — listeners key per-job progress off this tag instead
+    "job": "",
 }
 
 MIN_INTERVAL_S = 1.0
@@ -64,6 +69,7 @@ def _notify(done: int, total: int, rate: float, eta: float,
     info = {
         "done": int(done), "total": int(total), "rate": float(rate),
         "eta": float(eta), "label": _STATE["label"], "final": bool(final),
+        "job": _STATE["job"],
     }
     for fn in list(_LISTENERS):
         try:
@@ -73,15 +79,19 @@ def _notify(done: int, total: int, rate: float, eta: float,
 
 
 def configure(total_events: int, label: str = "scan", sink=None,
-              base: int = 0):
+              base: int = 0, job: str = ""):
     """Arm the heartbeat for the next scan: total event count for the ETA
     and a label for the line. Called by the driver right before each
     dispatch whose engine was built with a heartbeat. `base` = events of
     the RUN already replayed by earlier scans (the fault path's segment
-    offset), so chunk/segment ticks report run-level progress."""
+    offset), so chunk/segment ticks report run-level progress. `job` tags
+    every tick of this scan with a run/job id (ISSUE 7) so listeners
+    serving several queued jobs from one process can keep their progress
+    streams apart; empty keeps the anonymous single-run behavior."""
     _STATE.update(
         total=int(total_events), label=label, t0=time.perf_counter(),
         last_emit=0.0, ticks=0, sink=sink, base=int(base), resumed=0,
+        job=str(job or ""),
     )
 
 

@@ -62,6 +62,10 @@ class MonitorServer:
     time so the scrape path is a buffer copy); start()/stop() own the
     server thread."""
 
+    # bound on the per-job /progress map: a long-lived service must not
+    # grow state per job forever — the oldest entries age out FIFO
+    MAX_JOB_PROGRESS = 64
+
     def __init__(self, listen: str = "", prefix: str = "tpusim"):
         self.host, self.port = parse_listen(listen)
         self.prefix = prefix
@@ -72,6 +76,12 @@ class MonitorServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._hb_listener = None
+        # extension request handlers (tpusim.svc.api grows the POST side
+        # here, ISSUE 7): each app's handle(method, path, body) returns
+        # (code, content_type, body_bytes[, extra_headers]) or None to
+        # fall through; first non-None answer wins, built-ins serve as
+        # the GET fallback
+        self._apps: list = []
 
     # ---- write surface ----
 
@@ -88,21 +98,56 @@ class MonitorServer:
             self._progress.update(fields)
             self._progress["updated_unix"] = time.time()
 
+    def publish_job_progress(self, job: str, fields: dict):
+        """Per-run/job progress (ISSUE 7): keyed under /progress's
+        `jobs` map instead of flat-merged, so several queued jobs served
+        by one process never interleave into one anonymous stream.
+        `job` also lands top-level as the most-recently-active id."""
+        job = str(job)
+        with self._lock:
+            jobs = self._progress.setdefault("jobs", {})
+            entry = jobs.setdefault(job, {})
+            entry.update(fields)
+            entry["updated_unix"] = time.time()
+            while len(jobs) > self.MAX_JOB_PROGRESS:
+                jobs.pop(next(iter(jobs)))
+            self._progress["job"] = job
+            self._progress["updated_unix"] = time.time()
+
+    def add_app(self, app) -> "MonitorServer":
+        """Register an extension request handler (see __init__)."""
+        self._apps.append(app)
+        return self
+
+    def _dispatch_app(self, method: str, path: str, body: bytes):
+        for app in self._apps:
+            resp = app.handle(method, path, body)
+            if resp is not None:
+                return resp
+        return None
+
     def attach_heartbeat(self):
         """Feed /progress from the in-scan heartbeat ticks
-        (obs.heartbeat listener hook)."""
+        (obs.heartbeat listener hook). Ticks tagged with a job id
+        (heartbeat.configure(job=...), ISSUE 7) land in the per-job
+        `jobs` map; untagged ticks keep the flat single-run fields."""
         from tpusim.obs import heartbeat
 
         def on_tick(info):
             # final means THIS SCAN finished — a fault segment or chunk,
             # not necessarily the run; the driver/CLI publishes
             # phase="done" itself when the whole run's result lands
-            self.publish_progress(
+            fields = dict(
                 phase="scan" if not info["final"] else "scan-done",
                 events_done=info["done"], events_total=info["total"],
                 ev_per_s=round(info["rate"], 1),
                 eta_s=round(info["eta"], 1),
             )
+            job = info.get("job") or ""
+            if job:
+                self.publish_job_progress(job, fields)
+            else:
+                self.publish_progress(**fields)
 
         self._hb_listener = on_tick
         heartbeat.add_listener(on_tick)
@@ -116,14 +161,46 @@ class MonitorServer:
             def log_message(self, *args):  # quiet: scrapes are not news
                 pass
 
-            def _send(self, code, ctype, body: bytes):
+            def _send(self, code, ctype, body: bytes, headers=None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _try_apps(self, method: str) -> bool:
+                """Route through the registered extension apps (the svc
+                POST/job plane); True when one answered. An app exception
+                becomes a 500 — one bad request must not kill the
+                serving thread."""
+                path = self.path.split("?", 1)[0]
+                body = b""
+                if method == "POST":
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length > 0 else b""
+                try:
+                    resp = srv._dispatch_app(method, path, body)
+                except Exception as err:
+                    self._send(
+                        500, "text/plain",
+                        f"internal error: {type(err).__name__}: {err}\n"
+                        .encode(),
+                    )
+                    return True
+                if resp is None:
+                    return False
+                self._send(*resp)
+                return True
+
+            def do_POST(self):
+                if not self._try_apps("POST"):
+                    self._send(404, "text/plain", b"not found\n")
+
             def do_GET(self):
+                if self._try_apps("GET"):
+                    return
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
                     with srv._lock:
@@ -200,9 +277,19 @@ def watch_dir(path: str) -> Tuple[Optional[dict], dict]:
         progress["phase"] = "missing-dir"
         return None, progress
 
+    def _mtime(fname: str) -> float:
+        # stat defensively: live artifact dirs churn (checkpoint prunes,
+        # tmp-file renames, result rewrites), so a file listed a moment
+        # ago may be gone by stat time — rank vanished files oldest
+        # instead of letting the OSError kill the whole poll
+        try:
+            return os.path.getmtime(os.path.join(path, fname))
+        except OSError:
+            return float("-inf")
+
     jsonls = sorted(
         (f for f in os.listdir(path) if f.endswith(".jsonl")),
-        key=lambda f: os.path.getmtime(os.path.join(path, f)),
+        key=_mtime,
     )
     for fname in reversed(jsonls):
         try:

@@ -151,7 +151,10 @@ _REPLAY_CACHE = {}
 # heavy jitted machinery keyed WITHOUT weights: the weight vector is a
 # traced operand (sim.step.resolve_weights), so every weight config of a
 # policy family shares one jaxpr — a what-if weight change costs a device
-# call, not a recompile (ISSUE 6)
+# call, not a recompile (ISSUE 6). The cached engine is also the
+# multi-trace sweep's sequential vmap target (ISSUE 7): pod specs and
+# event streams batch per lane (tuned trace variants are data), so the
+# replay service's sequential fallback shares it too.
 _ENGINE_CACHE = {}
 
 

@@ -531,7 +531,15 @@ def make_table_replay(
 class _TableEngine(NamedTuple):
     """The weight-operand jitted surface one policy family shares:
     every callable takes the i32[num_pol] weight vector as a traced
-    argument (never baked), so the family compiles once."""
+    argument (never baked), so the family compiles once.
+
+    `replay` is also the multi-trace sweep's vmap target (ISSUE 7,
+    driver._sweep_engine_multi): pods, types.type_id, and the event
+    streams batch per lane while types.share/types.whole — the distinct
+    type set the tables index — broadcast, so tuned trace variants are
+    data, not jaxpr structure. Nothing in the engine reads type_id
+    except as a per-pod gather key, which is what makes the lift
+    possible without touching the scan body."""
 
     replay: object  # (state, pods, types, evk, evp, tp, key, wts, rank, tables)
     init_carry: object  # (state, pods, types, tp, key, wts, rank, tables)

@@ -1,0 +1,24 @@
+"""tpusim.svc — the queueing what-if replay service (ISSUE 7).
+
+Fuses the pieces the earlier rounds landed into simulation-as-a-service:
+POSTed what-if jobs (policy weights x seed x tune factor over a hosted
+trace) are content-digest-dedup'd (svc.jobs), grouped into compatible
+batches by jaxpr identity (svc.batcher), and served by ONE worker thread
+through the vmapped multi-trace sweep — one compiled scan per batch,
+zero recompiles across batches differing only in operands (svc.worker)
+— with an HTTP plane grown onto the PR 5 MonitorServer (svc.api) and a
+backpressure-honoring client (svc.client, `tpusim submit`).
+"""
+
+from tpusim.svc.api import JobService, start_job_server  # noqa: F401
+from tpusim.svc.batcher import Job, JobQueue, QueueFull  # noqa: F401
+from tpusim.svc.jobs import (  # noqa: F401
+    JobSpec,
+    docs_from_payload,
+    find_result,
+    job_digest,
+    jobs_from_grid,
+    validate_job,
+    write_result,
+)
+from tpusim.svc.worker import TraceRef, Worker, load_trace  # noqa: F401

@@ -1,0 +1,193 @@
+"""HTTP plane of the replay service: the POST side of `tpusim serve`
+(ISSUE 7).
+
+JobService is a MonitorServer extension app (obs.server.add_app), so one
+listener carries both planes — the PR 5 observability GETs (/metrics,
+/healthz, /progress with per-job windows) and the job plane:
+
+  POST /jobs             submit one job object or {"jobs": [...]};
+                         202 on enqueue, 200 when every job was answered
+                         from the digest cache, 400 on a malformed spec,
+                         429 + Retry-After on a full queue (the
+                         kube_client backoff contract)
+  GET  /jobs/<id>        lifecycle: queued/batched/running/done/failed +
+                         batch/lane placement
+  GET  /jobs/<id>/result result document (placements summary, gpu_alloc,
+                         frag, counters); 409 while the job is still in
+                         flight, 404 for unknown ids
+  GET  /queue            depth, capacity, batches formed, dedup hits,
+                         compiled sweep-executable count (the PR 6
+                         jit._cache_size() zero-recompile check, live)
+
+start_job_server wires the full stack — queue + worker + monitor — and
+is what `tpusim serve DIR --jobs` and the smoke/test surfaces drive.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional, Tuple
+
+from tpusim.svc import jobs as svc_jobs
+from tpusim.svc.batcher import JobQueue, QueueFull
+from tpusim.svc.worker import TraceRef, Worker
+
+_JSON = "application/json"
+
+
+def _json_body(code: int, doc, headers: Optional[dict] = None):
+    body = (json.dumps(doc, sort_keys=True) + "\n").encode()
+    if headers:
+        return code, _JSON, body, headers
+    return code, _JSON, body
+
+
+class JobService:
+    """The extension app MonitorServer routes /jobs and /queue to."""
+
+    def __init__(self, queue: JobQueue, worker: Worker,
+                 traces: Dict[str, TraceRef], artifact_dir: str,
+                 monitor=None):
+        self.queue = queue
+        self.worker = worker
+        self.traces = dict(traces)
+        self.artifact_dir = artifact_dir
+        self.monitor = monitor
+        # submit path serializes digest lookup + enqueue so concurrent
+        # duplicate POSTs dedup instead of double-running
+        self._submit_lock = threading.Lock()
+
+    # ---- submission (shared by HTTP and in-process callers) ----
+
+    def submit_payload(self, payload: dict) -> dict:
+        """Validate + dedup + enqueue one job document. Returns the job
+        description (with `cached` marking digest-cache answers); raises
+        ValueError (→ 400) or QueueFull (→ 429)."""
+        spec = svc_jobs.validate_job(payload)
+        trace = self.traces.get(spec.trace)
+        if trace is None:
+            raise ValueError(
+                f"unknown trace {spec.trace!r} (hosted: "
+                f"{', '.join(sorted(self.traces)) or 'none'})"
+            )
+        digest = svc_jobs.job_digest(spec, trace.digest)
+        with self._submit_lock:
+            cached = svc_jobs.find_result(self.artifact_dir, digest)
+            job = self.queue.submit(spec, digest, cached_result=cached)
+        if self.monitor is not None:
+            self.monitor.publish_job_progress(
+                job.id, {"status": job.status, "phase": "submitted"}
+            )
+        return job.describe()
+
+    # ---- the MonitorServer app hook ----
+
+    def handle(self, method: str, path: str, body: bytes):
+        if path == "/jobs" and method == "POST":
+            return self._post_jobs(body)
+        if path == "/queue" and method == "GET":
+            return self._get_queue()
+        if path.startswith("/jobs/"):
+            if method != "GET":
+                return _json_body(405, {"error": "method not allowed"})
+            rest = path[len("/jobs/"):]
+            if rest.endswith("/result"):
+                return self._get_result(rest[: -len("/result")])
+            return self._get_job(rest)
+        return None  # not ours: fall through to the monitor built-ins
+
+    def _post_jobs(self, body: bytes):
+        try:
+            payload = json.loads(body.decode() or "null")
+        except (json.JSONDecodeError, UnicodeDecodeError) as err:
+            return _json_body(400, {"error": f"bad JSON body: {err}"})
+        is_batch = isinstance(payload, dict) and "jobs" in payload
+        docs = payload["jobs"] if is_batch else [payload]
+        if not isinstance(docs, list) or not docs:
+            return _json_body(
+                400, {"error": 'want a job object or {"jobs": [...]}'}
+            )
+        accepted = []
+        for doc in docs:
+            try:
+                accepted.append(self.submit_payload(doc))
+            except ValueError as err:
+                # reject the lot on the first malformed doc: a half-
+                # accepted batch would make retries re-submit (harmless,
+                # dedup'd) but hides the error from casual clients
+                return _json_body(
+                    400, {"error": str(err), "accepted": accepted}
+                )
+            except QueueFull as err:
+                # backpressure: whatever was accepted stands (dedup makes
+                # the client's retry of the full list safe), the rest
+                # should come back after Retry-After
+                return _json_body(
+                    429,
+                    {"error": str(err), "accepted": accepted,
+                     "retry_after_s": err.retry_after_s},
+                    headers={"Retry-After": str(err.retry_after_s)},
+                )
+        all_cached = all(d["status"] == "done" for d in accepted)
+        doc = {"jobs": accepted} if is_batch else accepted[0]
+        return _json_body(200 if all_cached else 202, doc)
+
+    def _get_job(self, job_id: str):
+        job = self.queue.get(job_id)
+        if job is None:
+            return _json_body(404, {"error": f"unknown job {job_id!r}"})
+        return _json_body(200, job.describe())
+
+    def _get_result(self, job_id: str):
+        job = self.queue.get(job_id)
+        if job is None:
+            return _json_body(404, {"error": f"unknown job {job_id!r}"})
+        if job.status == "failed":
+            return _json_body(
+                500, {"error": job.error or "job failed", "id": job.id}
+            )
+        if job.status != "done" or job.result is None:
+            return _json_body(
+                409,
+                {"error": f"job {job.id} is {job.status}; result not "
+                 "ready", "status": job.status},
+            )
+        return _json_body(200, job.result)
+
+    def _get_queue(self):
+        stats = self.queue.stats()
+        stats["sweep_executables"] = self.worker.sweep_executables()
+        stats["batches_run"] = self.worker.batches_run
+        stats["traces"] = sorted(self.traces)
+        return _json_body(200, stats)
+
+
+def start_job_server(
+    artifact_dir: str, traces: Dict[str, TraceRef], listen: str = "",
+    lane_width: int = 8, queue_size: int = 64, bucket: int = 512,
+    table_cache_dir: str = "", compile_cache_dir: str = "",
+    start_worker: bool = True,
+) -> Tuple[object, JobService, Worker]:
+    """Wire the full service: MonitorServer (+ heartbeat-fed /progress)
+    with the JobService app, a bounded JobQueue, and the single Worker
+    thread. Returns (server, service, worker); caller owns shutdown
+    (worker.stop(); server.stop()). start_worker=False leaves batch
+    dispatch to the caller (deterministic tests)."""
+    from tpusim.obs.server import MonitorServer
+
+    srv = MonitorServer(listen)
+    queue = JobQueue(maxsize=queue_size, lane_width=lane_width)
+    worker = Worker(
+        queue, traces, artifact_dir, bucket=bucket, monitor=srv,
+        table_cache_dir=table_cache_dir,
+        compile_cache_dir=compile_cache_dir,
+    )
+    service = JobService(queue, worker, traces, artifact_dir, monitor=srv)
+    srv.add_app(service)
+    srv.start()
+    srv.attach_heartbeat()
+    srv.publish_progress(phase="serving-jobs")
+    if start_worker:
+        worker.start()
+    return srv, service, worker

@@ -1,0 +1,243 @@
+"""Batcher: the bounded FIFO job queue + batch formation (ISSUE 7).
+
+The queue is the service's backpressure boundary: `submit` on a full
+queue raises QueueFull, which the HTTP plane answers as 429 with a
+Retry-After header — the same contract tpusim.io.kube_client's retry
+loop already honors client-side (capped-exponential backoff, the
+server-provided delay wins), so a tpusim-built client dogpiles neither
+the service nor, transitively, the device.
+
+Batch formation is FIFO with compatibility grouping: the next batch is
+the OLDEST queued job plus every other queued job sharing its family
+key (JobSpec.family_key — the jaxpr-identity rule: same trace + policy
+family + scoring methods + engine), in submission order, up to the
+worker's lane width. Jobs whose family differs ride later batches —
+possibly singleton lanes — so one incompatible job can delay but never
+starve the stream. Everything here is host-side bookkeeping under one
+lock; the single Worker thread is the only consumer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpusim.svc.jobs import JobSpec
+
+# job lifecycle: queued -> batched -> running -> done | failed
+# (dedup'd submissions adopt the original job — same id, same record)
+STATUSES = ("queued", "batched", "running", "done", "failed")
+
+
+class QueueFull(RuntimeError):
+    """Bounded queue overflow — the 429/Retry-After surface."""
+
+    def __init__(self, depth: int, retry_after_s: int):
+        super().__init__(
+            f"job queue full ({depth} queued); retry after "
+            f"{retry_after_s}s"
+        )
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+@dataclass
+class Job:
+    """One submitted job's runtime record."""
+
+    id: str
+    spec: JobSpec
+    digest: str
+    status: str = "queued"
+    batch: int = -1  # batch sequence number once grouped
+    lane: int = -1  # lane index inside its batch's sweep
+    cached: bool = False  # answered from the digest cache, never ran
+    result: Optional[dict] = None
+    error: str = ""
+    submitted_unix: float = field(default_factory=time.time)
+    finished_unix: float = 0.0
+
+    def describe(self) -> dict:
+        """The GET /jobs/<id> document."""
+        out = {
+            "id": self.id,
+            "digest": self.digest,
+            "status": self.status,
+            "cached": self.cached,
+            "trace": self.spec.trace,
+            "weights": list(self.spec.weights),
+            "seed": self.spec.seed,
+            "tune": self.spec.tune,
+        }
+        if self.batch >= 0:
+            out["batch"] = self.batch
+            out["lane"] = self.lane
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+class JobQueue:
+    """Bounded FIFO queue + job registry (thread-safe)."""
+
+    def __init__(self, maxsize: int = 64, lane_width: int = 8,
+                 retry_after_s: int = 2):
+        if maxsize < 1 or lane_width < 1:
+            raise ValueError(
+                f"maxsize and lane_width must be >= 1 "
+                f"(got {maxsize}, {lane_width})"
+            )
+        self.maxsize = int(maxsize)
+        self.lane_width = int(lane_width)
+        self.retry_after_s = int(retry_after_s)
+        self._cond = threading.Condition()
+        self._queue: List[Job] = []  # submission order
+        self._jobs: Dict[str, Job] = {}  # id -> Job (all lifecycles)
+        self._by_digest: Dict[str, Job] = {}  # digest -> canonical Job
+        self._seq = 0
+        self._batches = 0
+        self.stats_counters = {
+            "submitted": 0, "dedup_hits": 0, "rejected": 0,
+            "done": 0, "failed": 0,
+        }
+
+    # ---- submission / lookup ----
+
+    def submit(self, spec: JobSpec, digest: str,
+               cached_result: Optional[dict] = None) -> Job:
+        """Register a job. A digest already known (queued, running, or
+        done) dedups to the existing Job — the duplicate never touches
+        the queue or the device. `cached_result` short-circuits a fresh
+        digest straight to done (the disk-cache hit). Raises QueueFull
+        when a genuinely new job meets a full queue."""
+        with self._cond:
+            existing = self._by_digest.get(digest)
+            if existing is not None and existing.status != "failed":
+                self.stats_counters["dedup_hits"] += 1
+                return existing
+            if cached_result is not None:
+                job = self._new_job(spec, digest)
+                job.status = "done"
+                job.cached = True
+                job.result = cached_result
+                job.finished_unix = time.time()
+                self.stats_counters["dedup_hits"] += 1
+                self.stats_counters["done"] += 1
+                return job
+            if len(self._queue) >= self.maxsize:
+                self.stats_counters["rejected"] += 1
+                raise QueueFull(len(self._queue), self.retry_after_s)
+            job = self._new_job(spec, digest)
+            self._queue.append(job)
+            self.stats_counters["submitted"] += 1
+            self._cond.notify_all()
+            return job
+
+    def _new_job(self, spec: JobSpec, digest: str) -> Job:
+        self._seq += 1
+        job = Job(id=f"j{self._seq:05d}-{digest[:10]}", spec=spec,
+                  digest=digest)
+        self._jobs[job.id] = job
+        self._by_digest[digest] = job
+        return job
+
+    def get(self, job_id: str) -> Optional[Job]:
+        with self._cond:
+            return self._jobs.get(job_id)
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # ---- batch formation (the single Worker thread's pop) ----
+
+    def next_batch(self, timeout: Optional[float] = None,
+                   linger_s: float = 0.0) -> List[Job]:
+        """Pop the next batch: the oldest queued job + every queued job
+        sharing its family key, FIFO order, up to lane_width. Blocks up
+        to `timeout` for work; an empty list means none arrived.
+        `linger_s` is the batching window: once work exists, wait up to
+        that long for the rest of a concurrent submission wave to land
+        (a wave split across two batches costs two scans — and, when the
+        stragglers carry bigger tuned traces, a recompile the one-batch
+        form would have amortized)."""
+        with self._cond:
+            if not self._queue:
+                self._cond.wait(timeout)
+            if not self._queue:
+                return []
+            if linger_s > 0:
+                deadline = time.time() + linger_s
+                while len(self._queue) < self.lane_width:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            fam = self._queue[0].spec.family_key()
+            batch = [
+                j for j in self._queue if j.spec.family_key() == fam
+            ][: self.lane_width]
+            taken = set(id(j) for j in batch)
+            self._queue = [j for j in self._queue if id(j) not in taken]
+            self._batches += 1
+            for lane, job in enumerate(batch):
+                job.status = "batched"
+                job.batch = self._batches
+                job.lane = lane
+            self._cond.notify_all()
+            return batch
+
+    # ---- worker-side lifecycle transitions ----
+
+    def mark_running(self, batch: List[Job]) -> None:
+        with self._cond:
+            for job in batch:
+                job.status = "running"
+
+    def mark_done(self, job: Job, result: dict) -> None:
+        with self._cond:
+            job.status = "done"
+            job.result = result
+            job.finished_unix = time.time()
+            self.stats_counters["done"] += 1
+
+    def mark_failed(self, job: Job, error: str) -> None:
+        with self._cond:
+            job.status = "failed"
+            job.error = str(error)
+            job.finished_unix = time.time()
+            self.stats_counters["failed"] += 1
+            # a failed digest must not swallow future submissions of the
+            # same job (submit() skips failed entries already; dropping
+            # the mapping keeps the registry from pinning the failure)
+            if self._by_digest.get(job.digest) is job:
+                del self._by_digest[job.digest]
+
+    # ---- introspection (the GET /queue document) ----
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "depth": len(self._queue),
+                "capacity": self.maxsize,
+                "lane_width": self.lane_width,
+                "batches_formed": self._batches,
+                **self.stats_counters,
+            }
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until every submitted job reached a terminal state
+        (test/smoke helper). True on idle, False on timeout."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._cond:
+                busy = [
+                    j for j in self._jobs.values()
+                    if j.status not in ("done", "failed")
+                ]
+            if not busy:
+                return True
+            time.sleep(0.02)
+        return False

@@ -1,0 +1,166 @@
+"""Client side of the replay service: `tpusim submit` (ISSUE 7).
+
+POSTs job documents to a `tpusim serve --jobs` endpoint and polls them
+to completion. Backpressure is first-class: a 429 answer sleeps the
+server-provided Retry-After (falling back to kube_client's capped-
+exponential-with-jitter schedule — the SAME delay discipline its List
+retries use, so a fleet of submitters never dogpiles the service) and
+re-submits the remainder; dedup on the service side makes re-submitting
+an already-accepted document harmless.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Sequence, Tuple
+
+from tpusim.io.kube_client import _retry_delay_s
+
+TERMINAL = ("done", "failed")
+
+
+class ServiceError(RuntimeError):
+    pass
+
+
+def _request(url: str, data: Optional[bytes] = None,
+             timeout: float = 30.0) -> Tuple[int, dict, dict]:
+    """(status, headers, parsed JSON body); HTTP errors with a JSON body
+    (the service's 4xx/5xx answers) are returned, transport errors
+    raise."""
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(
+                resp.read().decode() or "null"
+            )
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read().decode() or "null")
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            body = {"error": str(e)}
+        return e.code, dict(e.headers or {}), body
+
+
+def submit_jobs(url: str, docs: Sequence[dict], max_retries: int = 8,
+                timeout: float = 30.0, out=None) -> List[dict]:
+    """POST every job document, honoring 429/Retry-After backpressure:
+    rejected remainders are re-submitted after the advertised delay
+    (dedup makes overlap safe). Returns the accepted job descriptions in
+    submission order; raises ServiceError on a 400 or when the queue
+    never drains within max_retries rounds."""
+    url = url.rstrip("/")
+    pending = list(docs)
+    accepted: List[dict] = []
+    for attempt in range(1, max_retries + 1):
+        body = json.dumps({"jobs": pending}).encode()
+        code, headers, doc = _request(url + "/jobs", body, timeout)
+        if code in (200, 202):
+            accepted.extend(doc.get("jobs", [doc]))
+            return accepted
+        if code == 400:
+            raise ServiceError(f"rejected: {doc.get('error', doc)}")
+        if code == 429:
+            got = doc.get("accepted") or []
+            accepted.extend(got)
+            pending = pending[len(got):]
+            if attempt >= max_retries:
+                break
+            delay = _retry_delay_s(attempt, headers.get("Retry-After"))
+            if out is not None:
+                print(
+                    f"[submit] queue full ({len(pending)} left), "
+                    f"retrying in {delay:.1f}s", file=out,
+                )
+            time.sleep(delay)
+            continue
+        raise ServiceError(f"POST /jobs -> HTTP {code}: {doc}")
+    raise ServiceError(
+        f"queue stayed full after {max_retries} attempts "
+        f"({len(pending)} jobs unsubmitted)"
+    )
+
+
+def wait_jobs(url: str, job_ids: Sequence[str], timeout: float = 300.0,
+              poll_s: float = 0.2) -> List[dict]:
+    """Poll GET /jobs/<id> until every job is terminal; returns their
+    final descriptions in order. Raises ServiceError on timeout."""
+    url = url.rstrip("/")
+    deadline = time.time() + timeout
+    last = {jid: None for jid in job_ids}
+    while time.time() < deadline:
+        busy = False
+        for jid in job_ids:
+            if last[jid] and last[jid]["status"] in TERMINAL:
+                continue
+            code, _, doc = _request(f"{url}/jobs/{jid}")
+            if code != 200:
+                raise ServiceError(f"GET /jobs/{jid} -> HTTP {code}: {doc}")
+            last[jid] = doc
+            if doc["status"] not in TERMINAL:
+                busy = True
+        if not busy:
+            return [last[jid] for jid in job_ids]
+        time.sleep(poll_s)
+    stuck = [j for j, d in last.items()
+             if not d or d["status"] not in TERMINAL]
+    raise ServiceError(f"jobs still running after {timeout}s: {stuck}")
+
+
+def fetch_results(url: str, job_ids: Sequence[str],
+                  timeout: float = 30.0) -> List[dict]:
+    """GET /jobs/<id>/result for every (terminal) job."""
+    url = url.rstrip("/")
+    out = []
+    for jid in job_ids:
+        code, _, doc = _request(f"{url}/jobs/{jid}/result", timeout=timeout)
+        if code != 200:
+            raise ServiceError(
+                f"GET /jobs/{jid}/result -> HTTP {code}: {doc}"
+            )
+        out.append(doc)
+    return out
+
+
+def format_results_table(results: Sequence[dict]) -> str:
+    """Per-job summary table — the `tpusim submit` output (one row per
+    job: weights, seed, tune, placed/failed, gpu_alloc, frag)."""
+    head = (
+        f"{'job':>4} {'weights':<24} {'seed':>6} {'tune':>5} "
+        f"{'placed':>7} {'failed':>7} {'gpu_alloc%':>10} "
+        f"{'frag_gpu_milli':>15}"
+    )
+    rows = [head, "-" * len(head)]
+    for i, r in enumerate(results):
+        wstr = ",".join(str(int(x)) for x in r.get("weights", []))
+        rows.append(
+            f"{i:>4} {wstr:<24} {r.get('seed', ''):>6} "
+            f"{r.get('tune', 0):>5} {r.get('placed', ''):>7} "
+            f"{r.get('failed', ''):>7} "
+            f"{r.get('gpu_alloc_pct', 0.0):>10.2f} "
+            f"{r.get('frag_gpu_milli', 0.0):>15.0f}"
+        )
+    return "\n".join(rows)
+
+
+def submit_and_wait(url: str, docs: Sequence[dict], timeout: float = 300.0,
+                    out=None) -> List[dict]:
+    """The whole `tpusim submit` flow: POST (with backpressure retries),
+    poll to terminal, fetch results. Raises ServiceError when any job
+    failed server-side."""
+    accepted = submit_jobs(url, docs, out=out)
+    ids = [a["id"] for a in accepted]
+    final = wait_jobs(url, ids, timeout=timeout)
+    failed = [d for d in final if d["status"] == "failed"]
+    if failed:
+        raise ServiceError(
+            "job(s) failed: "
+            + "; ".join(f"{d['id']}: {d.get('error', '?')}" for d in failed)
+        )
+    return fetch_results(url, ids)

@@ -1,0 +1,342 @@
+"""Job plane of the what-if replay service (ISSUE 7).
+
+A job is one what-if replay request over a trace the service hosts: the
+policy family, a weight vector, a seed, and the gpu-sel/tune knobs —
+exactly the axes the reference grids with a process per experiment
+(1020 replays, experiments/README.md) and the config-axis sweep
+(ISSUE 6) turned into traced operands. Everything else about a job is
+derived:
+
+  digest   content key via io.storage.checkpoint_digest — the engine-
+           source salt + the trace content digest + the canonical spec
+           tuple. Two identical submissions share one digest, so the
+           second is answered from the result cache without touching
+           the device (the dedup contract), and any code change makes
+           every old result silently miss instead of serving stale
+           placements (the checkpoint-vocabulary discipline).
+  family   the batching compatibility key: jobs sharing (trace, policy
+           names, gpu_sel, norm, dim_ext, engine) run ONE jaxpr — their
+           weights/seeds/tune factors are sweep operands — so the
+           batcher packs them onto a single compiled scan.
+
+Results persist as digest-signed JSONL files in the artifact dir
+(io.storage.write_signed_jsonl — the decisions-file torn-write
+discipline, ISSUE 4): `<job digest>.result.jsonl`, atomic rename,
+payload digest verified on read.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+from tpusim.policies import POLICY_NAMES
+
+RESULT_SCHEMA = "tpusim-svc-result/1"
+RESULT_SUFFIX = ".result.jsonl"
+
+ENGINES = ("auto", "table", "sequential")
+
+# every key a job document may carry — unknown keys are rejected loudly
+# (a typo'd "wieghts" must not silently become a default-weight replay)
+JOB_KEYS = frozenset((
+    "trace", "policies", "weights", "seed", "gpu_sel", "norm", "dim_ext",
+    "tune", "tune_seed", "engine",
+))
+
+DEFAULT_POLICIES = (("FGDScore", 1000),)
+
+
+@dataclass(frozen=True)
+class JobSpec:
+    """One validated what-if replay request (all fields hashable — the
+    spec tuple is the digest's canonical form)."""
+
+    trace: str = "default"
+    policies: Tuple[Tuple[str, int], ...] = DEFAULT_POLICIES
+    weights: Tuple[int, ...] = ()  # resolved vector, len == len(policies)
+    seed: int = 42
+    gpu_sel: str = "best"
+    norm: str = "max"
+    dim_ext: str = "share"
+    tune: float = 0.0  # workload tuning ratio (0 = untuned trace)
+    tune_seed: int = 233
+    engine: str = "auto"
+
+    def family_key(self) -> tuple:
+        """Batching compatibility key — everything that shapes the
+        compiled sweep's jaxpr. Weights, seed, and tune factor are
+        deliberately ABSENT: they are traced operands (ISSUE 6/7), so
+        jobs differing only in them pack onto one compiled scan."""
+        return (
+            self.trace, tuple(n for n, _ in self.policies),
+            self.gpu_sel, self.norm, self.dim_ext, self.engine,
+        )
+
+    def canonical(self) -> tuple:
+        """The digest's canonical form: every field, deterministic order,
+        tune as a repr-stable float."""
+        return (
+            self.trace, self.policies, self.weights, self.seed,
+            self.gpu_sel, self.norm, self.dim_ext, float(self.tune),
+            self.tune_seed, self.engine,
+        )
+
+
+def validate_job(payload: dict) -> JobSpec:
+    """Job document -> JobSpec, failing loudly (ValueError with a usable
+    message) on anything malformed — the 400 surface of POST /jobs."""
+    if not isinstance(payload, dict):
+        raise ValueError(
+            f"job must be a JSON object, got {type(payload).__name__}"
+        )
+    unknown = set(payload) - JOB_KEYS
+    if unknown:
+        raise ValueError(
+            f"unknown job key(s) {sorted(unknown)} (known: "
+            f"{sorted(JOB_KEYS)})"
+        )
+
+    raw_pol = payload.get("policies", [list(p) for p in DEFAULT_POLICIES])
+    if (
+        not isinstance(raw_pol, (list, tuple)) or not raw_pol
+        or not all(
+            isinstance(p, (list, tuple)) and len(p) == 2
+            and isinstance(p[0], str) for p in raw_pol
+        )
+    ):
+        raise ValueError(
+            'policies must be a non-empty list of [name, weight] pairs, '
+            f"got {raw_pol!r}"
+        )
+    policies = []
+    for name, w in raw_pol:
+        if name not in POLICY_NAMES:
+            raise ValueError(
+                f"unknown policy {name!r} (known: {', '.join(POLICY_NAMES)})"
+            )
+        policies.append((name, _as_int(w, f"policies[{name}] weight")))
+
+    weights = payload.get("weights")
+    if weights is None:
+        weights = [w for _, w in policies]
+    if not isinstance(weights, (list, tuple)) or len(weights) != len(policies):
+        raise ValueError(
+            f"weights must list one integer per policy "
+            f"({len(policies)} expected), got {weights!r}"
+        )
+    weights = tuple(_as_int(w, f"weights[{i}]") for i, w in enumerate(weights))
+
+    engine = str(payload.get("engine", "auto"))
+    if engine not in ENGINES:
+        raise ValueError(
+            f"engine must be one of {ENGINES}, got {engine!r} (pallas has "
+            "no batched sweep form)"
+        )
+    # the scheduler-config vocabulary (config.scheduler._validate_methods):
+    # an unknown method string would not fail downstream — sim.step's
+    # gpu_sel dispatch falls through to a default branch — so a typo'd
+    # 'bets' would run, return plausibly-wrong placements, and cache them
+    # under the typo'd digest. Same fail-loudly bar as the key check.
+    gpu_sel = str(payload.get("gpu_sel", "best"))
+    if gpu_sel not in ("best", "worst", "random") + tuple(POLICY_NAMES):
+        raise ValueError(
+            f"gpu_sel must be best | worst | random | a score-plugin "
+            f"name, got {gpu_sel!r}"
+        )
+    norm = str(payload.get("norm", "max"))
+    if norm not in ("node", "pod", "max"):
+        raise ValueError(f"norm must be node | pod | max, got {norm!r}")
+    dim_ext = str(payload.get("dim_ext", "share"))
+    if dim_ext not in ("merge", "share", "divide", "extend"):
+        raise ValueError(
+            f"dim_ext must be merge | share | divide | extend, got "
+            f"{dim_ext!r}"
+        )
+    tune = payload.get("tune", 0.0)
+    try:
+        tune = float(tune)
+    except (TypeError, ValueError):
+        raise ValueError(f"tune must be a number, got {tune!r}")
+    if tune < 0:
+        raise ValueError(f"tune must be >= 0, got {tune}")
+
+    return JobSpec(
+        trace=str(payload.get("trace", "default")),
+        policies=tuple(policies),
+        weights=weights,
+        seed=_as_int(payload.get("seed", 42), "seed"),
+        gpu_sel=gpu_sel,
+        norm=norm,
+        dim_ext=dim_ext,
+        tune=tune,
+        tune_seed=_as_int(payload.get("tune_seed", 233), "tune_seed"),
+        engine=engine,
+    )
+
+
+def _as_int(v, what: str) -> int:
+    if isinstance(v, bool) or not isinstance(v, int):
+        raise ValueError(f"{what} must be an integer, got {v!r}")
+    return int(v)
+
+
+# keys an apply-style grid document may carry: the per-row vectors plus
+# every scalar JOB_KEYS field that applies to all rows
+GRID_SHARED_KEYS = ("trace", "policies", "gpu_sel", "norm", "dim_ext",
+                    "engine", "tune_seed")
+GRID_KEYS = frozenset(("weights", "seeds", "tunes") + GRID_SHARED_KEYS)
+
+
+def docs_from_payload(payload):
+    """Submit-file payload -> job documents, routing by shape: a list of
+    job objects or a {"jobs": [...]} wrapper passes through, a bare
+    list-of-rows or a dict whose `weights` is a list of ROWS expands
+    via jobs_from_grid, and anything else is ONE job document (note
+    `weights` as a flat vector is a JOB_KEYS field of a single job, not
+    a one-row grid — `tpusim submit` must not misroute it)."""
+    if isinstance(payload, list):
+        if payload and isinstance(payload[0], dict):
+            return list(payload)
+        return jobs_from_grid(payload)
+    if isinstance(payload, dict):
+        if "jobs" in payload:
+            return jobs_from_grid(payload)
+        w = payload.get("weights")
+        if (isinstance(w, (list, tuple)) and w
+                and isinstance(w[0], (list, tuple))):
+            return jobs_from_grid(payload)
+    return [payload]
+
+
+def jobs_from_grid(payload, default_policies=None):
+    """Expand an apply-style weights grid into per-row job documents —
+    the `tpusim submit weights.json` convenience: a bare [[w, ...], ...]
+    list or {"weights": [[...]], "seeds": [...], "tunes": [...], ...}
+    becomes one job per row (the scalar GRID_SHARED_KEYS — trace,
+    policies, gpu_sel, norm, dim_ext, engine, tune_seed — apply to
+    every row; unknown keys are rejected loudly, matching validate_job:
+    a singular "seed"/"tune" typo must not silently run every row at
+    the defaults). Full job documents ({"jobs": [...]}) pass through
+    untouched."""
+    if isinstance(payload, dict) and "jobs" in payload:
+        jobs = payload["jobs"]
+        if not isinstance(jobs, list) or not jobs:
+            raise ValueError('"jobs" must be a non-empty list of job objects')
+        return list(jobs)
+    if isinstance(payload, dict):
+        unknown = set(payload) - GRID_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown grid key(s) {sorted(unknown)} (known: "
+                f"{sorted(GRID_KEYS)}; per-row vectors are plural — "
+                '"seeds"/"tunes", not "seed"/"tune")'
+            )
+        weights = payload.get("weights")
+        seeds = payload.get("seeds")
+        tunes = payload.get("tunes")
+        shared = {k: payload[k] for k in GRID_SHARED_KEYS if k in payload}
+    else:
+        weights, seeds, tunes, shared = payload, None, None, {}
+    if not weights:
+        raise ValueError(
+            "no weight rows (want [[w, ...], ...], "
+            '{"weights": [[...]], "seeds": [...], "tunes": [...]}, or '
+            '{"jobs": [...]})'
+        )
+    if "policies" not in shared and default_policies is not None:
+        shared["policies"] = [list(p) for p in default_policies]
+    b = len(weights)
+    for name, vals in (("seeds", seeds), ("tunes", tunes)):
+        if vals is not None and len(vals) != b:
+            raise ValueError(
+                f"{name} has {len(vals)} entries for {b} weight rows"
+            )
+    out = []
+    for i, row in enumerate(weights):
+        job = dict(shared)
+        job["weights"] = list(row)
+        if seeds is not None:
+            job["seed"] = seeds[i]
+        if tunes is not None:
+            job["tune"] = tunes[i]
+        out.append(job)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Content digest + signed result persistence
+# ---------------------------------------------------------------------------
+
+
+def job_digest(spec: JobSpec, trace_digest: str) -> str:
+    """Content key of one job: the engine-source version salt (any
+    engine/policy code change invalidates every cached result), the
+    hosted trace's content digest (a changed CSV is a different job),
+    and the canonical spec tuple."""
+    from tpusim.io.storage import checkpoint_digest
+    from tpusim.sim.driver import _engine_source_digest
+
+    def chunks():
+        yield _engine_source_digest()
+        yield str(trace_digest).encode()
+        yield repr(spec.canonical()).encode()
+
+    return checkpoint_digest(chunks())
+
+
+def trace_digest(nodes: Sequence, pods: Sequence) -> str:
+    """Content digest of a hosted trace (NodeRow/PodRow lists — their
+    dataclass reprs are value-complete, so this keys on content, not on
+    file paths or mtimes)."""
+    from tpusim.io.storage import checkpoint_digest
+
+    def chunks():
+        for n in nodes:
+            yield repr(n).encode()
+        for p in pods:
+            yield repr(p).encode()
+
+    return checkpoint_digest(chunks())
+
+
+def result_path(artifact_dir: str, digest: str) -> str:
+    return os.path.join(artifact_dir, f"{digest}{RESULT_SUFFIX}")
+
+
+def write_result(artifact_dir: str, digest: str, result: dict) -> str:
+    """Persist one job result as digest-signed JSONL (atomic; the
+    decisions-file discipline). The header names the job digest so a
+    renamed/foreign file never matches on read."""
+    from tpusim.io import storage
+
+    header = {"schema": RESULT_SCHEMA, "job": digest}
+    line = json.dumps(result, sort_keys=True, separators=(",", ":"))
+    return storage.write_signed_jsonl(
+        result_path(artifact_dir, digest), header, [line]
+    )
+
+
+def find_result(artifact_dir: str, digest: str) -> Optional[dict]:
+    """Load a persisted result for this job digest, or None. Torn /
+    digest-mismatched / foreign files are DELETED and treated as a miss
+    — content addressing makes recomputation always safe, and a bad file
+    left behind would shadow every future write."""
+    from tpusim.io import storage
+
+    path = result_path(artifact_dir, digest)
+    if not os.path.isfile(path):
+        return None
+    try:
+        header, payload = storage.read_signed_jsonl(path, RESULT_SCHEMA)
+        if header.get("job") != digest or len(payload) != 1:
+            raise ValueError("foreign or malformed result file")
+        return json.loads(payload[0])
+    except (OSError, ValueError, json.JSONDecodeError):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None

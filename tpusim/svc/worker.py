@@ -1,0 +1,280 @@
+"""Worker: ONE thread owns the device and serves every tenant (ISSUE 7).
+
+The worker drains the JobQueue batch by batch and dispatches each batch
+through the multi-trace vmapped sweep (driver.schedule_pods_sweep_multi)
+— so a whole batch of what-if jobs costs one compiled scan, and across
+batches the one-jaxpr-per-family contract holds: per-family Simulators
+are cached (sharing the weight-operand engines, the content-keyed table
+cache entry, and the persistent compile cache), batches are padded to a
+FIXED lane width (a 3-job batch repeats its tail job into the dead
+lanes — vmap's axis size is jaxpr structure), and per-family pod/event
+shape high-water marks are sticky (the driver's min_pods/min_events
+floors), so consecutive batches differing only in weights/seeds/tune
+factors reuse ONE compiled executable — `jit._cache_size()` stable, the
+acceptance criterion.
+
+Results are summarized host-side (placements, counters, gpu_alloc,
+frag, a placements digest for cheap bit-identity checks), persisted as
+digest-signed JSONL (svc.jobs.write_result), and marked on the queue.
+A batch that raises marks its jobs failed and the worker keeps serving
+— one poisoned job family must not take the service down.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tpusim.svc import jobs as svc_jobs
+from tpusim.svc.batcher import Job, JobQueue
+
+
+@dataclass
+class TraceRef:
+    """One hosted trace: the cluster + workload every job of this ref
+    replays, plus their content digest (part of every job digest)."""
+
+    name: str
+    nodes: list
+    pods: list
+    digest: str
+
+
+def load_trace(name: str, nodes_csv: str, pods_csv: str,
+               max_pods: int = 0) -> TraceRef:
+    """Load a hosted trace from node/pod CSVs (`tpusim serve --jobs
+    --nodes ... --pods ...`); max_pods > 0 truncates the workload (the
+    smoke/prefix knob)."""
+    from tpusim.io.trace import load_node_csv, load_pod_csv
+
+    nodes = load_node_csv(nodes_csv)
+    pods = load_pod_csv(pods_csv)
+    if max_pods > 0:
+        pods = pods[:max_pods]
+    return TraceRef(
+        name=name, nodes=nodes, pods=pods,
+        digest=svc_jobs.trace_digest(nodes, pods),
+    )
+
+
+def summarize_lane(lane, job: Job) -> dict:
+    """SweepLane -> the persisted/HTTP result document. Placements ride
+    along in full (i32 node per pod; -1 = unplaced) plus a sha256 over
+    (placed_node, dev_mask) bytes so bit-identity against a standalone
+    run is one string compare."""
+    from tpusim.obs.counters import COUNTER_FIELDS
+
+    pn = np.asarray(lane.placed_node, np.int32)
+    dm = np.asarray(lane.dev_mask, bool)
+    h = hashlib.sha256()
+    h.update(pn.tobytes())
+    h.update(dm.tobytes())
+    out = {
+        "job": job.digest,
+        "trace": job.spec.trace,
+        "policies": [list(p) for p in job.spec.policies],
+        "weights": list(job.spec.weights),
+        "seed": job.spec.seed,
+        "tune": job.spec.tune,
+        "events": int(lane.events),
+        "placed": int(lane.placed),
+        "failed": int(lane.failed),
+        "gpu_alloc_pct": float(lane.gpu_alloc_pct),
+        "frag_gpu_milli": float(lane.frag_gpu_milli),
+        "placed_node": pn.tolist(),
+        "placements_sha256": h.hexdigest(),
+    }
+    if lane.counters is not None:
+        out["counters"] = {
+            f: int(c) for f, c in zip(COUNTER_FIELDS, lane.counters)
+        }
+    return out
+
+
+class Worker:
+    """The single batch-serving thread (see module docstring)."""
+
+    def __init__(self, queue: JobQueue, traces: Dict[str, TraceRef],
+                 artifact_dir: str, bucket: int = 512, monitor=None,
+                 table_cache_dir: str = "", compile_cache_dir: str = "",
+                 linger_s: float = 0.05):
+        self.queue = queue
+        self.traces = dict(traces)
+        self.artifact_dir = artifact_dir
+        self.bucket = int(bucket)
+        self.monitor = monitor  # MonitorServer (per-job /progress) or None
+        self.table_cache_dir = table_cache_dir
+        self.compile_cache_dir = compile_cache_dir
+        self.linger_s = float(linger_s)  # batching window (JobQueue.next_batch)
+        self._sims: dict = {}  # family_key -> Simulator
+        self._shape_hw: dict = {}  # family_key -> (max pods, max events)
+        self._sweep_fns: set = set()  # jitted sweep wrappers dispatched
+        self.batches_run = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ----
+
+    def start(self) -> "Worker":
+        from tpusim.sim.driver import enable_compile_cache
+
+        enable_compile_cache(self.compile_cache_dir)
+        self._thread = threading.Thread(
+            target=self._loop, name="tpusim-svc-worker", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self.queue.next_batch(
+                timeout=0.2, linger_s=self.linger_s
+            )
+            if batch:
+                self.run_batch(batch)
+
+    # ---- per-family simulator cache ----
+
+    def _sim_for(self, job: Job):
+        """The family's shared Simulator: one weight-operand engine, one
+        table-cache entry, one typical-pod distribution for every tenant
+        of the family."""
+        from tpusim.sim.driver import Simulator, SimulatorConfig
+
+        key = job.spec.family_key()
+        sim = self._sims.get(key)
+        if sim is None:
+            trace = self.traces[job.spec.trace]
+            cfg = SimulatorConfig(
+                policies=job.spec.policies,
+                gpu_sel_method=job.spec.gpu_sel,
+                norm_method=job.spec.norm,
+                dim_ext_method=job.spec.dim_ext,
+                engine=job.spec.engine,
+                report_per_event=False,
+                shuffle_pod=False,
+                seed=42,
+                table_cache_dir=self.table_cache_dir,
+            )
+            sim = Simulator(trace.nodes, cfg)
+            sim.set_workload_pods(trace.pods)
+            sim.set_typical_pods()
+            self._sims[key] = sim
+        return sim
+
+    # ---- the batch dispatch ----
+
+    def run_batch(self, batch: List[Job]) -> None:
+        """Serve one compatible batch through a single vmapped sweep.
+        Public so smoke/tests can drive it synchronously."""
+        self.queue.mark_running(batch)
+        self._publish(batch, phase="running")
+        try:
+            lanes = self._dispatch(batch)
+        except Exception as err:  # poisoned family: fail the jobs, live on
+            msg = f"{type(err).__name__}: {err}"
+            for job in batch:
+                self.queue.mark_failed(job, msg)
+            self._publish(batch, phase="failed", error=msg)
+            return
+        for job, lane in zip(batch, lanes):
+            result = summarize_lane(lane, job)
+            svc_jobs.write_result(self.artifact_dir, job.digest, result)
+            self.queue.mark_done(job, result)
+        self.batches_run += 1
+        self._publish(batch, phase="done")
+
+    def _dispatch(self, batch: List[Job]):
+        from tpusim.sim.driver import (
+            _sweep_engine_multi,
+            schedule_pods_sweep_multi,
+        )
+
+        sim = self._sim_for(batch[0])
+        key = batch[0].spec.family_key()
+        # tag the shared heartbeat stream with this batch's lead job so
+        # /progress keeps per-job windows apart (obs.heartbeat, ISSUE 7
+        # satellite); the vmapped sweep itself strips in-scan heartbeats,
+        # but chunked/standalone replays of the same sim honor it
+        sim._hb_job = batch[0].id
+
+        pods_list = [
+            sim.prepare_pods(
+                tuning_ratio=j.spec.tune, tuning_seed=j.spec.tune_seed
+            )
+            for j in batch
+        ]
+        weights = [list(j.spec.weights) for j in batch]
+        seeds = [j.spec.seed for j in batch]
+        # pad to the FIXED lane width by repeating the tail job: vmap's
+        # axis size is jaxpr structure, so a short batch must not compile
+        # its own executable; dead lanes are sliced off below
+        n = len(batch)
+        while len(weights) < self.queue.lane_width:
+            pods_list.append(pods_list[-1])
+            weights.append(weights[-1])
+            seeds.append(seeds[-1])
+
+        # sticky per-family shape floors (see module docstring): without
+        # them a later batch of slightly smaller tuned traces would land
+        # on a smaller padded shape and recompile. The event count is the
+        # real build_events length under the family's event ordering
+        # (sweep_multi builds the same streams right after — this extra
+        # host-side O(P) pass per lane is noise next to the scan), not a
+        # bound: an inflated floor would pad dead EV_SKIPs into every
+        # future scan
+        from tpusim.io.trace import build_events
+
+        p_max = max(len(p) for p in pods_list)
+        e_max = max(
+            len(build_events(p, sim.cfg.use_timestamps)[0])
+            for p in pods_list
+        )
+        hw_p, hw_e = self._shape_hw.get(key, (0, 0))
+        hw_p, hw_e = max(hw_p, p_max), max(hw_e, e_max)
+        self._shape_hw[key] = (hw_p, hw_e)
+
+        sim._reset_run_state()
+        lanes = schedule_pods_sweep_multi(
+            sim, pods_list, np.asarray(weights, np.int32), seeds=seeds,
+            bucket=self.bucket, min_pods=hw_p, min_events=hw_e,
+        )[:n]
+        # track the jitted sweep wrapper actually dispatched so /queue
+        # can report the compiled-executable count (the PR 6
+        # jit._cache_size() zero-recompile check, now a live metric)
+        used_table = sim._last_engine.startswith("table")
+        self._sweep_fns.add(_sweep_engine_multi(
+            sim._table_fn.engine.replay if used_table
+            else sim.replay_fn.engine,
+            table=used_table,
+        ))
+        return lanes
+
+    # ---- introspection ----
+
+    def sweep_executables(self) -> int:
+        """Compiled sweep executables across every family served — the
+        /queue `sweep_executables` field. Stable across batches differing
+        only in weights/seeds/tunes (zero recompiles); grows only when a
+        new job family or padded shape genuinely needs a new jaxpr."""
+        return sum(fn._cache_size() for fn in self._sweep_fns)
+
+    def _publish(self, batch: Sequence[Job], **fields) -> None:
+        if self.monitor is None:
+            return
+        for job in batch:
+            self.monitor.publish_job_progress(
+                job.id,
+                dict(fields, status=job.status, batch=job.batch,
+                     lane=job.lane),
+            )
