@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -85,6 +85,16 @@ serve-smoke: profile-smoke
 svc-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --svc-only
 
+# learned-scoring smoke (ENGINES.md "Round 13"): run `tpusim tune`'s
+# loop on a tiny synthetic trace for 3 generations on the local backend
+# and hard-check the lane's contracts — ONE compiled sweep executable
+# across every generation (jit._cache_size() stable: weights are traced
+# operands, the population is one vmapped scan), the digest-signed
+# tuning log reads back, and a resume of the finished log is a
+# byte-identical no-op.
+tune-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --tune-only
+
 # bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
 # measurement under profiling and diff it against the newest committed
 # BENCH_r*.json baseline — exact on events/placements/gpu_alloc
@@ -92,9 +102,11 @@ svc-smoke:
 # advisory on cross-backend throughput. Also smoke-checks the decision
 # JSONL round-trip (ISSUE 4), that a live /metrics scrape of the smoke
 # record parses and is byte-equal to the emitted textfile (ISSUE 5),
-# the one-compile sweep contract (ISSUE 6), and the replay-service POST
-# path — dedup + zero recompiles (ISSUE 7, the svc-smoke check). Exit 1
-# on regression; artifacts land in .tpusim_obs/.
+# the one-compile sweep contract (ISSUE 6), the replay-service POST
+# path — dedup + zero recompiles (ISSUE 7, the svc-smoke check) — and
+# the learned-scoring loop (ISSUE 9, the tune-smoke check: one
+# executable across generations, signed resumable log). Exit 1 on
+# regression; artifacts land in .tpusim_obs/.
 bench-gate:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate
 

@@ -556,6 +556,60 @@ def plot_series(run_jsonl: str, out_png: str = "") -> str:
     return out_png
 
 
+def plot_tuning(log_jsonl: str, out_png: str = "") -> str:
+    """Plot a tuning log (ISSUE 9; a `tpusim tune --log` output) to PNG:
+    two panels over the generation axis — the objective curves (per-gen
+    best, running best, population mean/min band, optional robustness
+    eval) and the optimizer's mean weight trajectory per policy.
+    Renders straight from the digest-signed log (tpusim.learn.read_log
+    verifies it) — no simulator, no recomputation. Returns the PNG
+    path (default: beside the log)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    from tpusim.learn.loop import read_log
+    from tpusim.obs.emitters import tuning_curve_series
+
+    header, records = read_log(log_jsonl)
+    if not records:
+        raise ValueError(f"{log_jsonl}: tuning log has no generations")
+    tracks = tuning_curve_series(records)
+    gens = np.asarray(tracks["tune_gen"])
+
+    fig, axes = plt.subplots(2, 1, figsize=(9, 7), sharex=True)
+    ax = axes[0]
+    ax.plot(gens, tracks["tune_best"], label="best so far", lw=2)
+    ax.plot(gens, tracks["tune_gen_best"], label="generation best")
+    ax.plot(gens, tracks["tune_mean"], label="population mean",
+            ls="--")
+    ax.fill_between(gens, tracks["tune_min"], tracks["tune_gen_best"],
+                    alpha=0.15)
+    if "tune_robust" in tracks:
+        ax.plot(gens, tracks["tune_robust"], label="robust (faulted)",
+                ls=":")
+    ax.set_ylabel("objective")
+    ax.legend(fontsize=7)
+
+    ax = axes[1]
+    means = np.asarray([r["state"]["mean"] for r in records])
+    names = [n for n, _ in header["config"]["policies"]]
+    for i, name in enumerate(names):
+        ax.plot(gens, means[:, i], label=name)
+    ax.set_ylabel("mean weight")
+    ax.set_xlabel("generation")
+    ax.legend(fontsize=7)
+
+    fig.suptitle(os.path.basename(log_jsonl))
+    fig.tight_layout()
+    out_png = out_png or (os.path.splitext(log_jsonl)[0] + "_tuning.png")
+    fig.savefig(out_png, dpi=120)
+    plt.close(fig)
+    return out_png
+
+
 def main():
     ap = argparse.ArgumentParser(description="simulator log → analysis CSVs")
     ap.add_argument("-g", "--log-dir", help="experiment directory")
@@ -577,9 +631,14 @@ def main():
         "bands, frag by category, feasible/DOWN/retry, score envelopes",
     )
     ap.add_argument(
+        "--plot-tuning", metavar="TUNE_JSONL",
+        help="plot a tuning log (tpusim tune --log) to PNG — objective "
+        "curves per generation + the mean weight trajectory per policy",
+    )
+    ap.add_argument(
         "-o", "--out", default="",
-        help="output PNG path for --plot-series (default: beside the "
-        "JSONL, *_series.png)",
+        help="output PNG path for --plot-series / --plot-tuning "
+        "(default: beside the JSONL, *_series.png / *_tuning.png)",
     )
     args = ap.parse_args()
     if args.plot_series:
@@ -587,6 +646,14 @@ def main():
             path = plot_series(args.plot_series, args.out)
         except (OSError, ValueError) as err:
             print(f"analysis --plot-series: {err}", file=sys.stderr)
+            return 2
+        print(f"[analysis] wrote {path}")
+        return 0
+    if args.plot_tuning:
+        try:
+            path = plot_tuning(args.plot_tuning, args.out)
+        except (OSError, ValueError) as err:
+            print(f"analysis --plot-tuning: {err}", file=sys.stderr)
             return 2
         print(f"[analysis] wrote {path}")
         return 0
@@ -603,7 +670,7 @@ def main():
         return 1 if d["first"] else 0
     if not args.log_dir:
         ap.error("-g/--log-dir is required (unless --diff-decisions / "
-                 "--plot-series)")
+                 "--plot-series / --plot-tuning)")
     result = analyze_dir(args.log_dir)
     s = result["summary"]
     print(

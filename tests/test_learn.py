@@ -1,0 +1,427 @@
+"""The learned-scoring lane (tpusim.learn; ISSUE 9).
+
+Pins the lane's contracts:
+
+  1. optimizers: seeded ES/CMA are bit-reproducible (same seed -> same
+     trajectory; state_dict round-trip continues identically) and
+     converge on a synthetic separable objective in <= 20 generations;
+  2. the i32 operand bridge: projection rounds/clips onto the engines'
+     weight space, integer collisions dedup before rollout;
+  3. the objective: scalarized exactly as documented, term vocabulary
+     identical between a local SweepLane and a service result document;
+  4. the loop: digest-signed tuning log, byte-identical re-runs under a
+     fixed seed, resume-from-log equivalence (kill at generation k,
+     resume -> the uninterrupted file's bytes), zero recompiles after
+     generation 1 on the local backend;
+  5. local-vs-remote: the same tuning run against a `serve --jobs`
+     service reproduces the local log bit-identically (slow — HTTP +
+     worker thread);
+  6. the openb acceptance (slow, `make resume-smoke`): `tpusim tune` on
+     an openb prefix strictly improves the scalarized objective over
+     the paper-default weights on the held-out trace suffix.
+
+The fast slice stays on a tiny synthetic cluster sharing one compiled
+family (~<= 15 s — the tier-1 budget); everything compile-heavy is
+slow-marked into `make resume-smoke`.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpusim.io.trace import NodeRow, PodRow
+from tpusim.learn import (
+    DiagonalCMA,
+    LocalRollout,
+    ObjectiveConfig,
+    OpenAIES,
+    TuneConfig,
+    centered_ranks,
+    dedup_rows,
+    lane_terms,
+    make_family_sim,
+    project_weights,
+    read_log,
+    run_tune,
+    scalarize,
+    terms_from_result,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAM = [("FGDScore", 1000), ("BestFitScore", 500)]
+
+TARGET = np.array([3.0, -2.0, 1.0])
+
+
+def _quad(xs):
+    """Separable synthetic objective (maximize; optimum = TARGET)."""
+    return -np.sum((np.asarray(xs) - TARGET) ** 2, axis=-1)
+
+
+def _mk_cluster(rng, n=16):
+    return [
+        NodeRow(f"n{i:03d}", 32000, 131072, int(g), "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], n))
+    ]
+
+
+def _mk_pods(rng, n=40):
+    out = []
+    for i in range(n):
+        gpu = int(rng.choice([0, 1, 2]))
+        milli = 1000 if gpu > 1 else int(rng.choice([0, 300, 500, 1000]))
+        if gpu == 0:
+            milli = 0
+        out.append(
+            PodRow(f"p{i:04d}", int(rng.choice([1000, 2000, 4000])), 2048,
+                   gpu, milli)
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. optimizers: reproducibility + convergence
+# ---------------------------------------------------------------------------
+
+
+def test_centered_ranks():
+    u = centered_ranks([10.0, -5.0, 3.0, 99.0])
+    assert u.min() == -0.5 and u.max() == 0.5
+    assert abs(u.sum()) < 1e-12  # mean-zero (antithetic cancellation)
+    # monotone-invariant: any order-preserving transform, same utilities
+    assert np.array_equal(u, centered_ranks([1.0, -1.0, 0.5, 2.0]))
+    assert np.array_equal(centered_ranks([7.0]), [0.0])
+
+
+@pytest.mark.parametrize("make", [
+    lambda: OpenAIES(np.zeros(3), sigma=0.5, lr=3.0, popsize=8, seed=5),
+    lambda: DiagonalCMA(np.zeros(3), sigma=1.0, popsize=8, seed=5),
+])
+def test_optimizer_bit_reproducible(make):
+    """Same seed -> identical trajectory; a state_dict round-trip into a
+    FRESH instance continues bit-identically (the resume contract —
+    generation draws are a pure function of (seed, gen))."""
+    a, b = make(), make()
+    for g in range(5):
+        xa, xb = a.ask(g), b.ask(g)
+        assert np.array_equal(xa, xb)
+        a.tell(g, _quad(xa))
+        b.tell(g, _quad(xb))
+    assert np.array_equal(a.mean, b.mean)
+
+    # JSON round-trip the state mid-run into a fresh optimizer
+    c = make()
+    c.load_state(json.loads(json.dumps(a.state_dict())))
+    for g in range(5, 8):
+        xa, xc = a.ask(g), c.ask(g)
+        assert np.array_equal(xa, xc)
+        a.tell(g, _quad(xa))
+        c.tell(g, _quad(xc))
+    assert np.array_equal(a.mean, c.mean)
+
+
+@pytest.mark.parametrize("make", [
+    lambda: OpenAIES(np.zeros(3), sigma=0.5, lr=3.0, popsize=16, seed=7),
+    lambda: DiagonalCMA(np.zeros(3), sigma=1.0, popsize=12, seed=7),
+])
+def test_optimizer_converges_separable(make):
+    """<= 20 generations to the optimum of a separable quadratic — the
+    ISSUE 9 sample-efficiency bar."""
+    opt = make()
+    for g in range(20):
+        xs = opt.ask(g)
+        opt.tell(g, _quad(xs))
+    assert _quad(opt.mean) > -0.25, opt.mean  # started at -14
+
+
+def test_optimizer_validation():
+    with pytest.raises(ValueError, match="even"):
+        OpenAIES(np.zeros(2), popsize=5)
+    with pytest.raises(ValueError, match=">= 4"):
+        DiagonalCMA(np.zeros(2), popsize=3)
+    opt = OpenAIES(np.zeros(2), popsize=4)
+    with pytest.raises(ValueError, match="shape"):
+        opt.tell(0, [1.0, 2.0])
+    with pytest.raises(ValueError, match="algo"):
+        opt.load_state({"algo": "cma"})
+
+
+# ---------------------------------------------------------------------------
+# 2. integer projection + dedup
+# ---------------------------------------------------------------------------
+
+
+def test_project_weights():
+    out = project_weights([[999.6, -3.0], [4500.2, 0.4]], lo=0, hi=4000)
+    assert out.dtype == np.int32
+    assert out.tolist() == [[1000, 0], [4000, 0]]
+    with pytest.raises(ValueError, match="lo < hi"):
+        project_weights([[1.0]], lo=5, hi=5)
+
+
+def test_dedup_rows():
+    rows = np.asarray([[10, 20], [30, 40], [10, 20], [10, 20]], np.int32)
+    uniq, where = dedup_rows(rows)
+    assert uniq == [(10, 20), (30, 40)]  # first-seen order
+    assert where == [0, 1, 0, 0]
+    # scattering objectives back covers every candidate
+    objs_u = [1.5, -2.0]
+    assert [objs_u[w] for w in where] == [1.5, -2.0, 1.5, 1.5]
+
+
+# ---------------------------------------------------------------------------
+# 3. the objective
+# ---------------------------------------------------------------------------
+
+
+def test_scalarize():
+    terms = {
+        "gpu_alloc_pct": 80.0, "frag_gpu_milli": 5000.0,
+        "gpu_total_milli": 100_000, "unscheduled": 2, "pods": 40,
+    }
+    # 1*80 - 1*(100*5000/100000) - 1*(100*2/40) = 80 - 5 - 5
+    assert scalarize(terms) == pytest.approx(70.0)
+    assert scalarize(
+        terms, ObjectiveConfig(w_alloc=2.0, w_frag=0.5, w_unsched=0.0)
+    ) == pytest.approx(160.0 - 2.5)
+
+
+def test_terms_vocabulary_local_vs_remote():
+    """terms_from_result over a service result doc yields EXACTLY the
+    dict lane_terms builds locally — key set, value types, values (the
+    log bit-identity reduces to this plus the sweep bit-identity
+    test_svc already pins)."""
+    doc = {
+        "weights": [1000, 500], "seed": 42, "events": 80, "pods": 40,
+        "placed": 38, "failed": 2, "unscheduled": 2,
+        "gpu_total_milli": 64000, "gpu_alloc_pct": 81.25,
+        "frag_gpu_milli": 1234.5, "placements_sha256": "ab" * 32,
+        # extra service-side keys are ignored, not copied
+        "job": "deadbeef", "placed_node": [0] * 40,
+    }
+    terms = terms_from_result(doc)
+    assert set(terms) == {
+        "weights", "seed", "events", "pods", "placed", "failed",
+        "unscheduled", "gpu_total_milli", "gpu_alloc_pct",
+        "frag_gpu_milli", "placements_sha256",
+    }
+    assert json.dumps(terms, sort_keys=True) == json.dumps(
+        {k: doc[k] for k in terms}, sort_keys=True
+    )
+
+
+# ---------------------------------------------------------------------------
+# 4. the loop on the local backend (device; the tier-1 slice's one
+#    compiled family)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def synth():
+    rng = np.random.default_rng(3)
+    return _mk_cluster(rng), _mk_pods(rng)
+
+
+CFG = dict(algo="es", generations=3, popsize=4, sigma=300.0, lr=400.0,
+           seed=9)
+
+
+def test_local_tune_log_resume_and_zero_recompile(synth, tmp_path):
+    """One small tuning run pins four contracts at once (one compile
+    family — the tier-1 budget): (a) the signed log round-trips with
+    one record per generation; (b) a same-seed re-run reproduces it
+    byte-identically; (c) killing after generation 1 and resuming
+    yields the SAME bytes as the uninterrupted run; (d) the whole run
+    dispatched ONE compiled sweep executable."""
+    nodes, pods = synth
+    cfg = TuneConfig(**CFG)
+
+    sim = make_family_sim(nodes, pods, FAM)
+    backend = LocalRollout(sim, width=cfg.popsize)
+    log_a = str(tmp_path / "a.jsonl")
+    result = run_tune(backend, FAM, cfg, log_a)
+
+    # (a) signed log: one record per generation, state present, the
+    # best-so-far is monotone
+    header, records = read_log(log_a)
+    assert header["config"]["algo"] == "es"
+    assert [r["gen"] for r in records] == [0, 1, 2]
+    bests = [r["best"]["objective"] for r in records]
+    assert bests == sorted(bests)
+    assert result.best_objective == bests[-1]
+    for r in records:
+        assert len(r["population"]) == cfg.popsize
+        assert len(r["terms"]) == len(r["unique"])
+        assert r["state"]["algo"] == "es"
+
+    # (b) byte-identical re-run (same backend: the jaxpr is cached, the
+    # trajectory is seed-determined)
+    log_b = str(tmp_path / "b.jsonl")
+    run_tune(backend, FAM, cfg, log_b)
+    with open(log_a, "rb") as f:
+        bytes_a = f.read()
+    with open(log_b, "rb") as f:
+        assert f.read() == bytes_a
+
+    # (c) kill/resume equivalence: 2 generations, then resume to 3
+    log_c = str(tmp_path / "c.jsonl")
+    run_tune(backend, FAM, TuneConfig(**{**CFG, "generations": 2}), log_c)
+    run_tune(backend, FAM, cfg, log_c, resume=True)
+    with open(log_c, "rb") as f:
+        assert f.read() == bytes_a
+
+    # resume under a different trajectory config fails loudly
+    with pytest.raises(ValueError, match="different config"):
+        run_tune(
+            backend, FAM, TuneConfig(**{**CFG, "seed": 10}), log_c,
+            resume=True,
+        )
+
+    # (d) zero recompiles: every generation of every run above rode one
+    # compiled sweep executable
+    assert backend.executables() == 1
+
+
+def test_tuning_curve_emitter(synth, tmp_path):
+    """The obs tuning-curve emitter renders straight from log records."""
+    from tpusim.obs.emitters import format_tuning_curve, tuning_curve_series
+
+    nodes, pods = synth
+    cfg = TuneConfig(**CFG)
+    sim = make_family_sim(nodes, pods, FAM)
+    backend = LocalRollout(sim, width=cfg.popsize)
+    log = str(tmp_path / "t.jsonl")
+    run_tune(backend, FAM, cfg, log)
+    _, records = read_log(log)
+
+    tracks = tuning_curve_series(records)
+    assert tracks["tune_gen"] == [0, 1, 2]
+    assert len(tracks["tune_best"]) == 3
+    assert tracks["tune_best"] == sorted(tracks["tune_best"])
+    text = format_tuning_curve(records)
+    assert "3 generations" in text and "best" in text
+    assert format_tuning_curve([]) == "[tune] no generations recorded"
+
+
+def test_lane_terms_match_backend(synth):
+    """LocalRollout's term dicts are lane_terms of the sweep lanes, and
+    carry the unscheduled/gpu_total fields the driver now exposes."""
+    nodes, pods = synth
+    sim = make_family_sim(nodes, pods, FAM)
+    backend = LocalRollout(sim, width=2)
+    terms = backend.rollout([(1000, 500), (500, 1000)], seed=42)
+    assert len(terms) == 2
+    lanes = sim.run_sweep(
+        np.asarray([[1000, 500], [500, 1000]], np.int32), seeds=[42, 42]
+    )
+    for t, lane in zip(terms, lanes):
+        assert t == lane_terms(lane)
+        assert t["unscheduled"] == lane.unscheduled
+        assert t["gpu_total_milli"] == sim.node_total_milli_gpu
+        assert t["pods"] == len(pods)
+    # a dedup-shrunk generation must not exceed the backend width
+    with pytest.raises(ValueError, match="exceed the backend width"):
+        backend.rollout([(1, 1), (2, 2), (3, 3)], seed=42)
+
+
+# ---------------------------------------------------------------------------
+# 5. local vs remote: identical tuning logs (slow — HTTP + worker thread)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_local_vs_remote_log_identical(synth, tmp_path):
+    """The remote backend (a real `serve --jobs` service over HTTP)
+    reproduces the local backend's tuning log bit-identically under the
+    same seed — the ISSUE 9 acceptance contract. CMA here so both
+    optimizer families cross a real rollout path somewhere."""
+    from tpusim.learn import RemoteRollout
+    from tpusim.svc import jobs as svc_jobs
+    from tpusim.svc.api import start_job_server
+    from tpusim.svc.worker import TraceRef
+
+    nodes, pods = synth
+    cfg = TuneConfig(algo="cma", generations=3, popsize=4, sigma=300.0,
+                     seed=9)
+
+    sim = make_family_sim(nodes, pods, FAM)
+    local_log = str(tmp_path / "local.jsonl")
+    run_tune(LocalRollout(sim, width=cfg.popsize), FAM, cfg, local_log)
+
+    trace = TraceRef(
+        "default", nodes, pods, svc_jobs.trace_digest(nodes, pods)
+    )
+    art = tmp_path / "art"
+    art.mkdir()
+    srv, service, worker = start_job_server(
+        str(art), {"default": trace}, listen=":0",
+        lane_width=cfg.popsize, queue_size=16,
+    )
+    try:
+        remote_log = str(tmp_path / "remote.jsonl")
+        run_tune(
+            RemoteRollout(srv.url, FAM), FAM, cfg, remote_log
+        )
+    finally:
+        worker.stop()
+        srv.stop()
+    with open(local_log, "rb") as fa, open(remote_log, "rb") as fb:
+        assert fa.read() == fb.read()
+
+
+# ---------------------------------------------------------------------------
+# 6. openb acceptance (slow; `make resume-smoke`)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_openb_tune_acceptance(tmp_path):
+    """ISSUE 9 acceptance on an openb prefix: tuning on the train
+    prefix strictly improves the scalarized objective over the
+    paper-default weights on the HELD-OUT trace suffix, with zero
+    recompiles after generation 1 and a signed resumable log."""
+    from tpusim.io.trace import load_node_csv, load_pod_csv
+    from tpusim.learn import format_holdout_report, holdout_report
+
+    nodes = load_node_csv(
+        os.path.join(REPO, "data/csv/openb_node_list_gpu_node.csv")
+    )
+    pods = load_pod_csv(
+        os.path.join(REPO, "data/csv/openb_pod_list_default.csv")
+    )[:400]
+    n_train = len(pods) - len(pods) // 5  # the CLI's --holdout 0.2 split
+    train, held = pods[:n_train], pods[n_train:]
+
+    cfg = TuneConfig(algo="es", generations=4, popsize=6, sigma=300.0,
+                     lr=400.0, seed=1)
+    sim = make_family_sim(nodes, train, FAM)
+    backend = LocalRollout(sim, width=cfg.popsize)
+    log = str(tmp_path / "openb.jsonl")
+
+    # generation 1 alone, then kill/resume to 4: zero recompiles after
+    # generation 1 (the wrapper's executable count is a process-global
+    # jit cache, so the contract is STABILITY, not an absolute count —
+    # earlier tests in this process may have compiled other shapes)
+    run_tune(backend, FAM, TuneConfig(**{**cfg.__dict__,
+                                         "generations": 1}), log)
+    execs_after_g1 = backend.executables()
+    result = run_tune(backend, FAM, cfg, log, resume=True)
+    assert backend.executables() == execs_after_g1
+
+    # the resumed log is byte-identical to an uninterrupted run's
+    log_b = str(tmp_path / "openb_b.jsonl")
+    run_tune(backend, FAM, cfg, log_b)
+    with open(log, "rb") as fa, open(log_b, "rb") as fb:
+        assert fa.read() == fb.read()
+
+    # held-out suffix: tuned strictly beats the paper-default weights
+    eval_sim = make_family_sim(nodes, held, FAM)
+    report = holdout_report(
+        eval_sim, FAM, result.best_weights, eval_seed=cfg.eval_seed
+    )
+    text = format_holdout_report(report, FAM)
+    assert report["improvement"] > 0, text
+    assert "tuned beats default" in text

@@ -630,3 +630,130 @@ def test_openb_service_acceptance(tmp_path):
     finally:
         worker.stop()
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# 8. ISSUE 9 satellites: singular grid keys, shared poll backoff,
+#    nonzero submit exit on failed jobs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("singular,plural", [
+    ("weight", "weights"), ("seed", "seeds"), ("tune", "tunes"),
+])
+def test_grid_singular_keys_rejected(singular, plural):
+    """Every singular form of a per-row vector fails LOUDLY, naming its
+    plural — a typo'd grid must never run rows at the defaults."""
+    with pytest.raises(ValueError) as err:
+        svc_jobs.jobs_from_grid(
+            {"weights": [[1], [2]], singular: 7}
+            if singular != "weight" else
+            {"weights": [[1], [2]], "weight": [3]}
+        )
+    msg = str(err.value)
+    assert f'"{singular}"' in msg and f'"{plural}"' in msg
+
+
+def test_wait_jobs_uses_shared_backoff(monkeypatch):
+    """The poll loop sleeps the kube_client capped-exponential-with-
+    jitter schedule (ONE shared utility): idle rounds escalate the
+    attempt count, any job reaching terminal resets it."""
+    from tpusim.svc import client
+
+    # job j1 turns done on the 2nd poll, j2 on the 5th
+    polls = {"n": 0}
+
+    def fake_request(url, data=None, timeout=30.0):
+        jid = url.rsplit("/", 1)[-1]
+        if jid == "j1":
+            status = "done" if polls["n"] >= 1 else "running"
+        else:
+            status = "done" if polls["n"] >= 4 else "running"
+        return 200, {}, {"id": jid, "status": status}
+
+    attempts = []
+
+    def fake_delay(attempt, retry_after=None):
+        attempts.append(attempt)
+        return 0.0
+
+    slept = []
+    monkeypatch.setattr(client, "_request", fake_request)
+    monkeypatch.setattr(client, "_retry_delay_s", fake_delay)
+
+    def fake_sleep(s):
+        slept.append(s)
+        polls["n"] += 1
+
+    monkeypatch.setattr(client.time, "sleep", fake_sleep)
+    final = client.wait_jobs("http://x", ["j1", "j2"], timeout=60)
+    assert [d["status"] for d in final] == ["done", "done"]
+    # round 0: both running -> attempt 1; round 1: j1 done (progress) ->
+    # reset to 1; rounds 2..: idle polls escalate 2, 3
+    assert attempts == [1, 1, 2, 3]
+
+
+def test_wait_jobs_poll_cap(monkeypatch):
+    """poll_s > 0 caps the shared-backoff delay (the fast-test knob)."""
+    from tpusim.svc import client
+
+    calls = {"n": 0}
+
+    def fake_request(url, data=None, timeout=30.0):
+        calls["n"] += 1
+        status = "done" if calls["n"] >= 3 else "running"
+        return 200, {}, {"id": "j1", "status": status}
+
+    slept = []
+    monkeypatch.setattr(client, "_request", fake_request)
+    monkeypatch.setattr(client.time, "sleep", slept.append)
+    client.wait_jobs("http://x", ["j1"], timeout=60, poll_s=0.01)
+    assert slept and all(s <= 0.01 for s in slept)
+
+
+def test_submit_exits_nonzero_on_failed_job(trace, tmp_path, monkeypatch):
+    """A server-side job failure surfaces as JobsFailed carrying the
+    done jobs' results, and `tpusim submit` exits nonzero while still
+    printing the partial table."""
+    import threading
+
+    from tpusim.cli import main as cli_main
+    from tpusim.svc.api import start_job_server
+    from tpusim.svc.client import JobsFailed, submit_and_wait
+    from tpusim.svc.worker import Worker
+
+    real_dispatch = Worker._dispatch
+
+    def poisoned(self, batch):
+        # split by family: the worst-gpu_sel family is the poisoned one
+        if batch[0].spec.gpu_sel == "worst":
+            raise RuntimeError("poisoned family")
+        return real_dispatch(self, batch)
+
+    monkeypatch.setattr(Worker, "_dispatch", poisoned)
+    srv, service, worker = start_job_server(
+        str(tmp_path), {"default": trace}, listen=":0", lane_width=2,
+        queue_size=8,
+    )
+    try:
+        good = {"policies": FAM, "weights": [1000, 500], "seed": 1}
+        bad = {"policies": FAM, "weights": [1000, 500], "seed": 1,
+               "gpu_sel": "worst"}
+        with pytest.raises(JobsFailed) as err:
+            submit_and_wait(srv.url, [good, bad], timeout=120)
+        assert len(err.value.failed) == 1
+        assert "poisoned family" in err.value.failed[0]["error"]
+        assert len(err.value.results) == 1  # the good job's result rode along
+        assert err.value.results[0]["placed"] >= 0
+
+        # the CLI surface: nonzero exit, partial table still printed
+        jobs_file = tmp_path / "jobs.json"
+        jobs_file.write_text(json.dumps([good, bad]))
+        rc = cli_main(
+            ["submit", str(jobs_file), "--url", srv.url,
+             "--timeout", "120"]
+        )
+        assert rc == 1
+    finally:
+        worker.stop()
+        srv.stop()

@@ -546,6 +546,34 @@ def load_weights_payload(path: str):
     return weights, seeds, tunes
 
 
+def save_weights_payload(path: str, weights, seeds=None, tunes=None,
+                         policies=None) -> str:
+    """Write a weights-grid JSON in the exact shape load_weights_payload /
+    `tpusim submit` read back — the shared weights-payload I/O (ISSUE 9):
+    `tpusim tune --best-out` persists its tuned vector here so the next
+    `apply --sweep-weights` or `submit` run replays it unchanged. Rows
+    are coerced to plain ints (the engines' i32 operand space); the
+    optional `policies` key names the columns for submit's grid form.
+    Atomic (tmp + rename) like every other artifact writer."""
+    import json
+
+    doc = {"weights": [[int(w) for w in row] for row in weights]}
+    if seeds is not None:
+        doc["seeds"] = [int(s) for s in seeds]
+    if tunes is not None:
+        doc["tunes"] = [float(t) for t in tunes]
+    if policies is not None:
+        doc["policies"] = [[str(n), int(w)] for n, w in policies]
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
 def _interactive_select(apps):
     """Multi-select confirmation (apply.go:172-189, survey lib)."""
     print("Confirm your apps (comma-separated indices, empty = all):")

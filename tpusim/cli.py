@@ -17,6 +17,11 @@ verbs (ISSUE 4) and the live-telemetry verbs (ISSUE 5):
            (ISSUE 7: POST /jobs, GET /jobs/<id>[/result], GET /queue)
   submit   POST what-if jobs to a `serve --jobs` service, wait, and
            print the per-job results
+  tune     learned-scoring lane (ISSUE 9): ES/CMA tuning of the
+           per-policy score weights over the vectorized sweep, local
+           or against a `serve --jobs` rollout service, with a
+           digest-signed resumable tuning log and a held-out
+           tuned-vs-default report
   version  print version/commit (ref: cmd/version/version.go)
   gen-doc  emit markdown docs for the CLI tree (ref: cmd/doc/)
   debug    scaffold, intentionally empty (ref: cmd/debug/debug.go)
@@ -261,6 +266,119 @@ def _build_parser() -> argparse.ArgumentParser:
         "with 429 + Retry-After",
     )
 
+    # the learned-scoring lane (ISSUE 9; README "Tune policy weights"):
+    # ES/CMA weight tuning over the vectorized sweep, with the job plane
+    # as an optional remote rollout farm
+    p_tune = sub.add_parser(
+        "tune",
+        help="tune the per-policy score weights with ES/CMA over the "
+        "vectorized sweep (one compiled scan per generation; --url "
+        "offloads rollouts to a `serve --jobs` service) and report "
+        "tuned-vs-default on a held-out trace suffix",
+    )
+    p_tune.add_argument(
+        "--nodes", required=True, metavar="CSV",
+        help="node CSV of the tuning trace",
+    )
+    p_tune.add_argument(
+        "--pods", required=True, metavar="CSV",
+        help="pod CSV of the tuning trace",
+    )
+    p_tune.add_argument(
+        "--max-pods", type=int, default=0, metavar="N",
+        help="truncate the workload to its first N pods (0 = all)",
+    )
+    p_tune.add_argument(
+        "--policies", default='[["FGDScore", 1000], ["BestFitScore", 500]]',
+        metavar="JSON",
+        help="policy family as [[name, default_weight], ...]; the "
+        "default weights seed the optimizer AND are the held-out "
+        "report's baseline",
+    )
+    p_tune.add_argument(
+        "--algo", choices=("es", "cma"), default="es",
+        help="optimizer: antithetic OpenAI-ES or diagonal CMA-ES",
+    )
+    p_tune.add_argument("--generations", type=int, default=10)
+    p_tune.add_argument("--popsize", type=int, default=8)
+    p_tune.add_argument(
+        "--sigma", type=float, default=250.0,
+        help="initial perturbation scale in weight units",
+    )
+    p_tune.add_argument(
+        "--lr", type=float, default=300.0,
+        help="ES step size in weight units (cma adapts its own)",
+    )
+    p_tune.add_argument(
+        "--seed", type=int, default=0,
+        help="optimizer draw seed (fixed seed -> byte-identical log)",
+    )
+    p_tune.add_argument(
+        "--eval-seed", type=int, default=42,
+        help="replay seed every candidate shares (common random numbers)",
+    )
+    p_tune.add_argument("--w-min", type=int, default=0)
+    p_tune.add_argument("--w-max", type=int, default=4000)
+    p_tune.add_argument(
+        "--obj-alloc", type=float, default=1.0,
+        help="objective weight on gpu_alloc_pct",
+    )
+    p_tune.add_argument(
+        "--obj-frag", type=float, default=1.0,
+        help="objective weight on frag percent of cluster GPU",
+    )
+    p_tune.add_argument(
+        "--obj-unsched", type=float, default=1.0,
+        help="objective weight on unscheduled percent of pods",
+    )
+    p_tune.add_argument(
+        "--holdout", type=float, default=0.2, metavar="FRAC",
+        help="trailing fraction of the pod list held out of tuning and "
+        "used for the final tuned-vs-default report (0 disables)",
+    )
+    p_tune.add_argument(
+        "--log", default=os.path.join(".tpusim_obs", "tune_log.jsonl"),
+        metavar="PATH",
+        help="digest-signed tuning log (JSONL; the --resume input and "
+        "the `analysis --plot-tuning` source)",
+    )
+    p_tune.add_argument(
+        "--resume", action="store_true",
+        help="continue from the log's last generation (byte-identical "
+        "to an uninterrupted run under the same flags)",
+    )
+    p_tune.add_argument(
+        "--url", default="", metavar="URL",
+        help="offload rollouts to a `tpusim serve --jobs` service (it "
+        "must host the tuning trace prefix); default: local vmapped "
+        "sweeps",
+    )
+    p_tune.add_argument(
+        "--engine", choices=("auto", "table", "sequential"),
+        default="auto", help="replay engine for the rollouts",
+    )
+    p_tune.add_argument(
+        "--best-out", default="", metavar="PATH",
+        help="write the tuned weight vector as a weights-grid JSON "
+        "(apply --sweep-weights / submit shape)",
+    )
+    p_tune.add_argument(
+        "--robust-mtbf", type=float, default=0.0, metavar="EVENTS",
+        help="per-generation robustness eval: replay the generation "
+        "best through seeded fault injection with this MTBF (0 = off; "
+        "logged, not fed back into the optimizer)",
+    )
+    p_tune.add_argument(
+        "--robust-mttr", type=float, default=0.0, metavar="EVENTS",
+        help="mean events until a failed node recovers in the "
+        "robustness eval",
+    )
+    p_tune.add_argument("--robust-seed", type=int, default=0)
+    p_tune.add_argument(
+        "--timeout", type=float, default=600.0, metavar="SECONDS",
+        help="per-generation wait budget on the remote backend",
+    )
+
     p_submit = sub.add_parser(
         "submit",
         help="POST what-if jobs to a `tpusim serve --jobs` replay "
@@ -488,16 +606,152 @@ def _serve_jobs(args) -> int:
     return 0
 
 
+def cmd_tune(args) -> int:
+    """`tpusim tune`: the learned-scoring lane's CLI (ISSUE 9)."""
+    from tpusim.learn import (
+        LocalRollout,
+        ObjectiveConfig,
+        RemoteRollout,
+        TuneConfig,
+        format_holdout_report,
+        holdout_report,
+        make_family_sim,
+        make_robust_eval,
+        run_tune,
+    )
+    from tpusim.policies import POLICY_NAMES
+    from tpusim.svc.client import ServiceError
+    from tpusim.svc.worker import load_trace
+
+    try:
+        policies = [
+            (str(n), int(w)) for n, w in json.loads(args.policies)
+        ]
+        for name, _ in policies:
+            if name not in POLICY_NAMES:
+                raise ValueError(
+                    f"unknown policy {name!r} (known: "
+                    f"{', '.join(POLICY_NAMES)})"
+                )
+        if not 0.0 <= args.holdout < 1.0:
+            raise ValueError(
+                f"--holdout must be in [0, 1), got {args.holdout}"
+            )
+        trace = load_trace(
+            "default", args.nodes, args.pods, max_pods=args.max_pods
+        )
+        n_train = len(trace.pods) - int(len(trace.pods) * args.holdout)
+        train, held = trace.pods[:n_train], trace.pods[n_train:]
+        if not train:
+            raise ValueError("no training pods left after the holdout split")
+
+        cfg = TuneConfig(
+            algo=args.algo, generations=args.generations,
+            popsize=args.popsize, sigma=args.sigma, lr=args.lr,
+            seed=args.seed, eval_seed=args.eval_seed,
+            w_lo=args.w_min, w_hi=args.w_max,
+            objective=ObjectiveConfig(
+                w_alloc=args.obj_alloc, w_frag=args.obj_frag,
+                w_unsched=args.obj_unsched,
+            ),
+        )
+        if args.url:
+            # the service must host the SAME train prefix this CLI
+            # computed (serve --jobs --max-pods), else the tuned vector
+            # describes a different workload
+            print(
+                f"[tune] remote rollouts via {args.url} (service must "
+                f"host the {len(train)}-pod train prefix of "
+                f"{os.path.basename(args.pods)})", file=sys.stderr,
+            )
+            backend = RemoteRollout(
+                args.url, policies, engine=args.engine,
+                timeout=args.timeout, out=sys.stderr,
+            )
+        else:
+            sim = make_family_sim(
+                trace.nodes, train, policies, engine=args.engine
+            )
+            backend = LocalRollout(sim, width=args.popsize)
+
+        robust_eval, robust_meta = None, None
+        if args.robust_mtbf > 0:
+            from tpusim.sim.faults import FaultConfig
+
+            robust_eval = make_robust_eval(
+                trace.nodes, train, policies,
+                FaultConfig(
+                    mtbf_events=args.robust_mtbf,
+                    mttr_events=args.robust_mttr,
+                    seed=args.robust_seed,
+                ),
+            )
+            # lands in the log header: the robustness knobs shape the
+            # log's bytes, so a resume under different ones must fail
+            # loudly instead of writing a mixed log
+            robust_meta = {
+                "mtbf": float(args.robust_mtbf),
+                "mttr": float(args.robust_mttr),
+                "seed": int(args.robust_seed),
+            }
+
+        result = run_tune(
+            backend, policies, cfg, args.log, resume=args.resume,
+            robust_eval=robust_eval, robust_meta=robust_meta,
+            out=sys.stderr,
+        )
+
+        from tpusim.obs.emitters import format_tuning_curve
+
+        print(format_tuning_curve(result.records))
+        print(
+            f"[tune] best weights "
+            f"{','.join(str(w) for w in result.best_weights)} "
+            f"(objective {result.best_objective:+.4f}) after "
+            f"{len(result.records)} generations -> {result.log_path}"
+        )
+        if held:
+            eval_sim = make_family_sim(
+                trace.nodes, held, policies, engine=args.engine
+            )
+            report = holdout_report(
+                eval_sim, policies, result.best_weights,
+                objective=cfg.objective, eval_seed=cfg.eval_seed,
+            )
+            print(format_holdout_report(report, policies))
+        if args.best_out:
+            from tpusim.apply import save_weights_payload
+
+            path = save_weights_payload(
+                args.best_out, [result.best_weights], policies=policies
+            )
+            print(f"[tune] wrote tuned weights payload {path}",
+                  file=sys.stderr)
+    except ServiceError as err:
+        # remote-backend failures (service down, job failed server-side,
+        # wait timeout) exit 1 like `tpusim submit` — the run state is
+        # safe: the log holds every completed generation and --resume
+        # continues from it
+        print(f"tpusim tune: {err}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError, json.JSONDecodeError) as err:
+        print(f"tpusim tune: {err}", file=sys.stderr)
+        return 2
+    return 0
+
+
 def cmd_submit(args) -> int:
     from tpusim.svc.client import (
+        JobsFailed,
         ServiceError,
         format_results_table,
         submit_and_wait,
     )
     from tpusim.svc.jobs import docs_from_payload
 
-    # same exit discipline as explain/diff/report: 2 on unusable input
-    # or a failed service round-trip, with a one-line error
+    # exit discipline: 2 on unusable input or a failed round-trip (one-
+    # line error), 1 when the service ran but some JOBS failed — partial
+    # results still print, the exit code never reads as success
     try:
         with open(args.jobs) as f:
             payload = json.load(f)
@@ -507,6 +761,16 @@ def cmd_submit(args) -> int:
         results = submit_and_wait(
             args.url, docs, timeout=args.timeout, out=sys.stderr
         )
+    except JobsFailed as err:
+        if err.results:
+            print(format_results_table(err.results))
+        for d in err.failed:
+            print(
+                f"[submit] FAILED {d['id']}: {d.get('error', '?')}",
+                file=sys.stderr,
+            )
+        print(f"tpusim submit: {err}", file=sys.stderr)
+        return 1
     except (OSError, ValueError, json.JSONDecodeError,
             ServiceError) as err:
         print(f"tpusim submit: {err}", file=sys.stderr)
@@ -539,6 +803,8 @@ def main(argv=None) -> int:
         return cmd_report(args)
     if args.command == "serve":
         return cmd_serve(args)
+    if args.command == "tune":
+        return cmd_tune(args)
     if args.command == "submit":
         return cmd_submit(args)
     if args.command == "version":

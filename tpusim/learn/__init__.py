@@ -1,0 +1,42 @@
+"""tpusim.learn — the learned-scoring lane (ISSUE 9).
+
+Gradient-free tuning of the per-policy score weights over the
+vectorized sweep: seeded antithetic OpenAI-ES (learn.es) and a minimal
+diagonal CMA-ES (learn.cma) propose continuous weight vectors, projected
+and dedup'd onto the engines' i32 operand space (learn.rollout), rolled
+out through one compiled vmapped scan per generation locally or through
+the `tpusim serve --jobs` replay service remotely (learn.rollout), and
+scored on the paper's own metrics — gpu_alloc up, FGD frag down,
+unscheduled bounded (learn.objective). The generation loop (learn.loop,
+`tpusim tune`) keeps a digest-signed resumable tuning log whose bytes
+are identical across backends and across kill/resume under a fixed seed.
+"""
+
+from tpusim.learn.cma import DiagonalCMA  # noqa: F401
+from tpusim.learn.es import OpenAIES, centered_ranks  # noqa: F401
+from tpusim.learn.loop import (  # noqa: F401
+    LOG_SCHEMA,
+    TuneConfig,
+    TuneResult,
+    format_holdout_report,
+    holdout_report,
+    make_optimizer,
+    read_log,
+    run_tune,
+    write_log,
+)
+from tpusim.learn.objective import (  # noqa: F401
+    ObjectiveConfig,
+    lane_terms,
+    make_robust_eval,
+    scalarize,
+    terms_from_result,
+    terms_from_simulate,
+)
+from tpusim.learn.rollout import (  # noqa: F401
+    LocalRollout,
+    RemoteRollout,
+    dedup_rows,
+    make_family_sim,
+    project_weights,
+)

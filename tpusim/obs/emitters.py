@@ -294,9 +294,19 @@ def chrome_counter_events(
     spans = list(spans)
     dicts = [s.to_dict() if hasattr(s, "to_dict") else dict(s) for s in spans]
     windows = [d for d in dicts if d.get("name") == "scan"] or dicts
+
+    def _end_s(d):
+        # the stretch the "X" slices actually render: dispatch + block
+        # when the span recorded them (profiled runs — the only ones
+        # emitting traces). total_s can run past that by whatever host
+        # pause hit between dispatched() and span exit, which would
+        # strand the tail counter points beyond every rendered slice.
+        halves = d.get("dispatch_s", 0) + d.get("block_s", 0)
+        return d["start_s"] + (halves if halves > 0 else d.get("total_s", 0))
+
     if windows:
         t0 = min(d["start_s"] for d in windows) * 1e6
-        t1 = max(d["start_s"] + d.get("total_s", 0) for d in windows) * 1e6
+        t1 = max(_end_s(d) for d in windows) * 1e6
     else:
         t0, t1 = 0.0, 1e6
     events: List[dict] = []
@@ -372,3 +382,76 @@ def emit_all(telemetry, jsonl: str = "", metrics: str = "", trace: str = "",
         record, telemetry.spans, jsonl=jsonl, metrics=metrics, trace=trace,
         counter_series=counter_series,
     )
+
+
+# ---------------------------------------------------------------------------
+# Tuning-curve emitter (ISSUE 9) — the learned-scoring lane's telemetry
+# ---------------------------------------------------------------------------
+#
+# A tuning log (tpusim.learn.loop, digest-signed JSONL) is a generation
+# series, not an event series — but it renders through the same two
+# surfaces the in-scan series plane uses: a per-track value map (the
+# Chrome-counter / plot vocabulary, consumed by `analysis --plot-tuning`)
+# and a terminal sparkline summary (the `tpusim report` idiom, printed by
+# `tpusim tune` when the loop finishes).
+
+
+def tuning_curve_series(records) -> dict:
+    """Tuning-log generation records -> track name -> per-generation
+    values. Tracks: the per-generation best objective, the running best,
+    the population mean/min objective, the optimizer's step scale, and
+    (when the robustness eval ran) the faulted objective of each
+    generation's best candidate."""
+    import numpy as np
+
+    gens = [int(r["gen"]) for r in records]
+    out = {
+        "tune_gen": gens,
+        "tune_gen_best": [float(r["gen_best"]["objective"])
+                          for r in records],
+        "tune_best": [float(r["best"]["objective"]) for r in records],
+        "tune_mean": [
+            float(np.mean(r["objectives"])) for r in records
+        ],
+        "tune_min": [
+            float(np.min(r["objectives"])) for r in records
+        ],
+        "tune_sigma": [float(r["state"]["sigma"]) for r in records],
+        "tune_unique": [len(r["unique"]) for r in records],
+    }
+    if records and all("robust" in r for r in records):
+        # all-or-none: a partial column could not align with the
+        # generation axis (mixed logs are unwritable since the robust
+        # knobs joined the resume-checked header, but an emitter must
+        # not crash on a foreign file either)
+        out["tune_robust"] = [
+            float(r["robust"]["objective"]) for r in records
+        ]
+    return out
+
+
+def format_tuning_curve(records) -> str:
+    """Terminal summary of a tuning run: one sparkline per curve (the
+    obs.series report idiom) plus first/last values — reads straight
+    from the log records, no recomputation."""
+    from tpusim.obs.series import sparkline
+
+    if not records:
+        return "[tune] no generations recorded"
+    tracks = tuning_curve_series(records)
+    gens = tracks.pop("tune_gen")
+    lines = [
+        f"[tune] {len(gens)} generations "
+        f"(gen {gens[0]}..{gens[-1]})",
+        f"  {'curve':<16}{'first':>12}{'last':>12}  trend",
+    ]
+    for name in ("tune_gen_best", "tune_best", "tune_mean",
+                 "tune_robust", "tune_sigma", "tune_unique"):
+        vals = tracks.get(name)
+        if not vals:
+            continue
+        lines.append(
+            f"  {name[5:]:<16}{vals[0]:>12.4f}{vals[-1]:>12.4f}  "
+            f"{sparkline(vals)}"
+        )
+    return "\n".join(lines)

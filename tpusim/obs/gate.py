@@ -407,6 +407,98 @@ def svc_smoke(nodes, pods, out_dir: str, b: int = 4) -> Tuple[bool, List[str]]:
     return True, msgs
 
 
+def tune_smoke(out_dir: str, generations: int = 3) -> Tuple[bool, List[str]]:
+    """ISSUE 9 satellite (`make tune-smoke`): run the learned-scoring
+    loop on a tiny synthetic trace for a few generations on the LOCAL
+    backend and hard-check the lane's contracts — (a) zero recompiles
+    after generation 1 (every generation's population rides ONE compiled
+    sweep executable; jit._cache_size() via the backend's tracked
+    wrapper), (b) the digest-signed tuning log reads back (signature
+    verifies, one record per generation, optimizer state present), and
+    (c) a resume of the finished log under the same flags is a no-op
+    that reproduces the file byte-identically. Any exception is a FAIL
+    verdict, not a traceback."""
+    msgs: List[str] = []
+    try:
+        import numpy as np
+
+        from tpusim.io.trace import NodeRow, PodRow
+        from tpusim.learn import (
+            LocalRollout,
+            TuneConfig,
+            make_family_sim,
+            read_log,
+            run_tune,
+        )
+
+        rng = np.random.default_rng(11)
+        nodes = [
+            NodeRow(f"n{i:03d}", 32000, 131072, int(g),
+                    "V100M16" if g else "")
+            for i, g in enumerate(rng.choice([0, 2, 4, 8], 16))
+        ]
+        pods = []
+        for i in range(48):
+            gpu = int(rng.choice([0, 1, 2]))
+            milli = 1000 if gpu > 1 else int(rng.choice([300, 500, 1000]))
+            if gpu == 0:
+                milli = 0
+            pods.append(PodRow(
+                f"p{i:04d}", int(rng.choice([1000, 2000, 4000])), 2048,
+                gpu, milli,
+            ))
+        policies = [("FGDScore", 1000), ("BestFitScore", 500)]
+        cfg = TuneConfig(algo="es", generations=generations, popsize=4,
+                         sigma=300.0, lr=400.0, seed=3)
+        log_path = os.path.join(out_dir, "tune_smoke_log.jsonl")
+        if os.path.isfile(log_path):
+            os.unlink(log_path)
+
+        sim = make_family_sim(nodes, pods, policies)
+        backend = LocalRollout(sim, width=cfg.popsize)
+        result = run_tune(backend, policies, cfg, log_path)
+
+        execs = backend.executables()
+        if execs != 1:
+            return False, [
+                f"[gate] tune: expected ONE compiled sweep executable "
+                f"across {generations} generations, found {execs} (FAIL)"
+            ]
+        header, records = read_log(log_path)  # signature verifies here
+        if len(records) != generations or any(
+            "state" not in r for r in records
+        ):
+            return False, [
+                f"[gate] tune: log carries {len(records)} records for "
+                f"{generations} generations (FAIL)"
+            ]
+        with open(log_path, "rb") as f:
+            before = f.read()
+        resumed = run_tune(backend, policies, cfg, log_path, resume=True)
+        with open(log_path, "rb") as f:
+            after = f.read()
+        if before != after:
+            return False, [
+                "[gate] tune: resume of a finished log rewrote it "
+                "differently (FAIL)"
+            ]
+        if resumed.best_weights != result.best_weights:
+            return False, [
+                "[gate] tune: resume diverged from the original best "
+                "(FAIL)"
+            ]
+        msgs.append(
+            f"[gate] tune: {generations} generations x {cfg.popsize} "
+            f"candidates on one compiled sweep (zero recompiles), log "
+            f"signed + resume byte-identical — best "
+            f"{','.join(str(w) for w in result.best_weights)} at "
+            f"{result.best_objective:+.4f}"
+        )
+    except Exception as err:
+        return False, [f"[gate] tune: FAIL ({type(err).__name__}: {err})"]
+    return True, msgs
+
+
 def metrics_scrape_check(record: dict, prom_path: str) -> Tuple[bool, str]:
     """ISSUE 5 satellite: publish the smoke record to an ephemeral
     MonitorServer, scrape /metrics over real HTTP, and require (a) the
@@ -470,7 +562,18 @@ def main(argv=None) -> int:
         help="run only the replay-service smoke (ISSUE 7) — the "
         "`make svc-smoke` mode",
     )
+    ap.add_argument(
+        "--tune-only", action="store_true",
+        help="run only the learned-scoring smoke (ISSUE 9) — the "
+        "`make tune-smoke` mode",
+    )
     args = ap.parse_args(argv)
+
+    if args.tune_only:
+        ok, msgs = tune_smoke(args.out)
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
 
     base = latest_baseline()
     sys.path.insert(0, REPO)
@@ -532,7 +635,11 @@ def main(argv=None) -> int:
     # across a weights+tune wave
     svc_ok, svc_msgs = svc_smoke(nodes, pods, args.out)
     print("\n".join(svc_msgs))
-    smoke_ok = dec_ok and scrape_ok and swp_ok and svc_ok
+    # learned-scoring smoke (ISSUE 9 satellite): the tuning loop on one
+    # compiled sweep — zero recompiles, signed resumable log
+    tune_ok, tune_msgs = tune_smoke(args.out)
+    print("\n".join(tune_msgs))
+    smoke_ok = dec_ok and scrape_ok and swp_ok and svc_ok and tune_ok
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

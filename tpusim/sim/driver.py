@@ -2801,6 +2801,12 @@ class SweepLane:
     failed: int  # creation attempts rejected
     gpu_alloc_pct: float
     frag_gpu_milli: float
+    # pods that ended the trace unplaced AFTER a rejected creation — the
+    # schedule_pods_with_faults "unscheduled" semantics (a later retry may
+    # place an ever-failed pod; a placed-then-deleted pod is neither).
+    # The learned-scoring objective's third term (ISSUE 9): gpu_alloc up,
+    # frag down, unscheduled bounded.
+    unscheduled: int = 0
 
 
 def _sweep_engine(engine, table: bool):
@@ -2922,6 +2928,7 @@ def _slice_sweep_lane(out, amounts, i, wrow, seed, p, e, pad_skips):
         failed=int(failed_i.sum()),
         gpu_alloc_pct=alloc,
         frag_gpu_milli=float(frag_sum_except_q3(amounts[i])),
+        unscheduled=int(((pn < 0) & failed_i).sum()),
     )
 
 

@@ -26,6 +26,18 @@ class ServiceError(RuntimeError):
     pass
 
 
+class JobsFailed(ServiceError):
+    """Some jobs reached status=failed server-side. Carries the failure
+    descriptions AND the successful jobs' fetched results, so `tpusim
+    submit` can print what succeeded and still exit nonzero (the
+    partial-failure contract)."""
+
+    def __init__(self, message: str, failed, results):
+        super().__init__(message)
+        self.failed = list(failed)  # final job descriptions, status=failed
+        self.results = list(results)  # fetched results of the done jobs
+
+
 def _request(url: str, data: Optional[bytes] = None,
              timeout: float = 30.0) -> Tuple[int, dict, dict]:
     """(status, headers, parsed JSON body); HTTP errors with a JSON body
@@ -88,14 +100,26 @@ def submit_jobs(url: str, docs: Sequence[dict], max_retries: int = 8,
 
 
 def wait_jobs(url: str, job_ids: Sequence[str], timeout: float = 300.0,
-              poll_s: float = 0.2) -> List[dict]:
+              poll_s: float = 0.0) -> List[dict]:
     """Poll GET /jobs/<id> until every job is terminal; returns their
-    final descriptions in order. Raises ServiceError on timeout."""
+    final descriptions in order. Raises ServiceError on timeout.
+
+    The inter-poll sleep is the kube_client capped-exponential-backoff-
+    with-jitter schedule (io.kube_client._retry_delay_s — ONE shared
+    delay utility for every HTTP retry/poll loop in the tree): rounds
+    that observe no progress back off up to the 8 s cap so a fleet of
+    ES/CMA tuning clients (ISSUE 9) does not hammer the service through
+    a long generation, and any job reaching terminal resets the schedule
+    so a steadily-draining queue is polled briskly. `poll_s > 0` caps
+    the delay (the fast-test knob); 0 uses the shared schedule as-is."""
     url = url.rstrip("/")
     deadline = time.time() + timeout
     last = {jid: None for jid in job_ids}
+    attempt = 0  # idle polls since the last observed progress (1-based
+    # in the shared helper: the first sleep is the base delay)
     while time.time() < deadline:
         busy = False
+        progressed = False
         for jid in job_ids:
             if last[jid] and last[jid]["status"] in TERMINAL:
                 continue
@@ -103,11 +127,17 @@ def wait_jobs(url: str, job_ids: Sequence[str], timeout: float = 300.0,
             if code != 200:
                 raise ServiceError(f"GET /jobs/{jid} -> HTTP {code}: {doc}")
             last[jid] = doc
-            if doc["status"] not in TERMINAL:
+            if doc["status"] in TERMINAL:
+                progressed = True
+            else:
                 busy = True
         if not busy:
             return [last[jid] for jid in job_ids]
-        time.sleep(poll_s)
+        attempt = 1 if progressed else attempt + 1
+        delay = _retry_delay_s(attempt)
+        if poll_s > 0:
+            delay = min(delay, poll_s)
+        time.sleep(min(delay, max(deadline - time.time(), 0.0)))
     stuck = [j for j, d in last.items()
              if not d or d["status"] not in TERMINAL]
     raise ServiceError(f"jobs still running after {timeout}s: {stuck}")
@@ -152,15 +182,20 @@ def format_results_table(results: Sequence[dict]) -> str:
 def submit_and_wait(url: str, docs: Sequence[dict], timeout: float = 300.0,
                     out=None) -> List[dict]:
     """The whole `tpusim submit` flow: POST (with backpressure retries),
-    poll to terminal, fetch results. Raises ServiceError when any job
-    failed server-side."""
+    poll to terminal, fetch results. When any job failed server-side,
+    raises JobsFailed carrying BOTH the failure descriptions and the
+    done jobs' fetched results — the caller can report partial success
+    and must exit nonzero."""
     accepted = submit_jobs(url, docs, out=out)
     ids = [a["id"] for a in accepted]
     final = wait_jobs(url, ids, timeout=timeout)
     failed = [d for d in final if d["status"] == "failed"]
     if failed:
-        raise ServiceError(
+        done_ids = [d["id"] for d in final if d["status"] == "done"]
+        raise JobsFailed(
             "job(s) failed: "
-            + "; ".join(f"{d['id']}: {d.get('error', '?')}" for d in failed)
+            + "; ".join(f"{d['id']}: {d.get('error', '?')}" for d in failed),
+            failed,
+            fetch_results(url, done_ids) if done_ids else [],
         )
     return fetch_results(url, ids)

@@ -229,10 +229,24 @@ def jobs_from_grid(payload, default_policies=None):
     if isinstance(payload, dict):
         unknown = set(payload) - GRID_KEYS
         if unknown:
+            # the singular-key guard: a typo'd singular form of a
+            # per-row vector must fail naming its plural, not silently
+            # run every row at the defaults ("weight" joined the list
+            # with the learned-scoring lane's tuned-payload round-trip,
+            # ISSUE 9)
+            singular = {"seed": "seeds", "tune": "tunes",
+                        "weight": "weights"}
+            hits = sorted(k for k in unknown if k in singular)
+            hint = (
+                "; per-row vectors are plural — "
+                + ", ".join(f'"{singular[k]}", not "{k}"' for k in hits)
+                if hits else
+                '; per-row vectors are plural — "weights"/"seeds"/'
+                '"tunes", not "weight"/"seed"/"tune"'
+            )
             raise ValueError(
                 f"unknown grid key(s) {sorted(unknown)} (known: "
-                f"{sorted(GRID_KEYS)}; per-row vectors are plural — "
-                '"seeds"/"tunes", not "seed"/"tune")'
+                f"{sorted(GRID_KEYS)}{hint})"
             )
         weights = payload.get("weights")
         seeds = payload.get("seeds")

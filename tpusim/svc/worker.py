@@ -22,7 +22,6 @@ A batch that raises marks its jobs failed and the worker keeps serving
 
 from __future__ import annotations
 
-import hashlib
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
@@ -62,32 +61,27 @@ def load_trace(name: str, nodes_csv: str, pods_csv: str,
 
 
 def summarize_lane(lane, job: Job) -> dict:
-    """SweepLane -> the persisted/HTTP result document. Placements ride
-    along in full (i32 node per pod; -1 = unplaced) plus a sha256 over
-    (placed_node, dev_mask) bytes so bit-identity against a standalone
-    run is one string compare."""
+    """SweepLane -> the persisted/HTTP result document: the shared
+    per-lane term vocabulary (learn.objective.lane_terms — ONE code
+    path, so a remote tuning client's terms_from_result reads back
+    exactly what a local lane yields, the ISSUE 9 bit-identity
+    contract) plus the job's identity fields and the full placements
+    (i32 node per pod; -1 = unplaced; the terms' sha256 over
+    placed_node+dev_mask makes bit-identity against a standalone run
+    one string compare)."""
+    from tpusim.learn.objective import lane_terms
     from tpusim.obs.counters import COUNTER_FIELDS
 
-    pn = np.asarray(lane.placed_node, np.int32)
-    dm = np.asarray(lane.dev_mask, bool)
-    h = hashlib.sha256()
-    h.update(pn.tobytes())
-    h.update(dm.tobytes())
-    out = {
+    out = lane_terms(lane)
+    out.update({
         "job": job.digest,
         "trace": job.spec.trace,
         "policies": [list(p) for p in job.spec.policies],
         "weights": list(job.spec.weights),
         "seed": job.spec.seed,
         "tune": job.spec.tune,
-        "events": int(lane.events),
-        "placed": int(lane.placed),
-        "failed": int(lane.failed),
-        "gpu_alloc_pct": float(lane.gpu_alloc_pct),
-        "frag_gpu_milli": float(lane.frag_gpu_milli),
-        "placed_node": pn.tolist(),
-        "placements_sha256": h.hexdigest(),
-    }
+        "placed_node": np.asarray(lane.placed_node, np.int32).tolist(),
+    })
     if lane.counters is not None:
         out["counters"] = {
             f: int(c) for f, c in zip(COUNTER_FIELDS, lane.counters)
