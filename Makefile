@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke chaos-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -95,6 +95,16 @@ svc-smoke:
 tune-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --tune-only
 
+# chaos-sweep smoke (ENGINES.md "Round 14"): a tiny B-lane fault sweep
+# (one trace, varying fault seed/MTBF/evict cadence as per-lane
+# operands) with the hard contracts — ONE compiled chaos executable, a
+# second wave of DIFFERENT schedules adding ZERO executables
+# (jit._cache_size() stable), and lane 0's placements +
+# DisruptionMetrics reconciling exactly against the standalone
+# single-lane run_with_faults path.
+chaos-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --chaos-only
+
 # bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
 # measurement under profiling and diff it against the newest committed
 # BENCH_r*.json baseline — exact on events/placements/gpu_alloc
@@ -105,8 +115,10 @@ tune-smoke:
 # the one-compile sweep contract (ISSUE 6), the replay-service POST
 # path — dedup + zero recompiles (ISSUE 7, the svc-smoke check) — and
 # the learned-scoring loop (ISSUE 9, the tune-smoke check: one
-# executable across generations, signed resumable log). Exit 1 on
-# regression; artifacts land in .tpusim_obs/.
+# executable across generations, signed resumable log), and the chaos
+# sweep (ISSUE 10, the chaos-smoke check: fault schedules as operands —
+# zero recompiles across waves, lane-vs-standalone disruption
+# reconciliation). Exit 1 on regression; artifacts land in .tpusim_obs/.
 bench-gate:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate
 

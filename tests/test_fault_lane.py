@@ -1,0 +1,535 @@
+"""The in-scan fault plane (ISSUE 10; tpusim.sim.fault_lane): fault
+schedules as scan/sweep operands with an in-carry retry queue.
+
+Acceptance pins:
+- scan-vs-segmented bit-identity under one seed (placements,
+  DisruptionMetrics, final state) — `run_with_faults` became a thin
+  wrapper over the in-scan lane and must reproduce the PR 2 host loop;
+- engine invariance of the fault lane (sequential / flat table /
+  blocked table / shard_map);
+- kill/resume continuity of the retry-queue carry (run_chunk splits);
+- retry-queue overflow -> terminal max-retries-exceeded;
+- chaos-sweep lanes bit-identical to standalone runs per schedule;
+- the crash-safety satellites: torn-checkpoint walk-back, svc job-spec
+  persistence + restart recovery, graceful-drain 503s.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusim.io.trace import NodeRow, PodRow, build_events, pods_to_specs
+from tpusim.sim import fault_lane
+from tpusim.sim.driver import Simulator, SimulatorConfig
+from tpusim.sim.engine import EV_EVICT, EV_NODE_FAIL, EV_NODE_RECOVER
+from tpusim.sim.faults import FaultConfig, FaultEvent, generate_fault_schedule
+
+CFG = dict(
+    policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+    report_per_event=False,
+)
+
+
+def _sim(nodes, pods, **over):
+    sim = Simulator(nodes, SimulatorConfig(**{**CFG, **over}))
+    sim.set_workload_pods(pods)
+    sim.set_typical_pods()
+    return sim
+
+
+def _nodes(n=2):
+    return [
+        NodeRow(f"host-{i}", 16000, 65536, 2, "V100M16") for i in range(n)
+    ]
+
+
+def _pods(n):
+    return [PodRow(f"p{i}", 2000, 1024, 1, 500) for i in range(n)]
+
+
+def _mixed_fcfg(seed=5):
+    return FaultConfig(
+        mtbf_events=3, mttr_events=4, evict_every_events=5, seed=seed,
+        backoff_base=2, backoff_cap=8, max_retries=2,
+    )
+
+
+def _assert_same_run(ra, dma, rb, dmb, frag_tol=0.0):
+    assert np.array_equal(ra.placed_node, rb.placed_node)
+    assert np.array_equal(ra.dev_mask, rb.dev_mask)
+    for f, (x, y) in zip(
+        ra.state._fields,
+        zip(jax.tree.leaves(ra.state), jax.tree.leaves(rb.state)),
+    ):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), f
+    a, b = dma.as_dict(), dmb.as_dict()
+    for k in a:
+        if isinstance(a[k], float):
+            assert abs(a[k] - b[k]) <= frag_tol, (k, a[k], b[k])
+        else:
+            assert a[k] == b[k], (k, a[k], b[k])
+    assert (dma.reschedule_latency_events == dmb.reschedule_latency_events)
+    assert [u.reason for u in ra.unscheduled_pods] == [
+        u.reason for u in rb.unscheduled_pods
+    ]
+
+
+# ---- the acceptance pin: scan == segmented host loop ----
+
+
+def test_scan_equals_segmented_mixed_schedule():
+    """run_with_faults (now the in-scan lane) is bit-identical to the
+    PR 2 segmented path under one seed: an MTBF schedule with fails,
+    recovers, AND random-victim evictions — placements, every
+    DisruptionMetrics number (latency list included), final state, and
+    the unscheduled reasons."""
+    nodes, pods = _nodes(), _pods(6)
+    fcfg = _mixed_fcfg()
+    sa = _sim(nodes, pods, fault_mode="segments")
+    ra = sa.schedule_pods_with_faults(pods, fault_cfg=fcfg)
+    sb = _sim(nodes, pods, fault_mode="scan")
+    rb = sb.schedule_pods_with_faults(pods, fault_cfg=fcfg)
+    assert sb._last_engine.endswith("(fault lane)")
+    _assert_same_run(ra, sa.last_disruption, rb, sb.last_disruption)
+    # the scan lane narrates + reports like the host loop
+    assert any("[Disruption]" in l for l in sb.log.lines)
+    assert any("[Fault]" in l for l in sb.log.lines)
+    assert any(k.startswith("disruption_") for k in sb.analysis_summary)
+
+
+def test_fault_lane_engine_invariant():
+    """sequential vs flat-table vs blocked-table fault lanes replay one
+    schedule bit-identically (the shard engine is pinned separately)."""
+    nodes, pods = _nodes(), _pods(6)
+    fcfg = _mixed_fcfg(seed=7)
+    runs = []
+    for over in (
+        {"engine": "sequential"},
+        {"engine": "table"},
+        {"engine": "table", "block_size": 2},
+    ):
+        sim = _sim(nodes, pods, fault_mode="scan", **over)
+        res = sim.schedule_pods_with_faults(pods, fault_cfg=fcfg)
+        runs.append((res, sim.last_disruption))
+    for res, dm in runs[1:]:
+        _assert_same_run(runs[0][0], runs[0][1], res, dm)
+
+
+def test_fault_lane_shard_engine():
+    """The shard_map fault lane: owner-masked row resets/requeues under
+    a 2-device mesh match the segmented path (frag-delta list excepted —
+    psum f32 cannot be bit-equal, so the shard build skips it). Three
+    nodes on two devices exercises the mesh-padded node axis — pad rows
+    must stay invisible to victims, down clocks, and the dark-capacity
+    accounting."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    nodes, pods = _nodes(3), _pods(8)
+    fcfg = _mixed_fcfg(seed=11)
+    sa = _sim(nodes, pods, fault_mode="segments")
+    ra = sa.schedule_pods_with_faults(pods, fault_cfg=fcfg)
+    sb = _sim(nodes, pods, fault_mode="scan", mesh=2)
+    rb = sb.schedule_pods_with_faults(pods, fault_cfg=fcfg)
+    assert sb._last_engine.startswith("shard_map")
+    assert np.array_equal(ra.placed_node, rb.placed_node)
+    assert np.array_equal(ra.dev_mask, rb.dev_mask)
+    a, b = sa.last_disruption.as_dict(), sb.last_disruption.as_dict()
+    for k in a:
+        if k.startswith("post_recovery"):
+            continue
+        assert a[k] == b[k], (k, a[k], b[k])
+    for f, (x, y) in zip(
+        ra.state._fields,
+        zip(jax.tree.leaves(ra.state), jax.tree.leaves(rb.state)),
+    ):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), f
+
+
+# ---- retry-queue carry semantics ----
+
+
+def test_retry_carry_kill_resume_continuity():
+    """Splitting the merged stream across run_chunk calls (the
+    checkpoint surface) is bit-identical to one unsplit scan — the
+    retry queue, attempts, dead list, and down clocks are carry leaves
+    like everything else."""
+    from tpusim.sim.table_engine import build_pod_types, make_table_replay
+
+    nodes, pods = _nodes(), _pods(6)
+    sim = _sim(nodes, pods)
+    specs = pods_to_specs(pods, sim.node_index)
+    ev_kind, ev_pod = build_events(pods, False)
+    fcfg = _mixed_fcfg(seed=3)
+    faults = generate_fault_schedule(len(nodes), len(ev_kind), fcfg)
+    plan = fault_lane.compile_fault_plan(
+        ev_kind, ev_pod, faults, fcfg, len(nodes), len(pods)
+    )
+    types = build_pod_types(specs)
+    fn = make_table_replay(
+        sim._policy_fns, gpu_sel="FGDScore", faults=True,
+        fault_frag=plan.has_recover,
+    )
+    ops = fault_lane.FaultOps(
+        pos=jnp.asarray(plan.pos), arg=jnp.asarray(plan.arg),
+        aux=jnp.asarray(plan.aux), draws=jnp.asarray(plan.draws),
+        params=jnp.asarray(plan.params),
+        gcnt=jnp.asarray(sim.init_state.gpu_cnt),
+    )
+    fc0 = fault_lane.init_fault_carry(
+        len(pods), len(nodes), plan.capacity
+    )
+    key = jax.random.PRNGKey(42)
+    whole = fn(
+        sim.init_state, specs, types, jnp.asarray(plan.kind),
+        jnp.asarray(plan.idx), sim.typical, key, sim.rank,
+        fault_ops=ops, fault_carry0=fc0,
+    )
+    # split mid-stream, round-tripping the carry through host numpy (the
+    # kill/resume surface)
+    k = int(plan.kind.shape[0]) // 2
+    carry = fn.init_carry(
+        sim.init_state, specs, types, sim.typical, key, sim.rank,
+        fault_carry0=fc0,
+    )
+    for sl in (slice(0, k), slice(k, None)):
+        ops_sl = ops._replace(
+            pos=ops.pos[sl], arg=ops.arg[sl], aux=ops.aux[sl]
+        )
+        carry, _ = fn.run_chunk(
+            carry, specs, types, jnp.asarray(plan.kind[sl]),
+            jnp.asarray(plan.idx[sl]), sim.typical, sim.rank,
+            fault_ops=ops_sl,
+        )
+        carry = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), carry)
+    state, placed, masks, failed = fn.finish(carry)
+    assert np.array_equal(np.asarray(whole.placed_node), np.asarray(placed))
+    assert np.array_equal(np.asarray(whole.dev_mask), np.asarray(masks))
+    for x, y in zip(
+        jax.tree.leaves(whole.fault_carry), jax.tree.leaves(carry[1])
+    ):
+        # fault_carry is trimmed on the one-shot result; compare on the
+        # common prefix of each leaf
+        xa, ya = np.asarray(x), np.asarray(y)
+        assert np.array_equal(xa, ya[tuple(slice(0, s) for s in xa.shape)])
+
+
+def test_retry_queue_overflow_goes_terminal():
+    """An eviction wave past the static queue capacity goes terminal
+    max-retries-exceeded (the documented divergence from the unbounded
+    host heap) instead of silently corrupting."""
+    nodes = [NodeRow("only", 16000, 65536, 4, "V100M16"),
+             NodeRow("back", 2000, 1024, 0, "")]
+    pods = _pods(3)  # all land on `only`
+    fcfg = FaultConfig(backoff_base=2, backoff_cap=4, queue_capacity=1)
+    sim = _sim(nodes, pods, fault_mode="scan")
+    res = sim.schedule_pods_with_faults(
+        pods, faults=[FaultEvent(pos=3, kind=EV_NODE_FAIL, node=0)],
+        fault_cfg=fcfg,
+    )
+    dm = sim.last_disruption
+    assert dm.evicted_pods == 3
+    # one victim fits the queue; the overflow is terminal immediately
+    assert dm.unscheduled_after_retries >= 2
+    reasons = [u.reason for u in res.unscheduled_pods]
+    assert reasons.count("max-retries-exceeded") >= 2
+
+
+def test_fault_mode_validation():
+    nodes, pods = _nodes(), _pods(2)
+    sim = _sim(nodes, pods, fault_mode="nope")
+    with pytest.raises(ValueError, match="unknown fault_mode"):
+        sim.schedule_pods_with_faults(pods, fault_cfg=FaultConfig())
+    sim2 = _sim(nodes, pods, fault_mode="scan", report_per_event=True)
+    with pytest.raises(ValueError, match="fault_mode='scan'"):
+        sim2.schedule_pods_with_faults(pods, fault_cfg=FaultConfig())
+    # auto + reporting falls back to the segmented path, not an error
+    sim3 = _sim(nodes, pods, report_per_event=True)
+    sim3.schedule_pods_with_faults(
+        pods, faults=[FaultEvent(pos=1, kind=EV_EVICT, pod=0)],
+        fault_cfg=FaultConfig(backoff_base=1, backoff_cap=1),
+    )
+    assert not sim3._last_engine.endswith("(fault lane)")
+
+
+# ---- the chaos sweep ----
+
+
+@pytest.mark.slow  # compiles the chaos engine plus 3 standalone lanes
+def test_chaos_sweep_lanes_equal_standalone():
+    """B fault schedules in ONE vmapped scan: every lane bit-identical
+    (placements, DisruptionMetrics, state) to the standalone
+    run_with_faults run with that schedule — the B>=1 slice of the
+    acceptance criterion (`make chaos-smoke` runs the wider B=8 form
+    with the zero-recompile check; tier-1 keeps the cheap rejection
+    tests and the per-engine single-lane equivalences)."""
+    nodes, pods = _nodes(4), _pods(8)
+    specs = [
+        FaultConfig(
+            mtbf_events=4 + i, mttr_events=5, evict_every_events=6 - i,
+            seed=100 + i, backoff_base=2, backoff_cap=8, max_retries=2,
+        )
+        for i in range(3)
+    ]
+    sim = _sim(nodes, pods)
+    lanes = sim.run_sweep(
+        np.asarray([[1000]] * 3, np.int32), seeds=[42] * 3, faults=specs
+    )
+    assert sim._last_engine.endswith("chaos sweep)")
+    for i, lane in enumerate(lanes):
+        solo = _sim(nodes, pods)
+        res = solo.run_with_faults(fault_cfg=specs[i])
+        dm = solo.last_disruption
+        assert np.array_equal(res.placed_node, lane.placed_node), i
+        a, b = dm.as_dict(), lane.disruption.as_dict()
+        for k in a:
+            if isinstance(a[k], float):
+                assert abs(a[k] - b[k]) < 1e-9, (i, k)
+            else:
+                assert a[k] == b[k], (i, k)
+        for x, y in zip(
+            jax.tree.leaves(res.state), jax.tree.leaves(lane.state)
+        ):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_chaos_sweep_rejects_mismatched_lanes():
+    nodes, pods = _nodes(), _pods(4)
+    sim = _sim(nodes, pods)
+    with pytest.raises(ValueError, match="fault_specs has"):
+        sim.run_sweep(
+            np.asarray([[1000]] * 2, np.int32),
+            faults=[FaultConfig(mtbf_events=3)],
+        )
+    with pytest.raises(ValueError, match="FaultConfig"):
+        sim.run_sweep(
+            np.asarray([[1000]], np.int32), faults=["not-a-config"]
+        )
+    with pytest.raises(ValueError, match="tunes and faults"):
+        sim.run_sweep(
+            np.asarray([[1000]], np.int32), tunes=[0.0],
+            faults=[FaultConfig(mtbf_events=3)],
+        )
+
+
+def test_load_faults_payload(tmp_path):
+    from tpusim.apply import load_faults_payload
+
+    path = tmp_path / "faults.json"
+    path.write_text(json.dumps({
+        "faults": [
+            {"mtbf_events": 5, "seed": 1},
+            {"mtbf_events": 7, "seed": 2, "queue_capacity": 16},
+        ],
+        "seeds": [1, 2],
+    }))
+    specs, weights, seeds = load_faults_payload(
+        str(path), (("FGDScore", 1000),)
+    )
+    assert [s.mtbf_events for s in specs] == [5, 7]
+    assert weights == [[1000], [1000]] and seeds == [1, 2]
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps([{"mtbf": 5}]))
+    with pytest.raises(ValueError, match="unknown key"):
+        load_faults_payload(str(bad), (("FGDScore", 1000),))
+
+
+# ---- objective integration (ISSUE 10: disruption trainable) ----
+
+
+def test_objective_disruption_term():
+    from tpusim.learn.objective import ObjectiveConfig, scalarize
+
+    terms = {
+        "frag_gpu_milli": 0.0, "gpu_total_milli": 1000, "pods": 10,
+        "unscheduled": 0, "disrupted": 2, "gpu_alloc_pct": 50.0,
+    }
+    base = scalarize(terms, ObjectiveConfig())
+    hard = scalarize(terms, ObjectiveConfig(w_disrupt=1.0))
+    assert hard == pytest.approx(base - 100.0 * 2 / 10)
+    # w_disrupt = 0 keeps the pre-chaos log-header bytes
+    assert ObjectiveConfig().canonical() == [1.0, 1.0, 1.0]
+    assert ObjectiveConfig(w_disrupt=0.5).canonical() == [
+        1.0, 1.0, 1.0, 0.5
+    ]
+
+
+# ---- crash-safety satellites ----
+
+
+def test_torn_checkpoint_walkback(tmp_path):
+    """A corrupt/truncated newest checkpoint is skipped (and deleted)
+    with the resume continuing from the newest VALID one."""
+    from tpusim.io import storage
+
+    d = str(tmp_path)
+    digest = "ab" * 32
+    storage.save_checkpoint(d, digest, 2, {"x": np.arange(3)})
+    storage.save_checkpoint(d, digest, 4, {"x": np.arange(3) + 1})
+    torn = storage.checkpoint_path(d, digest, 4)
+    with open(torn, "wb") as f:
+        f.write(b"\x00truncated")
+    skipped = []
+    got = storage.load_valid_checkpoint(
+        d, digest, on_skip=lambda p, e: skipped.append(p)
+    )
+    assert got is not None
+    cursor, arrays, path = got
+    assert cursor == 2 and np.array_equal(arrays["x"], np.arange(3))
+    assert skipped == [torn] and not os.path.exists(torn)
+    # a validate rejection also walks back (vocabulary drift reads as
+    # corrupt)
+    storage.save_checkpoint(d, digest, 6, {"y": np.arange(2)})
+
+    def need_x(arrays):
+        arrays["x"]
+
+    got = storage.load_valid_checkpoint(d, digest, validate=need_x)
+    assert got is not None and got[0] == 2
+    # nothing valid at all -> None (fresh start), dir emptied of the junk
+    storage.prune_checkpoints(d, digest, 10**9)
+    assert storage.load_valid_checkpoint(d, digest) is None
+
+
+def test_svc_job_spec_persistence_and_recovery(tmp_path):
+    """Accepted jobs persist as .job.json; a restarted service requeues
+    every spec without a signed result (crash mid-batch no longer
+    strands jobs in `running`)."""
+    from tpusim.svc import jobs as svc_jobs
+    from tpusim.svc.api import start_job_server
+    from tpusim.svc.jobs import trace_digest
+    from tpusim.svc.worker import TraceRef
+
+    nodes, pods = _nodes(), _pods(4)
+    trace = TraceRef("default", nodes, pods, trace_digest(nodes, pods))
+    art = str(tmp_path)
+    fam = [["FGDScore", 1000]]
+
+    # first life: accept two jobs, run neither (start_worker=False =
+    # the crash), then "restart" and observe both requeued
+    srv, service, worker = start_job_server(
+        art, {"default": trace}, listen=":0", start_worker=False,
+        recover=False,
+    )
+    try:
+        service.submit_payload({"policies": fam, "weights": [700]})
+        service.submit_payload({"policies": fam, "weights": [900]})
+        specs = svc_jobs.pending_job_specs(art)
+        assert len(specs) == 2
+    finally:
+        worker.stop()
+        srv.stop()
+
+    srv2, service2, worker2 = start_job_server(
+        art, {"default": trace}, listen=":0", start_worker=False,
+        recover=True,
+    )
+    try:
+        assert service2.queue.stats()["depth"] == 2
+        # run the recovered batch synchronously; results persist and the
+        # pending list drains
+        batch = service2.queue.next_batch(timeout=1.0, linger_s=0.0)
+        worker2.run_batch(batch)
+        assert svc_jobs.pending_job_specs(art) == []
+    finally:
+        worker2.stop()
+        srv2.stop()
+
+
+def test_svc_graceful_drain(tmp_path):
+    """begin_drain flips /healthz to 503 and POSTs answer 503 +
+    Retry-After while the in-flight work finishes."""
+    import urllib.error
+    import urllib.request
+
+    from tpusim.svc.api import start_job_server
+    from tpusim.svc.jobs import trace_digest
+    from tpusim.svc.worker import TraceRef
+
+    nodes, pods = _nodes(), _pods(2)
+    trace = TraceRef("default", nodes, pods, trace_digest(nodes, pods))
+    srv, service, worker = start_job_server(
+        str(tmp_path), {"default": trace}, listen=":0",
+        start_worker=False, recover=False,
+    )
+    try:
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=10) as r:
+            assert json.loads(r.read().decode())["ok"] is True
+        srv.begin_drain()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert ei.value.code == 503
+        req = urllib.request.Request(
+            srv.url + "/jobs", data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") == "2"
+    finally:
+        worker.stop()
+        srv.stop()
+
+
+@pytest.mark.slow  # compiles the chaos engine at the service lane width
+def test_svc_fault_jobs_end_to_end(tmp_path):
+    """Fault jobs through the POST path: a batch of `fault`-carrying
+    jobs runs ONE compiled chaos sweep, results carry the
+    DisruptionMetrics block, and each matches the standalone
+    run_with_faults outcome for that schedule."""
+    from tpusim.svc.api import start_job_server
+    from tpusim.svc.jobs import trace_digest
+    from tpusim.svc.worker import TraceRef
+
+    nodes, pods = _nodes(4), _pods(8)
+    trace = TraceRef("default", nodes, pods, trace_digest(nodes, pods))
+    srv, service, worker = start_job_server(
+        str(tmp_path), {"default": trace}, listen=":0",
+        start_worker=False, recover=False, lane_width=4,
+    )
+    fam = [["FGDScore", 1000]]
+    try:
+        for i in range(2):
+            service.submit_payload({
+                "policies": fam,
+                "fault": {"mtbf_events": 4.0 + i, "mttr_events": 5.0,
+                          "seed": 100 + i, "backoff_base": 2,
+                          "backoff_cap": 8, "max_retries": 2},
+            })
+        batch = service.queue.next_batch(timeout=1.0, linger_s=0.0)
+        assert len(batch) == 2  # one family, one batch
+        worker.run_batch(batch)
+        for i, job in enumerate(batch):
+            assert job.status == "done", job.error
+            dis = job.result["disruption"]
+            solo = _sim(nodes, pods, shuffle_pod=False, seed=42)
+            res = solo.run_with_faults(
+                fault_cfg=job.spec.fault_config()
+            )
+            assert dis == solo.last_disruption.as_dict()
+            assert job.result["placed_node"] == [
+                int(x) for x in res.placed_node
+            ]
+    finally:
+        worker.stop()
+        srv.stop()
+
+
+def test_grid_fault_seeds_expansion():
+    from tpusim.svc import jobs as svc_jobs
+
+    docs = svc_jobs.jobs_from_grid({
+        "weights": [[1000], [1000]],
+        "fault": {"mtbf_events": 5.0, "mttr_events": 6.0},
+        "fault_seeds": [1, 2],
+    })
+    assert [d["fault"]["seed"] for d in docs] == [1, 2]
+    assert all(d["fault"]["mtbf_events"] == 5.0 for d in docs)
+    with pytest.raises(ValueError, match="fault_seeds"):
+        svc_jobs.jobs_from_grid(
+            {"weights": [[1]], "fault_seeds": [1]}
+        )

@@ -205,11 +205,13 @@ def test_terms_vocabulary_local_vs_remote():
     terms = terms_from_result(doc)
     assert set(terms) == {
         "weights", "seed", "events", "pods", "placed", "failed",
-        "unscheduled", "gpu_total_milli", "gpu_alloc_pct",
-        "frag_gpu_milli", "placements_sha256",
+        "unscheduled", "disrupted", "evicted", "gpu_total_milli",
+        "gpu_alloc_pct", "frag_gpu_milli", "placements_sha256",
     }
+    # pre-chaos result docs (no disruption keys) read back as fault-free
+    assert terms["disrupted"] == 0 and terms["evicted"] == 0
     assert json.dumps(terms, sort_keys=True) == json.dumps(
-        {k: doc[k] for k in terms}, sort_keys=True
+        {k: doc.get(k, 0) for k in terms}, sort_keys=True
     )
 
 

@@ -134,8 +134,19 @@ def test_validate_job():
     assert spec.weights == (7, 9) and spec.tune == 1.5
     assert spec.family_key() == (
         "default", ("FGDScore", "BestFitScore"), "FGDScore", "max",
-        "share", "table",
+        "share", "table", False, 0.0, 0,
     )
+    # fault jobs (ISSUE 10) batch separately and pin their tune factor
+    spec_f = svc_jobs.validate_job({
+        "policies": FAM, "tune": 1.5,
+        "fault": {"mtbf_events": 5.0, "seed": 7},
+    })
+    assert spec_f.fault_config().mtbf_events == 5.0
+    assert spec_f.family_key()[-3:] == (True, 1.5, 233)
+    with pytest.raises(ValueError, match="unknown fault key"):
+        svc_jobs.validate_job({"fault": {"mtbf": 5.0}})
+    with pytest.raises(ValueError, match="fault needs"):
+        svc_jobs.validate_job({"fault": {"seed": 3}})
 
     with pytest.raises(ValueError, match="unknown job key"):
         svc_jobs.validate_job({"wieghts": [1]})

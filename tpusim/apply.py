@@ -89,6 +89,13 @@ class ApplyOptions:
     # file is either a bare [[w...], ...] list or
     # {"weights": [[...]], "seeds": [...]}.
     sweep_weights: str = ""
+    # chaos sweep (ISSUE 10; README "Chaos sweep"): a faults JSON here
+    # replaces the main schedule with ONE vmapped fault-lane replay —
+    # same trace, B fault schedules (seed/MTBF/evict cadence/backoff as
+    # per-lane operands) — and prints the per-lane disruption frontier.
+    # The file is a bare [{...FaultConfig fields...}, ...] list or
+    # {"faults": [...], "weights": [[...]], "seeds": [...]}.
+    sweep_faults: str = ""
     # JAX persistent compilation cache dir (ISSUE 6 satellite;
     # SimulatorConfig.compile_cache_dir / $TPUSIM_COMPILE_CACHE_DIR):
     # wired before the first dispatch so re-runs skip the scan compile;
@@ -247,6 +254,21 @@ class Applier:
         ds_pods = cluster.daemonset_pods()
         sim.set_workload_pods(workload + ds_pods)
         fault_cfg = self._fault_config()
+        if self.options.sweep_faults:
+            # chaos sweep replaces the main schedule: one vmapped scan
+            # over B fault schedules, the disruption frontier table
+            if self.options.sweep_weights:
+                raise ValueError(
+                    "--sweep-faults and --sweep-weights are separate "
+                    "sweep axes; pass per-lane weights inside the faults "
+                    "JSON instead"
+                )
+            if fault_cfg is not None:
+                raise ValueError(
+                    "--sweep-faults replaces the --fault-* flags (each "
+                    "lane carries its own schedule)"
+                )
+            return self._run_chaos(sim, out)
         if self.options.sweep_weights:
             # config-axis sweep replaces the main schedule: one vmapped
             # replay over the weight grid, a summary table, telemetry —
@@ -362,6 +384,32 @@ class Applier:
             self.monitor.publish_progress(
                 phase="done", events_done=lanes[0].events * len(lanes),
                 events_total=lanes[0].events * len(lanes),
+            )
+        return None
+
+    def _run_chaos(self, sim: Simulator, out):
+        """`apply --sweep-faults`: load the per-lane fault documents, run
+        the chaos sweep (one compiled vmapped scan for all B disruption
+        what-ifs), print the per-lane disruption frontier (README "Chaos
+        sweep")."""
+        from tpusim.sim.driver import format_chaos_table
+
+        specs, weights, seeds = load_faults_payload(
+            self.options.sweep_faults, sim.cfg.policies
+        )
+        lanes = sim.run_sweep(weights, seeds=seeds, faults=specs)
+        print(
+            f"[Chaos] {len(lanes)} fault lanes x {lanes[0].events} events "
+            f"in one compiled scan ({sim._last_engine})",
+            file=out,
+        )
+        print(format_chaos_table(lanes, sim.cfg.policies), file=out)
+        self._note_compile_cache(sim)
+        self._emit_telemetry(sim, out)
+        if self.monitor is not None:
+            self.monitor.publish_progress(
+                phase="done", events_done=sum(l.events for l in lanes),
+                events_total=sum(l.events for l in lanes),
             )
         return None
 
@@ -544,6 +592,66 @@ def load_weights_payload(path: str):
             '{"weights": [[...]], "seeds": [...], "tunes": [...]})'
         )
     return weights, seeds, tunes
+
+
+# every key a chaos-lane fault document may carry — FaultConfig's field
+# names exactly; unknown keys are rejected loudly (a typo'd "mtbf" must
+# not silently run a fault-free lane)
+FAULT_PAYLOAD_KEYS = frozenset((
+    "mtbf_events", "mttr_events", "evict_every_events", "seed",
+    "max_retries", "backoff_base", "backoff_cap", "queue_capacity",
+))
+
+
+def load_faults_payload(path: str, policies):
+    """Chaos-sweep JSON -> (fault_specs, weights, seeds) for
+    `Simulator.run_sweep(faults=...)`: a bare [{...FaultConfig
+    fields...}, ...] list of per-lane fault documents, or
+    {"faults": [...], "weights": [[...]], "seeds": [...]} with optional
+    per-lane weight rows / seeds (defaults: the scheduler config's
+    weights and cfg.seed for every lane)."""
+    import json
+
+    from tpusim.sim.faults import FaultConfig
+
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, dict):
+        docs = payload.get("faults")
+        weights = payload.get("weights")
+        seeds = payload.get("seeds")
+        unknown = set(payload) - {"faults", "weights", "seeds"}
+        if unknown:
+            raise ValueError(
+                f"{path}: unknown key(s) {sorted(unknown)} (known: "
+                "faults, weights, seeds)"
+            )
+    else:
+        docs, weights, seeds = payload, None, None
+    if not isinstance(docs, list) or not docs:
+        raise ValueError(
+            f"{path}: no fault lanes (want [{{...FaultConfig fields...}}, "
+            '...] or {"faults": [...], "weights": [[...]], "seeds": [...]})'
+        )
+    specs = []
+    for i, doc in enumerate(docs):
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: fault lane {i} must be an object")
+        unknown = set(doc) - FAULT_PAYLOAD_KEYS
+        if unknown:
+            raise ValueError(
+                f"{path}: fault lane {i} has unknown key(s) "
+                f"{sorted(unknown)} (known: {sorted(FAULT_PAYLOAD_KEYS)})"
+            )
+        specs.append(FaultConfig(**doc))
+    if weights is None:
+        weights = [[w for _, w in policies]] * len(specs)
+    if len(weights) != len(specs):
+        raise ValueError(
+            f"{path}: {len(weights)} weight rows for {len(specs)} fault "
+            "lanes"
+        )
+    return specs, weights, seeds
 
 
 def save_weights_payload(path: str, weights, seeds=None, tunes=None,

@@ -166,6 +166,16 @@ def _build_parser() -> argparse.ArgumentParser:
         "summary table (gpu_alloc, frag, placed) — B configs, one "
         "compiled scan",
     )
+    # chaos sweep (ISSUE 10; README "Chaos sweep")
+    p_apply.add_argument(
+        "--sweep-faults", default="", metavar="FAULTS.json",
+        help="replace the main schedule with ONE vmapped chaos sweep: "
+        "same trace, B fault schedules (per-lane FaultConfig documents — "
+        "mtbf_events/mttr_events/evict_every_events/seed/backoff knobs; "
+        'bare list or {"faults": [...], "weights": [[...]], "seeds": '
+        "[...]}) and print the per-lane disruption frontier — B fault "
+        "what-ifs, one compiled scan",
+    )
     p_apply.add_argument(
         "--compile-cache-dir", default="", metavar="DIR",
         help="JAX persistent compilation cache (default "
@@ -374,6 +384,27 @@ def _build_parser() -> argparse.ArgumentParser:
         "robustness eval",
     )
     p_tune.add_argument("--robust-seed", type=int, default=0)
+    # chaos-sweep training (ISSUE 10): roll the POPULATION itself through
+    # a seeded fault schedule (one compiled faulted scan per generation)
+    # so the objective's disruption term trains directly
+    p_tune.add_argument(
+        "--train-fault-mtbf", type=float, default=0.0, metavar="EVENTS",
+        help="train under disruption: every rollout lane replays under "
+        "a seeded fault schedule with this MTBF (0 = fault-free "
+        "training); local backend only",
+    )
+    p_tune.add_argument(
+        "--train-fault-mttr", type=float, default=0.0, metavar="EVENTS")
+    p_tune.add_argument(
+        "--train-fault-evict-every", type=float, default=0.0,
+        metavar="EVENTS")
+    p_tune.add_argument("--train-fault-seed", type=int, default=0)
+    p_tune.add_argument(
+        "--obj-disrupt", type=float, default=0.0,
+        help="objective weight on pods terminally lost to disruption "
+        "(percent of trace pods); needs --train-fault-* to be non-zero "
+        "to matter",
+    )
     p_tune.add_argument(
         "--timeout", type=float, default=600.0, metavar="SECONDS",
         help="per-generation wait budget on the remote backend",
@@ -438,6 +469,7 @@ def cmd_apply(args) -> int:
         series_every=args.series_every,
         listen=args.listen,
         sweep_weights=args.sweep_weights,
+        sweep_faults=args.sweep_faults,
         compile_cache_dir=args.compile_cache_dir,
     )
     Applier(opts).run()
@@ -569,7 +601,26 @@ def _serve_jobs(args) -> int:
     srv, service, worker = start_job_server(
         args.dir, {"default": trace}, listen=args.listen,
         lane_width=args.lane_width, queue_size=args.queue_size,
+        out=sys.stderr,
     )
+    # graceful shutdown (ISSUE 10): SIGTERM/SIGINT begin the drain —
+    # /healthz flips to 503, POSTs answer 503 + Retry-After, the
+    # in-flight batch finishes (worker.stop joins after it), and every
+    # queued job's spec is already on disk for the next startup's
+    # recovery pass
+    import signal
+
+    stop_flag = {"stop": False}
+
+    def _graceful(_signum, _frame):
+        stop_flag["stop"] = True
+        srv.begin_drain()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+    except ValueError:
+        pass  # non-main thread (tests drive _serve_jobs directly)
     print(
         f"[serve] job plane at {srv.url} (POST /jobs, GET "
         f"/jobs/<id>[/result], /queue, /metrics, /healthz, /progress); "
@@ -593,15 +644,17 @@ def _serve_jobs(args) -> int:
                 file=sys.stderr,
             )
             return 0
-        while True:
+        while not stop_flag["stop"]:
             record, progress = watch_dir(args.dir)
             if record is not None:
                 srv.publish_record(record)
             time.sleep(max(args.poll, 0.2))
+        print("[serve] draining: finishing the in-flight batch",
+              file=sys.stderr)
     except KeyboardInterrupt:
-        pass
+        srv.begin_drain()
     finally:
-        worker.stop()
+        worker.stop()  # joins after the current batch — the drain
         srv.stop()
     return 0
 
@@ -653,9 +706,33 @@ def cmd_tune(args) -> int:
             objective=ObjectiveConfig(
                 w_alloc=args.obj_alloc, w_frag=args.obj_frag,
                 w_unsched=args.obj_unsched,
+                w_disrupt=args.obj_disrupt,
             ),
         )
+        train_fault = None
+        train_fault_meta = None
+        if args.train_fault_mtbf > 0 or args.train_fault_evict_every > 0:
+            from tpusim.sim.faults import FaultConfig
+
+            train_fault = FaultConfig(
+                mtbf_events=args.train_fault_mtbf,
+                mttr_events=args.train_fault_mttr,
+                evict_every_events=args.train_fault_evict_every,
+                seed=args.train_fault_seed,
+            )
+            train_fault_meta = {
+                "mtbf": float(args.train_fault_mtbf),
+                "mttr": float(args.train_fault_mttr),
+                "evict_every": float(args.train_fault_evict_every),
+                "seed": int(args.train_fault_seed),
+            }
         if args.url:
+            if train_fault is not None:
+                raise ValueError(
+                    "--train-fault-* needs the local backend (the remote "
+                    "job plane takes per-job `fault` fields instead — "
+                    "submit a chaos grid through `tpusim submit`)"
+                )
             # the service must host the SAME train prefix this CLI
             # computed (serve --jobs --max-pods), else the tuned vector
             # describes a different workload
@@ -672,7 +749,9 @@ def cmd_tune(args) -> int:
             sim = make_family_sim(
                 trace.nodes, train, policies, engine=args.engine
             )
-            backend = LocalRollout(sim, width=args.popsize)
+            backend = LocalRollout(
+                sim, width=args.popsize, fault=train_fault
+            )
 
         robust_eval, robust_meta = None, None
         if args.robust_mtbf > 0:
@@ -698,6 +777,7 @@ def cmd_tune(args) -> int:
         result = run_tune(
             backend, policies, cfg, args.log, resume=args.resume,
             robust_eval=robust_eval, robust_meta=robust_meta,
+            train_fault_meta=train_fault_meta,
             out=sys.stderr,
         )
 

@@ -246,12 +246,13 @@ def save_checkpoint(
     return path
 
 
-def find_checkpoint(cache_dir: str, digest: str) -> Optional[Tuple[int, str]]:
-    """Latest (cursor, path) checkpoint for a run digest, or None. Torn or
-    foreign files never match — the digest prefix is the whole contract."""
+def iter_checkpoints(cache_dir: str, digest: str) -> list:
+    """Every (cursor, path) checkpoint of a run digest, NEWEST first.
+    Foreign files never match — the digest prefix is the whole
+    contract."""
     if not cache_dir or not os.path.isdir(cache_dir):
-        return None
-    best: Optional[Tuple[int, str]] = None
+        return []
+    out = []
     prefix = digest + ".e"
     for fname in os.listdir(cache_dir):
         if not (fname.startswith(prefix) and fname.endswith(CHECKPOINT_SUFFIX)):
@@ -260,9 +261,46 @@ def find_checkpoint(cache_dir: str, digest: str) -> Optional[Tuple[int, str]]:
             cursor = int(fname[len(prefix):-len(CHECKPOINT_SUFFIX)])
         except ValueError:
             continue
-        if best is None or cursor > best[0]:
-            best = (cursor, os.path.join(cache_dir, fname))
-    return best
+        out.append((cursor, os.path.join(cache_dir, fname)))
+    out.sort(reverse=True)
+    return out
+
+
+def find_checkpoint(cache_dir: str, digest: str) -> Optional[Tuple[int, str]]:
+    """Latest (cursor, path) checkpoint for a run digest, or None."""
+    cands = iter_checkpoints(cache_dir, digest)
+    return cands[0] if cands else None
+
+
+def load_valid_checkpoint(cache_dir: str, digest: str, validate=None,
+                          on_skip=None):
+    """(cursor, arrays, path) of the NEWEST checkpoint that loads AND
+    passes `validate(arrays)` (ISSUE 10 torn-checkpoint tolerance): a
+    corrupt/truncated `.ckpt.npz` — a machine killed mid-write on a
+    filesystem without atomic rename, a short copy, an edited file — is
+    skipped (and deleted, so it cannot shadow future saves) with an
+    `on_skip(path, err)` callback instead of crashing the resume, and
+    the run continues from the newest VALID predecessor. Returns None
+    when no usable checkpoint exists (a fresh start is always safe —
+    content addressing guarantees it)."""
+    for cursor, path in iter_checkpoints(cache_dir, digest):
+        try:
+            cur, arrays = load_checkpoint(path)
+            if cur != cursor:
+                raise ValueError(
+                    f"cursor mismatch: file says {cur}, name says {cursor}"
+                )
+            if validate is not None:
+                validate(arrays)
+            return cursor, arrays, path
+        except Exception as err:
+            if on_skip is not None:
+                on_skip(path, err)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    return None
 
 
 def load_checkpoint(path: str) -> Tuple[int, Dict[str, "object"]]:

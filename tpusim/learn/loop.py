@@ -123,21 +123,25 @@ def read_log(log_path: str):
 
 def run_tune(backend, policies, cfg: TuneConfig, log_path: str,
              resume: bool = False, robust_eval=None, robust_meta=None,
-             out=None) -> TuneResult:
+             train_fault_meta=None, out=None) -> TuneResult:
     """The generation loop (see module docstring). `backend` is a
     learn.rollout backend; `robust_eval` an optional callable
     (weights) -> terms re-running the generation's best candidate under
     injected faults (objective.make_robust_eval) — logged, never fed
-    back into the optimizer (disruption robustness is a report, not a
-    training signal, until the fault plane grows sweep operands).
-    `robust_meta` describes the evaluator's knobs (fault mtbf/mttr/
-    seed) for the log header: robustness shapes the log's bytes, so a
-    resume that toggles or retunes it must fail the config check
-    instead of appending records of a different shape."""
+    back into the optimizer (disruption robustness is a report by
+    default; `tpusim tune --train-fault-*` instead rolls the whole
+    population through the chaos sweep so w_disrupt trains directly,
+    ISSUE 10). `robust_meta` / `train_fault_meta` describe the
+    evaluator's / training schedule's knobs for the log header:
+    both shape the log's bytes, so a resume that toggles or retunes
+    them must fail the config check instead of appending records of a
+    different shape."""
     header_cfg = cfg.canonical(policies)
     if (robust_eval is not None) or (robust_meta is not None):
         header_cfg["robust"] = robust_meta if robust_meta is not None \
             else True
+    if train_fault_meta is not None:
+        header_cfg["train_fault"] = train_fault_meta
     x0 = np.asarray([float(w) for _, w in policies], np.float64)
     opt = make_optimizer(cfg, x0)
 

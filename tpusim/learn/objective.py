@@ -32,16 +32,29 @@ import numpy as np
 
 @dataclass(frozen=True)
 class ObjectiveConfig:
-    """Term weights of the scalarized objective (all terms in percent)."""
+    """Term weights of the scalarized objective (all terms in percent).
+
+    w_disrupt (ISSUE 10) charges pods PERMANENTLY lost to disruption
+    (max-retries-exceeded under a fault schedule) — trainable now that
+    fault schedules are sweep operands (the chaos sweep rolls a whole
+    population through one faulted compiled scan). 0 keeps the
+    pre-fault objective AND the pre-fault log-header bytes (old tuning
+    logs stay resumable)."""
 
     w_alloc: float = 1.0
     w_frag: float = 1.0
     w_unsched: float = 1.0
+    w_disrupt: float = 0.0
 
     def canonical(self) -> list:
-        """Deterministic JSON form for the tuning-log header."""
-        return [float(self.w_alloc), float(self.w_frag),
+        """Deterministic JSON form for the tuning-log header. The
+        disruption weight joins only when non-zero so pre-chaos logs
+        keep their exact header bytes."""
+        base = [float(self.w_alloc), float(self.w_frag),
                 float(self.w_unsched)]
+        if self.w_disrupt:
+            base.append(float(self.w_disrupt))
+        return base
 
 
 def lane_terms(lane) -> dict:
@@ -55,6 +68,7 @@ def lane_terms(lane) -> dict:
     h = hashlib.sha256()
     h.update(pn.tobytes())
     h.update(dm.tobytes())
+    dis = getattr(lane, "disruption", None)
     return {
         "weights": [int(w) for w in lane.weights],
         "seed": int(lane.seed),
@@ -63,6 +77,11 @@ def lane_terms(lane) -> dict:
         "placed": int(lane.placed),
         "failed": int(lane.failed),
         "unscheduled": int(lane.unscheduled),
+        # chaos-sweep lanes (ISSUE 10): pods terminally lost to
+        # disruption + total evictions; 0 on fault-free lanes so the
+        # vocabulary is one dict either way
+        "disrupted": int(dis.unscheduled_after_retries) if dis else 0,
+        "evicted": int(dis.evicted_pods) if dis else 0,
         "gpu_total_milli": int(
             np.asarray(lane.state.gpu_cnt, np.int64).sum()
         ) * MILLI,
@@ -85,6 +104,9 @@ def terms_from_result(doc: dict) -> dict:
         "placed": int(doc["placed"]),
         "failed": int(doc["failed"]),
         "unscheduled": int(doc["unscheduled"]),
+        # absent on pre-chaos service results -> the fault-free value
+        "disrupted": int(doc.get("disrupted", 0)),
+        "evicted": int(doc.get("evicted", 0)),
         "gpu_total_milli": int(doc["gpu_total_milli"]),
         "gpu_alloc_pct": float(doc["gpu_alloc_pct"]),
         "frag_gpu_milli": float(doc["frag_gpu_milli"]),
@@ -99,10 +121,12 @@ def scalarize(terms: dict, cfg: ObjectiveConfig = None) -> float:
         terms["gpu_total_milli"], 1
     )
     unsched_pct = 100.0 * terms["unscheduled"] / max(terms["pods"], 1)
+    disrupt_pct = 100.0 * terms.get("disrupted", 0) / max(terms["pods"], 1)
     return (
         cfg.w_alloc * terms["gpu_alloc_pct"]
         - cfg.w_frag * frag_pct
         - cfg.w_unsched * unsched_pct
+        - cfg.w_disrupt * disrupt_pct
     )
 
 

@@ -94,7 +94,18 @@ class LocalRollout:
 
     name = "local"
 
-    def __init__(self, sim, width: int, bucket: int = 512):
+    def __init__(self, sim, width: int, bucket: int = 512, fault=None):
+        """`fault` (ISSUE 10): a FaultConfig makes every generation's
+        rollout a CHAOS sweep — the whole population replays under the
+        same seeded fault schedule (common random disruption, like the
+        shared eval seed), so the objective's w_disrupt term trains on
+        in-scan DisruptionMetrics instead of a post-hoc robustness
+        report. Still one compiled scan per generation: the schedule is
+        a lane operand."""
+        self.fault = fault
+        self._init_common(sim, width, bucket)
+
+    def _init_common(self, sim, width: int, bucket: int):
         if width < 1:
             raise ValueError(f"width must be >= 1, got {width}")
         if sim.cfg.heartbeat_every:
@@ -114,7 +125,7 @@ class LocalRollout:
         self._fns: set = set()  # jitted sweep wrappers dispatched
 
     def rollout(self, rows: Sequence[tuple], seed: int) -> List[dict]:
-        from tpusim.sim.driver import _sweep_engine
+        from tpusim.sim.driver import _sweep_engine, _sweep_fault_engine
 
         if not rows:
             return []
@@ -128,17 +139,23 @@ class LocalRollout:
         # not compile its own executable (the svc worker's discipline)
         padded = list(rows) + [rows[-1]] * (self.width - len(rows))
         w = np.asarray(padded, np.int32)
+        faults = [self.fault] * self.width if self.fault else None
         lanes = self.sim.run_sweep(
-            w, seeds=[int(seed)] * self.width, bucket=self.bucket
+            w, seeds=[int(seed)] * self.width, bucket=self.bucket,
+            faults=faults,
         )[: len(rows)]
         # track the dispatched wrapper so executables() can assert the
         # zero-recompile contract (the svc worker's /queue metric)
         used_table = self.sim._last_engine.startswith("table")
-        self._fns.add(_sweep_engine(
-            self.sim._table_fn.engine.replay if used_table
-            else self.sim.replay_fn.engine,
-            table=used_table,
-        ))
+        if self.fault:
+            # the chaos-sweep dispatch stashes its jitted wrapper
+            self._fns.add(self.sim._last_sweep_fn)
+        else:
+            self._fns.add(_sweep_engine(
+                self.sim._table_fn.engine.replay if used_table
+                else self.sim.replay_fn.engine,
+                table=used_table,
+            ))
         return [lane_terms(lane) for lane in lanes]
 
     def executables(self) -> int:

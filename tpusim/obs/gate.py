@@ -407,6 +407,96 @@ def svc_smoke(nodes, pods, out_dir: str, b: int = 4) -> Tuple[bool, List[str]]:
     return True, msgs
 
 
+def chaos_smoke(nodes, pods, b: int = 8) -> Tuple[bool, List[str]]:
+    """ISSUE 10 satellite: the chaos sweep end-to-end on a tiny trace
+    prefix — B fault schedules (varying seed/MTBF/evict cadence) in ONE
+    compiled vmapped scan, with three hard checks: exactly one compiled
+    chaos executable after the first wave, a second wave with DIFFERENT
+    schedules adds none (jit._cache_size() stable — fault schedules are
+    operands, never jaxpr), and lane 0's placements + DisruptionMetrics
+    reconcile exactly against the standalone single-lane
+    run_with_faults path."""
+    msgs: List[str] = []
+    try:
+        import numpy as np
+
+        from tpusim.sim.driver import Simulator, SimulatorConfig
+        from tpusim.sim.faults import FaultConfig
+
+        sub_nodes, sub_pods = nodes[:200], pods[:120]
+
+        def mk():
+            sim = Simulator(sub_nodes, SimulatorConfig(
+                policies=(("FGDScore", 1000),),
+                gpu_sel_method="FGDScore", report_per_event=False,
+                shuffle_pod=False, seed=42,
+            ))
+            sim.set_workload_pods(list(sub_pods))
+            return sim
+
+        def schedules(seed0):
+            # explicit queue capacity: retry-slot blocks scale with it,
+            # so pinning it (as a real service config would) keeps every
+            # wave's merged stream in one power-of-two shape class
+            return [
+                FaultConfig(
+                    mtbf_events=30 + 7 * i, mttr_events=40,
+                    evict_every_events=25 - 3 * i, seed=seed0 + i,
+                    backoff_base=4, backoff_cap=32, max_retries=3,
+                    queue_capacity=16,
+                )
+                for i in range(b)
+            ]
+        w = np.asarray([[1000]] * b, np.int32)
+
+        sim = mk()
+        lanes = sim.run_sweep(w, seeds=[42] * b, faults=schedules(100))
+        fn = sim._last_sweep_fn
+        execs = fn._cache_size()
+        if execs != 1:
+            return False, [
+                f"[gate] chaos: expected ONE compiled chaos executable, "
+                f"found {execs} (FAIL)"
+            ]
+        # lane 0 vs the standalone single-lane fault path: placements
+        # and every DisruptionMetrics number must reconcile
+        solo = mk()
+        res = solo.run_with_faults(fault_cfg=schedules(100)[0])
+        if not np.array_equal(res.placed_node, lanes[0].placed_node):
+            return False, [
+                "[gate] chaos: lane 0 placements diverge from the "
+                "standalone run_with_faults path (FAIL)"
+            ]
+        a, c = solo.last_disruption.as_dict(), lanes[0].disruption.as_dict()
+        for k in a:
+            same = (abs(a[k] - c[k]) < 1e-6 if isinstance(a[k], float)
+                    else a[k] == c[k])
+            if not same:
+                return False, [
+                    f"[gate] chaos: DisruptionMetrics[{k}] diverges "
+                    f"(standalone {a[k]} vs lane {c[k]}) (FAIL)"
+                ]
+        # second wave, different schedules, same Simulator (the service
+        # worker keeps per-family sims, so its sticky shape floors
+        # apply): zero recompiles — the HARD operand contract
+        sim.run_sweep(w, seeds=[42] * b, faults=schedules(900))
+        if sim._last_sweep_fn is not fn or fn._cache_size() != execs:
+            return False, [
+                f"[gate] chaos: a new fault-schedule wave RECOMPILED "
+                f"({execs} -> {fn._cache_size()} executables) (FAIL)"
+            ]
+        dm = lanes[0].disruption
+        msgs.append(
+            f"[gate] chaos: {b}-lane fault sweep x2 waves on one "
+            f"executable (zero recompiles); lane0 reconciles standalone "
+            f"(evicted={dm.evicted_pods} resched={dm.rescheduled_pods} "
+            f"dead={dm.unscheduled_after_retries})"
+        )
+    except Exception as err:
+        return False, [f"[gate] chaos: FAIL ({type(err).__name__}: {err})"]
+    return True, msgs
+
+
 def tune_smoke(out_dir: str, generations: int = 3) -> Tuple[bool, List[str]]:
     """ISSUE 9 satellite (`make tune-smoke`): run the learned-scoring
     loop on a tiny synthetic trace for a few generations on the LOCAL
@@ -567,6 +657,11 @@ def main(argv=None) -> int:
         help="run only the learned-scoring smoke (ISSUE 9) — the "
         "`make tune-smoke` mode",
     )
+    ap.add_argument(
+        "--chaos-only", action="store_true",
+        help="run only the chaos-sweep smoke (ISSUE 10) — the "
+        "`make chaos-smoke` mode",
+    )
     args = ap.parse_args(argv)
 
     if args.tune_only:
@@ -585,6 +680,11 @@ def main(argv=None) -> int:
 
     if args.svc_only:
         ok, msgs = svc_smoke(nodes, pods, args.out)
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
+    if args.chaos_only:
+        ok, msgs = chaos_smoke(nodes, pods)
         print("\n".join(msgs))
         print(f"[gate] {'PASS' if ok else 'FAIL'}")
         return 0 if ok else 1
@@ -639,7 +739,12 @@ def main(argv=None) -> int:
     # compiled sweep — zero recompiles, signed resumable log
     tune_ok, tune_msgs = tune_smoke(args.out)
     print("\n".join(tune_msgs))
-    smoke_ok = dec_ok and scrape_ok and swp_ok and svc_ok and tune_ok
+    # chaos-sweep smoke (ISSUE 10 satellite): B-lane fault sweep — hard
+    # zero-recompile check + standalone disruption reconciliation
+    chaos_ok, chaos_msgs = chaos_smoke(nodes, pods)
+    print("\n".join(chaos_msgs))
+    smoke_ok = (dec_ok and scrape_ok and swp_ok and svc_ok and tune_ok
+                and chaos_ok)
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

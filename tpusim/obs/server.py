@@ -82,6 +82,22 @@ class MonitorServer:
         # fall through; first non-None answer wins, built-ins serve as
         # the GET fallback
         self._apps: list = []
+        # graceful shutdown (ISSUE 10 satellite): once draining, POSTs
+        # answer 503 + Retry-After (the client's connection-reset/503
+        # retry path resubmits against the restarted process — specs are
+        # already persisted) and /healthz flips to 503 so load balancers
+        # stop routing here while the in-flight batch finishes
+        self._draining = False
+
+    def begin_drain(self):
+        with self._lock:
+            self._draining = True
+            self._progress = dict(self._progress, phase="draining")
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
 
     # ---- write surface ----
 
@@ -195,6 +211,14 @@ class MonitorServer:
                 return True
 
             def do_POST(self):
+                if srv.draining:
+                    self._send(
+                        503, "application/json",
+                        b'{"error": "draining: service is shutting down"'
+                        b', "retry_after_s": 2}\n',
+                        headers={"Retry-After": "2"},
+                    )
+                    return
                 if not self._try_apps("POST"):
                     self._send(404, "text/plain", b"not found\n")
 
@@ -215,12 +239,14 @@ class MonitorServer:
                     )
                 elif path == "/healthz":
                     with srv._lock:
+                        draining = srv._draining
                         body = json.dumps({
-                            "ok": True,
+                            "ok": not draining,
                             "phase": srv._progress.get("phase"),
                             "records": srv._records,
                         }, sort_keys=True)
-                    self._send(200, "application/json",
+                    self._send(503 if draining else 200,
+                               "application/json",
                                (body + "\n").encode())
                 elif path == "/progress":
                     with srv._lock:

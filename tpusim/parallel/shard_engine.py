@@ -106,7 +106,8 @@ class ShardTableCarry(NamedTuple):
 def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                                report: bool = False, block_size: int = 0,
                                decisions: bool = False,
-                               series_every: int = 0):
+                               series_every: int = 0,
+                               faults: bool = False):
     """Build the explicit-collective sharded replayer. The node count must
     already be padded to a multiple of the mesh size (parallel.pad_nodes)
     and `state`/`tiebreak_rank` sharded over it (parallel.shard_state).
@@ -158,6 +159,21 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             "the shard_map engine replays metric-free; build the report "
             "series with tpusim.sim.metrics.compute_event_metrics"
         )
+    if faults and (decisions or series_every):
+        raise ValueError(
+            "the in-scan fault plane (faults=True) does not combine with "
+            "decisions/series builds on the shard engine"
+        )
+    if faults:
+        # fault transitions touch exactly one node row, so the DOWN
+        # masking IS the mem_left == -1 pad sentinel the local Filter
+        # already rejects; the requeue scatter and disruption counters
+        # are replicated bookkeeping (identical on every shard), and the
+        # state row resets/returns are owner-masked via the global-id
+        # row mask. The recover frag-delta capture stays OFF here — a
+        # psum of f32 partials cannot be bit-equal to the single-device
+        # cluster sum (ENGINES.md Round 14).
+        from tpusim.sim import fault_lane as _fl
     reject_randomized(policies, gpu_sel)
     sel_idx = selector_index(policies, gpu_sel)
     _columns, _init_tables = make_table_builders(policies, sel_idx)
@@ -180,7 +196,8 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             if all_none_norm else 0
         )
 
-    def _init_shard(state, rank, pods, types, tp, key, wts):
+    def _init_shard(state, rank, pods, types, tp, key, wts,
+                    fault_carry0=None):
         """Per-shard carry at event 0: local table shards + blocked local
         summaries + replicated bookkeeping (state/rank are the LOCAL node
         rows; wts is the replicated weight operand)."""
@@ -220,17 +237,20 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         masks = jnp.zeros((num_pods, MAX_GPUS_PER_NODE), jnp.bool_)
         failed = jnp.zeros(num_pods, jnp.bool_)
         z = jnp.int32(0)
-        return ShardTableCarry(
+        base = ShardTableCarry(
             state, packed_tbl, lt, lr, lwn, z, placed, masks, failed,
             z, z, key, zero_counters(),
         )
+        return (base, fault_carry0) if faults else base
 
-    def _chunk_shard(carry, rank, pods, types, ev_kind, ev_pod, tp, wts):
+    def _chunk_shard(carry, rank, pods, types, ev_kind, ev_pod, tp, wts,
+                     fault_ops=None):
         """Advance a per-shard carry over one event segment (the scan the
         one-shot replay runs over the whole stream). `wts` must be the
         weight vector the carry was initialized under (the blocked local
         summaries embed it)."""
-        nloc = carry.state.num_nodes
+        base0 = carry[0] if faults else carry
+        nloc = base0.state.num_nodes
         me = jax.lax.axis_index(NODE_AXIS)
         offset = (me * nloc).astype(jnp.int32)
         gids = offset + jnp.arange(nloc, dtype=jnp.int32)
@@ -239,13 +259,28 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         k_types = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
         bsz = _resolve_bsz(nloc, k_types)
         rank_p = (
-            _pad_rank(rank, carry.packed_tbl.shape[1]) if bsz else rank
+            _pad_rank(rank, base0.packed_tbl.shape[1]) if bsz else rank
         )
 
         def body(carry, ev):
+            if faults:
+                carry, fc = carry
+                kind, idx, fpos, farg, faux = ev
             (state, packed_tbl, lt, lr, lwn, dirty, placed, masks, failed,
              arr_cpu, arr_gpu, key, ctr) = carry
-            kind, idx = ev
+            if not faults:
+                kind, idx = ev
+                kc = jnp.clip(kind, 0, 2)
+            else:
+                from tpusim.sim.engine import EV_RETRY
+
+                is_slot = kind == EV_RETRY
+                fc, has_pop, rpod = _fl.pop_retry(fc, is_slot, fpos, farg)
+                idx = jnp.where(has_pop, rpod, idx)
+                kc = jnp.where(
+                    is_slot, jnp.where(has_pop, 0, 2),
+                    jnp.clip(kind, 0, 2),
+                )
             pod = jax.tree.map(lambda a: a[idx], pods)
             t_id = type_id[idx]
             key, k_col, k_sel = jax.random.split(key, 3)
@@ -557,7 +592,6 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             # cannot alias the carry, and the resulting per-event copies
             # of state/placed/masks dominated the loop at large nloc
             # (same restructure as the single-device table engine)
-            kc = jnp.clip(kind, 0, 2)
             outs = jax.lax.switch(kc, [do_create, do_delete, do_skip])
             if decisions:
                 node, dev, dec = outs
@@ -595,7 +629,14 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                           jnp.where(is_delete, False, masks[idx]))
             )
             failed = failed.at[idx].set(
-                jnp.where(is_create, node < 0, failed[idx])
+                jnp.where(
+                    is_create,
+                    # retry attempts accumulate ever-failed with OR (the
+                    # segmented path's per-segment `|=`)
+                    (failed[idx] & is_slot & is_create) | (node < 0)
+                    if faults else node < 0,
+                    failed[idx],
+                )
             )
             arr_cpu = arr_cpu + jnp.where(is_create, pod.cpu, 0)
             arr_gpu = arr_gpu + jnp.where(is_create, pod.total_gpu_milli(), 0)
@@ -603,16 +644,42 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             # skips the next refresh — same as the pre-restructure behavior
             dirty = jnp.where(kc == 2, dirty, node)
             ctr = ctr + counter_delta(kc, node)
-            return ShardTableCarry(
+            if faults:
+                # masked fault transitions: state row ops owner-masked by
+                # the global-id row mask, bookkeeping replicated
+                (state, placed, masks, failed, fc, ftouch, fy) = (
+                    _fl.apply_fault_step(
+                        state, placed, masks, failed, fc, pods, kind,
+                        farg, faux, fpos, fault_ops, tp, gids, False,
+                    )
+                )
+                fc, lat, _ = _fl.commit_retry(
+                    fc, has_pop, rpod, node, fpos, farg, fault_ops.params
+                )
+                fy = fy._replace(
+                    rpod=jnp.where(has_pop, rpod, -1).astype(jnp.int32),
+                    lat=lat,
+                )
+                dirty = jnp.where(ftouch >= 0, ftouch, dirty)
+                node = jnp.where(ftouch >= 0, ftouch, node)
+            new_carry = ShardTableCarry(
                 state, packed_tbl, lt, lr, lwn, dirty, placed, masks,
                 failed, arr_cpu, arr_gpu, key, ctr,
-            ), (
+            )
+            ys = (
                 (node, dev)
                 + ((dec,) if decisions else ())
                 + ((ser,) if series_every else ())
             )
+            if faults:
+                return (new_carry, fc), ys + (fy,)
+            return new_carry, ys
 
-        carry, ys = jax.lax.scan(body, carry, (ev_kind, ev_pod))
+        xs = (
+            (ev_kind, ev_pod, fault_ops.pos, fault_ops.arg, fault_ops.aux)
+            if faults else (ev_kind, ev_pod)
+        )
+        carry, ys = jax.lax.scan(body, carry, xs)
         return (carry,) + tuple(ys)
 
     state_specs = NodeState(*([P(NODE_AXIS)] * len(NodeState._fields)))
@@ -652,47 +719,75 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     ser_specs = obs_series.SeriesSample(
         *([P()] * len(obs_series.SeriesSample._fields))
     )
+    if faults:
+        # retry queue, disruption counters, streams, and fault telemetry
+        # are all replicated — identical on every shard by construction
+        fc_specs = _fl.FaultCarry(*([P()] * len(_fl.FaultCarry._fields)))
+        fops_specs = _fl.FaultOps(*([P()] * len(_fl.FaultOps._fields)))
+        fy_specs = _fl.FaultY(*([P()] * len(_fl.FaultY._fields)))
+        carry_specs = (carry_specs, fc_specs)
     mapped_init = _wrap(
         _init_shard,
         (state_specs, P(NODE_AXIS), spec_r, types_specs, tp_specs, P(),
-         P()),
+         P()) + ((fc_specs,) if faults else ()),
         carry_specs,
     )
     mapped_chunk = _wrap(
         _chunk_shard,
         (carry_specs, P(NODE_AXIS), spec_r, types_specs, P(), P(), tp_specs,
-         P()),
+         P()) + ((fops_specs,) if faults else ()),
         (carry_specs, P(), P())
         + ((dec_specs,) if decisions else ())
-        + ((ser_specs,) if series_every else ()),
+        + ((ser_specs,) if series_every else ())
+        + ((fy_specs,) if faults else ()),
     )
 
     from tpusim.sim.step import resolve_weights
 
     @jax.jit
-    def _init_carry_j(state, pods, types, tp, key, tiebreak_rank, wts):
+    def _init_carry_j(state, pods, types, tp, key, tiebreak_rank, wts,
+                      fault_carry0=None):
+        if faults:
+            return mapped_init(state, tiebreak_rank, pods, types, tp, key,
+                               wts, fault_carry0)
         return mapped_init(state, tiebreak_rank, pods, types, tp, key, wts)
 
     @jax.jit
     def _run_chunk_j(carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank,
-                     wts):
-        outs = mapped_chunk(
-            carry, tiebreak_rank, pods, types, ev_kind, ev_pod, tp, wts
-        )
+                     wts, fault_ops=None):
+        if faults:
+            outs = mapped_chunk(
+                carry, tiebreak_rank, pods, types, ev_kind, ev_pod, tp,
+                wts, fault_ops,
+            )
+        else:
+            outs = mapped_chunk(
+                carry, tiebreak_rank, pods, types, ev_kind, ev_pod, tp, wts
+            )
         return outs[0], tuple(outs[1:])
 
     # weights resolve OUTSIDE the jitted functions (ISSUE 6): the weight
     # vector is always a traced operand, never a baked constant, so one
     # compiled shard_map scan serves every weight vector of the family
     def init_carry(state, pods, types, tp, key, tiebreak_rank,
-                   weights=None):
+                   weights=None, fault_carry0=None):
+        if faults:
+            return _init_carry_j(
+                state, pods, types, tp, key, tiebreak_rank,
+                resolve_weights(policies, weights), fault_carry0,
+            )
         return _init_carry_j(
             state, pods, types, tp, key, tiebreak_rank,
             resolve_weights(policies, weights),
         )
 
     def run_chunk(carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank,
-                  weights=None):
+                  weights=None, fault_ops=None):
+        if faults:
+            return _run_chunk_j(
+                carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank,
+                resolve_weights(policies, weights), fault_ops,
+            )
         return _run_chunk_j(
             carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank,
             resolve_weights(policies, weights),
@@ -703,27 +798,44 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         """No pending-commit epilogue here (the shard engine binds in the
         event body); shaped like the table engine's finish so the driver's
         chunked dispatch is engine-agnostic."""
+        if faults:
+            carry = carry[0]
         return carry.state, carry.placed, carry.masks, carry.failed
 
     @jax.jit
     def _replay_impl(state, pods, types, ev_kind, ev_pod, tp, key,
-                     tiebreak_rank, wts) -> ReplayResult:
+                     tiebreak_rank, wts, fault_ops=None,
+                     fault_carry0=None) -> ReplayResult:
         carry = _init_carry_j(state, pods, types, tp, key, tiebreak_rank,
-                              wts)
+                              wts, fault_carry0)
         carry, ys = _run_chunk_j(
-            carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank, wts
+            carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank, wts,
+            fault_ops,
         )
         nodes, devs = ys[0], ys[1]
         rest = list(ys[2:])
         decs = rest.pop(0) if decisions else None
         sers = rest.pop(0) if series_every else None
+        if faults:
+            base, fc = carry
+            return ReplayResult(
+                base.state, base.placed, base.masks, base.failed, None,
+                nodes, devs, base.ctr, None, None, rest.pop(0), fc,
+            )
         return ReplayResult(
             carry.state, carry.placed, carry.masks, carry.failed, None,
             nodes, devs, carry.ctr, decs, sers,
         )
 
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,
-               tiebreak_rank, weights=None) -> ReplayResult:
+               tiebreak_rank, weights=None, fault_ops=None,
+               fault_carry0=None) -> ReplayResult:
+        if faults:
+            return _replay_impl(
+                state, pods, types, ev_kind, ev_pod, tp, key,
+                tiebreak_rank, resolve_weights(policies, weights),
+                fault_ops, fault_carry0,
+            )
         return _replay_impl(
             state, pods, types, ev_kind, ev_pod, tp, key, tiebreak_rank,
             resolve_weights(policies, weights),

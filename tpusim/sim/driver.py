@@ -166,6 +166,20 @@ class SimulatorConfig:
     # scan compile looked like a cache hit (dispatch-wall heuristic —
     # obs.spans.note_compile_cache).
     compile_cache_dir: str = ""
+    # Fault-replay execution mode (ISSUE 10): "auto" runs fault
+    # schedules INSIDE the compiled scan (tpusim.sim.fault_lane — fault
+    # events + an in-carry retry queue as merged stream operands, the
+    # chaos-sweep lane) whenever the config allows, falling back to the
+    # PR 2 segmented host loop for configs only it can serve (per-event
+    # reporting, extenders, decisions/series recording, checkpointing,
+    # pallas, heartbeat). "scan" forces the in-scan lane (raises on
+    # unsupported configs); "segments" forces the host loop. Both paths
+    # are bit-identical for deterministic configs (the acceptance pin);
+    # per-event-random configs (RandomScore / gpu_sel random) draw a
+    # different — still seeded and reproducible — PRNG chain on the scan
+    # lane, because the segmented path's per-segment key fold-in was an
+    # artifact of the segmentation.
+    fault_mode: str = "auto"
     # Device-mesh width: 0 = single device; N > 1 shards the node axis
     # over an N-device jax.sharding.Mesh and replays on the
     # explicit-collective shard_map engine (tpusim.parallel.shard_engine;
@@ -251,6 +265,9 @@ def _engine_source_digest() -> bytes:
                 # the series vocabulary shapes the checkpointed sample
                 # stream (ISSUE 5) — same invalidation discipline
                 "obs/series.py",
+                # the fault vocabulary shapes the fault-lane trajectory
+                # and the FaultCarry layout (ISSUE 10) — same discipline
+                "sim/fault_lane.py",
             )
         ]
         files += glob.glob(os.path.join(base, "policies", "*.py"))
@@ -1081,63 +1098,70 @@ class Simulator:
         dev_parts: list = []
         dec_parts: list = []  # DecisionRecord-of-np per segment (ISSUE 4)
         ser_parts: list = []  # SeriesSample-of-np per segment (ISSUE 5)
-        found = ckpt.find_checkpoint(cache_dir, digest)
+        def _validate(arrays):
+            """Layout check against the carry template — a vocabulary or
+            shape drift reads as corrupt and the resume walks back."""
+            leaves = [arrays[f"c{i:03d}"] for i in range(len(tleaves))]
+            if any(
+                a.shape != t.shape or a.dtype != t.dtype
+                for a, t in zip(leaves, tleaves)
+            ):
+                raise ValueError("carry layout mismatch")
+            arrays["event_node"], arrays["event_dev"]  # must exist
+            if record_dec:
+                for f in dec_fields:
+                    arrays[f"dec_{f}"]
+            if record_ser:
+                for f in ser_fields:
+                    arrays[f"ser_{f}"]
+
+        def _on_skip(path, err):
+            # torn/truncated/stale file (ISSUE 10 satellite): skip it
+            # with a [Degrade] warning and fall back to the newest VALID
+            # checkpoint instead of crashing (or silently restarting).
+            # The unusable file is deleted so it cannot shadow future
+            # saves below its cursor.
+            self.obs.count("degrade_checkpoint")
+            self.log.info(
+                f"[Degrade] skipping unusable checkpoint "
+                f"{os.path.basename(path)} ({err}); trying the newest "
+                "valid predecessor"
+            )
+
+        found = ckpt.load_valid_checkpoint(
+            cache_dir, digest, validate=_validate, on_skip=_on_skip
+        )
         if found is not None:
-            try:
-                cursor0, arrays = ckpt.load_checkpoint(found[1])
-                leaves = [arrays[f"c{i:03d}"] for i in range(len(tleaves))]
-                if any(
-                    a.shape != t.shape or a.dtype != t.dtype
-                    for a, t in zip(leaves, tleaves)
-                ):
-                    raise ValueError("carry layout mismatch")
-                carry = jax.tree.unflatten(
-                    tdef, [jnp.asarray(a) for a in leaves]
-                )
-                node_parts = [arrays["event_node"]]
-                dev_parts = [arrays["event_dev"]]
-                if record_dec:
-                    # the decision stream accumulated so far rides the
-                    # checkpoint beside event_node/event_dev, so a resumed
-                    # run's stream is continuous (missing keys -> the
-                    # usual drop-and-start-fresh path)
-                    dec_parts = [DecisionRecord(
-                        *(arrays[f"dec_{f}"] for f in dec_fields)
-                    )]
-                if record_ser:
-                    # likewise the per-event sample stream (ISSUE 5): the
-                    # stride clock itself is the carry's ctr leaf, so the
-                    # resumed scan keeps sampling on the same grid
-                    ser_parts = [SeriesSample(
-                        *(arrays[f"ser_{f}"] for f in ser_fields)
-                    )]
-                cursor = cursor0
-                if self.cfg.heartbeat_every:
-                    # the resumed carry's event counter already includes
-                    # `cursor` events this process never executed — keep
-                    # the tick line / /progress ev-per-s honest
-                    obs_heartbeat.note_resume(cursor)
-                self.log.info(
-                    f"[Checkpoint] resumed replay at event {cursor}/{e} "
-                    f"from {os.path.basename(found[1])}"
-                )
-            except Exception as err:
-                # torn/stale file: content addressing makes starting fresh
-                # always safe. DELETE the unusable file — find_checkpoint
-                # always picks the max cursor, so a bad high-cursor file
-                # left behind would shadow every good checkpoint this run
-                # writes below it and permanently disable resume
-                self.log.info(
-                    f"[Checkpoint] dropping unusable checkpoint "
-                    f"{os.path.basename(found[1])} ({err}); starting fresh"
-                )
-                try:
-                    os.unlink(found[1])
-                except OSError:
-                    pass
-                carry, cursor = None, 0
-                node_parts, dev_parts = [], []
-                dec_parts, ser_parts = [], []
+            cursor, arrays, path = found
+            leaves = [arrays[f"c{i:03d}"] for i in range(len(tleaves))]
+            carry = jax.tree.unflatten(
+                tdef, [jnp.asarray(a) for a in leaves]
+            )
+            node_parts = [arrays["event_node"]]
+            dev_parts = [arrays["event_dev"]]
+            if record_dec:
+                # the decision stream accumulated so far rides the
+                # checkpoint beside event_node/event_dev, so a resumed
+                # run's stream is continuous
+                dec_parts = [DecisionRecord(
+                    *(arrays[f"dec_{f}"] for f in dec_fields)
+                )]
+            if record_ser:
+                # likewise the per-event sample stream (ISSUE 5): the
+                # stride clock itself is the carry's ctr leaf, so the
+                # resumed scan keeps sampling on the same grid
+                ser_parts = [SeriesSample(
+                    *(arrays[f"ser_{f}"] for f in ser_fields)
+                )]
+            if self.cfg.heartbeat_every:
+                # the resumed carry's event counter already includes
+                # `cursor` events this process never executed — keep
+                # the tick line / /progress ev-per-s honest
+                obs_heartbeat.note_resume(cursor)
+            self.log.info(
+                f"[Checkpoint] resumed replay at event {cursor}/{e} "
+                f"from {os.path.basename(path)}"
+            )
         if carry is None:
             # only now resolve the table cache (table engine only): a
             # resumed run never reaches here and must not pay the build
@@ -1570,7 +1594,8 @@ class Simulator:
         self.cluster_analysis("InitSchedule")
         return res
 
-    def run_sweep(self, weights, seeds=None, bucket: int = 512, tunes=None):
+    def run_sweep(self, weights, seeds=None, bucket: int = 512, tunes=None,
+                  faults=None):
         """run()'s workload prep + ONE vmapped config-axis sweep replay
         (ISSUE 6): evaluate B (weight-vector, seed) what-if configs of
         this Simulator's policy family in a single compiled scan. See
@@ -1590,6 +1615,18 @@ class Simulator:
         self.log.info(
             f"Number of original workload pods: {len(self.workload_pods)}"
         )
+        if faults is not None:
+            # the chaos sweep (ISSUE 10): one trace, B fault schedules as
+            # per-lane operands — ONE compiled vmapped scan
+            if tunes is not None:
+                raise ValueError(
+                    "run_sweep cannot combine tunes and faults yet (the "
+                    "fault plan is compiled against one base stream)"
+                )
+            pods = self.prepare_pods()
+            return schedule_pods_sweep_faults(
+                self, pods, weights, faults, seeds=seeds, bucket=bucket
+            )
         if tunes is None:
             pods = self.prepare_pods()
             return schedule_pods_sweep(
@@ -1797,12 +1834,90 @@ class Simulator:
         self.log.info(f"[DescheduleCluster] Num of Failed Pods: {len(failed)}")
         return failed
 
-    # ---- fault injection (tpusim.sim.faults) ----
+    # ---- fault injection (tpusim.sim.faults / fault_lane) ----
+
+    def _fault_scan_blockers(self) -> list:
+        """Reasons this config cannot run the in-scan fault lane (each
+        one is a capability only the segmented host loop provides)."""
+        cfg = self.cfg
+        out = []
+        if cfg.report_per_event:
+            out.append("per-event reporting (the report postpass does not "
+                       "model fault transitions)")
+        if cfg.extenders:
+            out.append("extenders")
+        if cfg.record_decisions:
+            out.append("decision recording")
+        if cfg.series_every:
+            out.append("the in-scan series plane")
+        if cfg.checkpoint_every:
+            out.append("checkpointing (composes with the segmented path)")
+        if cfg.engine == "pallas":
+            out.append("the fused pallas engine")
+        if cfg.heartbeat_every:
+            out.append("the in-scan heartbeat")
+        return out
+
+    def _fault_randomized(self) -> bool:
+        """Per-event-random configs (RandomScore / gpu_sel random): the
+        scan lane replays them seeded-and-reproducibly, but its one-key-
+        chain-per-merged-stream discipline necessarily differs from the
+        segmented path's per-segment fold-in — so fault_mode='auto'
+        keeps them on the segmented path (same-seed results stay what
+        PR 2 produced) and only an explicit fault_mode='scan' opts into
+        the lane's chain."""
+        return (
+            any(fn.policy_name == "RandomScore"
+                for fn, _ in self._policy_fns)
+            or self.cfg.gpu_sel_method == "random"
+        )
 
     def schedule_pods_with_faults(
         self, pods: Sequence[PodRow], faults=None, fault_cfg=None
     ) -> SimulateResult:
-        """schedule_pods under a fault schedule: NodeFail / NodeRecover /
+        """schedule_pods under a fault schedule. Since ISSUE 10 the
+        default execution is the IN-SCAN fault lane
+        (tpusim.sim.fault_lane): the schedule merges into the event
+        stream as fixed-shape operands and the retry queue rides the
+        scan carry, so the whole disruption trajectory is ONE compiled
+        scan — and, crucially, a vmappable one (Simulator.run_sweep's
+        `faults=` axis). Configs the lane cannot serve (see
+        _fault_scan_blockers) fall back to the PR 2 segmented host loop,
+        which remains bit-identical for deterministic configs;
+        SimulatorConfig.fault_mode forces either path."""
+        mode = getattr(self.cfg, "fault_mode", "auto")
+        if mode not in ("auto", "scan", "segments"):
+            raise ValueError(
+                f"unknown fault_mode {mode!r}: expected auto | scan | "
+                "segments"
+            )
+        blockers = self._fault_scan_blockers()
+        if mode == "scan" and blockers:
+            raise ValueError(
+                f"fault_mode='scan' cannot serve this config: {blockers[0]}"
+            )
+        if mode == "auto" and not blockers and self._fault_randomized():
+            # soft preference, not a capability gap: the lane CAN replay
+            # randomized configs (fault_mode='scan' opts in), but auto
+            # must not silently change PR 2's same-seed results
+            blockers = [
+                "per-event randomness draws a different (still seeded) "
+                "PRNG chain on the scan lane; fault_mode='scan' opts in"
+            ]
+        if mode == "segments" or blockers:
+            if blockers and mode == "auto":
+                self.log.info(
+                    f"[Fault] segmented replay ({blockers[0]})"
+                )
+            return self._schedule_pods_with_faults_segmented(
+                pods, faults, fault_cfg
+            )
+        return self._schedule_pods_faults_scan(pods, faults, fault_cfg)
+
+    def _schedule_pods_with_faults_segmented(
+        self, pods: Sequence[PodRow], faults=None, fault_cfg=None
+    ) -> SimulateResult:
+        """The PR 2 host loop: NodeFail / NodeRecover /
         Evict events fire between compiled replay segments, evicted pods
         re-enter through a capped-exponential-backoff retry queue
         (tpusim.sim.queues.RetryQueue), and pods out of retries become
@@ -2093,6 +2208,236 @@ class Simulator:
             series=concat_series(ser_logs),
         )
         return self.last_result
+
+    # ---- the in-scan fault lane (ISSUE 10; tpusim.sim.fault_lane) ----
+
+    def _schedule_pods_faults_scan(
+        self, pods: Sequence[PodRow], faults=None, fault_cfg=None
+    ) -> SimulateResult:
+        """schedule_pods_with_faults on the in-scan lane: ONE compiled
+        scan over the merged (base + fault + retry-slot) stream, the
+        retry queue in the carry, DisruptionMetrics assembled from exact
+        in-scan counters + per-event fault telemetry. Bit-identical to
+        the segmented path for deterministic configs (the acceptance
+        pin, tests/test_fault_lane.py)."""
+        from tpusim.sim import fault_lane
+        from tpusim.sim.faults import FaultConfig, generate_fault_schedule
+        from tpusim.sim.reports import disruption_report_block
+
+        if self.cfg.use_timestamps:
+            raise ValueError(
+                "schedule_pods_with_faults replays creation-ordered traces "
+                "(use_timestamps=False); model deletions as Evict fault "
+                "events instead"
+            )
+        if self.typical is None:
+            self.set_typical_pods()
+        fcfg = fault_cfg or FaultConfig()
+        pods = list(pods)
+        ev_kind, ev_pod = build_events(pods, False)
+        if faults is None:
+            faults = generate_fault_schedule(
+                len(self.nodes), len(ev_kind), fcfg
+            )
+        t0 = time.perf_counter()
+        specs = pods_to_specs(pods, self.node_index)
+        plan = fault_lane.compile_fault_plan(
+            ev_kind, ev_pod, faults, fcfg, len(self.nodes), len(pods)
+        )
+        out = self._dispatch_fault_scan(specs, plan)
+        with self.obs.span("fetch", events=int(plan.kind.shape[0])):
+            out = device_fetch(out)
+        dm, dead, attempts_run = fault_lane.assemble_disruption(
+            plan, out.fault_ys, out.fault_carry,
+            np.asarray(self.init_state.gpu_cnt),
+        )
+        e_m = int(plan.kind.shape[0])
+        # fault events + inert retry slots counted as skips in-scan; the
+        # true event count is base events + actual retry attempts
+        self.obs.note_scan(
+            self._last_engine, counters=out.counters,
+            pad_skips=e_m - plan.num_events - attempts_run,
+            events=plan.num_events + attempts_run,
+        )
+        self.log.info(
+            f"[Engine] fault-lane replay of {plan.num_events} events "
+            f"(+{attempts_run} retries, merged stream {e_m}) ran on: "
+            f"{self._last_engine}"
+        )
+        self._emit_fault_log_lines(plan, out.fault_ys, pods)
+        self.analysis_summary.update(disruption_report_block(self.log, dm))
+        self.last_disruption = dm
+        self.obs.note_disruption(dm)
+        placed = np.asarray(out.placed_node)
+        ever_failed = np.asarray(out.ever_failed)
+        skipped = np.array([p.unscheduled for p in pods], bool)
+        dead = np.asarray(dead)[: len(pods)]
+        unscheduled = []
+        for i in range(len(pods)):
+            if skipped[i]:
+                unscheduled.append(UnscheduledPod(
+                    pods[i], reason="pod-unscheduled annotation"
+                ))
+            elif dead[i]:
+                unscheduled.append(UnscheduledPod(
+                    pods[i], reason="max-retries-exceeded"
+                ))
+            elif placed[i] < 0 and bool(ever_failed[i]):
+                unscheduled.append(UnscheduledPod(pods[i]))
+        self.last_result = SimulateResult(
+            unscheduled_pods=unscheduled,
+            placed_node=placed,
+            dev_mask=np.asarray(out.dev_mask),
+            state=jax.tree.map(np.asarray, out.state),
+            pods=pods,
+            node_names=self.node_names,
+            wall_seconds=time.perf_counter() - t0,
+            events=plan.num_events + attempts_run,
+            creation_rank=fault_lane.fault_creation_rank(
+                plan, out.fault_ys, len(pods)
+            ),
+            telemetry=self.run_telemetry(),
+        )
+        return self.last_result
+
+    def _dispatch_fault_scan(self, specs, plan):
+        """Engine dispatch for one fault-lane replay: shard_map under a
+        mesh, else the table engine when the workload amortizes its init
+        (the run_events heuristic), else the sequential oracle."""
+        from tpusim.sim import fault_lane
+        from tpusim.sim.engine import make_replay
+        from tpusim.sim.table_engine import (
+            build_pod_types,
+            make_table_replay,
+            num_pod_types,
+        )
+
+        key = jax.random.PRNGKey(self.cfg.seed)
+        e = plan.num_events
+        kind_d = jnp.asarray(plan.kind)
+        idx_d = jnp.asarray(plan.idx)
+        p = int(specs.cpu.shape[0])
+        if self._shard_fn is not None:
+            from tpusim.parallel import pad_nodes, shard_state
+            from tpusim.parallel.shard_engine import (
+                make_shardmap_table_replay,
+            )
+
+            n0 = self.init_state.num_nodes
+            state_p, rank_p = pad_nodes(
+                self.init_state, self.rank, self.cfg.mesh
+            )
+            n_pad = state_p.num_nodes
+            state_p = shard_state(state_p, self._mesh)
+            ops = fault_lane.FaultOps(
+                pos=jnp.asarray(plan.pos), arg=jnp.asarray(plan.arg),
+                aux=jnp.asarray(plan.aux), draws=jnp.asarray(plan.draws),
+                params=jnp.asarray(plan.params),
+                gcnt=jnp.pad(
+                    jnp.asarray(self.init_state.gpu_cnt), (0, n_pad - n0)
+                ),
+            )
+            fc0 = fault_lane.init_fault_carry(p, n_pad, plan.capacity)
+            fn = make_shardmap_table_replay(
+                self._policy_fns, self._mesh,
+                gpu_sel=self.cfg.gpu_sel_method,
+                block_size=self.cfg.block_size, faults=True,
+            )
+            self._last_engine = (
+                f"shard_map (mesh={self.cfg.mesh}, fault lane)"
+            )
+            out = self._dispatch_span(
+                lambda: fn(
+                    state_p, specs, build_pod_types(specs), kind_d, idx_d,
+                    self.typical, key, rank_p, fault_ops=ops,
+                    fault_carry0=fc0,
+                ),
+                engine=self._last_engine, events=e,
+            )
+            return out._replace(
+                state=jax.tree.map(lambda a: a[:n0], out.state)
+            )
+
+        ops = fault_lane.FaultOps(
+            pos=jnp.asarray(plan.pos), arg=jnp.asarray(plan.arg),
+            aux=jnp.asarray(plan.aux), draws=jnp.asarray(plan.draws),
+            params=jnp.asarray(plan.params),
+            gcnt=jnp.asarray(self.init_state.gpu_cnt),
+        )
+        fc0 = fault_lane.init_fault_carry(
+            p, self.init_state.num_nodes, plan.capacity
+        )
+        types = build_pod_types(specs)
+        k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+        use_table = (
+            self.cfg.engine != "sequential"
+            and k > 0
+            and (self.cfg.engine == "table" or e >= 2 * num_pod_types(specs))
+        )
+        if use_table:
+            fn = make_table_replay(
+                self._policy_fns, gpu_sel=self.cfg.gpu_sel_method,
+                report=False, block_size=self.cfg.block_size, faults=True,
+                fault_frag=plan.has_recover,
+            )
+            self._last_engine = "table (fault lane)"
+            out = self._dispatch_span(
+                lambda: fn(
+                    self.init_state, specs, types, kind_d, idx_d,
+                    self.typical, key, self.rank,
+                    tables=self._cached_tables(self.init_state, types, key),
+                    fault_ops=ops, fault_carry0=fc0,
+                ),
+                engine=self._last_engine, events=e,
+            )
+        else:
+            fn = make_replay(
+                self._policy_fns, gpu_sel=self.cfg.gpu_sel_method,
+                report=False, faults=True, fault_frag=plan.has_recover,
+            )
+            self._last_engine = "sequential (fault lane)"
+            out = self._dispatch_span(
+                lambda: fn(
+                    self.init_state, specs, kind_d, idx_d, self.typical,
+                    key, self.rank, fault_ops=ops, fault_carry0=fc0,
+                ),
+                engine=self._last_engine, events=e,
+            )
+        return out
+
+    def _emit_fault_log_lines(self, plan, ys, pods):
+        """The segmented path's [Fault] narration, reconstructed from the
+        plan + per-event fault telemetry (down/up transitions are a pure
+        function of the schedule; victims come from the ys)."""
+        from tpusim.sim.engine import EV_EVICT, EV_NODE_FAIL, EV_NODE_RECOVER
+
+        nvict = np.asarray(ys.nvict)
+        vpod = np.asarray(ys.vpod)
+        vnode = np.asarray(ys.vnode)
+        fb = np.asarray(ys.fb, np.float64)
+        fa = np.asarray(ys.fa, np.float64)
+        down: set = set()
+        for i, k in enumerate(plan.kind.tolist()):
+            pos = int(plan.pos[i])
+            a = int(plan.arg[i])
+            if k == EV_NODE_FAIL and a not in down:
+                down.add(a)
+                self.log.info(
+                    f"[Fault] node {self.node_names[a]} failed at event "
+                    f"{pos}: {int(nvict[i])} pods evicted"
+                )
+            elif k == EV_NODE_RECOVER and a in down:
+                down.discard(a)
+                delta = float(fa[i]) - float(fb[i])
+                self.log.info(
+                    f"[Fault] node {self.node_names[a]} recovered at "
+                    f"event {pos} (frag delta {delta:+.1f})"
+                )
+            elif k == EV_EVICT and int(vpod[i]) >= 0:
+                self.log.info(
+                    f"[Fault] pod {pods[int(vpod[i])].name} evicted from "
+                    f"node {self.node_names[int(vnode[i])]} at event {pos}"
+                )
 
     # ---- reporting (analysis.go) ----
 
@@ -2807,6 +3152,10 @@ class SweepLane:
     # The learned-scoring objective's third term (ISSUE 9): gpu_alloc up,
     # frag down, unscheduled bounded.
     unscheduled: int = 0
+    # tpusim.sim.metrics.DisruptionMetrics of this lane's fault schedule
+    # (ISSUE 10; None on fault-free sweeps) — bit-identical to the
+    # standalone run_with_faults run with the same schedule/seed.
+    disruption: object = None
 
 
 def _sweep_engine(engine, table: bool):
@@ -3335,6 +3684,280 @@ def schedule_pods_sweep_multi(
         )
         for i in range(b)
     ]
+
+
+# ---------------------------------------------------------------------------
+# Chaos sweep: fault schedules as sweep operands (ISSUE 10)
+# ---------------------------------------------------------------------------
+#
+# The config-axis sweep's last missing operand: a fault schedule used to
+# force one full compile+replay per scenario (the segmented host loop
+# cannot vmap). With the fault plane inside the scan
+# (tpusim.sim.fault_lane), a schedule is just five i32 streams + a draw
+# table + a param vector — per-lane DATA. B disruption what-ifs over one
+# trace (varying fault seed / MTBF / evict cadence / backoff) therefore
+# run as ONE compiled vmapped scan; each lane is bit-identical to the
+# standalone run_with_faults run with that schedule.
+
+_SWEEP_FAULT_WRAP_CACHE = {}
+
+
+def _sweep_fault_engine(engine, table: bool):
+    """jit(vmap(engine)) for the chaos sweep: per-lane (merged streams,
+    key, weights, rank, fault ops); cluster state, pod specs, types,
+    typical pods, tables, the initial fault carry, and the global
+    gpu-count row broadcast."""
+    from tpusim.sim.fault_lane import FaultOps
+
+    if engine not in _SWEEP_FAULT_WRAP_CACHE:
+        fops_axes = FaultOps(0, 0, 0, 0, 0, None)
+        if table:
+            # (state, pods, types, evk, evp, tp, key, wts, rank, tables,
+            #  fault_ops, fault_carry0)
+            in_axes = (None, None, None, 0, 0, None, 0, 0, 0, None,
+                       fops_axes, None)
+        else:
+            # (state, pods, evk, evp, tp, key, wts, rank, fault_ops,
+            #  fault_carry0)
+            in_axes = (None, None, 0, 0, None, 0, 0, 0, fops_axes, None)
+        _SWEEP_FAULT_WRAP_CACHE[engine] = jax.jit(
+            jax.vmap(engine, in_axes=in_axes)
+        )
+    return _SWEEP_FAULT_WRAP_CACHE[engine]
+
+
+def resolve_fault_spec(spec, num_nodes: int, num_events: int):
+    """One chaos-sweep lane spec -> (FaultConfig, [FaultEvent]): a bare
+    FaultConfig generates its seeded MTBF schedule; a (FaultConfig,
+    events) tuple carries an explicit schedule with the config supplying
+    the retry/backoff knobs."""
+    from tpusim.sim.faults import FaultConfig, generate_fault_schedule
+
+    if isinstance(spec, tuple) and len(spec) == 2:
+        fcfg, events = spec
+        return fcfg, list(events)
+    if isinstance(spec, FaultConfig):
+        return spec, generate_fault_schedule(num_nodes, num_events, spec)
+    raise ValueError(
+        "each fault lane must be a FaultConfig (seeded MTBF schedule) or "
+        f"a (FaultConfig, [FaultEvent]) tuple, got {type(spec).__name__}"
+    )
+
+
+def schedule_pods_sweep_faults(
+    sim: "Simulator", pods, weights, fault_specs, seeds=None,
+    bucket: int = 512,
+) -> List[SweepLane]:
+    """Evaluate B fault what-ifs of ONE workload in ONE vmapped replay:
+    lane i replays the shared trace under weight row i, seed i, and
+    fault spec i (resolve_fault_spec). Lanes share the compiled scan —
+    the merged streams are padded to a common bucketed length (inert
+    EV_SKIP steps), draw tables to a common row count, and the retry
+    queue capacity is unified to the lanes' max — so a later sweep with
+    DIFFERENT schedules of similar size hits the same executable (the
+    chaos-smoke zero-recompile pin). Each SweepLane carries its
+    DisruptionMetrics, bit-identical to the standalone run_with_faults
+    run with that schedule (tests/test_fault_lane.py)."""
+    from tpusim.ops.frag import cluster_frag_amounts
+    from tpusim.sim import fault_lane
+    from tpusim.sim.engine import make_replay
+    from tpusim.sim.table_engine import (
+        build_pod_types,
+        make_table_replay,
+        num_pod_types,
+        pad_pod_types,
+    )
+    from tpusim.types import PodSpec
+
+    cfg = sim.cfg
+    _reject_unsweepable(cfg)
+    if cfg.use_timestamps:
+        raise ValueError(
+            "the chaos sweep replays creation-ordered traces "
+            "(use_timestamps=False)"
+        )
+    w, b, seeds = _check_sweep_grid(cfg, weights, seeds)
+    if len(fault_specs) != b:
+        raise ValueError(
+            f"fault_specs has {len(fault_specs)} entries for {b} weight "
+            "rows (want one fault schedule per lane)"
+        )
+    if sim.typical is None:
+        sim.set_typical_pods()
+
+    specs = pods_to_specs(pods, sim.node_index, device=False)
+    ev_kind_l, ev_pod_l = build_events(pods, False)
+    validate_events(ev_kind_l, ev_pod_l, int(specs.cpu.shape[0]))
+    p, e = int(specs.cpu.shape[0]), len(ev_kind_l)
+
+    resolved = [
+        resolve_fault_spec(s, len(sim.nodes), e) for s in fault_specs
+    ]
+    # sticky per-Simulator shape floors (the svc worker's min_pods/
+    # min_events discipline): queue capacity, padded stream length, and
+    # draw-table rows only ever grow, so consecutive chaos waves on one
+    # sim share one executable (the zero-recompile pin)
+    hw_em, hw_rows, hw_cap, hw_rec = getattr(
+        sim, "_chaos_hw", (0, 0, 0, False)
+    )
+    capacity = max(
+        max(fault_lane.resolve_capacity(fcfg, p) for fcfg, _ in resolved),
+        hw_cap,
+    )
+    # dedup identical lane specs before compiling: a tuning population
+    # rolls EVERY lane under one schedule (learn.rollout), and each plan
+    # compile walks the merged stream + pre-draws victim tables — paying
+    # it once per distinct schedule instead of once per lane
+    plan_cache: dict = {}
+    plans = []
+    for fcfg, events in resolved:
+        key = (repr(fcfg), tuple(events))
+        plan = plan_cache.get(key)
+        if plan is None:
+            plan = fault_lane.compile_fault_plan(
+                ev_kind_l, ev_pod_l, events, fcfg, len(sim.nodes), p,
+                capacity=capacity,
+            )
+            plan_cache[key] = plan
+        plans.append(plan)
+    (kinds, idxs, poss, args, auxs, draws, params, capacity, has_rec) = (
+        fault_lane.pad_fault_plans(
+            plans, bucket=bucket, min_stream=hw_em, min_rows=hw_rows,
+        )
+    )
+    e_m = int(kinds.shape[1])
+    # the frag-delta capture is a static build flag (engine cache key) —
+    # sticky too, so a recover-free wave after a recovering one reuses
+    # the recovering build (the extra ys are just zeros)
+    has_rec = bool(has_rec or hw_rec)
+    sim._chaos_hw = (e_m, int(draws.shape[1]), capacity, has_rec)
+
+    specs_d = PodSpec(
+        *(jnp.asarray(np.asarray(getattr(specs, f)))
+          for f in PodSpec._fields)
+    )
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
+    ranks = jnp.stack(
+        [jnp.asarray(tiebreak_rank(len(sim.nodes), s)) for s in seeds]
+    )
+    weights_d = jnp.asarray(w)
+    state = sim.init_state
+    ops = fault_lane.FaultOps(
+        pos=jnp.asarray(poss), arg=jnp.asarray(args),
+        aux=jnp.asarray(auxs), draws=jnp.asarray(draws),
+        params=jnp.asarray(params), gcnt=jnp.asarray(state.gpu_cnt),
+    )
+    fc0 = fault_lane.init_fault_carry(p, state.num_nodes, capacity)
+    kinds_d, idxs_d = jnp.asarray(kinds), jnp.asarray(idxs)
+
+    types = build_pod_types(specs)
+    k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+    use_table = (
+        cfg.engine != "sequential"
+        and k > 0
+        and (cfg.engine == "table" or e >= 2 * num_pod_types(specs))
+    )
+    if use_table:
+        types = pad_pod_types(types)  # stabilize K across chaos batches
+        key0 = jax.random.PRNGKey(seeds[0])
+        table_fn = make_table_replay(
+            sim._policy_fns, gpu_sel=cfg.gpu_sel_method, report=False,
+            block_size=cfg.block_size, faults=True, fault_frag=has_rec,
+        )
+        tables = sim._cached_tables(state, types, key0)
+        if tables is None:
+            with sim.obs.span("init_tables", cache="sweep-shared") as h:
+                tables = table_fn.engine.build_tables(
+                    state, types, sim.typical, key0
+                )
+                h.dispatched()
+        fn = _sweep_fault_engine(table_fn.engine.replay, table=True)
+        sim._last_sweep_fn = fn  # executables() tracking (learn.rollout)
+        sim._last_engine = f"table ({b}-lane chaos sweep)"
+        out = sim._dispatch_span(
+            lambda: fn(
+                state, specs_d, types, kinds_d, idxs_d, sim.typical,
+                keys, weights_d, ranks, tables, ops, fc0,
+            ),
+            engine=sim._last_engine, events=e * b,
+        )
+    else:
+        seq_fn = make_replay(
+            sim._policy_fns, gpu_sel=cfg.gpu_sel_method, report=False,
+            faults=True, fault_frag=has_rec,
+        )
+        fn = _sweep_fault_engine(seq_fn.engine, table=False)
+        sim._last_sweep_fn = fn  # executables() tracking (learn.rollout)
+        sim._last_engine = f"sequential ({b}-lane chaos sweep)"
+        out = sim._dispatch_span(
+            lambda: fn(
+                state, specs_d, kinds_d, idxs_d, sim.typical, keys,
+                weights_d, ranks, ops, fc0,
+            ),
+            engine=sim._last_engine, events=e * b,
+        )
+    sim.obs.note_scan(sim._last_engine, counters=None, events=e * b)
+    sim.log.info(
+        f"[Engine] chaos sweep of {b} fault lanes x {e} events "
+        f"(merged stream {e_m}) ran on: {sim._last_engine}"
+    )
+    amounts = jax.jit(
+        jax.vmap(
+            lambda s, tp: cluster_frag_amounts(s, tp).sum(0),
+            in_axes=(0, None),
+        )
+    )(out.state, sim.typical)
+    with sim.obs.span("fetch", events=e * b):
+        out = device_fetch(out)
+        amounts = np.asarray(amounts)
+
+    gcnt_h = np.asarray(state.gpu_cnt)
+    lanes = []
+    for i in range(b):
+        ys_i = jax.tree.map(lambda a: np.asarray(a)[i], out.fault_ys)
+        fc_i = jax.tree.map(lambda a: np.asarray(a)[i], out.fault_carry)
+        dm, dead, attempts_run = fault_lane.assemble_disruption(
+            plans[i], ys_i, fc_i, gcnt_h
+        )
+        lane = _slice_sweep_lane(
+            out, amounts, i, w[i], seeds[i], p, e,
+            e_m - plans[i].num_events - attempts_run,
+        )
+        lane.disruption = dm
+        lane.events = plans[i].num_events + attempts_run
+        # dead pods are terminal max-retries-exceeded — the standalone
+        # path's unscheduled accounting includes them
+        lane.unscheduled = int(
+            ((lane.placed_node < 0)
+             & (lane.ever_failed | dead[:p])).sum()
+        )
+        lanes.append(lane)
+    return lanes
+
+
+def format_chaos_table(lanes: Sequence[SweepLane], policies) -> str:
+    """Per-lane disruption frontier of a chaos sweep — the `tpusim apply
+    --sweep-faults` output: placements plus the DisruptionMetrics
+    headline numbers per fault schedule."""
+    names = [n for n, _ in policies]
+    head = (
+        f"{'lane':>4} {'weights(' + ','.join(names) + ')':<28} "
+        f"{'seed':>6} {'placed':>7} {'evicted':>8} {'resched':>8} "
+        f"{'dead':>5} {'fails':>6} {'lat_mean':>9} {'gpu_alloc%':>10} "
+        f"{'frag_gpu_milli':>15}"
+    )
+    rows = [head, "-" * len(head)]
+    for i, ln in enumerate(lanes):
+        dm = ln.disruption
+        wstr = ",".join(str(int(x)) for x in ln.weights)
+        rows.append(
+            f"{i:>4} {wstr:<28} {ln.seed:>6} {ln.placed:>7} "
+            f"{dm.evicted_pods:>8} {dm.rescheduled_pods:>8} "
+            f"{dm.unscheduled_after_retries:>5} {dm.node_failures:>6} "
+            f"{dm.mean_reschedule_latency():>9.2f} "
+            f"{ln.gpu_alloc_pct:>10.2f} {ln.frag_gpu_milli:>15.0f}"
+        )
+    return "\n".join(rows)
 
 
 def format_sweep_table(lanes: Sequence[SweepLane], policies) -> str:

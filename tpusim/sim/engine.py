@@ -44,6 +44,12 @@ EV_SKIP = 2  # padding / `simon/pod-unscheduled`-annotated pods (simulator.go:39
 EV_NODE_FAIL = 3  # node crashes; its pods are evicted into the retry queue
 EV_NODE_RECOVER = 4  # node returns, empty
 EV_EVICT = 5  # single-pod eviction (preemption), pod re-enters via retry
+# Since ISSUE 10 fault kinds ALSO run inside the compiled scan: engines
+# built with faults=True accept merged streams carrying all seven kinds
+# plus EV_RETRY slots (tpusim.sim.fault_lane), handling them with an
+# in-carry retry queue — run_events (the fault-free dispatch) still
+# rejects them, routing callers at Simulator.run_with_faults instead.
+EV_RETRY = 6  # retry-queue slot: pops the earliest due evicted pod
 
 _power_nodes = jax.vmap(node_power)
 
@@ -100,6 +106,13 @@ class ReplayResult(NamedTuple):
     # elsewhere. None unless the engine was built with series_every > 0;
     # fully engine-invariant and bit-reproducible like the counters.
     series: object = None
+    # tpusim.sim.fault_lane.FaultY stacked over the merged event axis +
+    # the final FaultCarry — the in-scan fault plane's telemetry
+    # (ISSUE 10). None unless the engine was built with faults=True; the
+    # driver assembles DisruptionMetrics / dead pods / creation ranks
+    # from these host-side (fault_lane.assemble_disruption).
+    fault_ys: object = None
+    fault_carry: object = None
 
 
 def cluster_usage(state: NodeState):
@@ -159,7 +172,8 @@ _ENGINE_CACHE = {}
 
 
 def make_replay(policies, gpu_sel: str = "best", report: bool = True,
-                decisions: bool = False, series_every: int = 0):
+                decisions: bool = False, series_every: int = 0,
+                faults: bool = False, fault_frag: bool = False):
     """Build a jitted trace replayer for a static policy configuration.
 
     policies: [(policy_fn, weight)]; gpu_sel: Reserve-phase gpuSelMethod.
@@ -186,23 +200,39 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True,
     jitted engine (`replay.engine`) — the one-jaxpr-per-job-family
     contract the config-axis sweep vmaps over.
     """
+    if faults and (decisions or series_every):
+        raise ValueError(
+            "the in-scan fault plane (faults=True) does not combine with "
+            "decisions/series builds; run those through the segmented "
+            "fault path (Simulator fault_mode='segments')"
+        )
     cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
-                 decisions, int(series_every))
+                 decisions, int(series_every), bool(faults),
+                 bool(fault_frag))
     if cache_key in _REPLAY_CACHE:
         return _REPLAY_CACHE[cache_key]
     engine_key = (tuple(fn for fn, _ in policies), gpu_sel, report,
-                  decisions, int(series_every))
+                  decisions, int(series_every), bool(faults),
+                  bool(fault_frag))
     engine = _ENGINE_CACHE.get(engine_key)
     if engine is None:
         engine = _make_sequential_engine(
-            policies, gpu_sel, report, decisions, series_every
+            policies, gpu_sel, report, decisions, series_every, faults,
+            fault_frag,
         )
         _ENGINE_CACHE[engine_key] = engine
 
     from tpusim.sim.step import resolve_weights
 
     def replay(state, pods, ev_kind, ev_pod, tp, key, tiebreak_rank=None,
-               weights=None) -> ReplayResult:
+               weights=None, fault_ops=None,
+               fault_carry0=None) -> ReplayResult:
+        if faults:
+            return engine(
+                state, pods, ev_kind, ev_pod, tp, key,
+                resolve_weights(policies, weights), tiebreak_rank,
+                fault_ops, fault_carry0,
+            )
         return engine(
             state, pods, ev_kind, ev_pod, tp, key,
             resolve_weights(policies, weights), tiebreak_rank,
@@ -214,13 +244,21 @@ def make_replay(policies, gpu_sel: str = "best", report: bool = True,
 
 
 def _make_sequential_engine(policies, gpu_sel, report, decisions,
-                            series_every):
+                            series_every, faults=False, fault_frag=False):
     """The weight-operand jitted machinery behind make_replay: `weights`
     is an i32[num_pol] traced argument, never baked, so every weight
     vector of the (kernels, gpu_sel, flags) family runs one jaxpr. The
     closed-over `policies` weights are deliberately never read — only the
     kernel objects and their normalize/name metadata are."""
     num_pol = len(policies)
+    if faults:
+        if report:
+            raise ValueError(
+                "fault-plane replays run metric-free (the merged stream "
+                "interleaves fault transitions the report postpass does "
+                "not model); reconstruct reports via the segmented path"
+            )
+        return _make_sequential_fault_engine(policies, gpu_sel, fault_frag)
 
     @jax.jit
     def replay(
@@ -362,6 +400,127 @@ def _make_sequential_engine(policies, gpu_sel, report, decisions,
             state, placed, masks, failed, metrics, nodes, devs, ctr,
             decs if decisions else None,
             sers if series_every else None,
+        )
+
+    return replay
+
+
+def _make_sequential_fault_engine(policies, gpu_sel, fault_frag):
+    """Fault-plane sequential engine (ISSUE 10): the oracle's scan over a
+    MERGED stream (base events + fault transitions + retry slots,
+    tpusim.sim.fault_lane.compile_fault_plan) with the retry queue as
+    carry state. Base kinds replay through the identical schedule_one
+    cycle (one key split per merged step); fault kinds apply as masked
+    one-node row ops after the switch; retry slots pop the earliest due
+    evicted pod and run it through the same create branch. The engine is
+    the chaos sweep's vmap target — every stream/draw/param is a traced
+    operand, and the initial FaultCarry arrives as an input so its
+    static queue capacity is just an input shape."""
+    from tpusim.sim import fault_lane
+
+    @jax.jit
+    def replay(
+        state: NodeState,
+        pods: PodSpec,
+        ev_kind: jnp.ndarray,  # i32[E_m] merged stream kinds
+        ev_pod: jnp.ndarray,  # i32[E_m] base pod index per step
+        tp,
+        key,
+        weights,
+        tiebreak_rank=None,
+        fault_ops: "fault_lane.FaultOps" = None,
+        fault_carry0: "fault_lane.FaultCarry" = None,
+    ) -> ReplayResult:
+        num_pods = pods.cpu.shape[0]
+        n = state.num_nodes
+        node_ids = jnp.arange(n, dtype=jnp.int32)
+        placed = jnp.full(num_pods, -1, jnp.int32)
+        masks = jnp.zeros((num_pods, state.gpu_left.shape[1]), jnp.bool_)
+        failed = jnp.zeros(num_pods, jnp.bool_)
+
+        def body(carry, ev):
+            state, placed, masks, failed, ctr, key, fc = carry
+            kind, idx, pos, arg, aux = ev
+            is_slot = kind == EV_RETRY
+            fc, has, rpod = fault_lane.pop_retry(fc, is_slot, pos, arg)
+            eff_idx = jnp.where(has, rpod, idx)
+            kc = jnp.where(
+                is_slot, jnp.where(has, 0, 2), jnp.clip(kind, 0, 2)
+            )
+            pod = jax.tree.map(lambda a: a[eff_idx], pods)
+            key, sub = jax.random.split(key)
+
+            def do_create(_):
+                new_state, pl = schedule_one(
+                    state, pod, sub, policies, gpu_sel, tp,
+                    tiebreak_rank, weights,
+                )
+                newf = pl.node < 0
+                return (
+                    new_state,
+                    placed.at[eff_idx].set(pl.node),
+                    masks.at[eff_idx].set(pl.dev_mask),
+                    # retry attempts accumulate ever-failed with OR — the
+                    # segmented path's `ever_failed[created] |=` per
+                    # segment; a base create still overwrites (it runs
+                    # exactly once per pod)
+                    failed.at[eff_idx].set(
+                        jnp.where(is_slot, failed[eff_idx] | newf, newf)
+                    ),
+                    pl.node,
+                    pl.dev_mask,
+                )
+
+            def do_delete(_):
+                pl = Placement(placed[eff_idx], masks[eff_idx])
+                new_state = unschedule(state, pod, pl)
+                return (
+                    new_state,
+                    placed.at[eff_idx].set(-1),
+                    masks.at[eff_idx].set(False),
+                    failed,
+                    pl.node,
+                    pl.dev_mask,
+                )
+
+            def do_skip(_):
+                return (
+                    state, placed, masks, failed,
+                    jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
+                )
+
+            (state2, placed2, masks2, failed2, node, dev) = jax.lax.switch(
+                kc, [do_create, do_delete, do_skip], None
+            )
+            ctr2 = ctr + counter_delta(kc, node)
+            # fault transitions: masked one-node ops, inert off-kind
+            (state2, placed2, masks2, failed2, fc, ftouch, fy) = (
+                fault_lane.apply_fault_step(
+                    state2, placed2, masks2, failed2, fc, pods, kind,
+                    arg, aux, pos, fault_ops, tp, node_ids, fault_frag,
+                )
+            )
+            fc, lat, _ = fault_lane.commit_retry(
+                fc, has, rpod, node, pos, arg, fault_ops.params
+            )
+            fy = fy._replace(
+                rpod=jnp.where(has, rpod, -1).astype(jnp.int32), lat=lat
+            )
+            node_out = jnp.where(ftouch >= 0, ftouch, node)
+            return (
+                state2, placed2, masks2, failed2, ctr2, key, fc,
+            ), (node_out, dev, fy)
+
+        init = (state, placed, masks, failed, zero_counters(), key,
+                fault_carry0)
+        (state, placed, masks, failed, ctr, _, fc), (
+            nodes, devs, fys
+        ) = jax.lax.scan(body, init, (
+            ev_kind, ev_pod, fault_ops.pos, fault_ops.arg, fault_ops.aux,
+        ))
+        return ReplayResult(
+            state, placed, masks, failed, None, nodes, devs, ctr,
+            None, None, fys, fc,
         )
 
     return replay

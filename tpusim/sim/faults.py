@@ -76,6 +76,13 @@ class FaultConfig:
     max_retries: int = 3
     backoff_base: int = 8
     backoff_cap: int = 256
+    # static capacity of the IN-SCAN retry queue (ISSUE 10;
+    # fault_lane.resolve_capacity): 0 = auto (min(num_pods, 256)). The
+    # host-loop RetryQueue is unbounded; on the scan lane an eviction
+    # wave past this capacity goes terminal ("max-retries-exceeded")
+    # instead of silently corrupting — size it at the worst simultaneous
+    # outstanding-retry count the schedule can produce.
+    queue_capacity: int = 0
 
 
 def _geometric(rng: np.random.Generator, mean: float) -> int:

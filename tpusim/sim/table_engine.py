@@ -49,7 +49,7 @@ from tpusim.policies import (
     minmax_scale_i32,
     pwr_normalize_i32,
 )
-from tpusim.sim.engine import ReplayResult
+from tpusim.sim.engine import EV_RETRY, ReplayResult
 from tpusim.sim.step import (
     SELF_SELECT_POLICIES,
     PendingCommit,
@@ -372,6 +372,7 @@ def make_table_replay(
     policies, gpu_sel: str = "best", report: bool = False,
     block_size: int = 0, heartbeat_every: int = 0,
     decisions: bool = False, series_every: int = 0,
+    faults: bool = False, fault_frag: bool = False,
 ):
     """Build the jitted incremental replayer for a static policy config.
 
@@ -471,40 +472,65 @@ def make_table_replay(
             "the table engine replays metric-free; build the report series "
             "with tpusim.sim.metrics.compute_event_metrics"
         )
+    if faults and (decisions or series_every or heartbeat_every):
+        raise ValueError(
+            "the in-scan fault plane (faults=True) does not combine with "
+            "decisions/series/heartbeat builds; run those through the "
+            "segmented fault path (Simulator fault_mode='segments')"
+        )
     cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
                  int(block_size), int(heartbeat_every), bool(decisions),
-                 int(series_every))
+                 int(series_every), bool(faults), bool(fault_frag))
     if cache_key in _TABLE_REPLAY_CACHE:
         return _TABLE_REPLAY_CACHE[cache_key]
     engine_key = (tuple(fn for fn, _ in policies), gpu_sel,
                   int(block_size), int(heartbeat_every), bool(decisions),
-                  int(series_every))
+                  int(series_every), bool(faults), bool(fault_frag))
     eng = _TABLE_ENGINE_CACHE.get(engine_key)
     if eng is None:
         eng = _make_table_engine(
             policies, gpu_sel, block_size, heartbeat_every, decisions,
-            series_every,
+            series_every, faults, fault_frag,
         )
         _TABLE_ENGINE_CACHE[engine_key] = eng
 
     from tpusim.sim.step import resolve_weights
 
     def replay(state, pods, types, ev_kind, ev_pod, tp, key,
-               tiebreak_rank=None, tables=None, weights=None) -> ReplayResult:
+               tiebreak_rank=None, tables=None, weights=None,
+               fault_ops=None, fault_carry0=None) -> ReplayResult:
+        if faults:
+            return eng.replay(
+                state, pods, types, ev_kind, ev_pod, tp, key,
+                resolve_weights(policies, weights), tiebreak_rank, tables,
+                fault_ops, fault_carry0,
+            )
         return eng.replay(
             state, pods, types, ev_kind, ev_pod, tp, key,
             resolve_weights(policies, weights), tiebreak_rank, tables,
         )
 
     def init_carry(state, pods, types, tp, key, tiebreak_rank=None,
-                   tables=None, weights=None):
+                   tables=None, weights=None, fault_carry0=None):
+        if faults:
+            return eng.init_carry(
+                state, pods, types, tp, key,
+                resolve_weights(policies, weights), tiebreak_rank, tables,
+                fault_carry0,
+            )
         return eng.init_carry(
             state, pods, types, tp, key,
             resolve_weights(policies, weights), tiebreak_rank, tables,
         )
 
     def run_chunk(carry, pods, types, ev_kind, ev_pod, tp,
-                  tiebreak_rank=None, weights=None):
+                  tiebreak_rank=None, weights=None, fault_ops=None):
+        if faults:
+            return eng.run_chunk(
+                carry, pods, types, ev_kind, ev_pod, tp,
+                resolve_weights(policies, weights), tiebreak_rank,
+                fault_ops,
+            )
         return eng.run_chunk(
             carry, pods, types, ev_kind, ev_pod, tp,
             resolve_weights(policies, weights), tiebreak_rank,
@@ -550,13 +576,27 @@ class _TableEngine(NamedTuple):
 
 def _make_table_engine(
     policies, gpu_sel: str, block_size: int, heartbeat_every: int,
-    decisions: bool, series_every: int,
+    decisions: bool, series_every: int, faults: bool = False,
+    fault_frag: bool = False,
 ) -> _TableEngine:
     """Build the jitted weight-operand machinery make_table_replay wraps.
     The closed-over `policies` weights are deliberately never read — only
     the kernel objects and their normalize/name metadata are static; the
-    numeric weights always arrive as the `wts` operand."""
+    numeric weights always arrive as the `wts` operand.
+
+    faults=True (ISSUE 10) builds the fault-plane variant: the scan
+    consumes the MERGED stream (base + fault + retry-slot steps,
+    tpusim.sim.fault_lane) with three extra xs (pos/arg/aux), the carry
+    becomes (table carry, FaultCarry) — the retry queue rides the same
+    checkpoint/resume surface as every other leaf — and fault kinds
+    apply as masked one-node ops AFTER the event switch (they clip to
+    EV_SKIP inside it, so the base machinery is untouched). Fault
+    transitions touch exactly one node, so the existing dirty-column /
+    dirty-block refresh keeps the tables exact; DOWN rows carry the
+    mem_left == -1 sentinel the Filter already rejects."""
     num_pol = len(policies)
+    if faults:
+        from tpusim.sim import fault_lane as _fl
     sel_idx = selector_index(policies, gpu_sel)
     _columns, _init_tables = make_table_builders(policies, sel_idx)
     has_random = any(fn.policy_name == "RandomScore" for fn, _ in policies)
@@ -614,7 +654,7 @@ def _make_table_engine(
 
     def make_blocked_body(
         pods, type_id, types, tp, rank_p, n, num_pods, bsz, k_types, nblk,
-        offs, wts,
+        offs, wts, fault_ops=None,
     ):
         """Scan body of the blocked O(B + N/B) select path: tables padded
         to a whole number of B-node blocks (sentinel columns: infeasible,
@@ -639,10 +679,23 @@ def _make_table_engine(
         n_norm = len(norm_idx)
 
         def body(carry, ev):
+            if faults:
+                carry, fc = carry
+                kind, idx, fpos, farg, faux = ev
             (state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
              brmin, brmax, slo, shi, pend, dirty,
              placed, masks, failed, arr_cpu, arr_gpu, key, ctr) = carry
-            kind, idx = ev
+            if not faults:
+                kind, idx = ev
+                kc = jnp.clip(kind, 0, 2)
+            else:
+                is_slot = kind == EV_RETRY
+                fc, has_pop, rpod = _fl.pop_retry(fc, is_slot, fpos, farg)
+                idx = jnp.where(has_pop, rpod, idx)
+                kc = jnp.where(
+                    is_slot, jnp.where(has_pop, 0, 2),
+                    jnp.clip(kind, 0, 2),
+                )
             pod = jax.tree.map(lambda a: a[idx], pods)
             t_id = type_id[idx]
             # identical key-split discipline to the flat path / oracle
@@ -863,7 +916,6 @@ def _make_table_engine(
                 )
                 return base + ((no_decision(num_pol),) if decisions else ())
 
-            kc = jnp.clip(kind, 0, 2)
             outs = jax.lax.switch(kc, [do_create, do_delete, do_skip])
             if decisions:
                 node, dev, dec = outs
@@ -879,26 +931,66 @@ def _make_table_engine(
                 obs_heartbeat.emit_from_scan(
                     ctr[0] + ctr[3] + ctr[4], heartbeat_every
                 )
-            return BlockedTableCarry(
+            if faults:
+                pend = pend._replace(failed_val=jnp.where(
+                    is_slot, failed[idx] | (node < 0), node < 0
+                ))
+                (state, placed, masks, failed, fc, ftouch, fy) = (
+                    _fl.apply_fault_step(
+                        state, placed, masks, failed, fc, pods, kind,
+                        farg, faux, fpos, fault_ops, tp,
+                        jnp.arange(n, dtype=jnp.int32), fault_frag,
+                    )
+                )
+                fc, lat, _ = _fl.commit_retry(
+                    fc, has_pop, rpod, node, fpos, farg, fault_ops.params
+                )
+                fy = fy._replace(
+                    rpod=jnp.where(has_pop, rpod, -1).astype(jnp.int32),
+                    lat=lat,
+                )
+                dirty = jnp.where(ftouch >= 0, ftouch, dirty)
+                node = jnp.where(ftouch >= 0, ftouch, node)
+            new_carry = BlockedTableCarry(
                 state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
                 brmin, brmax, slo, shi, pend, dirty,
                 placed, masks, failed, arr_cpu, arr_gpu, key, ctr,
-            ), (
+            )
+            ys = (
                 (node, dev)
                 + ((dec,) if decisions else ())
                 + ((ser,) if series_every else ())
             )
+            if faults:
+                return (new_carry, fc), ys + (fy,)
+            return new_carry, ys
 
         return body
 
     def make_flat_body(pods, type_id, types, tp, tiebreak_rank, n, num_pods,
-                       wts):
+                       wts, fault_ops=None):
         """Scan body of the flat O(N) select path."""
 
         def body(carry, ev):
+            if faults:
+                carry, fc = carry
+                kind, idx, fpos, farg, faux = ev
             (state, score_tbl, sdev_tbl, feas_tbl, pend, dirty,
              placed, masks, failed, arr_cpu, arr_gpu, key, ctr) = carry
-            kind, idx = ev
+            if not faults:
+                kind, idx = ev
+                kc = jnp.clip(kind, 0, 2)
+            else:
+                # retry slots pop the earliest due evicted pod and run it
+                # through the ordinary create branch; fault kinds clip to
+                # skip here and apply as masked ops after the switch
+                is_slot = kind == EV_RETRY
+                fc, has_pop, rpod = _fl.pop_retry(fc, is_slot, fpos, farg)
+                idx = jnp.where(has_pop, rpod, idx)
+                kc = jnp.where(
+                    is_slot, jnp.where(has_pop, 0, 2),
+                    jnp.clip(kind, 0, 2),
+                )
             pod = jax.tree.map(lambda a: a[idx], pods)
             t_id = type_id[idx]
             # the sequential oracle's split discipline exactly (engine.py
@@ -991,7 +1083,6 @@ def _make_table_engine(
                 )
                 return base + ((no_decision(num_pol),) if decisions else ())
 
-            kc = jnp.clip(kind, 0, 2)
             outs = jax.lax.switch(kc, [do_create, do_delete, do_skip])
             if decisions:
                 node, dev, dec = outs
@@ -1009,20 +1100,64 @@ def _make_table_engine(
                 obs_heartbeat.emit_from_scan(
                     ctr[0] + ctr[3] + ctr[4], heartbeat_every
                 )
-            return FlatTableCarry(
+            if faults:
+                # retry creates accumulate ever-failed with OR (the
+                # segmented path's per-segment `|=`); base creates still
+                # overwrite (they run once per pod)
+                pend = pend._replace(failed_val=jnp.where(
+                    is_slot, failed[idx] | (node < 0), node < 0
+                ))
+                (state, placed, masks, failed, fc, ftouch, fy) = (
+                    _fl.apply_fault_step(
+                        state, placed, masks, failed, fc, pods, kind,
+                        farg, faux, fpos, fault_ops, tp,
+                        jnp.arange(n, dtype=jnp.int32), fault_frag,
+                    )
+                )
+                fc, lat, _ = _fl.commit_retry(
+                    fc, has_pop, rpod, node, fpos, farg, fault_ops.params
+                )
+                fy = fy._replace(
+                    rpod=jnp.where(has_pop, rpod, -1).astype(jnp.int32),
+                    lat=lat,
+                )
+                dirty = jnp.where(ftouch >= 0, ftouch, dirty)
+                node = jnp.where(ftouch >= 0, ftouch, node)
+            new_carry = FlatTableCarry(
                 state, score_tbl, sdev_tbl, feas_tbl, pend, dirty,
                 placed, masks, failed, arr_cpu, arr_gpu, key, ctr,
-            ), (
+            )
+            ys = (
                 (node, dev)
                 + ((dec,) if decisions else ())
                 + ((ser,) if series_every else ())
             )
+            if faults:
+                return (new_carry, fc), ys + (fy,)
+            return new_carry, ys
 
         return body
 
+    def _pad_fc(fc0):
+        """Size the FaultCarry's pod axis to the carry's P+1 bookkeeping
+        rows (the dummy row absorbing skip writes can never be evicted —
+        placed[P] stays -1 — so the pad rows are inert)."""
+        return fc0._replace(
+            attempts=jnp.pad(fc0.attempts, (0, 1)),
+            evicted_at=jnp.pad(fc0.evicted_at, (0, 1), constant_values=-1),
+            dead=jnp.pad(fc0.dead, (0, 1)),
+        )
+
+    def _trim_fc(fc):
+        return fc._replace(
+            attempts=fc.attempts[:-1],
+            evicted_at=fc.evicted_at[:-1],
+            dead=fc.dead[:-1],
+        )
+
     @jax.jit
     def init_carry(state, pods, types, tp, key, wts, tiebreak_rank=None,
-                   tables=None):
+                   tables=None, fault_carry0=None):
         """Engine state at event 0: score/sdev/feas tables from the
         committed state + an inert pipeline register (and, on the blocked
         path, the per-(policy, type, block) aggregates built from the
@@ -1056,10 +1191,11 @@ def _make_table_engine(
         pend = no_pending_commit(num_pods)
         z = jnp.int32(0)
         if not bsz:
-            return FlatTableCarry(
+            flat = FlatTableCarry(
                 state, score_tbl, sdev_tbl, feas_tbl, pend, z,
                 placed, masks, failed, z, z, key, zero_counters(),
             )
+            return (flat, _pad_fc(fault_carry0)) if faults else flat
 
         nblk = -(-n // bsz)
         n_pad = nblk * bsz
@@ -1095,15 +1231,16 @@ def _make_table_engine(
             tot0.reshape(k_types, nblk, bsz), rank_p.reshape(nblk, bsz)
         )
         bn = offs[None, :] + ba  # [K, nblk] global winner node ids
-        return BlockedTableCarry(
+        blocked = BlockedTableCarry(
             state, score_tbl, sdev_tbl, feas_tbl, bt, br, bn,
             brmin, brmax, slo, shi, pend, z,
             placed, masks, failed, z, z, key, zero_counters(),
         )
+        return (blocked, _pad_fc(fault_carry0)) if faults else blocked
 
     @jax.jit
     def run_chunk(carry, pods, types, ev_kind, ev_pod, tp, wts,
-                  tiebreak_rank=None):
+                  tiebreak_rank=None, fault_ops=None):
         """Advance `carry` over a segment of the event stream; returns
         (carry', (event_node, event_dev)) for the segment — extended with
         a per-event DecisionRecord element when the engine was built with
@@ -1115,27 +1252,33 @@ def _make_table_engine(
         (i32/bool/u32), so even a host/disk round-trip between chunks
         cannot perturb the trajectory. `wts` must be the weight vector
         the carry was initialized under (the blocked summaries embed it)."""
-        n = carry.state.num_nodes
+        base = carry[0] if faults else carry
+        n = base.state.num_nodes
         num_pods = pods.cpu.shape[0]
         if tiebreak_rank is None:
             tiebreak_rank = jnp.arange(n, dtype=jnp.int32)
         type_id = types.type_id
-        if isinstance(carry, BlockedTableCarry):
-            k_types, nblk = carry.bt.shape
-            bsz = carry.score_tbl.shape[2] // nblk
+        if isinstance(base, BlockedTableCarry):
+            k_types, nblk = base.bt.shape
+            bsz = base.score_tbl.shape[2] // nblk
             rank_p = _pad_rank(tiebreak_rank, nblk * bsz)
             offs = jnp.arange(nblk, dtype=jnp.int32) * bsz
             body = make_blocked_body(
                 pods, type_id, types, tp, rank_p, n, num_pods, bsz,
-                k_types, nblk, offs, wts,
+                k_types, nblk, offs, wts, fault_ops,
             )
         else:
             body = make_flat_body(
-                pods, type_id, types, tp, tiebreak_rank, n, num_pods, wts
+                pods, type_id, types, tp, tiebreak_rank, n, num_pods, wts,
+                fault_ops,
             )
+        xs = (
+            (ev_kind, ev_pod, fault_ops.pos, fault_ops.arg, fault_ops.aux)
+            if faults else (ev_kind, ev_pod)
+        )
         # unroll amortizes per-iteration fixed costs (~20% wall on the openb
         # replay); higher factors showed no further gain
-        return jax.lax.scan(body, carry, (ev_kind, ev_pod), unroll=4)
+        return jax.lax.scan(body, carry, xs, unroll=4)
 
     @jax.jit
     def finish(carry):
@@ -1143,6 +1286,8 @@ def _make_table_engine(
         and strip the dummy bookkeeping row. Returns (state, placed,
         masks, failed). A finished carry must not be resumed — the pending
         commit has landed."""
+        if faults:
+            carry = carry[0]
         state, placed, masks, failed = apply_commit(
             carry.state, carry.placed, carry.masks, carry.failed, carry.pend
         )
@@ -1160,18 +1305,28 @@ def _make_table_engine(
         wts,  # i32[num_pol] traced weight operand
         tiebreak_rank=None,
         tables=None,
+        fault_ops=None,
+        fault_carry0=None,
     ) -> ReplayResult:
         carry = init_carry(
-            state, pods, types, tp, key, wts, tiebreak_rank, tables
+            state, pods, types, tp, key, wts, tiebreak_rank, tables,
+            fault_carry0,
         )
         carry, ys = run_chunk(
-            carry, pods, types, ev_kind, ev_pod, tp, wts, tiebreak_rank
+            carry, pods, types, ev_kind, ev_pod, tp, wts, tiebreak_rank,
+            fault_ops,
         )
         state, placed, masks, failed = finish(carry)
         nodes, devs = ys[0], ys[1]
         rest = list(ys[2:])
         decs = rest.pop(0) if decisions else None
         sers = rest.pop(0) if series_every else None
+        if faults:
+            base, fc = carry
+            return ReplayResult(
+                state, placed, masks, failed, None, nodes, devs, base.ctr,
+                None, None, rest.pop(0), _trim_fc(fc),
+            )
         return ReplayResult(
             state, placed, masks, failed, None, nodes, devs, carry.ctr,
             decs, sers,

@@ -75,6 +75,12 @@ class JobService:
         with self._submit_lock:
             cached = svc_jobs.find_result(self.artifact_dir, digest)
             job = self.queue.submit(spec, digest, cached_result=cached)
+            if cached is None:
+                # persist the accepted spec BEFORE it becomes runnable: a
+                # crash mid-batch leaves a recoverable `.job.json` on
+                # disk instead of a job stranded in `running` forever
+                # (recover_pending_jobs requeues it at the next startup)
+                svc_jobs.write_job_spec(self.artifact_dir, digest, payload)
         if self.monitor is not None:
             self.monitor.publish_job_progress(
                 job.id, {"status": job.status, "phase": "submitted"}
@@ -163,17 +169,51 @@ class JobService:
         return _json_body(200, stats)
 
 
+def recover_pending_jobs(service: JobService, out=None) -> int:
+    """Restart recovery (ISSUE 10 satellite): requeue every persisted
+    job spec with no signed result — a service killed mid-batch answers
+    its stranded jobs after restart instead of leaving them `running`
+    forever. Returns the number requeued; malformed or no-longer-valid
+    specs (code drift changes the digest, a hosted trace vanished) are
+    skipped with a note, never fatal."""
+    n = 0
+    for digest, payload in svc_jobs.pending_job_specs(service.artifact_dir):
+        try:
+            service.submit_payload(payload)
+            n += 1
+        except QueueFull:
+            if out is not None:
+                print(
+                    f"[serve] recovery stopped at a full queue "
+                    f"({digest[:12]}… left for the client's retry)",
+                    file=out,
+                )
+            break
+        except ValueError as err:
+            if out is not None:
+                print(
+                    f"[serve] skipping unrecoverable job "
+                    f"{digest[:12]}…: {err}", file=out,
+                )
+    if n and out is not None:
+        print(f"[serve] requeued {n} interrupted job(s) from "
+              f"{service.artifact_dir}", file=out)
+    return n
+
+
 def start_job_server(
     artifact_dir: str, traces: Dict[str, TraceRef], listen: str = "",
     lane_width: int = 8, queue_size: int = 64, bucket: int = 512,
     table_cache_dir: str = "", compile_cache_dir: str = "",
-    start_worker: bool = True,
+    start_worker: bool = True, recover: bool = True, out=None,
 ) -> Tuple[object, JobService, Worker]:
     """Wire the full service: MonitorServer (+ heartbeat-fed /progress)
     with the JobService app, a bounded JobQueue, and the single Worker
     thread. Returns (server, service, worker); caller owns shutdown
-    (worker.stop(); server.stop()). start_worker=False leaves batch
-    dispatch to the caller (deterministic tests)."""
+    (srv.begin_drain(); worker.stop(); srv.stop()). start_worker=False
+    leaves batch dispatch to the caller (deterministic tests);
+    recover=True requeues crash-interrupted jobs from the artifact dir
+    before the worker starts."""
     from tpusim.obs.server import MonitorServer
 
     srv = MonitorServer(listen)
@@ -185,6 +225,10 @@ def start_job_server(
     )
     service = JobService(queue, worker, traces, artifact_dir, monitor=srv)
     srv.add_app(service)
+    if recover:
+        # before start(): recovered jobs must be queued before the first
+        # client request can observe the service
+        recover_pending_jobs(service, out=out)
     srv.start()
     srv.attach_heartbeat()
     srv.publish_progress(phase="serving-jobs")

@@ -67,17 +67,67 @@ def submit_jobs(url: str, docs: Sequence[dict], max_retries: int = 8,
     (dedup makes overlap safe). Returns the accepted job descriptions in
     submission order; raises ServiceError on a 400 or when the queue
     never drains within max_retries rounds."""
+    import http.client
+
     url = url.rstrip("/")
     pending = list(docs)
     accepted: List[dict] = []
     for attempt in range(1, max_retries + 1):
         body = json.dumps({"jobs": pending}).encode()
-        code, headers, doc = _request(url + "/jobs", body, timeout)
+        try:
+            code, headers, doc = _request(url + "/jobs", body, timeout)
+        except (ConnectionResetError,
+                http.client.RemoteDisconnected, urllib.error.URLError) as e:
+            # a draining/restarting service (ISSUE 10 graceful shutdown)
+            # resets the connection mid-POST; accepted specs are
+            # persisted server-side and dedup makes the full-list retry
+            # safe — treat it exactly like backpressure. Connection
+            # REFUSED is different: nothing is listening (down service,
+            # typo'd --url) and must fail fast, not burn the whole
+            # backoff schedule.
+            reason = getattr(e, "reason", e)
+            if isinstance(e, ConnectionRefusedError) or isinstance(
+                reason, ConnectionRefusedError
+            ):
+                raise ServiceError(
+                    f"POST /jobs: connection refused at {url} — is the "
+                    "service running?"
+                )
+            if attempt >= max_retries:
+                raise ServiceError(
+                    f"POST /jobs kept failing ({type(e).__name__}: {e}) "
+                    f"after {max_retries} attempts"
+                )
+            delay = _retry_delay_s(attempt)
+            if out is not None:
+                print(
+                    f"[submit] connection lost ({type(e).__name__}; "
+                    f"service draining/restarting?), retrying in "
+                    f"{delay:.1f}s", file=out,
+                )
+            time.sleep(delay)
+            continue
         if code in (200, 202):
             accepted.extend(doc.get("jobs", [doc]))
             return accepted
         if code == 400:
             raise ServiceError(f"rejected: {doc.get('error', doc)}")
+        if code == 503:
+            # drain answer: the service is finishing its in-flight batch
+            # before exiting; the restarted process recovers persisted
+            # specs, so waiting + resubmitting is the right move
+            if attempt >= max_retries:
+                raise ServiceError(
+                    f"service stayed draining after {max_retries} attempts"
+                )
+            delay = _retry_delay_s(attempt, headers.get("Retry-After"))
+            if out is not None:
+                print(
+                    f"[submit] service draining, retrying in {delay:.1f}s",
+                    file=out,
+                )
+            time.sleep(delay)
+            continue
         if code == 429:
             got = doc.get("accepted") or []
             accepted.extend(got)

@@ -43,8 +43,15 @@ ENGINES = ("auto", "table", "sequential")
 # (a typo'd "wieghts" must not silently become a default-weight replay)
 JOB_KEYS = frozenset((
     "trace", "policies", "weights", "seed", "gpu_sel", "norm", "dim_ext",
-    "tune", "tune_seed", "engine",
+    "tune", "tune_seed", "engine", "fault",
 ))
+
+# the per-job fault document's vocabulary == FaultConfig's fields
+# (tpusim.sim.faults); canonical order for the spec tuple
+FAULT_FIELDS = (
+    "mtbf_events", "mttr_events", "evict_every_events", "seed",
+    "max_retries", "backoff_base", "backoff_cap", "queue_capacity",
+)
 
 DEFAULT_POLICIES = (("FGDScore", 1000),)
 
@@ -64,15 +71,26 @@ class JobSpec:
     tune: float = 0.0  # workload tuning ratio (0 = untuned trace)
     tune_seed: int = 233
     engine: str = "auto"
+    # fault what-if (ISSUE 10): the FaultConfig values in FAULT_FIELDS
+    # order, or () for a fault-free replay. A sweep OPERAND like
+    # weights/seed/tune — fault jobs batch onto one compiled chaos scan.
+    fault: Tuple = ()
 
     def family_key(self) -> tuple:
         """Batching compatibility key — everything that shapes the
-        compiled sweep's jaxpr. Weights, seed, and tune factor are
-        deliberately ABSENT: they are traced operands (ISSUE 6/7), so
-        jobs differing only in them pack onto one compiled scan."""
+        compiled sweep's jaxpr. Weights, seed, tune factor, and the
+        fault schedule are traced operands (ISSUE 6/7/10), so jobs
+        differing only in them pack onto one compiled scan. Two
+        exceptions: fault jobs batch separately from fault-free ones
+        (the fault build is a different jaxpr), and a fault batch pins
+        one tune factor (the chaos sweep replays ONE base trace; its
+        fault plans are compiled against that stream)."""
         return (
             self.trace, tuple(n for n, _ in self.policies),
             self.gpu_sel, self.norm, self.dim_ext, self.engine,
+            bool(self.fault),
+            float(self.tune) if self.fault else 0.0,
+            self.tune_seed if self.fault else 0,
         )
 
     def canonical(self) -> tuple:
@@ -82,7 +100,15 @@ class JobSpec:
             self.trace, self.policies, self.weights, self.seed,
             self.gpu_sel, self.norm, self.dim_ext, float(self.tune),
             self.tune_seed, self.engine,
-        )
+        ) + ((self.fault,) if self.fault else ())
+
+    def fault_config(self):
+        """The job's FaultConfig, or None for a fault-free replay."""
+        if not self.fault:
+            return None
+        from tpusim.sim.faults import FaultConfig
+
+        return FaultConfig(**dict(zip(FAULT_FIELDS, self.fault)))
 
 
 def validate_job(payload: dict) -> JobSpec:
@@ -163,7 +189,36 @@ def validate_job(payload: dict) -> JobSpec:
     if tune < 0:
         raise ValueError(f"tune must be >= 0, got {tune}")
 
+    fault = payload.get("fault")
+    fault_t: Tuple = ()
+    if fault is not None:
+        if not isinstance(fault, dict):
+            raise ValueError(
+                f"fault must be an object of FaultConfig fields "
+                f"({', '.join(FAULT_FIELDS)}), got {fault!r}"
+            )
+        unknown = set(fault) - set(FAULT_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown fault key(s) {sorted(unknown)} (known: "
+                f"{sorted(FAULT_FIELDS)})"
+            )
+        from tpusim.sim.faults import FaultConfig
+
+        fc = FaultConfig(**fault)
+        if fc.mtbf_events <= 0 and fc.evict_every_events <= 0:
+            raise ValueError(
+                "fault needs mtbf_events > 0 or evict_every_events > 0 "
+                "(an empty schedule is a fault-free job — drop the key)"
+            )
+        fault_t = tuple(
+            float(getattr(fc, f)) if f.endswith("_events")
+            else int(getattr(fc, f))
+            for f in FAULT_FIELDS
+        )
+
     return JobSpec(
+        fault=fault_t,
         trace=str(payload.get("trace", "default")),
         policies=tuple(policies),
         weights=weights,
@@ -184,10 +239,14 @@ def _as_int(v, what: str) -> int:
 
 
 # keys an apply-style grid document may carry: the per-row vectors plus
-# every scalar JOB_KEYS field that applies to all rows
+# every scalar JOB_KEYS field that applies to all rows ("fault" is a
+# shared chaos schedule; per-row "fault_seeds" vary its seed — the
+# disruption-frontier grid: one trace, B fault seeds, one POST)
 GRID_SHARED_KEYS = ("trace", "policies", "gpu_sel", "norm", "dim_ext",
-                    "engine", "tune_seed")
-GRID_KEYS = frozenset(("weights", "seeds", "tunes") + GRID_SHARED_KEYS)
+                    "engine", "tune_seed", "fault")
+GRID_KEYS = frozenset(
+    ("weights", "seeds", "tunes", "fault_seeds") + GRID_SHARED_KEYS
+)
 
 
 def docs_from_payload(payload):
@@ -251,9 +310,16 @@ def jobs_from_grid(payload, default_policies=None):
         weights = payload.get("weights")
         seeds = payload.get("seeds")
         tunes = payload.get("tunes")
+        fault_seeds = payload.get("fault_seeds")
         shared = {k: payload[k] for k in GRID_SHARED_KEYS if k in payload}
+        if fault_seeds is not None and "fault" not in shared:
+            raise ValueError(
+                '"fault_seeds" needs a shared "fault" document to vary '
+                "the seed of"
+            )
     else:
         weights, seeds, tunes, shared = payload, None, None, {}
+        fault_seeds = None
     if not weights:
         raise ValueError(
             "no weight rows (want [[w, ...], ...], "
@@ -263,7 +329,8 @@ def jobs_from_grid(payload, default_policies=None):
     if "policies" not in shared and default_policies is not None:
         shared["policies"] = [list(p) for p in default_policies]
     b = len(weights)
-    for name, vals in (("seeds", seeds), ("tunes", tunes)):
+    for name, vals in (("seeds", seeds), ("tunes", tunes),
+                       ("fault_seeds", fault_seeds)):
         if vals is not None and len(vals) != b:
             raise ValueError(
                 f"{name} has {len(vals)} entries for {b} weight rows"
@@ -276,6 +343,8 @@ def jobs_from_grid(payload, default_policies=None):
             job["seed"] = seeds[i]
         if tunes is not None:
             job["tune"] = tunes[i]
+        if fault_seeds is not None:
+            job["fault"] = dict(job["fault"], seed=fault_seeds[i])
         out.append(job)
     return out
 
@@ -318,6 +387,87 @@ def trace_digest(nodes: Sequence, pods: Sequence) -> str:
 
 def result_path(artifact_dir: str, digest: str) -> str:
     return os.path.join(artifact_dir, f"{digest}{RESULT_SUFFIX}")
+
+
+# ---------------------------------------------------------------------------
+# Job-spec persistence — crash/restart recovery (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+#
+# Accepted jobs used to live only in the in-memory JobQueue: a service
+# killed mid-batch stranded them in `running` forever (the client polls a
+# job id the restarted process has never heard of). Now every accepted
+# job document persists as `<digest>.job.json` BEFORE it is runnable, and
+# `tpusim serve --jobs` startup requeues every spec with no signed result
+# (svc.api.recover_pending_jobs) — the crash simply becomes a retry. The
+# spec file is tiny, atomic (tmp + rename), and content-addressed by the
+# job digest, so re-accepting the same document is an idempotent
+# overwrite and completed jobs are skipped by their result file.
+
+JOB_SUFFIX = ".job.json"
+JOB_SPEC_SCHEMA = "tpusim-svc-job/1"
+
+
+def job_path(artifact_dir: str, digest: str) -> str:
+    return os.path.join(artifact_dir, f"{digest}{JOB_SUFFIX}")
+
+
+def write_job_spec(artifact_dir: str, digest: str, payload: dict) -> str:
+    """Persist one ACCEPTED job document (the validated submission
+    payload — revalidating it on recovery rebuilds the identical spec
+    and digest)."""
+    os.makedirs(artifact_dir, exist_ok=True)
+    path = job_path(artifact_dir, digest)
+    doc = {"schema": JOB_SPEC_SCHEMA, "job": digest, "spec": payload}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def delete_job_spec(artifact_dir: str, digest: str) -> None:
+    """Drop a job's persisted spec once it reaches a TERMINAL state: a
+    done job's result file is its durable record (and the dedup key), a
+    failed job must NOT be requeued by every future restart (a poisoned
+    batch would re-fail forever) — its failure stays queryable for the
+    session and the client's re-submit is an explicit retry."""
+    try:
+        os.unlink(job_path(artifact_dir, digest))
+    except OSError:
+        pass
+
+
+def pending_job_specs(artifact_dir: str):
+    """[(digest, spec payload)] of persisted jobs with NO valid signed
+    result — the restart-recovery work list. Torn/foreign spec files are
+    deleted and skipped (content addressing makes a lost spec merely a
+    job the client will re-submit)."""
+    if not os.path.isdir(artifact_dir):
+        return []
+    out = []
+    for fname in sorted(os.listdir(artifact_dir)):
+        if not fname.endswith(JOB_SUFFIX):
+            continue
+        path = os.path.join(artifact_dir, fname)
+        digest = fname[: -len(JOB_SUFFIX)]
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if (doc.get("schema") != JOB_SPEC_SCHEMA
+                    or doc.get("job") != digest
+                    or not isinstance(doc.get("spec"), dict)):
+                raise ValueError("foreign or malformed job-spec file")
+        except (OSError, ValueError, json.JSONDecodeError):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            continue
+        if find_result(artifact_dir, digest) is not None:
+            continue  # already answered — nothing to recover
+        out.append((digest, doc["spec"]))
+    return out
 
 
 def write_result(artifact_dir: str, digest: str, result: dict) -> str:

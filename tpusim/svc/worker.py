@@ -86,6 +86,10 @@ def summarize_lane(lane, job: Job) -> dict:
         out["counters"] = {
             f: int(c) for f, c in zip(COUNTER_FIELDS, lane.counters)
         }
+    if lane.disruption is not None:
+        # chaos lanes (ISSUE 10): the full DisruptionMetrics scalar
+        # summary rides the result document beside the objective terms
+        out["disruption"] = lane.disruption.as_dict()
     return out
 
 
@@ -179,12 +183,17 @@ class Worker:
             msg = f"{type(err).__name__}: {err}"
             for job in batch:
                 self.queue.mark_failed(job, msg)
+                # terminal: drop the persisted spec so restart recovery
+                # does not re-run the poisoned batch forever
+                svc_jobs.delete_job_spec(self.artifact_dir, job.digest)
             self._publish(batch, phase="failed", error=msg)
             return
         for job, lane in zip(batch, lanes):
             result = summarize_lane(lane, job)
             svc_jobs.write_result(self.artifact_dir, job.digest, result)
             self.queue.mark_done(job, result)
+            # terminal: the signed result is the durable record now
+            svc_jobs.delete_job_spec(self.artifact_dir, job.digest)
         self.batches_run += 1
         self._publish(batch, phase="done")
 
@@ -194,6 +203,8 @@ class Worker:
             schedule_pods_sweep_multi,
         )
 
+        if batch[0].spec.fault:
+            return self._dispatch_chaos(batch)
         sim = self._sim_for(batch[0])
         key = batch[0].spec.family_key()
         # tag the shared heartbeat stream with this batch's lead job so
@@ -252,6 +263,39 @@ class Worker:
             else sim.replay_fn.engine,
             table=used_table,
         ))
+        return lanes
+
+    def _dispatch_chaos(self, batch: List[Job]):
+        """Fault-job batches (ISSUE 10): ONE compiled chaos sweep — the
+        family key pins one (trace, tune), so every lane replays the
+        same base stream under its own fault schedule/weights/seed.
+        Lane-vs-standalone bit-identity and the zero-recompile contract
+        are the driver's (schedule_pods_sweep_faults)."""
+        from tpusim.sim.driver import schedule_pods_sweep_faults
+
+        sim = self._sim_for(batch[0])
+        sim._hb_job = batch[0].id
+        pods = sim.prepare_pods(
+            tuning_ratio=batch[0].spec.tune,
+            tuning_seed=batch[0].spec.tune_seed,
+        )
+        jobs = list(batch)
+        n = len(batch)
+        while len(jobs) < self.queue.lane_width:
+            jobs.append(jobs[-1])  # tail-repeat padding (vmap axis size)
+        weights = np.asarray(
+            [list(j.spec.weights) for j in jobs], np.int32
+        )
+        seeds = [j.spec.seed for j in jobs]
+        fault_specs = [j.spec.fault_config() for j in jobs]
+        sim._reset_run_state()
+        if sim.typical is None:
+            sim.set_typical_pods()
+        lanes = schedule_pods_sweep_faults(
+            sim, pods, weights, fault_specs, seeds=seeds,
+            bucket=self.bucket,
+        )[:n]
+        self._sweep_fns.add(sim._last_sweep_fn)
         return lanes
 
     # ---- introspection ----
