@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke chaos-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke chaos-smoke mesh-chaos-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py tests/test_pipeline.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -104,6 +104,18 @@ tune-smoke:
 # single-lane run_with_faults path.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --chaos-only
+
+# mesh-chaos smoke (ENGINES.md "Round 15"): the pipelined shard engine
+# on a small forced-virtual mesh — a FAULTED mesh replay must reconcile
+# the single-device fault lane exactly (retry pops + DOWN-row resets
+# through the pending registers) with the frag-delta degrade loud, and
+# a chunked replay with buffer DONATION armed must hold ONE compiled
+# executable across equal-size chunks, consume its input carries, keep
+# the live-buffer census stable (nothing re-materialized), and finish
+# bit-identical to the one-shot replay. Also prints the advisory
+# comparison of the newest committed MULTICHIP_r*.json scale capture.
+mesh-chaos-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --mesh-chaos-only
 
 # bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
 # measurement under profiling and diff it against the newest committed

@@ -101,22 +101,38 @@ def test_scan_equals_segmented_mixed_schedule():
     assert any(k.startswith("disruption_") for k in sb.analysis_summary)
 
 
-def test_fault_lane_engine_invariant():
-    """sequential vs flat-table vs blocked-table fault lanes replay one
-    schedule bit-identically (the shard engine is pinned separately)."""
+def _invariance_runs(overrides):
     nodes, pods = _nodes(), _pods(6)
     fcfg = _mixed_fcfg(seed=7)
     runs = []
-    for over in (
-        {"engine": "sequential"},
-        {"engine": "table"},
-        {"engine": "table", "block_size": 2},
-    ):
+    for over in overrides:
         sim = _sim(nodes, pods, fault_mode="scan", **over)
         res = sim.schedule_pods_with_faults(pods, fault_cfg=fcfg)
         runs.append((res, sim.last_disruption))
     for res, dm in runs[1:]:
         _assert_same_run(runs[0][0], runs[0][1], res, dm)
+
+
+def test_fault_lane_engine_invariant():
+    """sequential vs flat-table fault lanes replay one schedule
+    bit-identically (the shard engine is pinned separately; the
+    blocked-table lane — a third engine compile — runs under
+    resume-smoke: tier-1 trim, ISSUE 11 satellite)."""
+    _invariance_runs((
+        {"engine": "sequential"},
+        {"engine": "table"},
+    ))
+
+
+@pytest.mark.slow  # compiles the blocked fault engine on top of the two
+# the fast case pays for — resume-smoke runs it
+def test_fault_lane_engine_invariant_blocked():
+    """The blocked-table fault lane (block summaries + retry pops) joins
+    the sequential/flat invariance set."""
+    _invariance_runs((
+        {"engine": "sequential"},
+        {"engine": "table", "block_size": 2},
+    ))
 
 
 def test_fault_lane_shard_engine():
@@ -183,19 +199,26 @@ def test_retry_carry_kill_resume_continuity():
         len(pods), len(nodes), plan.capacity
     )
     key = jax.random.PRNGKey(42)
-    whole = fn(
-        sim.init_state, specs, types, jnp.asarray(plan.kind),
-        jnp.asarray(plan.idx), sim.typical, key, sim.rank,
-        fault_ops=ops, fault_carry0=fc0,
-    )
-    # split mid-stream, round-tripping the carry through host numpy (the
-    # kill/resume surface)
+    # an even-length merged-stream prefix on purpose: the two split
+    # chunks below then have EQUAL length and share one compiled
+    # executable instead of two (tier-1 trim, ISSUE 11 satellite);
+    # a truncated stream is as valid a kill/resume subject as the full
+    # one — both sides of the contract replay the same prefix
     k = int(plan.kind.shape[0]) // 2
+    em2 = 2 * k
+    whole = fn(
+        sim.init_state, specs, types, jnp.asarray(plan.kind[:em2]),
+        jnp.asarray(plan.idx[:em2]), sim.typical, key, sim.rank,
+        fault_ops=ops._replace(
+            pos=ops.pos[:em2], arg=ops.arg[:em2], aux=ops.aux[:em2]
+        ),
+        fault_carry0=fc0,
+    )
     carry = fn.init_carry(
         sim.init_state, specs, types, sim.typical, key, sim.rank,
         fault_carry0=fc0,
     )
-    for sl in (slice(0, k), slice(k, None)):
+    for sl in (slice(0, k), slice(k, em2)):
         ops_sl = ops._replace(
             pos=ops.pos[sl], arg=ops.arg[sl], aux=ops.aux[sl]
         )
@@ -217,6 +240,9 @@ def test_retry_carry_kill_resume_continuity():
         assert np.array_equal(xa, ya[tuple(slice(0, s) for s in xa.shape)])
 
 
+@pytest.mark.slow  # its capacity-1 merged stream is a one-off shape ->
+# a dedicated ~5 s engine compile; resume-smoke runs it (tier-1 trim,
+# ISSUE 11 satellite)
 def test_retry_queue_overflow_goes_terminal():
     """An eviction wave past the static queue capacity goes terminal
     max-retries-exceeded (the documented divergence from the unbounded

@@ -1,0 +1,367 @@
+"""The shard engine's software-pipelined commit (ISSUE 11).
+
+Acceptance pins:
+- pipelined == unpipelined bit-identity (placements, telemetry,
+  counters, final state) across policies/mixes/gpu_sel and mesh shapes,
+  and under the fault lane (retry pops + DOWN-row resets through the
+  pending registers);
+- run_chunk kill/resume splits: a cut always lands between an event and
+  its deferred Bind (the commit applies at the top of the NEXT
+  iteration), so every boundary must resume bit-identically — including
+  through host numpy round-trips and under fault-lane retry pops;
+- buffer donation (run_chunk_donated): bit-identical to the
+  non-donating entry, actually consumes the input carry, and the
+  kill/resume contract holds with donation armed for the table AND
+  shard engines.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.fixtures import random_cluster, random_pods
+from tpusim.io.trace import tiebreak_rank
+from tpusim.policies import make_policy
+from tpusim.sim.table_engine import build_pod_types, make_table_replay
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs >= 2 virtual devices"
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _shard_engine(pol_names, gpu_sel, n_dev, block, faults, pipelined):
+    """One shard replayer per config for the whole module — the builder
+    has no cache of its own, and every build is a fresh ~2 s compile."""
+    from tpusim.parallel import make_mesh
+    from tpusim.parallel.shard_engine import make_shardmap_table_replay
+
+    policies = [(make_policy(n), w) for n, w in pol_names]
+    return make_shardmap_table_replay(
+        policies, make_mesh(n_dev), gpu_sel=gpu_sel, block_size=block,
+        faults=faults, pipelined=pipelined,
+    )
+
+
+def _fixture(n_dev, num_nodes=22, num_pods=44, seed=9):
+    from tests.test_table_engine import _events_with_deletes
+    from tpusim.parallel import pad_nodes, shard_state
+
+    rng = np.random.default_rng(seed)
+    state, tp = random_cluster(rng, num_nodes=num_nodes)
+    pods = random_pods(rng, num_pods=num_pods)
+    ev_kind, ev_pod = _events_with_deletes(num_pods, rng)
+    types = build_pod_types(pods)
+    rank = jnp.asarray(tiebreak_rank(num_nodes, seed=3))
+    from tpusim.parallel import make_mesh
+
+    mesh = make_mesh(n_dev)
+    pstate, prank = pad_nodes(state, rank, n_dev)
+    pstate = shard_state(pstate, mesh)
+    key = jax.random.PRNGKey(7)
+    return state, tp, pods, types, ev_kind, ev_pod, pstate, prank, key
+
+
+def _assert_replays_equal(r0, r1):
+    assert np.array_equal(np.asarray(r0.placed_node),
+                          np.asarray(r1.placed_node))
+    assert np.array_equal(np.asarray(r0.dev_mask), np.asarray(r1.dev_mask))
+    assert np.array_equal(np.asarray(r0.ever_failed),
+                          np.asarray(r1.ever_failed))
+    assert np.array_equal(np.asarray(r0.event_node),
+                          np.asarray(r1.event_node))
+    assert np.array_equal(np.asarray(r0.event_dev),
+                          np.asarray(r1.event_dev))
+    assert np.array_equal(np.asarray(r0.counters), np.asarray(r1.counters))
+    for f, (a, b) in zip(
+        r0.state._fields,
+        zip(jax.tree.leaves(r0.state), jax.tree.leaves(r1.state)),
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f
+
+
+PIPE_CONFIGS = [
+    # tier-1 keeps one mix on the small mesh with the blocked local
+    # select forced (the layout the 1M lane runs); the wider
+    # policy/gpu_sel/mesh grid compiles ~2 engines per case and runs
+    # under `make resume-smoke`
+    ((("FGDScore", 1000), ("BestFitScore", 500)), "FGDScore", 2, 4),
+    pytest.param((("FGDScore", 1000),), "FGDScore", 8, 4,
+                 marks=pytest.mark.slow),
+    pytest.param((("PWRScore", 1000),), "PWRScore", 2, 0,
+                 marks=pytest.mark.slow),  # normalized -> flat local path
+    pytest.param((("BestFitScore", 1000),), "worst", 8, 0,
+                 marks=pytest.mark.slow),
+    pytest.param((("GpuPackingScore", 600), ("DotProductScore", 400)),
+                 "DotProductScore", 2, 0, marks=pytest.mark.slow),
+]
+
+
+@pytest.mark.parametrize("pol,gpu_sel,n_dev,block", PIPE_CONFIGS,
+                         ids=lambda p: str(p))
+def test_pipelined_matches_unpipelined(pol, gpu_sel, n_dev, block):
+    """The pipelined commit is bit-identical to the unpipelined body —
+    placements, device masks, telemetry, counters, final state — for
+    policy mixes, normalized policies, and both mesh shapes."""
+    (state, tp, pods, types, ev_kind, ev_pod, pstate, prank,
+     key) = _fixture(n_dev)
+    r_pipe = _shard_engine(pol, gpu_sel, n_dev, block, False, True)(
+        pstate, pods, types, ev_kind, ev_pod, tp, key, prank
+    )
+    r_base = _shard_engine(pol, gpu_sel, n_dev, block, False, False)(
+        pstate, pods, types, ev_kind, ev_pod, tp, key, prank
+    )
+    _assert_replays_equal(r_pipe, r_base)
+    # ... and both match the single-device table engine (the standing
+    # shard-equality contract)
+    policies = [(make_policy(n), w) for n, w in pol]
+    r_tab = make_table_replay(policies, gpu_sel=gpu_sel)(
+        state, pods, types, ev_kind, ev_pod, tp, key,
+        jnp.asarray(tiebreak_rank(state.num_nodes, seed=3)),
+    )
+    assert np.array_equal(
+        np.asarray(r_tab.placed_node), np.asarray(r_pipe.placed_node)
+    )
+    assert np.array_equal(
+        np.asarray(r_tab.dev_mask), np.asarray(r_pipe.dev_mask)
+    )
+
+
+def _fault_inputs(n_dev, seed=11):
+    """A merged fault stream (fails + recovers + evictions + retry
+    slots) over the 2-device fixture, plus the padded FaultOps/carry."""
+    from tpusim.io.trace import NodeRow, PodRow, build_events, pods_to_specs
+    from tpusim.sim import fault_lane
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+    from tpusim.sim.faults import FaultConfig, generate_fault_schedule
+
+    nodes = [NodeRow(f"host-{i}", 16000, 65536, 2, "V100M16")
+             for i in range(3)]
+    pods = [PodRow(f"p{i}", 2000, 1024, 1, 500) for i in range(8)]
+    sim = Simulator(nodes, SimulatorConfig(
+        policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+        report_per_event=False, mesh=n_dev,
+    ))
+    sim.set_workload_pods(pods)
+    sim.set_typical_pods()
+    specs = pods_to_specs(pods, sim.node_index)
+    ev_kind, ev_pod = build_events(pods, False)
+    fcfg = FaultConfig(
+        mtbf_events=3, mttr_events=4, evict_every_events=5, seed=seed,
+        backoff_base=2, backoff_cap=8, max_retries=2,
+    )
+    faults = generate_fault_schedule(len(nodes), len(ev_kind), fcfg)
+    plan = fault_lane.compile_fault_plan(
+        ev_kind, ev_pod, faults, fcfg, len(nodes), len(pods)
+    )
+    from tpusim.parallel import pad_nodes, shard_state
+
+    n0 = sim.init_state.num_nodes
+    state_p, rank_p = pad_nodes(sim.init_state, sim.rank, n_dev)
+    n_pad = state_p.num_nodes
+    state_p = shard_state(state_p, sim._mesh)
+    ops = fault_lane.FaultOps(
+        pos=jnp.asarray(plan.pos), arg=jnp.asarray(plan.arg),
+        aux=jnp.asarray(plan.aux), draws=jnp.asarray(plan.draws),
+        params=jnp.asarray(plan.params),
+        gcnt=jnp.pad(jnp.asarray(sim.init_state.gpu_cnt),
+                     (0, n_pad - n0)),
+    )
+    fc0 = fault_lane.init_fault_carry(len(pods), n_pad, plan.capacity)
+    types = build_pod_types(specs)
+    key = jax.random.PRNGKey(42)
+    return sim, specs, types, plan, ops, fc0, state_p, rank_p, key
+
+
+@pytest.mark.parametrize("n_dev", [
+    2, pytest.param(8, marks=pytest.mark.slow)
+])
+def test_pipelined_fault_lane_matches_unpipelined(n_dev):
+    """Fault kinds flow through the pending registers: retry pops,
+    DOWN-row resets, and eviction returns replay bit-identically to the
+    unpipelined in-body fault application — per-event fault telemetry
+    and the final retry-queue carry included."""
+    (sim, specs, types, plan, ops, fc0, state_p, rank_p,
+     key) = _fault_inputs(n_dev)
+    kind_d, idx_d = jnp.asarray(plan.kind), jnp.asarray(plan.idx)
+    pol = (("FGDScore", 1000),)
+    outs = []
+    for pipelined in (True, False):
+        fn = _shard_engine(pol, "FGDScore", n_dev, 0, True, pipelined)
+        outs.append(fn(
+            state_p, specs, types, kind_d, idx_d, sim.typical, key,
+            rank_p, fault_ops=ops, fault_carry0=fc0,
+        ))
+    a, b = outs
+    _assert_replays_equal(a, b)
+    for f, (x, y) in zip(
+        a.fault_ys._fields,
+        zip(jax.tree.leaves(a.fault_ys), jax.tree.leaves(b.fault_ys)),
+    ):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), f
+    for f, (x, y) in zip(
+        a.fault_carry._fields,
+        zip(jax.tree.leaves(a.fault_carry),
+            jax.tree.leaves(b.fault_carry)),
+    ):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), f
+
+
+def test_shard_chunk_resume_between_event_and_bind():
+    """ISSUE 11 satellite: with the pipelined register, EVERY chunk cut
+    lands between an event and its deferred Bind — the commit is still
+    in the carry, not in the buffers. Cutting at several boundaries
+    (with a host numpy round-trip, the checkpoint surface) must resume
+    bit-identically to the one-shot replay."""
+    n_dev = 2
+    pol = (("FGDScore", 1000), ("BestFitScore", 500))
+    (state, tp, pods, types, ev_kind, ev_pod, pstate, prank,
+     key) = _fixture(n_dev)
+    fn = _shard_engine(pol, "FGDScore", n_dev, 4, False, True)
+    ref = fn(pstate, pods, types, ev_kind, ev_pod, tp, key, prank)
+    e = int(ev_kind.shape[0])
+    for cut in (1, e // 2):
+        carry = fn.init_carry(pstate, pods, types, tp, key, prank)
+        parts = []
+        for a, b in ((0, cut), (cut, e)):
+            carry, ys = fn.run_chunk(
+                carry, pods, types, ev_kind[a:b], ev_pod[a:b], tp, prank
+            )
+            # host round-trip: what checkpoint serialization does; jit
+            # re-shards the gathered leaves on the way back in
+            carry = jax.tree.map(
+                lambda x: jnp.asarray(np.asarray(x)), carry
+            )
+            parts.append(np.asarray(ys[0]))
+        st, placed, masks, failed = fn.finish(carry)
+        assert np.array_equal(np.asarray(placed),
+                              np.asarray(ref.placed_node))
+        assert np.array_equal(np.asarray(masks), np.asarray(ref.dev_mask))
+        assert np.array_equal(np.asarray(failed),
+                              np.asarray(ref.ever_failed))
+        assert np.array_equal(np.concatenate(parts),
+                              np.asarray(ref.event_node))
+        for a_, b_ in zip(jax.tree.leaves(st), jax.tree.leaves(ref.state)):
+            assert np.array_equal(np.asarray(a_), np.asarray(b_))
+
+
+def test_shard_chunk_resume_under_fault_retry_pops():
+    """The same cut contract on the fault lane: a boundary inside the
+    retry region (after pops have drained part of the queue, with a
+    pending fault register in flight) resumes bit-identically —
+    FaultCarry, pending registers, and bookkeeping all ride the
+    checkpointed carry."""
+    n_dev = 2
+    (sim, specs, types, plan, ops, fc0, state_p, rank_p,
+     key) = _fault_inputs(n_dev)
+    pol = (("FGDScore", 1000),)
+    fn = _shard_engine(pol, "FGDScore", n_dev, 0, True, True)
+    kind_d, idx_d = jnp.asarray(plan.kind), jnp.asarray(plan.idx)
+    ref = fn(state_p, specs, types, kind_d, idx_d, sim.typical, key,
+             rank_p, fault_ops=ops, fault_carry0=fc0)
+    e_m = int(plan.kind.shape[0])
+    # cut right after the first retry slot (a popped-and-committed or
+    # popped-and-pending retry straddles the boundary), plus mid-stream
+    slots = np.flatnonzero(plan.kind == 6)  # EV_RETRY
+    cuts = {int(slots[0]) + 1 if slots.size else 1, e_m // 2}
+    for cut in sorted(cuts):
+        carry = fn.init_carry(state_p, specs, types, sim.typical, key,
+                              rank_p, fault_carry0=fc0)
+        for a, b in ((0, cut), (cut, e_m)):
+            ops_sl = ops._replace(
+                pos=ops.pos[a:b], arg=ops.arg[a:b], aux=ops.aux[a:b]
+            )
+            carry, ys = fn.run_chunk(
+                carry, specs, types, kind_d[a:b], idx_d[a:b],
+                sim.typical, rank_p, fault_ops=ops_sl,
+            )
+            carry = jax.tree.map(
+                lambda x: jnp.asarray(np.asarray(x)), carry
+            )
+        st, placed, masks, failed = fn.finish(carry)
+        assert np.array_equal(np.asarray(placed),
+                              np.asarray(ref.placed_node))
+        assert np.array_equal(np.asarray(failed),
+                              np.asarray(ref.ever_failed))
+        for f, (x, y) in zip(
+            ref.fault_carry._fields,
+            zip(jax.tree.leaves(ref.fault_carry),
+                jax.tree.leaves(carry[1])),
+        ):
+            xa, ya = np.asarray(x), np.asarray(y)
+            # the one-shot result's fault carry is trimmed; compare on
+            # the common prefix of each leaf
+            assert np.array_equal(
+                xa, ya[tuple(slice(0, s) for s in xa.shape)]
+            ), f
+
+
+@pytest.mark.parametrize("engine", ["table", "shard"])
+def test_donated_chunk_entry_bit_identical_and_consuming(engine):
+    """run_chunk_donated (ISSUE 11): equals the non-donating entry
+    bit-for-bit across a kill/resume split (host round-trip between
+    chunks — the acceptance's 'donation armed' resume contract), and
+    actually consumes its input carry (the donated buffers are
+    deleted)."""
+    n_dev = 2
+    pol = (("FGDScore", 1000), ("BestFitScore", 500))
+    (state, tp, pods, types, ev_kind, ev_pod, pstate, prank,
+     key) = _fixture(n_dev)
+    if engine == "table":
+        policies = [(make_policy(n), w) for n, w in pol]
+        fn = make_table_replay(policies, gpu_sel="FGDScore")
+        st0, rk = state, jnp.asarray(
+            tiebreak_rank(state.num_nodes, seed=3)
+        )
+    else:
+        fn = _shard_engine(pol, "FGDScore", n_dev, 4, False, True)
+        st0, rk = pstate, prank
+    ref = fn(st0, pods, types, ev_kind, ev_pod, tp, key, rk)
+    e = int(ev_kind.shape[0])
+    cut = e // 2
+    carry = fn.init_carry(st0, pods, types, tp, key, rk)
+    for i, (a, b) in enumerate(((0, cut), (cut, e))):
+        prev_leaves = jax.tree.leaves(carry)
+        # snapshot-then-donate: exactly the driver checkpoint order
+        host = jax.tree.map(np.asarray, carry)
+        carry, ys = fn.run_chunk_donated(
+            carry, pods, types, ev_kind[a:b], ev_pod[a:b], tp, rk
+        )
+        jax.block_until_ready(jax.tree.leaves(carry))
+        # the donated input really was consumed: every sizable buffer
+        # (tables, state rows, bookkeeping) must be deleted on the
+        # pipelined shard engine (its body is strictly write-then-read,
+        # so every buffer is donatable). The table engine's flat path
+        # still reads score rows inside its event switch, which can
+        # leave one buffer un-aliasable — donation is per-buffer
+        # best-effort there, so require only that MOST big leaves were
+        # consumed (the state/bookkeeping ones always are).
+        big = [l for l in prev_leaves if l.size >= 1024]
+        alive = [
+            l for l in big
+            if not getattr(l, "is_deleted", lambda: True)()
+        ]
+        if engine == "shard":
+            assert not alive, (
+                f"{len(alive)} big donated buffers still alive"
+            )
+        else:
+            assert len(alive) <= 1, (
+                f"{len(alive)}/{len(big)} big donated buffers still alive"
+            )
+        if i == 0:
+            # kill/resume: rebuild the carry from the host snapshot and
+            # re-run the first chunk through the donating entry — the
+            # continuation below must still match the one-shot replay
+            carry = jax.tree.map(jnp.asarray, host)
+            carry, ys = fn.run_chunk_donated(
+                carry, pods, types, ev_kind[a:b], ev_pod[a:b], tp, rk
+            )
+    st, placed, masks, failed = fn.finish(carry)
+    assert np.array_equal(np.asarray(placed), np.asarray(ref.placed_node))
+    assert np.array_equal(np.asarray(masks), np.asarray(ref.dev_mask))
+    for a_, b_ in zip(jax.tree.leaves(st), jax.tree.leaves(ref.state)):
+        assert np.array_equal(np.asarray(a_), np.asarray(b_))

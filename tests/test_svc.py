@@ -722,6 +722,9 @@ def test_wait_jobs_poll_cap(monkeypatch):
     assert slept and all(s <= 0.01 for s in slept)
 
 
+@pytest.mark.slow  # boots a real service + compiles its sweep (~13 s);
+# the error-path contract runs under `make resume-smoke` (tier-1 trim,
+# ISSUE 11 satellite)
 def test_submit_exits_nonzero_on_failed_job(trace, tmp_path, monkeypatch):
     """A server-side job failure surfaces as JobsFailed carrying the
     done jobs' results, and `tpusim submit` exits nonzero while still

@@ -497,6 +497,262 @@ def chaos_smoke(nodes, pods, b: int = 8) -> Tuple[bool, List[str]]:
     return True, msgs
 
 
+def latest_multichip(repo: str = REPO) -> Optional[dict]:
+    """Newest committed MULTICHIP_r*.json carrying a `scale` block (the
+    ISSUE 11 scale-lane capture written by `bench_multichip.py
+    --scale-lane --json-out`), parsed into the block plus {path, n}.
+    Older rounds' dryrun captures (n_devices/tail schema) are skipped."""
+    best = None
+    for path in glob.glob(os.path.join(repo, "MULTICHIP_r*.json")):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if data.get("rc") != 0 or not isinstance(
+                data.get("scale"), dict
+            ):
+                continue
+            n = int(data.get("n") or m.group(1))
+        except (OSError, json.JSONDecodeError, TypeError, ValueError):
+            continue
+        if best is None or n > best["n"]:
+            best = {"path": path, "n": n, **data["scale"]}
+    return best
+
+
+def multichip_advisory(base: Optional[dict]) -> Tuple[bool, List[str]]:
+    """ISSUE 11 satellite: advisory comparison of the newest committed
+    scale-lane capture, like the BENCH_r*.json baselines — never gates
+    on walls (cross-machine), but prints the pipelined-vs-unpipelined
+    speedups and the aggregate row so a missing/torn capture or a
+    pipelined row that stopped beating the unpipelined body is visible
+    in every `make bench-gate` run. FAILs only on a capture whose rows
+    report placement divergence (equal=false) — that is a correctness
+    bit, not a wall."""
+    if base is None:
+        return True, [
+            "[gate] multichip: no committed scale-lane capture "
+            "(bench_multichip.py --scale-lane --json-out "
+            "MULTICHIP_rNN.json)"
+        ]
+    msgs = []
+    ok = True
+    for r in base.get("rows", []):
+        if not r.get("equal", True):
+            ok = False
+            msgs.append(
+                f"[gate] multichip: row nloc={r.get('nloc')} recorded "
+                "pipelined/unpipelined placement DIVERGENCE (FAIL)"
+            )
+            continue
+        msgs.append(
+            f"[gate] multichip baseline "
+            f"{os.path.basename(base['path'])} (round {base['n']}): "
+            f"nloc={r.get('nloc')} "
+            f"{r.get('us_per_event_pipelined')} us/ev pipelined vs "
+            f"{r.get('us_per_event_unpipelined')} unpipelined "
+            f"(x{r.get('speedup')}) — advisory"
+        )
+    agg = base.get("aggregate")
+    if agg:
+        line = (
+            f"[gate] multichip aggregate: {agg.get('nodes')} nodes on "
+            f"{agg.get('devices')} devices, "
+            f"{agg.get('us_per_event')} us/ev (donated chunked stream)"
+        )
+        if agg.get("fault"):
+            line += (
+                f"; chaos {agg['fault'].get('us_per_event')} us/ev over "
+                f"{agg['fault'].get('merged_events')} merged events"
+            )
+        msgs.append(line)
+    return ok, msgs
+
+
+def mesh_chaos_smoke(n_dev: int = 2) -> Tuple[bool, List[str]]:
+    """ISSUE 11 satellite (`make mesh-chaos-smoke`): the pipelined shard
+    engine end-to-end on a small forced-virtual mesh — (a) a FAULTED
+    mesh replay must reproduce the single-device fault lane's placements
+    and DisruptionMetrics (the pending registers carry fault kinds too),
+    with the frag-delta degrade loud (warning + obs counter, not silent
+    zeros); (b) a chunked replay with DONATION armed must hold ONE
+    compiled executable across equal-size chunks
+    (run_chunk_donated._cache_size), actually consume its input carries
+    (donated buffers deleted), keep the live-buffer census stable across
+    chunks (nothing re-materialized), and finish bit-identical to the
+    one-shot replay. Skips (PASS) when fewer than `n_dev` devices are
+    visible — `make mesh-chaos-smoke` forces a virtual CPU mesh."""
+    msgs: List[str] = []
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        if len(jax.devices()) < n_dev:
+            return True, [
+                f"[gate] mesh-chaos: skipped — {len(jax.devices())} "
+                f"device(s) visible, needs {n_dev} (run `make "
+                "mesh-chaos-smoke` for the forced-virtual-mesh form)"
+            ]
+        from tpusim.io.trace import NodeRow, PodRow
+        from tpusim.sim.driver import Simulator, SimulatorConfig
+        from tpusim.sim.faults import FaultConfig
+
+        rng = np.random.default_rng(7)
+        nodes = [
+            NodeRow(f"n{i:02d}", 32000, 131072, int(g),
+                    "V100M16" if g else "")
+            for i, g in enumerate(rng.choice([0, 2, 4, 8], 10))
+        ]
+        pods = [
+            PodRow(f"p{i:03d}", int(rng.choice([1000, 2000])), 2048,
+                   int(rng.choice([0, 1])), 500)
+            for i in range(36)
+        ]
+        fcfg = FaultConfig(
+            mtbf_events=9, mttr_events=8, evict_every_events=7, seed=5,
+            backoff_base=2, backoff_cap=8, max_retries=2,
+            queue_capacity=8,
+        )
+
+        def mk(mesh):
+            sim = Simulator(nodes, SimulatorConfig(
+                policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+                report_per_event=False, seed=42, mesh=mesh,
+            ))
+            sim.set_workload_pods(list(pods))
+            return sim
+
+        # (a) faulted mesh replay reconciles the single-device lane
+        solo = mk(0)
+        ra = solo.run_with_faults(fault_cfg=fcfg)
+        mesh_sim = mk(n_dev)
+        rb = mesh_sim.run_with_faults(fault_cfg=fcfg)
+        if not mesh_sim._last_engine.startswith("shard_map"):
+            return False, [
+                f"[gate] mesh-chaos: fault replay ran on "
+                f"{mesh_sim._last_engine!r}, not the shard engine (FAIL)"
+            ]
+        if not np.array_equal(ra.placed_node, rb.placed_node):
+            return False, [
+                "[gate] mesh-chaos: faulted mesh placements diverge "
+                "from the single-device fault lane (FAIL)"
+            ]
+        a = solo.last_disruption.as_dict()
+        b = mesh_sim.last_disruption.as_dict()
+        for k in a:
+            if k.startswith("post_recovery"):
+                continue
+            if a[k] != b[k]:
+                return False, [
+                    f"[gate] mesh-chaos: DisruptionMetrics[{k}] "
+                    f"diverges ({a[k]} vs {b[k]}) (FAIL)"
+                ]
+        # the degrade must be LOUD when recovers were scheduled
+        had_recover = solo.last_disruption.node_recoveries > 0
+        degraded_loudly = any(
+            "[Degrade] mesh fault replay" in l for l in mesh_sim.log.lines
+        ) and mesh_sim.obs.counts.get("degrade_mesh_frag", 0) > 0
+        if had_recover and not degraded_loudly:
+            return False, [
+                "[gate] mesh-chaos: frag-delta capture dropped "
+                "SILENTLY (no [Degrade] line / obs counter) (FAIL)"
+            ]
+
+        # (b) donated chunked replay: one executable, buffers consumed,
+        # census stable, bit-identical finish
+        from tpusim.io.trace import pods_to_specs
+        from tpusim.parallel import make_mesh, pad_nodes, shard_state
+        from tpusim.parallel.shard_engine import (
+            make_shardmap_table_replay,
+        )
+        from tpusim.policies import make_policy
+        from tpusim.sim.table_engine import build_pod_types
+
+        sim = mk(0)
+        sim.set_typical_pods()
+        specs = pods_to_specs(pods, sim.node_index)
+        e = len(pods)
+        ev_kind = jnp.zeros(e, jnp.int32)
+        ev_pod = jnp.arange(e, dtype=jnp.int32)
+        types = build_pod_types(specs)
+        key = jax.random.PRNGKey(3)
+        mesh = make_mesh(n_dev)
+        state, rank = pad_nodes(sim.init_state, sim.rank, n_dev)
+        state = shard_state(state, mesh)
+        policies = [(make_policy("FGDScore"), 1000)]
+        replay = make_shardmap_table_replay(
+            policies, mesh, gpu_sel="FGDScore"
+        )
+        ref = replay(state, specs, types, ev_kind, ev_pod, sim.typical,
+                     key, rank)
+        chunk = e // 4
+        carry = replay.init_carry(state, specs, types, sim.typical, key,
+                                  rank)
+        census = []
+        steady = None
+        for i in range(4):
+            prev_leaves = jax.tree.leaves(carry)
+            carry, _ys = replay.run_chunk_donated(
+                carry, specs, types,
+                ev_kind[i * chunk:(i + 1) * chunk],
+                ev_pod[i * chunk:(i + 1) * chunk], sim.typical, rank,
+            )
+            jax.block_until_ready(jax.tree.leaves(carry))
+            if i > 0 and not all(
+                getattr(l, "is_deleted", lambda: True)()
+                for l in prev_leaves
+            ):
+                return False, [
+                    "[gate] mesh-chaos: donated input carry still "
+                    "alive after the chunk dispatch — donation not "
+                    "armed (FAIL)"
+                ]
+            census.append(len(jax.live_arrays()))
+            if i == 1:
+                # chunk 0 consumes the init-shaped carry (its own
+                # executable); chunk 1 compiles the steady-state entry
+                # every later chunk MUST reuse
+                steady = replay.run_chunk_donated._cache_size()
+        execs = replay.run_chunk_donated._cache_size()
+        if execs != steady or execs > 2:
+            return False, [
+                f"[gate] mesh-chaos: donated chunk executables grew "
+                f"past steady state ({steady} -> {execs}) — equal-size "
+                "chunks recompiled (FAIL)"
+            ]
+        if len(set(census[1:])) != 1:
+            return False, [
+                f"[gate] mesh-chaos: live-buffer census drifted across "
+                f"chunks {census} — donated buffers re-materialized "
+                "(FAIL)"
+            ]
+        st, placed, masks, failed = replay.finish(carry)
+        if not (
+            np.array_equal(np.asarray(placed), np.asarray(ref.placed_node))
+            and np.array_equal(np.asarray(masks), np.asarray(ref.dev_mask))
+        ):
+            return False, [
+                "[gate] mesh-chaos: donated chunked replay diverges "
+                "from the one-shot replay (FAIL)"
+            ]
+        dm = mesh_sim.last_disruption
+        msgs.append(
+            f"[gate] mesh-chaos: faulted {n_dev}-device replay "
+            f"reconciles single-device (evicted={dm.evicted_pods} "
+            f"resched={dm.rescheduled_pods}); donated chunked replay "
+            f"held {execs} executable(s) at steady state, census stable "
+            f"at {census[-1]} buffers, finish bit-identical"
+        )
+    except Exception as err:
+        return False, [
+            f"[gate] mesh-chaos: FAIL ({type(err).__name__}: {err})"
+        ]
+    return True, msgs
+
+
 def tune_smoke(out_dir: str, generations: int = 3) -> Tuple[bool, List[str]]:
     """ISSUE 9 satellite (`make tune-smoke`): run the learned-scoring
     loop on a tiny synthetic trace for a few generations on the LOCAL
@@ -662,7 +918,31 @@ def main(argv=None) -> int:
         help="run only the chaos-sweep smoke (ISSUE 10) — the "
         "`make chaos-smoke` mode",
     )
+    ap.add_argument(
+        "--mesh-chaos-only", action="store_true",
+        help="run only the mesh-chaos smoke (ISSUE 11: pipelined shard "
+        "fault replay + donated chunked replay on a forced virtual "
+        "mesh) — the `make mesh-chaos-smoke` mode",
+    )
     args = ap.parse_args(argv)
+
+    if args.mesh_chaos_only:
+        # a CPU smoke by design (the Makefile target pins
+        # JAX_PLATFORMS=cpu, like chaos-smoke): force a 2-device virtual
+        # CPU mesh BEFORE jax initializes. force=True because this image
+        # registers inert cuda/rocm/tpu plugin factories that would make
+        # the conservative helper bail; it still no-ops on an already-up
+        # backend.
+        from tpusim.virtual_mesh import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(2, force=True)
+        ok, msgs = mesh_chaos_smoke()
+        adv_ok, adv = multichip_advisory(latest_multichip())
+        msgs += adv
+        ok = ok and adv_ok
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
 
     if args.tune_only:
         ok, msgs = tune_smoke(args.out)
@@ -743,8 +1023,17 @@ def main(argv=None) -> int:
     # zero-recompile check + standalone disruption reconciliation
     chaos_ok, chaos_msgs = chaos_smoke(nodes, pods)
     print("\n".join(chaos_msgs))
+    # mesh-chaos smoke (ISSUE 11 satellite): pipelined shard fault
+    # replay + donated chunked replay — skips (PASS) on single-device
+    # hosts; `make mesh-chaos-smoke` runs the forced-virtual-mesh form
+    mesh_ok, mesh_msgs = mesh_chaos_smoke()
+    print("\n".join(mesh_msgs))
+    # scale-lane advisory (ISSUE 11 satellite): newest committed
+    # MULTICHIP_r*.json, like the BENCH_r*.json baselines
+    mc_ok, mc_msgs = multichip_advisory(latest_multichip())
+    print("\n".join(mc_msgs))
     smoke_ok = (dec_ok and scrape_ok and swp_ok and svc_ok and tune_ok
-                and chaos_ok)
+                and chaos_ok and mesh_ok and mc_ok)
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

@@ -33,6 +33,26 @@ scaling-book recipe says to when the partitioner's choices matter:
 Per-event collective payload: 3 scalars + one 8-lane mask, independent of
 N and D — the us/event curve stays flat as the mesh grows (MULTICHIP.md).
 Placements are bit-identical to the single-device table engine.
+
+Since ISSUE 11 the step body is SOFTWARE-PIPELINED one event deep, the
+way Round 6 restructured the single-device table engine: each iteration
+first applies the PREVIOUS event's deferred commit (the replicated
+`sim.step.PendingCommit` register riding ShardTableCarry — owner-masked
+state scatters via `apply_commit_sharded`, replicated [P+1] bookkeeping
+writes) and only then reads state/tables, so every carried buffer is
+written before it is read and XLA aliases the scatters in place instead
+of taking a whole-buffer defensive copy per event. Under the fault lane
+the fault step kinds flow through the same discipline: the DECISION
+(victim draw, queue bookkeeping — fc is read-modify-write in-line, it is
+small) happens at the event, while the state/bookkeeping WRITES ride a
+second register (`fault_lane.FaultPending`) applied right after the bind
+commit at the top of the next iteration. The collective payload is
+untouched and placements/telemetry/counters are bit-identical to the
+unpipelined body by construction (the same scatters land before anything
+reads them); `pipelined=False` keeps the old in-body commit for A/B
+measurement (bench_multichip --scale-lane). At nloc = N/D >= ~10k the
+eliminated copies dominate the loop — the 1M-node lane headline
+(MULTICHIP.md "The 1M-node lane").
 """
 
 from __future__ import annotations
@@ -54,8 +74,13 @@ from tpusim.policies.base import (
 )
 from tpusim.sim.engine import ReplayResult
 from tpusim.sim.step import (
+    PendingCommit,
+    apply_commit,
+    apply_commit_sharded,
     block_reduce,
     choose_devices,
+    make_pending_commit,
+    no_pending_commit,
     packed_argmax,
     packed_topk,
 )
@@ -89,10 +114,16 @@ class ShardTableCarry(NamedTuple):
     lt: jnp.ndarray  # i32[K, nloc/B] block max totals ([0,0] when flat)
     lr: jnp.ndarray  # i32[K, nloc/B] block min winner ranks
     lwn: jnp.ndarray  # i32[K, nloc/B] block winner LOCAL node indices
+    # the software-pipeline register (ISSUE 11): the previous event's
+    # deferred commit, replicated (node is the GLOBAL winner id); inert
+    # no_pending_commit forever on pipelined=False builds
+    pend: PendingCommit
     dirty: jnp.ndarray  # i32 global node id to refresh next (replicated)
-    placed: jnp.ndarray  # i32[P] (replicated)
-    masks: jnp.ndarray  # bool[P, 8]
-    failed: jnp.ndarray  # bool[P]
+    placed: jnp.ndarray  # i32[P+1] (replicated; dummy row absorbs the
+    #                      pipelined commit's skip writes, like the table
+    #                      engines — finish() strips it)
+    masks: jnp.ndarray  # bool[P+1, 8]
+    failed: jnp.ndarray  # bool[P+1]
     arr_cpu: jnp.ndarray  # i32
     arr_gpu: jnp.ndarray  # i32
     key: jnp.ndarray  # PRNG key after the events consumed so far
@@ -107,7 +138,8 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                                report: bool = False, block_size: int = 0,
                                decisions: bool = False,
                                series_every: int = 0,
-                               faults: bool = False):
+                               faults: bool = False,
+                               pipelined: bool = True):
     """Build the explicit-collective sharded replayer. The node count must
     already be padded to a multiple of the mesh size (parallel.pad_nodes)
     and `state`/`tiebreak_rank` sharded over it (parallel.shard_state).
@@ -153,7 +185,18 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     nodes' mem_left == -1 and must count as neither). Samples land only
     at stride points (a replicated cond), so the extra collective
     payload amortizes to O(1/series_every) per event. ys become
-    (node, dev[, dec][, ser]) in that order, like the table engine."""
+    (node, dev[, dec][, ser]) in that order, like the table engine.
+
+    pipelined=True (ISSUE 11, the default) software-pipelines the step
+    body one event deep (module docstring): the Bind scatter and — under
+    faults — the fault-step row writes ride pending registers applied at
+    the top of the next iteration, so the body is strictly
+    write-then-read and the per-event whole-buffer state copies vanish.
+    Bit-identical to pipelined=False (the pre-ISSUE-11 in-body commit,
+    kept for A/B measurement) for every policy/mix/gpu_sel and under the
+    fault lane; both paths share one carry layout ([P+1] bookkeeping +
+    the — possibly inert — pend register), so the driver's chunked
+    checkpoint dispatch is knob-agnostic."""
     if report:
         raise ValueError(
             "the shard_map engine replays metric-free; build the report "
@@ -233,15 +276,24 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         else:
             lt = lr = lwn = jnp.zeros((0, 0), jnp.int32)
 
-        placed = jnp.full(num_pods, -1, jnp.int32)
-        masks = jnp.zeros((num_pods, MAX_GPUS_PER_NODE), jnp.bool_)
-        failed = jnp.zeros(num_pods, jnp.bool_)
+        # one extra dummy row absorbs skip-event writes of the pipelined
+        # commit (PendingCommit.pod_write); sliced off by finish(). The
+        # unpipelined path shares the layout (its in-body writes never
+        # touch the dummy row), so both knobs run one carry shape.
+        placed = jnp.full(num_pods + 1, -1, jnp.int32)
+        masks = jnp.zeros((num_pods + 1, MAX_GPUS_PER_NODE), jnp.bool_)
+        failed = jnp.zeros(num_pods + 1, jnp.bool_)
         z = jnp.int32(0)
         base = ShardTableCarry(
-            state, packed_tbl, lt, lr, lwn, z, placed, masks, failed,
-            z, z, key, zero_counters(),
+            state, packed_tbl, lt, lr, lwn, no_pending_commit(num_pods),
+            z, placed, masks, failed, z, z, key, zero_counters(),
         )
-        return (base, fault_carry0) if faults else base
+        if not faults:
+            return base
+        fcp = _fl.pad_fault_carry(fault_carry0)
+        if pipelined:
+            return (base, fcp, _fl.no_fault_pending(num_pods + 1))
+        return (base, fcp)
 
     def _chunk_shard(carry, rank, pods, types, ev_kind, ev_pod, tp, wts,
                      fault_ops=None):
@@ -263,11 +315,31 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         )
 
         def body(carry, ev):
+            fpend = None
             if faults:
-                carry, fc = carry
+                if pipelined:
+                    carry, fc, fpend = carry
+                else:
+                    carry, fc = carry
                 kind, idx, fpos, farg, faux = ev
-            (state, packed_tbl, lt, lr, lwn, dirty, placed, masks, failed,
-             arr_cpu, arr_gpu, key, ctr) = carry
+            (state, packed_tbl, lt, lr, lwn, pend, dirty, placed, masks,
+             failed, arr_cpu, arr_gpu, key, ctr) = carry
+            if pipelined:
+                # apply the PREVIOUS event's deferred scatters first —
+                # every carried buffer is written before anything reads
+                # it this iteration, so all updates alias in place
+                # (sim.step.PendingCommit; the state half is owner-masked
+                # on this shard's local row window)
+                state, placed, masks, failed = apply_commit_sharded(
+                    state, placed, masks, failed, pend, offset, nloc
+                )
+                if faults:
+                    # ... then the previous event's fault writes (row
+                    # reset / evict return / victim clearing) — the same
+                    # in-line order the unpipelined body commits in
+                    state, placed, masks, failed = _fl.apply_fault_pending(
+                        state, placed, masks, failed, fpend, offset, nloc
+                    )
             if not faults:
                 kind, idx = ev
                 kc = jnp.clip(kind, 0, 2)
@@ -293,25 +365,64 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             owns_d = (li >= 0) & (li < nloc)
             lic = jnp.clip(li, 0, nloc - 1)
 
-            # the cond computes only the [K, 1, C] column (non-owners reuse
-            # the old slice); the table write itself stays OUTSIDE the cond
-            # so XLA can alias the dynamic_update_slice in place — a cond
-            # returning the whole table forces a full-buffer copy per event
-            def refresh_col():
-                cs, cd, cf = _columns(_row_state(state, lic), types, tp, k_col)
-                return jnp.concatenate(
-                    [cs.T, cd[:, None], cf.astype(jnp.int32)[:, None]],
-                    axis=-1,
-                )[:, None, :]
+            if pipelined:
+                # no whole-buffer operand may cross the cond boundary
+                # (ISSUE 11): XLA copies big buffers captured by branch
+                # computations, so the cond closes over only the
+                # PRE-GATHERED one-node row, and the column write is an
+                # owner-masked OOB-drop scatter — non-owners write
+                # nothing instead of reading back the old column. No
+                # packed_tbl read, no DUS: the update touches exactly
+                # one column's elements.
+                row1 = _row_state(state, lic)
 
-            new_col = jax.lax.cond(
-                owns_d,
-                refresh_col,
-                lambda: jax.lax.dynamic_slice_in_dim(packed_tbl, lic, 1, axis=1),
-            )
-            packed_tbl = jax.lax.dynamic_update_slice_in_dim(
-                packed_tbl, new_col, lic, axis=1
-            )
+                def refresh_col_p():
+                    cs, cd, cf = _columns(row1, types, tp, k_col)
+                    return jnp.concatenate(
+                        [cs.T, cd[:, None],
+                         cf.astype(jnp.int32)[:, None]],
+                        axis=-1,
+                    )  # [K, C]
+
+                col = jax.lax.cond(
+                    owns_d,
+                    refresh_col_p,
+                    lambda: jnp.zeros(
+                        (k_types, npol + 2), jnp.int32
+                    ),
+                )
+                tgt_col = jnp.where(
+                    owns_d, lic, packed_tbl.shape[1]
+                )
+                packed_tbl = packed_tbl.at[:, tgt_col, :].set(
+                    col, mode="drop"
+                )
+            else:
+                # the cond computes only the [K, 1, C] column (non-owners
+                # reuse the old slice); the table write itself stays
+                # OUTSIDE the cond so XLA can alias the
+                # dynamic_update_slice in place — a cond returning the
+                # whole table forces a full-buffer copy per event
+                def refresh_col():
+                    cs, cd, cf = _columns(
+                        _row_state(state, lic), types, tp, k_col
+                    )
+                    return jnp.concatenate(
+                        [cs.T, cd[:, None],
+                         cf.astype(jnp.int32)[:, None]],
+                        axis=-1,
+                    )[:, None, :]
+
+                new_col = jax.lax.cond(
+                    owns_d,
+                    refresh_col,
+                    lambda: jax.lax.dynamic_slice_in_dim(
+                        packed_tbl, lic, 1, axis=1
+                    ),
+                )
+                packed_tbl = jax.lax.dynamic_update_slice_in_dim(
+                    packed_tbl, new_col, lic, axis=1
+                )
 
             if bsz:
                 # dirty-block summary refresh for all K types: non-owner
@@ -587,57 +698,97 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                 )
                 return base + ((no_decision(npol),) if decisions else ())
 
-            # the switch returns only the replicated (node, dev_mask)
-            # decision: a carried buffer returned from a switch branch
-            # cannot alias the carry, and the resulting per-event copies
-            # of state/placed/masks dominated the loop at large nloc
-            # (same restructure as the single-device table engine)
-            outs = jax.lax.switch(kc, [do_create, do_delete, do_skip])
+            # either way the event decision is only the replicated
+            # (node, dev_mask[, dec]) — a carried buffer returned from a
+            # branch cannot alias the carry (the round-6 restructure);
+            # the pipelined path goes further and drops the switch itself
+            if pipelined:
+                # no lax.switch around the create path (ISSUE 11): branch
+                # computations capture the score-table/state buffers, and
+                # XLA materializes whole-buffer copies for captured
+                # conditional operands — the dominant per-event cost at
+                # nloc >= ~100k. The create computation is pure (the
+                # commit is deferred through the register), so it runs
+                # UNCONDITIONALLY and the small (node, dev[, dec])
+                # results merge by event kind. Collectives now run on
+                # every event (delete/skip included) with the same
+                # per-event payload; all shards agree on kc, so they
+                # always pair up.
+                outs_c = do_create()
+                outs_d = do_delete()
+                outs_s = do_skip()
+                outs = tuple(
+                    jax.tree.map(
+                        lambda a, b, c: jnp.where(
+                            kc == 0, a, jnp.where(kc == 1, b, c)
+                        ),
+                        oc, od, os_,
+                    )
+                    for oc, od, os_ in zip(outs_c, outs_d, outs_s)
+                )
+            else:
+                outs = jax.lax.switch(kc, [do_create, do_delete, do_skip])
             if decisions:
                 node, dev, dec = outs
             else:
                 node, dev = outs
             is_create = kc == 0
             is_delete = kc == 1
-            lbind = jnp.clip(node - offset, 0, nloc - 1)
-            apply = (node >= 0) & (node >= offset) & (node < offset + nloc)
-            rs = jnp.where(is_delete, 1, -1)  # delete returns, create takes
-            from tpusim.policies.clustering import pod_affinity_class
-
-            cls = pod_affinity_class(pod)
-            state = state._replace(
-                cpu_left=state.cpu_left.at[lbind].add(
-                    jnp.where(apply, rs * pod.cpu, 0)
-                ),
-                mem_left=state.mem_left.at[lbind].add(
-                    jnp.where(apply, rs * pod.mem, 0)
-                ),
-                gpu_left=state.gpu_left.at[lbind].add(
-                    jnp.where(apply, rs, 0)
-                    * dev.astype(jnp.int32) * pod.gpu_milli
-                ),
-                aff_cnt=state.aff_cnt.at[lbind, jnp.maximum(cls, 0)].add(
-                    jnp.where(apply & (cls >= 0), -rs, 0)
-                ),
-            )
-            placed = placed.at[idx].set(
-                jnp.where(is_create, node,
-                          jnp.where(is_delete, -1, placed[idx]))
-            )
-            masks = masks.at[idx].set(
-                jnp.where(is_create, dev,
-                          jnp.where(is_delete, False, masks[idx]))
-            )
-            failed = failed.at[idx].set(
-                jnp.where(
-                    is_create,
-                    # retry attempts accumulate ever-failed with OR (the
-                    # segmented path's per-segment `|=`)
-                    (failed[idx] & is_slot & is_create) | (node < 0)
-                    if faults else node < 0,
-                    failed[idx],
+            if pipelined:
+                # defer this event's scatters to the next iteration: the
+                # register is replicated (node is the GLOBAL winner id);
+                # apply_commit_sharded owner-masks the state half
+                pend = make_pending_commit(kc, idx, node, dev, pod,
+                                           num_pods)
+                if faults:
+                    # retry creates accumulate ever-failed with OR (the
+                    # segmented path's per-segment `|=`); base creates
+                    # still overwrite (they run once per pod)
+                    pend = pend._replace(failed_val=jnp.where(
+                        is_slot, failed[idx] | (node < 0), node < 0
+                    ))
+            else:
+                lbind = jnp.clip(node - offset, 0, nloc - 1)
+                apply = (node >= 0) & (node >= offset) & (
+                    node < offset + nloc
                 )
-            )
+                rs = jnp.where(is_delete, 1, -1)  # delete returns
+                from tpusim.policies.clustering import pod_affinity_class
+
+                cls = pod_affinity_class(pod)
+                state = state._replace(
+                    cpu_left=state.cpu_left.at[lbind].add(
+                        jnp.where(apply, rs * pod.cpu, 0)
+                    ),
+                    mem_left=state.mem_left.at[lbind].add(
+                        jnp.where(apply, rs * pod.mem, 0)
+                    ),
+                    gpu_left=state.gpu_left.at[lbind].add(
+                        jnp.where(apply, rs, 0)
+                        * dev.astype(jnp.int32) * pod.gpu_milli
+                    ),
+                    aff_cnt=state.aff_cnt.at[lbind, jnp.maximum(cls, 0)].add(
+                        jnp.where(apply & (cls >= 0), -rs, 0)
+                    ),
+                )
+                placed = placed.at[idx].set(
+                    jnp.where(is_create, node,
+                              jnp.where(is_delete, -1, placed[idx]))
+                )
+                masks = masks.at[idx].set(
+                    jnp.where(is_create, dev,
+                              jnp.where(is_delete, False, masks[idx]))
+                )
+                failed = failed.at[idx].set(
+                    jnp.where(
+                        is_create,
+                        # retry attempts accumulate ever-failed with OR
+                        # (the segmented path's per-segment `|=`)
+                        (failed[idx] & is_slot & is_create) | (node < 0)
+                        if faults else node < 0,
+                        failed[idx],
+                    )
+                )
             arr_cpu = arr_cpu + jnp.where(is_create, pod.cpu, 0)
             arr_gpu = arr_gpu + jnp.where(is_create, pod.total_gpu_milli(), 0)
             # node == -1 (failed create) leaves no owner, so every shard
@@ -645,14 +796,23 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             dirty = jnp.where(kc == 2, dirty, node)
             ctr = ctr + counter_delta(kc, node)
             if faults:
-                # masked fault transitions: state row ops owner-masked by
-                # the global-id row mask, bookkeeping replicated
-                (state, placed, masks, failed, fc, ftouch, fy) = (
-                    _fl.apply_fault_step(
-                        state, placed, masks, failed, fc, pods, kind,
-                        farg, faux, fpos, fault_ops, tp, gids, False,
+                if pipelined:
+                    # decide the fault step now (it reads only committed
+                    # bookkeeping — the current event can never both bind
+                    # AND fault), defer its writes one iteration
+                    fpend, fc, ftouch, fy = _fl.plan_fault_step(
+                        placed, masks, fc, pods, kind, farg, faux, fpos,
+                        fault_ops,
                     )
-                )
+                else:
+                    # masked fault transitions: state row ops owner-masked
+                    # by the global-id row mask, bookkeeping replicated
+                    (state, placed, masks, failed, fc, ftouch, fy) = (
+                        _fl.apply_fault_step(
+                            state, placed, masks, failed, fc, pods, kind,
+                            farg, faux, fpos, fault_ops, tp, gids, False,
+                        )
+                    )
                 fc, lat, _ = _fl.commit_retry(
                     fc, has_pop, rpod, node, fpos, farg, fault_ops.params
                 )
@@ -663,8 +823,8 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                 dirty = jnp.where(ftouch >= 0, ftouch, dirty)
                 node = jnp.where(ftouch >= 0, ftouch, node)
             new_carry = ShardTableCarry(
-                state, packed_tbl, lt, lr, lwn, dirty, placed, masks,
-                failed, arr_cpu, arr_gpu, key, ctr,
+                state, packed_tbl, lt, lr, lwn, pend, dirty, placed,
+                masks, failed, arr_cpu, arr_gpu, key, ctr,
             )
             ys = (
                 (node, dev)
@@ -672,6 +832,8 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                 + ((ser,) if series_every else ())
             )
             if faults:
+                if pipelined:
+                    return (new_carry, fc, fpend), ys + (fy,)
                 return (new_carry, fc), ys + (fy,)
             return new_carry, ys
 
@@ -689,11 +851,14 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
 
     tp_specs = TypicalPods(*([P()] * len(TypicalPods._fields)))
     # the carry's table shards / block summaries live on the node axis;
-    # bookkeeping is replicated (identical on every shard by construction)
+    # bookkeeping — the pipeline register included — is replicated
+    # (identical on every shard by construction)
+    pend_specs = PendingCommit(*([P()] * len(PendingCommit._fields)))
     carry_specs = ShardTableCarry(
         state=state_specs,
         packed_tbl=P(None, NODE_AXIS),
         lt=P(None, NODE_AXIS), lr=P(None, NODE_AXIS), lwn=P(None, NODE_AXIS),
+        pend=pend_specs,
         dirty=P(), placed=P(), masks=P(), failed=P(),
         arr_cpu=P(), arr_gpu=P(), key=P(), ctr=P(),
     )
@@ -720,12 +885,19 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
         *([P()] * len(obs_series.SeriesSample._fields))
     )
     if faults:
-        # retry queue, disruption counters, streams, and fault telemetry
-        # are all replicated — identical on every shard by construction
+        # retry queue, disruption counters, streams, fault telemetry, and
+        # the deferred fault register are all replicated — identical on
+        # every shard by construction
         fc_specs = _fl.FaultCarry(*([P()] * len(_fl.FaultCarry._fields)))
         fops_specs = _fl.FaultOps(*([P()] * len(_fl.FaultOps._fields)))
         fy_specs = _fl.FaultY(*([P()] * len(_fl.FaultY._fields)))
-        carry_specs = (carry_specs, fc_specs)
+        if pipelined:
+            fp_specs = _fl.FaultPending(
+                *([P()] * len(_fl.FaultPending._fields))
+            )
+            carry_specs = (carry_specs, fc_specs, fp_specs)
+        else:
+            carry_specs = (carry_specs, fc_specs)
     mapped_init = _wrap(
         _init_shard,
         (state_specs, P(NODE_AXIS), spec_r, types_specs, tp_specs, P(),
@@ -752,9 +924,8 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                                wts, fault_carry0)
         return mapped_init(state, tiebreak_rank, pods, types, tp, key, wts)
 
-    @jax.jit
-    def _run_chunk_j(carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank,
-                     wts, fault_ops=None):
+    def _run_chunk_impl(carry, pods, types, ev_kind, ev_pod, tp,
+                        tiebreak_rank, wts, fault_ops=None):
         if faults:
             outs = mapped_chunk(
                 carry, tiebreak_rank, pods, types, ev_kind, ev_pod, tp,
@@ -765,6 +936,13 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
                 carry, tiebreak_rank, pods, types, ev_kind, ev_pod, tp, wts
             )
         return outs[0], tuple(outs[1:])
+
+    _run_chunk_j = jax.jit(_run_chunk_impl)
+    # the donating twin (ISSUE 11): the input carry's shards are donated
+    # to the outputs, so a chunked 1M-node replay stops reallocating its
+    # O(N*K) table shards every segment; the caller must treat the input
+    # carry as consumed (the driver snapshots to host before advancing)
+    _run_chunk_don = jax.jit(_run_chunk_impl, donate_argnums=0)
 
     # weights resolve OUTSIDE the jitted functions (ISSUE 6): the weight
     # vector is always a traced operand, never a baked constant, so one
@@ -793,14 +971,48 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             resolve_weights(policies, weights),
         )
 
-    @jax.jit
-    def finish(carry):
-        """No pending-commit epilogue here (the shard engine binds in the
-        event body); shaped like the table engine's finish so the driver's
-        chunked dispatch is engine-agnostic."""
+    def run_chunk_donated(carry, pods, types, ev_kind, ev_pod, tp,
+                          tiebreak_rank, weights=None, fault_ops=None):
+        """run_chunk with the input carry DONATED to the outputs
+        (ISSUE 11): the chunk scan reuses the carry's table/state shards
+        instead of reallocating them every segment. The passed carry is
+        consumed — snapshot it first if it must survive."""
         if faults:
-            carry = carry[0]
-        return carry.state, carry.placed, carry.masks, carry.failed
+            return _run_chunk_don(
+                carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank,
+                resolve_weights(policies, weights), fault_ops,
+            )
+        return _run_chunk_don(
+            carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank,
+            resolve_weights(policies, weights),
+        )
+
+    run_chunk_donated._cache_size = _run_chunk_don._cache_size
+
+    def _finish_impl(carry):
+        """Post-scan epilogue: apply the last event's still-pending
+        commit(s) on the gathered GLOBAL view (pend.node is a global id,
+        so sim.step.apply_commit applies directly; the registers are
+        inert no-ops on pipelined=False builds) and strip the dummy
+        bookkeeping row. A finished carry must not be resumed."""
+        fpend_f = None
+        if faults:
+            if pipelined:
+                carry, _fc, fpend_f = carry
+            else:
+                carry, _fc = carry
+        state, placed, masks, failed = apply_commit(
+            carry.state, carry.placed, carry.masks, carry.failed,
+            carry.pend,
+        )
+        if fpend_f is not None:
+            state, placed, masks, failed = _fl.apply_fault_pending(
+                state, placed, masks, failed, fpend_f, 0,
+                state.num_nodes,
+            )
+        return state, placed[:-1], masks[:-1], failed[:-1]
+
+    finish = jax.jit(_finish_impl)
 
     @jax.jit
     def _replay_impl(state, pods, types, ev_kind, ev_pod, tp, key,
@@ -812,18 +1024,21 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
             carry, pods, types, ev_kind, ev_pod, tp, tiebreak_rank, wts,
             fault_ops,
         )
+        state_f, placed, masks, failed = _finish_impl(carry)
         nodes, devs = ys[0], ys[1]
         rest = list(ys[2:])
         decs = rest.pop(0) if decisions else None
         sers = rest.pop(0) if series_every else None
         if faults:
-            base, fc = carry
+            base = carry[0]
+            fc = carry[1]
             return ReplayResult(
-                base.state, base.placed, base.masks, base.failed, None,
-                nodes, devs, base.ctr, None, None, rest.pop(0), fc,
+                state_f, placed, masks, failed, None,
+                nodes, devs, base.ctr, None, None, rest.pop(0),
+                _fl.trim_fault_carry(fc),
             )
         return ReplayResult(
-            carry.state, carry.placed, carry.masks, carry.failed, None,
+            state_f, placed, masks, failed, None,
             nodes, devs, carry.ctr, decs, sers,
         )
 
@@ -846,6 +1061,7 @@ def make_shardmap_table_replay(policies, mesh, gpu_sel: str = "best",
     # the way back in, and the continued scan is bit-identical
     replay.init_carry = init_carry
     replay.run_chunk = run_chunk
+    replay.run_chunk_donated = run_chunk_donated
     replay.finish = finish
     replay.engine = _replay_impl  # the weight-operand jitted impl
     return replay

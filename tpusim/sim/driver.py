@@ -1175,9 +1175,18 @@ class Simulator:
                     state, specs, types, self.typical, key, rank
                 )
 
+        # chunk advances go through the DONATING entry (ISSUE 11): the
+        # input carry's buffers are reused by the next segment instead of
+        # reallocating the O(N*K) tables every chunk. Safe by
+        # construction: the checkpoint snapshot below (np.asarray) copies
+        # the carry to host BEFORE the next donating dispatch consumes
+        # it, and nothing else holds a reference — the loop variable is
+        # rebound. Bit-identity is untouched (same jaxpr, only buffer
+        # aliasing moves).
+        run_chunk = getattr(fn, "run_chunk_donated", None) or fn.run_chunk
         while cursor < e:
             end = min(cursor + every, e)
-            carry, ys = fn.run_chunk(
+            carry, ys = run_chunk(
                 carry, specs, types, ev_kind[cursor:end],
                 ev_pod[cursor:end], self.typical, rank,
             )
@@ -2250,6 +2259,10 @@ class Simulator:
         dm, dead, attempts_run = fault_lane.assemble_disruption(
             plan, out.fault_ys, out.fault_carry,
             np.asarray(self.init_state.gpu_cnt),
+            # the shard engine never captures recover frag deltas — drop
+            # the series (with the [Degrade] warning above) instead of
+            # reporting placeholder zeros as measurements
+            frag_delta=self._shard_fn is None,
         )
         e_m = int(plan.kind.shape[0])
         # fault events + inert retry slots counted as skips in-scan; the
@@ -2338,6 +2351,21 @@ class Simulator:
                 ),
             )
             fc0 = fault_lane.init_fault_carry(p, n_pad, plan.capacity)
+            if plan.has_recover:
+                # the shard engine cannot capture recover frag deltas (a
+                # psum of f32 partials is not bit-equal to the
+                # single-device cluster sum, ENGINES.md Round 14) — say
+                # so loudly instead of reporting silent 0.0 deltas
+                # (ISSUE 11 satellite): counter + [Degrade] line, and
+                # assemble_disruption below drops the series entirely
+                self.obs.count("degrade_mesh_frag")
+                self.log.info(
+                    "[Degrade] mesh fault replay: recover frag-delta "
+                    "capture is unsupported on the shard engine (psum of "
+                    "f32 partials != the one-device sum); "
+                    "post_recovery_frag_delta will be empty — run "
+                    "mesh=0 to capture it"
+                )
             fn = make_shardmap_table_replay(
                 self._policy_fns, self._mesh,
                 gpu_sel=self.cfg.gpu_sel_method,

@@ -399,6 +399,27 @@ def init_fault_carry(num_pods: int, num_nodes: int, capacity: int) -> FaultCarry
     )
 
 
+def pad_fault_carry(fc0: FaultCarry) -> FaultCarry:
+    """Size the FaultCarry's pod axis to the engines' P+1 bookkeeping
+    rows (the dummy row absorbing the pipelined commit's skip writes can
+    never be evicted — placed[P] stays -1 — so the pad rows are inert).
+    Shared by the table and shard_map fault builds; trim_fault_carry is
+    the inverse the ReplayResult applies."""
+    return fc0._replace(
+        attempts=jnp.pad(fc0.attempts, (0, 1)),
+        evicted_at=jnp.pad(fc0.evicted_at, (0, 1), constant_values=-1),
+        dead=jnp.pad(fc0.dead, (0, 1)),
+    )
+
+
+def trim_fault_carry(fc: FaultCarry) -> FaultCarry:
+    return fc._replace(
+        attempts=fc.attempts[:-1],
+        evicted_at=fc.evicted_at[:-1],
+        dead=fc.dead[:-1],
+    )
+
+
 def backoff_of(att, base, cap):
     """min(base * 2^(att-1), cap) with traced operands, exact: the shift
     is clamped so base << s stays in i32 (and once it exceeds cap — which
@@ -499,6 +520,75 @@ def _frag_scalar(state, tp):
     return frag_sum_except_q3(cluster_frag_amounts(state, tp).sum(0))
 
 
+def _fault_decisions(placed, fc: FaultCarry, kind, arg, aux, ops: FaultOps):
+    """The decision half of one fault step, shared by the in-line apply
+    (apply_fault_step) and the pipelined plan (plan_fault_step): which
+    transition fires and on what — (do_fail, do_rec, do_evict, node,
+    victim, vnode). Reads only committed bookkeeping; writes nothing."""
+    is_fail = kind == EV_NODE_FAIL
+    is_rec = kind == EV_NODE_RECOVER
+    is_evict = kind == EV_EVICT
+    node = jnp.clip(arg, 0, fc.down_at.shape[0] - 1)
+    node_down = fc.down_at[node] >= 0
+    do_fail = is_fail & ~node_down
+    do_rec = is_rec & node_down
+
+    # ---- EV_EVICT victim selection (host pick_eviction_victim, exact:
+    # the PCG64 draw per placed-count is pre-tabulated in ops.draws)
+    placed_ok = placed >= 0
+    size = placed_ok.sum().astype(jnp.int32)
+    row = jnp.clip(aux, 0, ops.draws.shape[0] - 1)
+    j = ops.draws[row, jnp.clip(size, 0, ops.draws.shape[1] - 1)]
+    ranks = jnp.cumsum(placed_ok.astype(jnp.int32)) - 1
+    vsel = placed_ok & (ranks == j)
+    drawn = jnp.argmax(vsel).astype(jnp.int32)
+    use_explicit = is_evict & (arg >= 0)
+    exp_c = jnp.clip(arg, 0, placed.shape[0] - 1)
+    victim = jnp.where(use_explicit, exp_c, drawn)
+    found = jnp.where(
+        use_explicit, placed_ok[exp_c], (aux >= 0) & (j >= 0)
+    )
+    do_evict = is_evict & found
+    vnode = jnp.where(do_evict, placed[victim], -1)
+    return do_fail, do_rec, do_evict, node, victim, vnode
+
+
+def _fault_bookkeep(fc: FaultCarry, placed, node, victim, do_fail, do_rec,
+                    do_evict, pos, ops: FaultOps):
+    """The FaultCarry half of one fault step (victim requeue, down clock,
+    disruption counters) — shared by apply_fault_step and
+    plan_fault_step so the queue trajectory cannot depend on whether the
+    state writes were in-line or deferred. `placed` must be the
+    PRE-clearing bookkeeping (vm derives from it). Returns
+    (fc', vm victim mask, newly_dead mask)."""
+    params = ops.params
+    # node-fail evicts every pod on the node, evict exactly one; both
+    # requeue through the carry queue in ascending pod order (the host's
+    # flatnonzero discipline)
+    vm = (do_fail & (placed == node)) | (
+        do_evict & (jnp.arange(placed.shape[0]) == victim)
+    )
+    fc, newly_dead = _evict_into_queue(fc, vm, pos, jnp.int32(0), params)
+
+    # ---- down clock + recover accounting
+    fc = fc._replace(
+        down_at=fc.down_at.at[node].set(
+            jnp.where(do_fail, pos,
+                      jnp.where(do_rec, -1, fc.down_at[node]))
+        ),
+        dctr=fc.dctr.at[D_FAILURES].add(do_fail.astype(jnp.int32))
+        .at[D_RECOVERIES].add(do_rec.astype(jnp.int32))
+        .at[D_FN_GPU_EVENTS].add(
+            jnp.where(
+                do_rec,
+                ops.gcnt[node] * (pos - fc.down_at[node]),
+                0,
+            )
+        ),
+    )
+    return fc, vm, newly_dead
+
+
 def apply_fault_step(
     state,
     placed,
@@ -522,33 +612,9 @@ def apply_fault_step(
     replicated bookkeeping (placed/masks/failed/fc) updates identically
     on every shard. Returns (state, placed, masks, failed, fc, touched
     global node id (-1 none), FaultY minus the retry fields)."""
-    is_fail = kind == EV_NODE_FAIL
-    is_rec = kind == EV_NODE_RECOVER
-    is_evict = kind == EV_EVICT
-    params = ops.params
-    node = jnp.clip(arg, 0, fc.down_at.shape[0] - 1)
-    node_down = fc.down_at[node] >= 0
-    do_fail = is_fail & ~node_down
-    do_rec = is_rec & node_down
-
-    # ---- EV_EVICT victim selection (host pick_eviction_victim, exact:
-    # the PCG64 draw per placed-count is pre-tabulated in ops.draws)
-    placed_ok = placed >= 0
-    size = placed_ok.sum().astype(jnp.int32)
-    row = jnp.clip(aux, 0, ops.draws.shape[0] - 1)
-    j = ops.draws[row, jnp.clip(size, 0, ops.draws.shape[1] - 1)]
-    ranks = jnp.cumsum(placed_ok.astype(jnp.int32)) - 1
-    vsel = placed_ok & (ranks == j)
-    drawn = jnp.argmax(vsel).astype(jnp.int32)
-    explicit = arg
-    use_explicit = is_evict & (explicit >= 0)
-    exp_c = jnp.clip(explicit, 0, placed.shape[0] - 1)
-    victim = jnp.where(use_explicit, exp_c, drawn)
-    found = jnp.where(
-        use_explicit, placed_ok[exp_c], (aux >= 0) & (j >= 0)
+    do_fail, do_rec, do_evict, node, victim, vnode = _fault_decisions(
+        placed, fc, kind, arg, aux, ops
     )
-    do_evict = is_evict & found
-    vnode = jnp.where(do_evict, placed[victim], -1)
 
     # ---- frag-before capture (recover events; static flag)
     if frag_delta:
@@ -608,35 +674,16 @@ def apply_fault_step(
     else:
         fa = jnp.float32(0)
 
-    # ---- victim bookkeeping: node-fail evicts every pod on the node,
-    # evict exactly one; both requeue through the carry queue in
-    # ascending pod order (the host's flatnonzero discipline)
-    vm = (do_fail & (placed == node)) | (
-        do_evict & (jnp.arange(placed.shape[0]) == victim)
+    # ---- victim bookkeeping (shared _fault_bookkeep: requeue through
+    # the carry queue in ascending pod order, down clock, counters)
+    fc, vm, newly_dead = _fault_bookkeep(
+        fc, placed, node, victim, do_fail, do_rec, do_evict, pos, ops
     )
     placed = jnp.where(vm, -1, placed)
     masks = jnp.where(vm[:, None], False, masks)
-    fc, newly_dead = _evict_into_queue(fc, vm, pos, jnp.int32(0), params)
     # a pod out of retries AT EVICTION marks ever-failed explicitly (the
     # host's evict_bookkeep; retry failures mark it via the create path)
     failed = failed | newly_dead
-
-    # ---- down clock + recover accounting
-    fc = fc._replace(
-        down_at=fc.down_at.at[node].set(
-            jnp.where(do_fail, pos,
-                      jnp.where(do_rec, -1, fc.down_at[node]))
-        ),
-        dctr=fc.dctr.at[D_FAILURES].add(do_fail.astype(jnp.int32))
-        .at[D_RECOVERIES].add(do_rec.astype(jnp.int32))
-        .at[D_FN_GPU_EVENTS].add(
-            jnp.where(
-                do_rec,
-                ops.gcnt[node] * (pos - fc.down_at[node]),
-                0,
-            )
-        ),
-    )
 
     touched = jnp.where(
         do_reset, node, jnp.where(do_evict, vnode, -1)
@@ -652,6 +699,151 @@ def apply_fault_step(
         fa=fa,
     )
     return state, placed, masks, failed, fc, touched, y
+
+
+class FaultPending(NamedTuple):
+    """One fault step's deferred write set — the fault half of the
+    shard engine's software pipeline (ISSUE 11): the DECISION (victim
+    draw, row targets, queue bookkeeping) happens in-line at the event —
+    it only reads committed bookkeeping — while every state/placed/
+    masks/failed WRITE is encoded here and applied at the top of the
+    NEXT scan iteration by apply_fault_pending, keeping the body
+    strictly write-then-read. All node ids are GLOBAL; fields are inert
+    (-1 / zeros) on non-fault steps."""
+
+    reset_node: jnp.ndarray  # i32 node to reset (-1 none)
+    reset_fail: jnp.ndarray  # bool: True -> DOWN sentinel, False -> empty
+    evict_node: jnp.ndarray  # i32 node returning an evicted pod's
+    #                          resources (-1 none)
+    evict_cpu: jnp.ndarray  # i32
+    evict_mem: jnp.ndarray  # i32
+    evict_milli: jnp.ndarray  # i32 per-GPU milli of the victim
+    evict_mask: jnp.ndarray  # bool[8] the victim's recorded device mask
+    evict_cls: jnp.ndarray  # i32 affinity class (-1 none)
+    clear: jnp.ndarray  # bool[Pp] rows cleared in placed/masks
+    dead_or: jnp.ndarray  # bool[Pp] OR-ed into ever-failed
+
+
+def no_fault_pending(num_rows: int) -> FaultPending:
+    z = jnp.int32(0)
+    return FaultPending(
+        reset_node=jnp.int32(-1), reset_fail=jnp.bool_(False),
+        evict_node=jnp.int32(-1), evict_cpu=z, evict_mem=z, evict_milli=z,
+        evict_mask=jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
+        evict_cls=jnp.int32(-1),
+        clear=jnp.zeros(num_rows, jnp.bool_),
+        dead_or=jnp.zeros(num_rows, jnp.bool_),
+    )
+
+
+def plan_fault_step(
+    placed,
+    masks,
+    fc: FaultCarry,
+    specs,
+    kind,
+    arg,
+    aux,
+    pos,
+    ops: FaultOps,
+):
+    """apply_fault_step with the state/bookkeeping WRITES deferred: runs
+    the same decision + queue bookkeeping (shared _fault_decisions /
+    _fault_bookkeep, so the trajectory is bit-identical by construction)
+    but returns the write set as a FaultPending instead of mutating the
+    buffers. The recover frag-delta capture is unsupported here (the
+    post-reset state is never materialized at the event) — the shard
+    engine, the only pipelined-fault consumer, never captures it anyway
+    (ENGINES.md Round 14). Returns (FaultPending, fc', touched global
+    node id, FaultY minus the retry fields)."""
+    do_fail, do_rec, do_evict, node, victim, vnode = _fault_decisions(
+        placed, fc, kind, arg, aux, ops
+    )
+    vpod_spec = jax.tree.map(lambda a: a[victim], specs)
+    from tpusim.policies.clustering import pod_affinity_class
+
+    cls = pod_affinity_class(vpod_spec)
+    fc, vm, newly_dead = _fault_bookkeep(
+        fc, placed, node, victim, do_fail, do_rec, do_evict, pos, ops
+    )
+    do_reset = do_fail | do_rec
+    fp = FaultPending(
+        reset_node=jnp.where(do_reset, node, -1).astype(jnp.int32),
+        reset_fail=do_fail,
+        evict_node=jnp.where(do_evict, vnode, -1).astype(jnp.int32),
+        evict_cpu=vpod_spec.cpu,
+        evict_mem=vpod_spec.mem,
+        evict_milli=vpod_spec.gpu_milli,
+        evict_mask=masks[victim],
+        evict_cls=cls,
+        clear=vm,
+        dead_or=newly_dead,
+    )
+    touched = jnp.where(
+        do_reset, node, jnp.where(do_evict, vnode, -1)
+    ).astype(jnp.int32)
+    y = FaultY(
+        rpod=jnp.int32(-1),
+        lat=jnp.int32(-1),
+        vpod=jnp.where(do_evict, victim, -1).astype(jnp.int32),
+        vnode=jnp.where(do_evict, vnode, -1).astype(jnp.int32),
+        nvict=vm.sum().astype(jnp.int32),
+        rec=do_rec.astype(jnp.int32),
+        fb=jnp.float32(0),
+        fa=jnp.float32(0),
+    )
+    return fp, fc, touched, y
+
+
+def apply_fault_pending(state, placed, masks, failed, fp: FaultPending,
+                        offset, nloc: int):
+    """Apply one FaultPending's deferred writes — strictly write-only on
+    every touched buffer: the node-row effects land as one-row scatters
+    with out-of-range-drop owner masking (`offset`/`nloc` select this
+    shard's local window; 0/N on a gathered global view), the [Pp]
+    bookkeeping as masked whole-row selects. The value reads touch only
+    the never-written capacity leaves (cpu_cap/mem_cap/gpu_cnt), so the
+    scatters alias in place under scan exactly like apply_commit's."""
+    # ---- node row reset (fail -> DOWN sentinel, recover -> empty)
+    lres = fp.reset_node - offset
+    owns_r = (fp.reset_node >= 0) & (lres >= 0) & (lres < nloc)
+    ri = jnp.clip(lres, 0, nloc - 1)
+    tgt_r = jnp.where(owns_r, ri, nloc)  # nloc = out of range -> dropped
+    gpu_full = (
+        jnp.arange(MAX_GPUS_PER_NODE, dtype=jnp.int32) < state.gpu_cnt[ri]
+    ).astype(jnp.int32) * MILLI
+    state = state._replace(
+        cpu_left=state.cpu_left.at[tgt_r].set(
+            state.cpu_cap[ri], mode="drop"
+        ),
+        mem_left=state.mem_left.at[tgt_r].set(
+            jnp.where(fp.reset_fail, jnp.int32(-1), state.mem_cap[ri]),
+            mode="drop",
+        ),
+        gpu_left=state.gpu_left.at[tgt_r].set(gpu_full, mode="drop"),
+        aff_cnt=state.aff_cnt.at[tgt_r].set(0, mode="drop"),
+    )
+
+    # ---- EV_EVICT resource return at the victim's node
+    lev = fp.evict_node - offset
+    owns_e = (fp.evict_node >= 0) & (lev >= 0) & (lev < nloc)
+    ei = jnp.clip(lev, 0, nloc - 1)
+    tgt_e = jnp.where(owns_e, ei, nloc)
+    state = state._replace(
+        cpu_left=state.cpu_left.at[tgt_e].add(fp.evict_cpu, mode="drop"),
+        mem_left=state.mem_left.at[tgt_e].add(fp.evict_mem, mode="drop"),
+        gpu_left=state.gpu_left.at[tgt_e].add(
+            fp.evict_mask.astype(jnp.int32) * fp.evict_milli, mode="drop"
+        ),
+        aff_cnt=state.aff_cnt.at[
+            tgt_e, jnp.maximum(fp.evict_cls, 0)
+        ].add(jnp.where(fp.evict_cls >= 0, -1, 0), mode="drop"),
+    )
+
+    placed = jnp.where(fp.clear, -1, placed)
+    masks = jnp.where(fp.clear[:, None], False, masks)
+    failed = failed | fp.dead_or
+    return state, placed, masks, failed
 
 
 def commit_retry(fc: FaultCarry, has, pod, node, pos, era, params):
@@ -696,11 +888,15 @@ def commit_retry(fc: FaultCarry, has, pod, node, pos, era, params):
 
 
 def assemble_disruption(plan: FaultPlan, ys: FaultY, final_fc,
-                        gpu_cnt: np.ndarray):
+                        gpu_cnt: np.ndarray, frag_delta: bool = True):
     """(DisruptionMetrics, dead_pods bool[Pp], retry attempt count) from
     the scan's fault telemetry — the exact numbers the segmented host
     loop accumulates, including the end-of-trace dark-capacity clock for
-    nodes still down when the trace ends."""
+    nodes still down when the trace ends. frag_delta=False (the shard
+    engine, whose replay cannot capture it) leaves
+    post_recovery_frag_delta EMPTY instead of reporting the ys' zero
+    placeholders as if they were measured deltas (ISSUE 11 satellite —
+    the driver pairs this with a [Degrade] warning + obs counter)."""
     from tpusim.sim.metrics import DisruptionMetrics
 
     dctr = np.asarray(final_fc.dctr, np.int64)
@@ -728,12 +924,15 @@ def assemble_disruption(plan: FaultPlan, ys: FaultY, final_fc,
     )
     lat = np.asarray(ys.lat, np.int64)
     dm.reschedule_latency_events = [int(x) for x in lat[lat >= 0]]
-    rec = np.asarray(ys.rec) > 0
-    fb = np.asarray(ys.fb, np.float64)
-    fa = np.asarray(ys.fa, np.float64)
-    dm.post_recovery_frag_delta = [
-        float(fa[i]) - float(fb[i]) for i in np.flatnonzero(rec)
-    ]
+    if frag_delta:
+        rec = np.asarray(ys.rec) > 0
+        fb = np.asarray(ys.fb, np.float64)
+        fa = np.asarray(ys.fa, np.float64)
+        dm.post_recovery_frag_delta = [
+            float(fa[i]) - float(fb[i]) for i in np.flatnonzero(rec)
+        ]
+    else:
+        dm.post_recovery_frag_delta = []
     dead = np.asarray(final_fc.dead, bool)
     attempts_run = int((np.asarray(ys.rpod) >= 0).sum())
     return dm, dead, attempts_run

@@ -345,18 +345,37 @@ def make_pending_commit(
 def apply_commit(state: NodeState, placed, masks, failed, p: "PendingCommit"):
     """Apply a PendingCommit's scatters — the write-only half of the
     pipelined event loop. placed/masks/failed carry one extra dummy row
-    ([P]) that absorbs skip-event writes."""
-    apply = p.node >= 0
-    sel = jnp.maximum(p.node, 0)
+    ([P]) that absorbs skip-event writes. The global view of
+    apply_commit_sharded (offset 0, the full node window), so the commit
+    arithmetic exists exactly once."""
+    return apply_commit_sharded(
+        state, placed, masks, failed, p, jnp.int32(0), state.num_nodes
+    )
+
+
+def apply_commit_sharded(state: NodeState, placed, masks, failed,
+                         p: "PendingCommit", offset, nloc: int):
+    """apply_commit for a node-axis-sharded carry (the shard_map engine's
+    software pipeline, ISSUE 11): `p.node` is a GLOBAL node id, so each
+    shard lands the state scatters owner-masked on its local row window
+    (`offset` = this shard's first global id, `nloc` rows) while the
+    [P+1] bookkeeping writes — replicated by construction — apply
+    identically on every shard. Strictly write-only on every touched
+    buffer, like apply_commit, so the scatters alias in place under scan.
+    With offset == 0 and nloc == N this IS apply_commit on a global view
+    (the shard engine's finish epilogue uses apply_commit directly)."""
+    li = p.node - offset
+    owns = (p.node >= 0) & (li >= 0) & (li < nloc)
+    sel = jnp.clip(li, 0, nloc - 1)
     state = state._replace(
-        cpu_left=state.cpu_left.at[sel].add(jnp.where(apply, p.rs * p.cpu, 0)),
-        mem_left=state.mem_left.at[sel].add(jnp.where(apply, p.rs * p.mem, 0)),
+        cpu_left=state.cpu_left.at[sel].add(jnp.where(owns, p.rs * p.cpu, 0)),
+        mem_left=state.mem_left.at[sel].add(jnp.where(owns, p.rs * p.mem, 0)),
         gpu_left=state.gpu_left.at[sel].add(
-            jnp.where(apply, p.rs, 0) * p.dev_mask.astype(jnp.int32)
+            jnp.where(owns, p.rs, 0) * p.dev_mask.astype(jnp.int32)
             * p.gpu_milli
         ),
         aff_cnt=state.aff_cnt.at[sel, jnp.maximum(p.cls, 0)].add(
-            jnp.where(apply & (p.cls >= 0), -p.rs, 0)
+            jnp.where(owns & (p.cls >= 0), -p.rs, 0)
         ),
     )
     placed = placed.at[p.pod_write].set(p.placed_val)

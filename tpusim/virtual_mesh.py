@@ -8,7 +8,7 @@ __init__ stays import-light by design."""
 from __future__ import annotations
 
 
-def force_virtual_cpu_devices(n_devices: int) -> None:
+def force_virtual_cpu_devices(n_devices: int, force: bool = False) -> None:
     """Best-effort: before first backend init, force an n-device virtual
     CPU platform when the host would otherwise come up with fewer devices
     than the requested mesh. Two cases act:
@@ -24,7 +24,11 @@ def force_virtual_cpu_devices(n_devices: int) -> None:
       --xla_force_host_platform_device_count gives it the virtual mesh.
 
     No-op on real multi-device accelerator platforms (cuda, multi-chip
-    tpu, ...) or once a backend is up."""
+    tpu, ...) or once a backend is up. force=True skips the
+    accelerator-factory guard (still never acts on an already-up
+    backend): the CPU-by-design smokes (`make mesh-chaos-smoke`,
+    bench_multichip) must get their virtual mesh even on images that
+    register inert cuda/rocm/tpu plugin factories."""
     import os
     import re
 
@@ -37,7 +41,7 @@ def force_virtual_cpu_devices(n_devices: int) -> None:
         name for name in _xb._backend_factories
         if name not in ("cpu", "interpreter")
     ]
-    if n_devices <= 1 or accel not in ([], ["axon"]):
+    if n_devices <= 1 or (not force and accel not in ([], ["axon"])):
         return
     flags = re.sub(
         r"--xla_force_host_platform_device_count=\d+",
