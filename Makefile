@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke chaos-smoke mesh-chaos-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py tests/test_pipeline.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -117,6 +117,17 @@ chaos-smoke:
 mesh-chaos-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --mesh-chaos-only
 
+# fleet-chaos smoke (ENGINES.md "Round 16"): the kill-tolerant worker
+# fleet end-to-end — a single-worker reference run (cold caches), then
+# a coordinator + 3 worker PROCESSES on the same caches with a random
+# `kill -9` mid-batch. Hard checks: 100% of accepted jobs reach signed
+# results BYTE-identical to the single-worker run, the dead worker's
+# leases are stolen without operator action (/queue steals +
+# lease_expired), and a fresh joiner's first batch skips the cold
+# compile via the shared persistent-compile/table caches.
+fleet-chaos-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --fleet-chaos-only
+
 # bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
 # measurement under profiling and diff it against the newest committed
 # BENCH_r*.json baseline — exact on events/placements/gpu_alloc
@@ -130,7 +141,10 @@ mesh-chaos-smoke:
 # executable across generations, signed resumable log), and the chaos
 # sweep (ISSUE 10, the chaos-smoke check: fault schedules as operands —
 # zero recompiles across waves, lane-vs-standalone disruption
-# reconciliation). Exit 1 on regression; artifacts land in .tpusim_obs/.
+# reconciliation), and the worker fleet (ISSUE 12, the
+# fleet-chaos-smoke check: kill -9 mid-batch, orphan stealing,
+# byte-identical results, warm-joiner compile skip). Exit 1 on
+# regression; artifacts land in .tpusim_obs/.
 bench-gate:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate
 

@@ -334,9 +334,11 @@ def test_chaos_sweep_rejects_mismatched_lanes():
         sim.run_sweep(
             np.asarray([[1000]], np.int32), faults=["not-a-config"]
         )
-    with pytest.raises(ValueError, match="tunes and faults"):
+    # the chaos x tune lift (ISSUE 12): combining tunes and faults is
+    # legal now, but the per-lane lists must still line up
+    with pytest.raises(ValueError, match="fault_specs has"):
         sim.run_sweep(
-            np.asarray([[1000]], np.int32), tunes=[0.0],
+            np.asarray([[1000]] * 2, np.int32), tunes=[0.0, 0.1],
             faults=[FaultConfig(mtbf_events=3)],
         )
 

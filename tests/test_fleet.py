@@ -1,0 +1,596 @@
+"""The resilient fleet (ISSUE 12): leased job ownership, orphan
+stealing, and the kill-tolerant multi-worker service plane.
+
+The tier-1 slice is pure host-side protocol — no device dispatch, no
+compiles (~2 s):
+
+  1. lease files: signed round-trip, torn/edited files skipped+DELETED
+     with a [Degrade] warning (the load_valid_checkpoint pattern),
+     foreign headers rejected, clock-skew margin honored
+     (TPUSIM_LEASE_SKEW_S);
+  2. JobQueue claim/steal: claim stamps owner + deadline, expired
+     leases are stolen back to the FRONT of the queue in submission
+     order, renew extends and reports lost leases, release_worker
+     reclaims a known-dead worker instantly;
+  3. duplicate completion of a stolen job is a silent dedup (the
+     at-least-once/idempotent contract);
+  4. per-family admission quotas: QuotaFull 429 + Retry-After naming
+     the family, other families unaffected, depths surfaced in /queue;
+  5. the claim handshake: spec_to_payload round-trips to the identical
+     spec + digest; the FleetService register/claim/renew/complete
+     protocol driven synchronously (no HTTP, no device) including the
+     stolen-but-already-finished shortcut and coordinator-restart
+     lease adoption;
+  6. fleet /healthz degrading to 503 only when NO worker is live.
+
+Slow (resume-smoke / `make fleet-chaos-smoke`): the mixed
+fault/tune/weight batch through the REAL dispatch path
+(lane-vs-standalone bit-identity), and the full 3-process kill -9
+acceptance via gate.fleet_chaos_smoke.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpusim.io.trace import NodeRow, PodRow
+from tpusim.svc import jobs as svc_jobs
+from tpusim.svc import leases as svc_leases
+from tpusim.svc.api import JobService, start_job_server
+from tpusim.svc.batcher import JobQueue, QuotaFull, QueueFull
+from tpusim.svc.fleet import FleetService
+from tpusim.svc.worker import TraceRef, Worker
+
+FAM = [["FGDScore", 1000], ["BestFitScore", 500]]
+
+
+def _mk_cluster(rng, n=16):
+    return [
+        NodeRow(f"n{i:03d}", 32000, 131072, int(g), "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], n))
+    ]
+
+
+def _mk_pods(rng, n=40):
+    out = []
+    for i in range(n):
+        gpu = int(rng.choice([0, 1, 2]))
+        milli = 1000 if gpu > 1 else int(rng.choice([0, 300, 500, 1000]))
+        if gpu == 0:
+            milli = 0
+        out.append(
+            PodRow(f"p{i:04d}", int(rng.choice([1000, 2000, 4000])), 2048,
+                   gpu, milli)
+        )
+    return out
+
+
+@pytest.fixture(scope="module")
+def trace():
+    rng = np.random.default_rng(3)
+    nodes, pods = _mk_cluster(rng), _mk_pods(rng)
+    return TraceRef(
+        "default", nodes, pods, svc_jobs.trace_digest(nodes, pods)
+    )
+
+
+def _spec(i=0, fault=False, tune=0.0):
+    doc = {"policies": FAM, "weights": [1000 + i, 500], "seed": 42,
+           "tune": tune}
+    if fault:
+        doc["fault"] = {"mtbf_events": 5.0, "seed": 7 + i}
+    return svc_jobs.validate_job(doc)
+
+
+def _submit(queue, trace, i=0, **kw):
+    spec = _spec(i, **kw)
+    return queue.submit(spec, svc_jobs.job_digest(spec, trace.digest))
+
+
+# ---------------------------------------------------------------------------
+# 1. lease files
+# ---------------------------------------------------------------------------
+
+
+def test_lease_file_roundtrip(tmp_path):
+    art = str(tmp_path)
+    path = svc_leases.write_lease(
+        art, "d" * 64, "w001", 1234, 1000.5, ["d" * 64, "e" * 64]
+    )
+    assert path.endswith(".lease.json")
+    doc = svc_leases.read_lease(art, "d" * 64)
+    assert doc["worker"] == "w001" and doc["pid"] == 1234
+    assert doc["deadline_unix"] == 1000.5
+    assert doc["members"] == ["d" * 64, "e" * 64]
+    assert [d for d, _ in svc_leases.scan_leases(art)] == ["d" * 64]
+    svc_leases.delete_lease(art, "d" * 64)
+    assert svc_leases.read_lease(art, "d" * 64) is None
+
+
+def test_lease_torn_file_degrades(tmp_path):
+    """A torn/edited lease is skipped AND deleted with a [Degrade]
+    callback — never trusted, never fatal, never shadowing re-claims."""
+    art = str(tmp_path)
+    svc_leases.write_lease(art, "a" * 64, "w001", 1, 99.0, ["a" * 64])
+    path = svc_leases.lease_path(art, "a" * 64)
+    with open(path) as f:
+        lines = f.read().splitlines()
+    # edit the payload without updating the signed header digest
+    doc = json.loads(lines[1])
+    doc["deadline_unix"] = 10**9  # an attacker-immortal lease
+    with open(path, "w") as f:
+        f.write(lines[0] + "\n")
+        f.write(json.dumps(doc, sort_keys=True) + "\n")
+    skipped = []
+    assert svc_leases.read_lease(
+        art, "a" * 64, on_skip=lambda p, e: skipped.append((p, e))
+    ) is None
+    assert skipped and not os.path.isfile(path)
+
+    # truncated file: same fate
+    svc_leases.write_lease(art, "b" * 64, "w001", 1, 99.0, ["b" * 64])
+    path_b = svc_leases.lease_path(art, "b" * 64)
+    with open(path_b, "w") as f:
+        f.write('{"schema": "tpusim-svc-lease/1"')
+    assert svc_leases.read_lease(art, "b" * 64,
+                                 on_skip=lambda p, e: None) is None
+    assert not os.path.isfile(path_b)
+
+    # foreign header (job digest mismatch under a renamed file)
+    svc_leases.write_lease(art, "c" * 64, "w001", 1, 99.0, ["c" * 64])
+    os.replace(svc_leases.lease_path(art, "c" * 64),
+               svc_leases.lease_path(art, "f" * 64))
+    assert svc_leases.read_lease(art, "f" * 64,
+                                 on_skip=lambda p, e: None) is None
+
+
+def test_lease_expiry_skew_margin(monkeypatch):
+    lease = {"worker": "w", "deadline_unix": 100.0}
+    monkeypatch.setenv("TPUSIM_LEASE_SKEW_S", "30")
+    assert svc_leases.lease_skew_s() == 30.0
+    # within the margin: a clock 29 s past the deadline must NOT steal
+    assert not svc_leases.lease_expired(lease, now=129.0)
+    assert svc_leases.lease_expired(lease, now=131.0)
+    # explicit skew overrides the env
+    assert svc_leases.lease_expired(lease, now=101.0, skew_s=0.5)
+    monkeypatch.setenv("TPUSIM_LEASE_SKEW_S", "not-a-number")
+    assert svc_leases.lease_skew_s() == 2.0  # falls back, never raises
+
+
+# ---------------------------------------------------------------------------
+# 2./3. claim, steal, renew, duplicate completion
+# ---------------------------------------------------------------------------
+
+
+def test_claim_steal_ordering_and_renew(trace):
+    queue = JobQueue(maxsize=16, lane_width=2, lease_s=0.5)
+    jobs = [_submit(queue, trace, i) for i in range(5)]
+
+    batch = queue.claim_batch("w1", timeout=0)
+    assert [j.seq for j in batch] == [1, 2]
+    assert all(j.worker == "w1" and j.status == "batched" for j in batch)
+    assert all(j.lease_deadline_unix > time.time() for j in batch)
+    assert len(queue.jobs_of_worker("w1")) == 2
+
+    # not expired yet: nothing to steal
+    assert queue.steal_expired() == []
+    # renew keeps them alive past the original deadline
+    renewed, lost = queue.renew("w1", [j.digest for j in batch])
+    assert len(renewed) == 2 and not lost
+    # another worker's renew owns nothing -> all lost
+    _, lost = queue.renew("w2", [j.digest for j in batch])
+    assert len(lost) == 2
+
+    # force expiry: stolen back to the FRONT in submission order,
+    # ahead of the younger queued jobs (seq 3..5)
+    stolen = queue.steal_expired(now=time.time() + 10)
+    assert [j.seq for j in stolen] == [1, 2]
+    assert all(j.status == "queued" and not j.worker for j in stolen)
+    assert all(j.stolen == 1 for j in stolen)
+    nxt = queue.claim_batch("w2", timeout=0)
+    assert [j.seq for j in nxt] == [1, 2]  # the orphans go first
+    st = queue.stats()
+    assert st["steals"] == 2 and st["lease_expired"] == 2
+
+    # release_worker: instant reclaim for a known-dead worker
+    stolen2 = queue.release_worker("w2")
+    assert [j.seq for j in stolen2] == [1, 2]
+    assert queue.stats()["steals"] == 4
+
+
+def test_duplicate_completion_is_silent_dedup(trace):
+    queue = JobQueue(maxsize=8, lane_width=1, lease_s=0.01)
+    job = _submit(queue, trace, 0)
+    [j] = queue.claim_batch("w1", timeout=0)
+    # w1 stalls; the lease expires; w2 steals and completes
+    queue.steal_expired(now=time.time() + 10)
+    [j2] = queue.claim_batch("w2", timeout=0)
+    assert j2 is job
+    queue.mark_done(job, {"placed": 1})
+    # the not-actually-dead w1 completes the SAME job later
+    queue.mark_done(job, {"placed": 1})
+    st = queue.stats()
+    assert st["done"] == 1 and st["dup_completions"] == 1
+    assert job.status == "done"
+    # a late failure report can't un-done it either
+    queue.mark_failed(job, "spurious")
+    assert job.status == "done"
+    assert queue.stats()["dup_completions"] == 2
+
+
+# ---------------------------------------------------------------------------
+# 4. per-family admission quotas
+# ---------------------------------------------------------------------------
+
+
+def test_family_quota_429(trace, tmp_path):
+    queue = JobQueue(maxsize=16, lane_width=2, family_quota=2)
+    _submit(queue, trace, 0)
+    _submit(queue, trace, 1)
+    with pytest.raises(QuotaFull) as exc:
+        _submit(queue, trace, 2)
+    assert exc.value.quota == 2
+    assert exc.value.family.endswith("|nofault")
+    assert isinstance(exc.value, QueueFull)  # same 429 surface
+    # a DIFFERENT family (fault jobs batch separately) is unaffected
+    _submit(queue, trace, 0, fault=True)
+    st = queue.stats()
+    assert st["quota_rejected"] == 1 and st["family_quota"] == 2
+    assert sorted(st["families"].values()) == [1, 2]
+
+    # the HTTP body names the family and carries Retry-After
+    service = JobService(queue, None, {"default": trace}, str(tmp_path))
+    resp = service.handle(
+        "POST", "/jobs",
+        json.dumps({"policies": FAM, "weights": [1003, 500],
+                    "seed": 42}).encode(),
+    )
+    code, _, body = resp[0], resp[1], json.loads(resp[2].decode())
+    headers = resp[3] if len(resp) > 3 else {}
+    assert code == 429 and "family" in body
+    assert headers.get("Retry-After")
+
+
+def test_quota_rejection_is_not_prefix(trace, tmp_path):
+    """A quota-full doc must not block LATER docs of other families in
+    the same POST: the 429 body lists rejected_indices and the client
+    retries exactly those (no starvation, no dropped docs)."""
+    queue = JobQueue(maxsize=16, lane_width=2, family_quota=1)
+    service = JobService(queue, None, {"default": trace}, str(tmp_path))
+    docs = [
+        {"policies": FAM, "weights": [1000, 500], "seed": 1},  # admits
+        {"policies": FAM, "weights": [1001, 500], "seed": 2},  # quota
+        {"policies": FAM, "weights": [1002, 500], "seed": 3,   # other
+         "fault": {"mtbf_events": 5.0, "seed": 1}},            # family
+    ]
+    resp = service.handle("POST", "/jobs",
+                          json.dumps({"jobs": docs}).encode())
+    code, body = resp[0], json.loads(resp[2].decode())
+    assert code == 429
+    assert body["rejected_indices"] == [1]
+    assert len(body["accepted"]) == 2  # doc 0 AND doc 2 admitted
+    assert body["family"].endswith("|nofault")
+
+    # the client-side retry arithmetic consumes rejected_indices
+    from tpusim.svc import client as svc_client
+
+    calls = []
+
+    def fake_request(url, data=None, timeout=30.0):
+        calls.append(json.loads(data.decode()))
+        if len(calls) == 1:
+            return 429, {"Retry-After": "0"}, body
+        return 202, {}, {"jobs": [{"id": "j2"}]}
+
+    monkey_sleep = svc_client.time.sleep
+    svc_client.time.sleep = lambda s: None
+    svc_client._request, real = fake_request, svc_client._request
+    try:
+        accepted = svc_client.submit_jobs("http://x", docs)
+    finally:
+        svc_client._request = real
+        svc_client.time.sleep = monkey_sleep
+    assert len(accepted) == 3
+    # the second POST carried ONLY the quota-rejected doc
+    assert calls[1]["jobs"] == [docs[1]]
+
+
+# ---------------------------------------------------------------------------
+# 5. the claim handshake + FleetService protocol (no HTTP, no device)
+# ---------------------------------------------------------------------------
+
+
+def test_spec_to_payload_roundtrip(trace):
+    for kw in ({}, {"fault": True}, {"tune": 0.7},
+               {"fault": True, "tune": 1.2}):
+        spec = _spec(3, **kw)
+        payload = svc_jobs.spec_to_payload(spec)
+        spec2 = svc_jobs.validate_job(payload)
+        assert spec2 == spec
+        assert (svc_jobs.job_digest(spec2, trace.digest)
+                == svc_jobs.job_digest(spec, trace.digest))
+
+
+def _fleet_stack(trace, tmp_path, lease_s=0.4, family_quota=0):
+    queue = JobQueue(maxsize=32, lane_width=2, lease_s=lease_s,
+                     family_quota=family_quota)
+    service = JobService(queue, None, {"default": trace}, str(tmp_path))
+    service.bucket = 512
+    fleet = FleetService(service)
+    service.fleet = fleet
+    return queue, service, fleet
+
+
+def _call(fleet, path, doc):
+    resp = fleet.handle("POST", path, json.dumps(doc).encode())
+    return resp[0], json.loads(resp[2].decode())
+
+
+def test_fleet_protocol_claim_steal_complete(trace, tmp_path):
+    queue, service, fleet = _fleet_stack(trace, tmp_path)
+    art = str(tmp_path)
+
+    # unknown workers are told to re-register (the restart contract)
+    code, doc = _call(fleet, "/workers/claim", {"worker": "ghost"})
+    assert code == 409 and doc["register"]
+
+    code, reg = _call(fleet, "/workers/register",
+                      {"worker": "", "pid": 111, "host": "h1"})
+    assert code == 200
+    w1 = reg["worker"]
+    assert reg["lane_width"] == 2 and reg["lease_s"] == queue.lease_s
+    assert reg["traces"]["default"]["digest"] == trace.digest
+
+    for i in range(4):
+        service.submit_payload(
+            {"policies": FAM, "weights": [1000 + i, 500], "seed": 42}
+        )
+    code, claim = _call(fleet, "/workers/claim", {"worker": w1})
+    assert code == 200 and len(claim["jobs"]) == 2
+    jd = claim["jobs"][0]
+    # the wire spec revalidates to the identical digest
+    spec = svc_jobs.validate_job(jd["spec"])
+    assert svc_jobs.job_digest(spec, trace.digest) == jd["digest"]
+
+    # the worker-side half: lease files staked, then one job finished
+    members = [j["digest"] for j in claim["jobs"]]
+    for d in members:
+        svc_leases.write_lease(art, d, w1, 111,
+                               claim["deadline_unix"], members)
+    res = {"placed": 1, "job": members[0]}
+    svc_jobs.write_result(art, members[0], res)
+    code, comp = _call(fleet, "/workers/complete",
+                       {"worker": w1, "done": [members[0]],
+                        "dispatch_s": 1.5})
+    assert code == 200 and comp["acked"] == 1
+    assert queue.get_by_digest(members[0]).status == "done"
+    assert fleet.registry.workers[w1].first_dispatch_s == 1.5
+
+    # w1 dies holding members[1]; a second worker's claim steals it
+    code, reg2 = _call(fleet, "/workers/register",
+                       {"worker": "", "pid": 222, "host": "h2"})
+    w2 = reg2["worker"]
+    time.sleep(queue.lease_s + 0.05)
+    code, claim2 = _call(fleet, "/workers/claim", {"worker": w2})
+    got = [j["digest"] for j in claim2["jobs"]]
+    assert members[1] in got  # the orphan rode the front of the queue
+    assert [j for j in claim2["jobs"] if j["digest"] == members[1]][
+        0]["stolen"] == 1
+    # the dead owner's lease FILE was cleaned by the coordinator sweep
+    assert svc_leases.read_lease(art, members[1]) is None
+    assert queue.stats()["steals"] >= 1
+
+    # completion reported without a result file on disk -> failed loudly
+    # (mark_failed drops the digest mapping so a re-submit can retry —
+    # hold the Job object to observe the terminal state)
+    job_obj = queue.get_by_digest(members[1])
+    code, comp2 = _call(fleet, "/workers/complete",
+                        {"worker": w2, "done": [members[1]]})
+    assert job_obj.status == "failed"
+    assert "no valid signed result" in job_obj.error
+
+
+def test_stale_failure_report_cannot_kill_stolen_job(trace, tmp_path):
+    """A stalled worker whose batch was stolen must not fail a job the
+    thief is validly running — only the CURRENT owner's failure report
+    lands. And a child the coordinator reaped is released instantly
+    (release_dead), no lease wait."""
+    queue, service, fleet = _fleet_stack(trace, tmp_path)
+    _call(fleet, "/workers/register", {"worker": "wA", "pid": 71})
+    _call(fleet, "/workers/register", {"worker": "wB", "pid": 72})
+    service.submit_payload(
+        {"policies": FAM, "weights": [4321, 500], "seed": 42}
+    )
+    code, claim = _call(fleet, "/workers/claim", {"worker": "wA"})
+    d = claim["jobs"][0]["digest"]
+    job = queue.get_by_digest(d)
+    # wA stalls; lease expires; wB steals and is running it
+    time.sleep(queue.lease_s + 0.05)
+    code, claim2 = _call(fleet, "/workers/claim", {"worker": "wB"})
+    assert [j["digest"] for j in claim2["jobs"]] == [d]
+    # wA resumes and reports failure — a stale verdict, ignored
+    code, comp = _call(fleet, "/workers/complete",
+                       {"worker": "wA", "failed": {d: "stale crash"}})
+    assert job.status == "running" or job.status == "batched"
+    assert comp["dup"] == 1  # counted as a late duplicate, not acked
+    # wB finishes normally
+    svc_jobs.write_result(str(tmp_path), d, {"placed": 1, "job": d})
+    code, comp = _call(fleet, "/workers/complete",
+                       {"worker": "wB", "done": [d]})
+    assert comp["acked"] == 1 and job.status == "done"
+
+    # release_dead: a reaped child's jobs go back instantly
+    service.submit_payload(
+        {"policies": FAM, "weights": [4322, 500], "seed": 42}
+    )
+    code, claim3 = _call(fleet, "/workers/claim", {"worker": "wB"})
+    assert len(claim3["jobs"]) == 1
+    assert fleet.release_dead(72) == 1
+    d3 = claim3["jobs"][0]["digest"]
+    assert queue.get_by_digest(d3).status == "queued"
+    assert fleet.release_dead(9999) == 0  # unknown pid: no-op
+
+
+def test_fleet_claim_shortcut_already_finished(trace, tmp_path):
+    """A stolen job whose presumed-dead owner DID write the signed
+    result is answered from disk at claim time — never re-run."""
+    queue, service, fleet = _fleet_stack(trace, tmp_path)
+    _call(fleet, "/workers/register", {"worker": "wA", "pid": 1})
+    _call(fleet, "/workers/register", {"worker": "wB", "pid": 2})
+    job = service.submit_payload(
+        {"policies": FAM, "weights": [1234, 500], "seed": 42}
+    )
+    code, claim = _call(fleet, "/workers/claim", {"worker": "wA"})
+    d = claim["jobs"][0]["digest"]
+    # wA writes the result but dies before POSTing complete
+    svc_jobs.write_result(str(tmp_path), d, {"placed": 1, "job": d})
+    time.sleep(queue.lease_s + 0.05)
+    code, claim2 = _call(fleet, "/workers/claim", {"worker": "wB"})
+    assert claim2["jobs"] == []  # answered from disk, not re-handed
+    assert queue.get_by_digest(d).status == "done"
+    assert queue.stats()["dup_completions"] == 0
+
+
+def test_coordinator_restart_adopts_live_leases(trace, tmp_path):
+    """A coordinator restart under a LIVE worker re-attaches its lease
+    instead of double-handing the batch out; an EXPIRED lease file is
+    cleaned and its jobs stay stealable."""
+    art = str(tmp_path)
+    spec = _spec(9)
+    digest = svc_jobs.job_digest(spec, trace.digest)
+    payload = svc_jobs.spec_to_payload(spec)
+    svc_jobs.write_job_spec(art, digest, payload)  # the PR 10 half
+    svc_leases.write_lease(art, digest, "w-live", 999,
+                           time.time() + 30.0, [digest])
+    spec2 = _spec(10)
+    digest2 = svc_jobs.job_digest(spec2, trace.digest)
+    svc_jobs.write_job_spec(art, digest2, svc_jobs.spec_to_payload(spec2))
+    svc_leases.write_lease(art, digest2, "w-dead", 998,
+                           time.time() - 60.0, [digest2])
+
+    # "restart": a fresh stack over the same artifact dir
+    from tpusim.svc.api import recover_pending_jobs
+
+    queue, service, fleet = _fleet_stack(trace, tmp_path)
+    assert recover_pending_jobs(service) == 2
+    adopted = fleet.adopt_leases()
+    assert adopted == 1
+    job = queue.get_by_digest(digest)
+    assert job.status == "batched" and job.worker == "w-live"
+    # the live owner's complete lands against the adopted claim
+    svc_jobs.write_result(art, digest, {"placed": 1, "job": digest})
+    code, comp = _call(fleet, "/workers/complete",
+                       {"worker": "w-live", "done": [digest]})
+    assert comp["acked"] == 1 and job.status == "done"
+    # the expired lease: file cleaned, job still claimable
+    assert svc_leases.read_lease(art, digest2) is None
+    assert queue.get_by_digest(digest2).status == "queued"
+
+
+# ---------------------------------------------------------------------------
+# 6. fleet /healthz
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_healthz_degrades_only_when_empty(trace, tmp_path):
+    import urllib.error
+    import urllib.request
+
+    srv, service, worker = start_job_server(
+        str(tmp_path), {"default": trace}, listen=":0", fleet=True,
+        lease_s=0.3, recover=False,
+    )
+    try:
+        assert worker is None
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+        assert exc.value.code == 503  # no worker live yet
+        body = json.loads(exc.value.read().decode())
+        assert body["ok"] is False and body["workers_live"] == 0
+
+        service.fleet.registry.register("w1", 123, "h")
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=5) as r:
+            body = json.loads(r.read().decode())
+        assert r.status == 200 and body["ok"] is True
+        # GET /workers lists the roster
+        with urllib.request.urlopen(srv.url + "/workers", timeout=5) as r:
+            body = json.loads(r.read().decode())
+        assert "w1" in body["workers"]
+
+        # the worker goes silent past the liveness window -> 503 again
+        service.fleet.registry.workers["w1"].last_seen_unix -= 3600
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+        assert exc.value.code == 503
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# slow: real dispatch + the full chaos acceptance
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mixed_fault_tune_batch_bit_identity(trace, tmp_path):
+    """The ISSUE 12 chaos x tune lift through the WORKER dispatch path:
+    one batch mixing fault seeds, tune factors, and weights runs one
+    compiled scan, each lane bit-identical to the standalone run."""
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+
+    queue = JobQueue(maxsize=16, lane_width=4)
+    worker = Worker(queue, {"default": trace}, str(tmp_path),
+                    lease_files=False)
+    service = JobService(queue, worker, {"default": trace}, str(tmp_path))
+    fault = {"mtbf_events": 12.0, "mttr_events": 15.0, "seed": 7,
+             "backoff_base": 2, "backoff_cap": 16, "max_retries": 2,
+             "queue_capacity": 16}
+    docs = [
+        {"policies": FAM, "weights": [1000, 500], "seed": 42,
+         "tune": 0.0, "engine": "sequential",
+         "fault": dict(fault, seed=11)},
+        {"policies": FAM, "weights": [700, 300], "seed": 43,
+         "tune": 0.5, "engine": "sequential",
+         "fault": dict(fault, seed=13)},
+        {"policies": FAM, "weights": [900, 100], "seed": 42,
+         "tune": 0.3, "engine": "sequential",
+         "fault": dict(fault, seed=17)},
+    ]
+    for d in docs:
+        service.submit_payload(d)
+    batch = queue.next_batch(timeout=0)
+    assert len(batch) == 3  # ONE family despite three tunes
+    worker.run_batch(batch)
+    for d, job in zip(docs, batch):
+        assert job.status == "done", job.error
+        sim = Simulator(trace.nodes, SimulatorConfig(
+            policies=tuple((n, w) for (n, _), w
+                           in zip(FAM, d["weights"])),
+            gpu_sel_method="best", seed=d["seed"],
+            report_per_event=False, shuffle_pod=False,
+            tuning_ratio=d["tune"], engine="sequential",
+        ))
+        sim.set_workload_pods(list(trace.pods))
+        res = sim.run_with_faults(
+            fault_cfg=svc_jobs.validate_job(d).fault_config()
+        )
+        assert job.result["placed_node"] == [
+            int(x) for x in res.placed_node
+        ]
+        assert job.result["disruption"] == sim.last_disruption.as_dict()
+
+
+@pytest.mark.slow
+def test_fleet_chaos_acceptance(tmp_path):
+    """The full ISSUE 12 acceptance: 3 worker processes, kill -9
+    mid-batch, 100% completion byte-identical to a single-worker run,
+    steal counters visible in /queue, warm joiner skips the compile —
+    gate.fleet_chaos_smoke IS the harness (also `make
+    fleet-chaos-smoke`)."""
+    from tpusim.obs.gate import fleet_chaos_smoke
+
+    ok, msgs = fleet_chaos_smoke(str(tmp_path))
+    assert ok, "\n".join(msgs)

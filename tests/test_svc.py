@@ -134,15 +134,23 @@ def test_validate_job():
     assert spec.weights == (7, 9) and spec.tune == 1.5
     assert spec.family_key() == (
         "default", ("FGDScore", "BestFitScore"), "FGDScore", "max",
-        "share", "table", False, 0.0, 0,
+        "share", "table", False,
     )
-    # fault jobs (ISSUE 10) batch separately and pin their tune factor
+    # fault jobs (ISSUE 10) batch separately; the ISSUE 12 lift made
+    # the tune factor an operand for them too — no longer in the key
     spec_f = svc_jobs.validate_job({
         "policies": FAM, "tune": 1.5,
         "fault": {"mtbf_events": 5.0, "seed": 7},
     })
     assert spec_f.fault_config().mtbf_events == 5.0
-    assert spec_f.family_key()[-3:] == (True, 1.5, 233)
+    assert spec_f.family_key()[-1] is True
+    spec_nf = svc_jobs.validate_job({"policies": FAM, "tune": 1.5})
+    assert spec_f.family_key() != spec_nf.family_key()
+    spec_f2 = svc_jobs.validate_job({
+        "policies": FAM, "tune": 0.5,
+        "fault": {"mtbf_events": 5.0, "seed": 7},
+    })
+    assert spec_f.family_key() == spec_f2.family_key()
     with pytest.raises(ValueError, match="unknown fault key"):
         svc_jobs.validate_job({"fault": {"mtbf": 5.0}})
     with pytest.raises(ValueError, match="fault needs"):

@@ -14,7 +14,13 @@ verbs (ISSUE 4) and the live-telemetry verbs (ISSUE 5):
   serve    watch a directory of run records / checkpoints and expose
            /metrics, /healthz, /progress over HTTP; --jobs additionally
            grows the POST side — a queueing what-if replay service
-           (ISSUE 7: POST /jobs, GET /jobs/<id>[/result], GET /queue)
+           (ISSUE 7: POST /jobs, GET /jobs/<id>[/result], GET /queue);
+           --workers N promotes it to a kill-tolerant worker FLEET
+           (ISSUE 12: leased ownership, orphan stealing, aggregated
+           /queue, fleet /healthz)
+  worker   join a `serve --jobs` coordinator as a fleet worker
+           (ISSUE 12): claim leased batches, renew while scanning,
+           write signed results into the shared artifact dir
   submit   POST what-if jobs to a `serve --jobs` service, wait, and
            print the per-job results
   tune     learned-scoring lane (ISSUE 9): ES/CMA tuning of the
@@ -274,6 +280,72 @@ def _build_parser() -> argparse.ArgumentParser:
         "--queue-size", type=int, default=64, metavar="N",
         help="bounded job queue depth; a full queue answers POST /jobs "
         "with 429 + Retry-After",
+    )
+    # the worker fleet (ISSUE 12; README "Worker fleet")
+    p_serve.add_argument(
+        "--workers", type=int, default=0, metavar="N",
+        help="spawn N worker PROCESSES draining the one job queue "
+        "under leased ownership (signed lease files, orphan stealing — "
+        "a kill -9'd worker's jobs are reclaimed by any live worker); "
+        "0 keeps the single in-process worker thread. Remote hosts "
+        "join the same fleet with `tpusim worker --join URL`",
+    )
+    p_serve.add_argument(
+        "--lease-s", type=float, default=0.0, metavar="SECONDS",
+        help="job lease duration (default 15): a worker silent this "
+        "long past its deadline forfeits its batch to the fleet",
+    )
+    p_serve.add_argument(
+        "--family-quota", type=int, default=0, metavar="N",
+        help="per-family admission quota: at most N queued jobs per "
+        "job family (a hot trace can't starve the rest); overflow "
+        "answers 429 + Retry-After naming the family (0 = no cap)",
+    )
+    p_serve.add_argument(
+        "--table-cache-dir", default="", metavar="DIR",
+        help="content-keyed init-table cache shared by the fleet "
+        "(default $TPUSIM_TABLE_CACHE_DIR)",
+    )
+    p_serve.add_argument(
+        "--compile-cache-dir", default="", metavar="DIR",
+        help="JAX persistent compile cache shared by the fleet — a "
+        "fresh joiner's first batch skips the ~5 s compile (default "
+        "$TPUSIM_COMPILE_CACHE_DIR)",
+    )
+
+    # the fleet worker process (ISSUE 12): joins a `serve --jobs`
+    # coordinator, pulls leased batches, writes signed results into the
+    # shared artifact dir
+    p_worker = sub.add_parser(
+        "worker",
+        help="join a `tpusim serve --jobs` coordinator as a fleet "
+        "worker: claim leased batches, run them on this host's device, "
+        "write signed results into the shared artifact dir, renew "
+        "leases while scanning; SIGTERM drains the in-flight batch",
+    )
+    p_worker.add_argument(
+        "--join", required=True, metavar="URL",
+        help="coordinator base URL (the address `serve --jobs` printed)",
+    )
+    p_worker.add_argument(
+        "--id", default="", metavar="NAME",
+        help="worker id (default: coordinator-assigned)",
+    )
+    p_worker.add_argument(
+        "--poll", type=float, default=0.2, metavar="SECONDS",
+        help="idle claim-poll interval",
+    )
+    p_worker.add_argument(
+        "--max-batches", type=int, default=0, metavar="N",
+        help="exit after serving N batches (0 = run until stopped)",
+    )
+    p_worker.add_argument(
+        "--table-cache-dir", default="", metavar="DIR",
+        help="shared content-keyed table cache",
+    )
+    p_worker.add_argument(
+        "--compile-cache-dir", default="", metavar="DIR",
+        help="shared JAX persistent compile cache",
     )
 
     # the learned-scoring lane (ISSUE 9; README "Tune policy weights"):
@@ -598,11 +670,26 @@ def _serve_jobs(args) -> int:
     trace = load_trace(
         "default", args.nodes, args.pods, max_pods=args.max_pods
     )
+    fleet_n = int(getattr(args, "workers", 0) or 0)
     srv, service, worker = start_job_server(
         args.dir, {"default": trace}, listen=args.listen,
         lane_width=args.lane_width, queue_size=args.queue_size,
+        table_cache_dir=args.table_cache_dir,
+        compile_cache_dir=args.compile_cache_dir,
+        fleet=fleet_n > 0, lease_s=args.lease_s,
+        family_quota=args.family_quota,
         out=sys.stderr,
     )
+    procs = []
+    if fleet_n > 0:
+        from tpusim.svc.fleet import spawn_local_workers
+
+        procs = spawn_local_workers(
+            srv.url, fleet_n,
+            table_cache_dir=args.table_cache_dir,
+            compile_cache_dir=args.compile_cache_dir,
+            out=sys.stderr,
+        )
     # graceful shutdown (ISSUE 10): SIGTERM/SIGINT begin the drain —
     # /healthz flips to 503, POSTs answer 503 + Retry-After, the
     # in-flight batch finishes (worker.stop joins after it), and every
@@ -621,11 +708,13 @@ def _serve_jobs(args) -> int:
         signal.signal(signal.SIGINT, _graceful)
     except ValueError:
         pass  # non-main thread (tests drive _serve_jobs directly)
+    mode = (f"fleet of {fleet_n} worker processes" if fleet_n
+            else "single in-process worker")
     print(
         f"[serve] job plane at {srv.url} (POST /jobs, GET "
-        f"/jobs/<id>[/result], /queue, /metrics, /healthz, /progress); "
-        f"trace 'default' = {len(trace.nodes)} nodes x "
-        f"{len(trace.pods)} pods; results -> "
+        f"/jobs/<id>[/result], /queue, /workers, /metrics, /healthz, "
+        f"/progress); {mode}; trace 'default' = {len(trace.nodes)} "
+        f"nodes x {len(trace.pods)} pods; results -> "
         f"{os.path.abspath(args.dir)}", file=sys.stderr,
     )
     try:
@@ -648,14 +737,73 @@ def _serve_jobs(args) -> int:
             record, progress = watch_dir(args.dir)
             if record is not None:
                 srv.publish_record(record)
+            for p in list(procs):
+                if p.poll() is not None:
+                    # a dead child is NOT an outage — and since WE
+                    # reaped it, its jobs can be released immediately
+                    # instead of waiting out the lease (a kill -9 from
+                    # outside still goes the lease-expiry route)
+                    released = (
+                        service.fleet.release_dead(p.pid)
+                        if service.fleet is not None else 0
+                    )
+                    print(
+                        f"[serve] worker pid {p.pid} exited "
+                        f"(rc {p.returncode}); released {released} "
+                        "held job(s) to the fleet", file=sys.stderr,
+                    )
+                    procs.remove(p)
             time.sleep(max(args.poll, 0.2))
         print("[serve] draining: finishing the in-flight batch",
               file=sys.stderr)
     except KeyboardInterrupt:
         srv.begin_drain()
     finally:
-        worker.stop()  # joins after the current batch — the drain
+        if procs:
+            from tpusim.svc.fleet import stop_workers
+
+            stop_workers(procs, out=sys.stderr)
+        if worker is not None:
+            worker.stop()  # joins after the current batch — the drain
         srv.stop()
+    return 0
+
+
+def cmd_worker(args) -> int:
+    """`tpusim worker --join URL`: the fleet worker process (ISSUE 12).
+    SIGTERM/SIGINT drain the in-flight batch before exit; a kill -9 is
+    recovered by the lease protocol (the coordinator steals)."""
+    import signal
+    import threading
+
+    from tpusim.svc.client import ServiceError
+    from tpusim.svc.fleet import run_worker
+
+    stop_event = threading.Event()
+
+    def _graceful(_signum, _frame):
+        stop_event.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+    except ValueError:
+        pass  # non-main thread (tests drive run_worker directly)
+    try:
+        served = run_worker(
+            args.join, worker_id=args.id, poll_s=args.poll,
+            max_batches=args.max_batches,
+            table_cache_dir=args.table_cache_dir,
+            compile_cache_dir=args.compile_cache_dir,
+            out=sys.stderr, stop_event=stop_event,
+        )
+    except ServiceError as err:
+        print(f"tpusim worker: {err}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError, json.JSONDecodeError) as err:
+        print(f"tpusim worker: {err}", file=sys.stderr)
+        return 2
+    print(f"[worker] drained after {served} batch(es)", file=sys.stderr)
     return 0
 
 
@@ -883,6 +1031,8 @@ def main(argv=None) -> int:
         return cmd_report(args)
     if args.command == "serve":
         return cmd_serve(args)
+    if args.command == "worker":
+        return cmd_worker(args)
     if args.command == "tune":
         return cmd_tune(args)
     if args.command == "submit":

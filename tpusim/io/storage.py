@@ -430,6 +430,28 @@ def read_signed_jsonl(path: str, schema: str = ""):
     return header, payload
 
 
+def write_signed_json(path: str, header: dict, doc: dict) -> str:
+    """Single-document convenience over write_signed_jsonl (ISSUE 12,
+    the lease-file plane): one canonical-JSON payload line under the
+    digest-signed header. Atomic like every write here — a `kill -9`'d
+    writer leaves the previous file intact, never a torn one."""
+    line = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return write_signed_jsonl(path, header, [line])
+
+
+def read_signed_json(path: str, schema: str = ""):
+    """(header, doc) from a single-document signed-JSON file; raises
+    ValueError on a torn/edited/multi-document file exactly like
+    read_signed_jsonl."""
+    header, payload = read_signed_jsonl(path, schema)
+    if len(payload) != 1:
+        raise ValueError(
+            f"{path}: want exactly one payload document, found "
+            f"{len(payload)}"
+        )
+    return header, json.loads(payload[0])
+
+
 def prune_checkpoints(cache_dir: str, digest: str, keep_cursor: int) -> None:
     """Drop a run's checkpoints below `keep_cursor` (each save supersedes
     its predecessors; only the newest is ever resumed from). Missing files

@@ -497,6 +497,250 @@ def chaos_smoke(nodes, pods, b: int = 8) -> Tuple[bool, List[str]]:
     return True, msgs
 
 
+def _write_fleet_trace(base: str, n_nodes: int = 16,
+                       n_pods: int = 40) -> Tuple[str, str]:
+    """Write a tiny synthetic node/pod CSV pair (the tune_smoke cluster
+    shape) — the fleet smoke hosts a REAL file-backed trace because the
+    register handshake hands CSV paths to worker processes."""
+    import csv
+
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    nodes_csv = os.path.join(base, "nodes.csv")
+    pods_csv = os.path.join(base, "pods.csv")
+    with open(nodes_csv, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["sn", "cpu_milli", "memory_mib", "gpu", "model"])
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], n_nodes)):
+            w.writerow([f"n{i:03d}", 32000, 131072, int(g),
+                        "V100M16" if g else ""])
+    with open(pods_csv, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["name", "cpu_milli", "memory_mib", "num_gpu",
+                    "gpu_milli"])
+        for i in range(n_pods):
+            gpu = int(rng.choice([0, 1, 2]))
+            milli = 1000 if gpu > 1 else int(rng.choice([300, 500, 1000]))
+            if gpu == 0:
+                milli = 0
+            w.writerow([f"p{i:04d}", int(rng.choice([1000, 2000, 4000])),
+                        2048, gpu, milli])
+    return nodes_csv, pods_csv
+
+
+def _fleet_jobs() -> list:
+    """The smoke's job mix: weight/seed/tune variants plus fault jobs
+    with DIFFERENT tunes (the ISSUE 12 chaos x tune lift — they must
+    share one compiled scan). engine pinned so both phases and every
+    worker resolve the identical jaxpr."""
+    # two policies: a meatier jaxpr widens the cold-compile vs
+    # cache-hit gap the phase-3 joiner check measures
+    fam = [["FGDScore", 1000], ["BestFitScore", 500]]
+    fault = {"mtbf_events": 12.0, "mttr_events": 15.0, "seed": 7,
+             "backoff_base": 2, "backoff_cap": 16, "max_retries": 2,
+             "queue_capacity": 16}
+    docs = [
+        {"policies": fam, "weights": [1000 + 37 * i, 500 + 13 * i],
+         "seed": 40 + i % 3, "tune": [0.0, 0.0, 0.3][i % 3],
+         "engine": "sequential"}
+        for i in range(8)
+    ]
+    docs += [
+        {"policies": fam, "weights": [900, 450], "seed": 42, "tune": 0.0,
+         "engine": "sequential", "fault": dict(fault, seed=11)},
+        {"policies": fam, "weights": [1100, 550], "seed": 42,
+         "tune": 0.4, "engine": "sequential",
+         "fault": dict(fault, seed=13)},
+    ]
+    return docs
+
+
+def fleet_chaos_smoke(out_dir: str, n_workers: int = 3
+                      ) -> Tuple[bool, List[str]]:
+    """ISSUE 12 (`make fleet-chaos-smoke`): the kill-tolerant fleet
+    end-to-end. Phase 1 runs every job on a single in-process worker
+    with FRESH caches — the byte-identity reference and the cold
+    compile wall. Phase 2 boots a coordinator + N worker processes on
+    the SAME caches, submits the same jobs over real HTTP, `kill -9`s
+    the first worker observed holding leases mid-batch, and hard-checks
+    the fleet contracts: (a) 100%% of accepted jobs reach signed
+    results BYTE-identical to the single-worker run, (b) the dead
+    worker's leases are stolen without operator action (/queue steals +
+    lease_expired counters), and (c) a FRESH worker joined after the
+    chaos wave serves its first batch well under the phase-1 cold
+    compile wall (the shared persistent-compile/table caches). Any
+    exception is a FAIL verdict, not a traceback."""
+    msgs: List[str] = []
+    procs = []
+    srv = worker = None
+    try:
+        import shutil
+        import signal as _signal
+        import time as _time
+
+        from tpusim.svc import load_trace, start_job_server
+        from tpusim.svc.client import _request, submit_jobs, wait_jobs
+        from tpusim.svc.fleet import spawn_local_workers, stop_workers
+
+        base = os.path.join(out_dir, "fleet_smoke")
+        if os.path.isdir(base):
+            shutil.rmtree(base)
+        os.makedirs(base)
+        nodes_csv, pods_csv = _write_fleet_trace(base)
+        ccache = os.path.join(base, "compile_cache")
+        tcache = os.path.join(base, "table_cache")
+        docs = _fleet_jobs()
+
+        # ---- phase 1: the single-worker reference (cold caches)
+        art1 = os.path.join(base, "ref")
+        os.makedirs(art1)
+        trace = load_trace("default", nodes_csv, pods_csv)
+        srv, service, worker = start_job_server(
+            art1, {"default": trace}, listen=":0", lane_width=2,
+            queue_size=64, compile_cache_dir=ccache,
+            table_cache_dir=tcache,
+        )
+        accepted = [service.submit_payload(d) for d in docs]
+        digests = [a["digest"] for a in accepted]
+        if not service.queue.wait_idle(timeout=300):
+            return False, ["[gate] fleet: phase-1 reference run did "
+                           "not drain (FAIL)"]
+        cold_s = worker.first_dispatch_s
+        ref_bytes = {}
+        for d in digests:
+            from tpusim.svc.jobs import result_path
+
+            with open(result_path(art1, d), "rb") as f:
+                ref_bytes[d] = f.read()
+        worker.stop()
+        srv.stop()
+        worker = srv = None
+
+        # ---- phase 2: the fleet, same caches, fresh artifact dir
+        art2 = os.path.join(base, "fleet")
+        os.makedirs(art2)
+        srv, service, _ = start_job_server(
+            art2, {"default": trace}, listen=":0", lane_width=2,
+            queue_size=64, fleet=True, lease_s=2.0,
+            compile_cache_dir=ccache, table_cache_dir=tcache,
+        )
+        # queue the jobs BEFORE the workers join: every worker's first
+        # claim then lands mid-compile — the widest kill window
+        accepted2 = submit_jobs(srv.url, docs)
+        ids2 = [a["id"] for a in accepted2]
+        procs = spawn_local_workers(
+            srv.url, n_workers, table_cache_dir=tcache,
+            compile_cache_dir=ccache,
+        )
+        killed = ""
+        deadline = _time.time() + 240
+        while _time.time() < deadline:
+            _, _, q = _request(srv.url + "/queue")
+            if not killed:
+                for wid, row in (q.get("workers") or {}).items():
+                    if row.get("leases_held", 0) > 0 and row.get("pid"):
+                        os.kill(row["pid"], _signal.SIGKILL)
+                        killed = wid
+                        msgs.append(
+                            f"[gate] fleet: kill -9'd {wid} (pid "
+                            f"{row['pid']}) holding "
+                            f"{row['leases_held']} lease(s) mid-batch"
+                        )
+                        break
+            if q.get("done", 0) >= len(docs) and killed:
+                break
+            _time.sleep(0.05)
+        if not killed:
+            return False, ["[gate] fleet: never observed a worker "
+                           "holding leases to kill (FAIL)"]
+        final = wait_jobs(srv.url, ids2, timeout=240)
+        bad = [d["id"] for d in final if d["status"] != "done"]
+        if bad:
+            return False, [
+                f"[gate] fleet: {len(bad)} job(s) never completed "
+                f"after the kill: {bad} (FAIL)"
+            ]
+        _, _, q = _request(srv.url + "/queue")
+        if q.get("steals", 0) < 1 or q.get("lease_expired", 0) < 1:
+            return False, [
+                f"[gate] fleet: dead worker's leases were NOT stolen "
+                f"(steals={q.get('steals')}, "
+                f"lease_expired={q.get('lease_expired')}) (FAIL)"
+            ]
+        # byte-identity of every result file against the single-worker
+        # reference — the whole idempotency argument, checked as bytes
+        from tpusim.svc.jobs import result_path
+
+        for d in digests:
+            with open(result_path(art2, d), "rb") as f:
+                got = f.read()
+            if got != ref_bytes[d]:
+                return False, [
+                    f"[gate] fleet: result {d[:12]}… diverges from the "
+                    "single-worker reference bytes (FAIL)"
+                ]
+        msgs.append(
+            f"[gate] fleet: {len(docs)} jobs (incl. mixed fault/tune "
+            f"lanes) on {n_workers} workers survived a mid-batch "
+            f"kill -9 — steals={q['steals']}, "
+            f"lease_expired={q['lease_expired']}, every result "
+            "byte-identical to the single-worker reference"
+        )
+
+        # ---- phase 3: the fresh joiner skips the compile. Drain the
+        # original fleet first so the joiner — not a warm survivor —
+        # provably serves the next wave
+        stop_workers(procs)
+        procs = []
+        joiner = spawn_local_workers(
+            srv.url, 1, table_cache_dir=tcache, compile_cache_dir=ccache,
+        )
+        procs = joiner
+        fresh = [
+            dict(d, weights=[5000 + 11 * i, 2500 + 7 * i])
+            for i, d in enumerate(_fleet_jobs()[:4])
+        ]
+        acc3 = submit_jobs(srv.url, fresh)
+        wait_jobs(srv.url, [a["id"] for a in acc3], timeout=240)
+        _, _, q = _request(srv.url + "/queue")
+        rows = q.get("workers") or {}
+        jrow = next(
+            (r for r in rows.values() if r.get("pid") == joiner[0].pid),
+            None,
+        )
+        if jrow is None or not jrow.get("first_dispatch_s"):
+            return False, ["[gate] fleet: the fresh joiner never "
+                           "served a batch (FAIL)"]
+        js = jrow["first_dispatch_s"]
+        if js >= 0.65 * cold_s:
+            return False, [
+                f"[gate] fleet: fresh joiner's first batch "
+                f"({js:.2f}s) did not skip the cold compile "
+                f"({cold_s:.2f}s) via the shared caches (FAIL)"
+            ]
+        msgs.append(
+            f"[gate] fleet: fresh joiner's first batch {js:.2f}s vs "
+            f"{cold_s:.2f}s cold — the shared compile/table caches "
+            "carried the warm state"
+        )
+    except Exception as err:
+        return False, [f"[gate] fleet: FAIL ({type(err).__name__}: {err})"]
+    finally:
+        try:
+            if procs:
+                from tpusim.svc.fleet import stop_workers
+
+                stop_workers(procs)
+            if worker is not None:
+                worker.stop()
+            if srv is not None:
+                srv.stop()
+        except Exception:
+            pass
+    return True, msgs
+
+
 def latest_multichip(repo: str = REPO) -> Optional[dict]:
     """Newest committed MULTICHIP_r*.json carrying a `scale` block (the
     ISSUE 11 scale-lane capture written by `bench_multichip.py
@@ -924,7 +1168,20 @@ def main(argv=None) -> int:
         "fault replay + donated chunked replay on a forced virtual "
         "mesh) — the `make mesh-chaos-smoke` mode",
     )
+    ap.add_argument(
+        "--fleet-chaos-only", action="store_true",
+        help="run only the fleet-chaos smoke (ISSUE 12: 3 worker "
+        "processes, random kill -9 mid-batch, byte-identity vs a "
+        "single-worker run, orphan stealing, warm-joiner compile "
+        "skip) — the `make fleet-chaos-smoke` mode",
+    )
     args = ap.parse_args(argv)
+
+    if args.fleet_chaos_only:
+        ok, msgs = fleet_chaos_smoke(args.out)
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
 
     if args.mesh_chaos_only:
         # a CPU smoke by design (the Makefile target pins
@@ -1028,12 +1285,16 @@ def main(argv=None) -> int:
     # hosts; `make mesh-chaos-smoke` runs the forced-virtual-mesh form
     mesh_ok, mesh_msgs = mesh_chaos_smoke()
     print("\n".join(mesh_msgs))
+    # fleet-chaos smoke (ISSUE 12): worker processes + kill -9 mid-batch
+    # — byte-identity vs single-worker, orphan stealing, warm joiner
+    fleet_ok, fleet_msgs = fleet_chaos_smoke(args.out)
+    print("\n".join(fleet_msgs))
     # scale-lane advisory (ISSUE 11 satellite): newest committed
     # MULTICHIP_r*.json, like the BENCH_r*.json baselines
     mc_ok, mc_msgs = multichip_advisory(latest_multichip())
     print("\n".join(mc_msgs))
     smoke_ok = (dec_ok and scrape_ok and swp_ok and svc_ok and tune_ok
-                and chaos_ok and mesh_ok and mc_ok)
+                and chaos_ok and mesh_ok and fleet_ok and mc_ok)
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

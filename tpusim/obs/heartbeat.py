@@ -44,6 +44,10 @@ _STATE = {
     # otherwise interleave consecutive scans' ticks into one anonymous
     # stream — listeners key per-job progress off this tag instead
     "job": "",
+    # worker id (ISSUE 12): in a fleet, ticks additionally say WHICH
+    # worker's scan is progressing, and the lease keeper treats any
+    # tick as proof of life (renew-on-heartbeat)
+    "worker": "",
 }
 
 MIN_INTERVAL_S = 1.0
@@ -69,7 +73,7 @@ def _notify(done: int, total: int, rate: float, eta: float,
     info = {
         "done": int(done), "total": int(total), "rate": float(rate),
         "eta": float(eta), "label": _STATE["label"], "final": bool(final),
-        "job": _STATE["job"],
+        "job": _STATE["job"], "worker": _STATE["worker"],
     }
     for fn in list(_LISTENERS):
         try:
@@ -79,7 +83,7 @@ def _notify(done: int, total: int, rate: float, eta: float,
 
 
 def configure(total_events: int, label: str = "scan", sink=None,
-              base: int = 0, job: str = ""):
+              base: int = 0, job: str = "", worker: str = ""):
     """Arm the heartbeat for the next scan: total event count for the ETA
     and a label for the line. Called by the driver right before each
     dispatch whose engine was built with a heartbeat. `base` = events of
@@ -87,11 +91,13 @@ def configure(total_events: int, label: str = "scan", sink=None,
     offset), so chunk/segment ticks report run-level progress. `job` tags
     every tick of this scan with a run/job id (ISSUE 7) so listeners
     serving several queued jobs from one process can keep their progress
-    streams apart; empty keeps the anonymous single-run behavior."""
+    streams apart; empty keeps the anonymous single-run behavior.
+    `worker` additionally tags the ticks with the serving worker's id
+    (ISSUE 12 — the fleet's /progress and lease-renewal surfaces)."""
     _STATE.update(
         total=int(total_events), label=label, t0=time.perf_counter(),
         last_emit=0.0, ticks=0, sink=sink, base=int(base), resumed=0,
-        job=str(job or ""),
+        job=str(job or ""), worker=str(worker or ""),
     )
 
 

@@ -88,6 +88,12 @@ class MonitorServer:
         # already persisted) and /healthz flips to 503 so load balancers
         # stop routing here while the in-flight batch finishes
         self._draining = False
+        # fleet liveness hook (ISSUE 12): a callable returning
+        # (ok: bool, extra fields) merged into the /healthz document —
+        # the job coordinator degrades to 503 only when NO worker is
+        # live (one dead worker of three is the fleet working as
+        # designed, not an outage). Draining still wins.
+        self.health_hook = None
 
     def begin_drain(self):
         with self._lock:
@@ -159,6 +165,8 @@ class MonitorServer:
                 ev_per_s=round(info["rate"], 1),
                 eta_s=round(info["eta"], 1),
             )
+            if info.get("worker"):
+                fields["worker"] = info["worker"]
             job = info.get("job") or ""
             if job:
                 self.publish_job_progress(job, fields)
@@ -238,14 +246,23 @@ class MonitorServer:
                         text.encode(),
                     )
                 elif path == "/healthz":
+                    hook = srv.health_hook
+                    hook_ok, extra = True, {}
+                    if hook is not None:
+                        try:
+                            hook_ok, extra = hook()
+                        except Exception:  # a broken hook must not 500
+                            hook_ok, extra = True, {}
                     with srv._lock:
                         draining = srv._draining
+                        ok = not draining and hook_ok
                         body = json.dumps({
-                            "ok": not draining,
+                            "ok": ok,
                             "phase": srv._progress.get("phase"),
                             "records": srv._records,
+                            **extra,
                         }, sort_keys=True)
-                    self._send(503 if draining else 200,
+                    self._send(200 if ok else 503,
                                "application/json",
                                (body + "\n").encode())
                 elif path == "/progress":

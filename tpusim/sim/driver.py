@@ -697,7 +697,7 @@ class Simulator:
             # and fault-segmented ticks report run-level progress/ETA
             obs_heartbeat.configure(
                 self._hb_base + e2, "replay", base=self._hb_base,
-                job=self._hb_job,
+                job=self._hb_job, worker=getattr(self, "_hb_worker", ""),
             )
         # dedup types from the UNPADDED specs (no spurious zero type); the
         # type_id axis is padded alongside the pod axis (padded events only
@@ -1628,9 +1628,23 @@ class Simulator:
             # the chaos sweep (ISSUE 10): one trace, B fault schedules as
             # per-lane operands — ONE compiled vmapped scan
             if tunes is not None:
-                raise ValueError(
-                    "run_sweep cannot combine tunes and faults yet (the "
-                    "fault plan is compiled against one base stream)"
+                # the chaos x tune lift (ISSUE 12): per-lane TUNED traces
+                # each with their OWN fault schedule (compiled against
+                # that lane's base stream) — mixed fault/tune/weight
+                # what-ifs still share one compiled scan
+                w = np.asarray(weights, np.int32)
+                if w.ndim != 2 or len(tunes) != int(w.shape[0]):
+                    raise ValueError(
+                        f"tunes has {len(tunes)} entries for weight grid "
+                        f"of shape {w.shape} (want one tuning ratio per "
+                        "weight row)"
+                    )
+                pods_list = [
+                    self.prepare_pods(tuning_ratio=t) for t in tunes
+                ]
+                return schedule_pods_sweep_multi(
+                    self, pods_list, w, seeds=seeds, bucket=bucket,
+                    fault_specs=faults,
                 )
             pods = self.prepare_pods()
             return schedule_pods_sweep_faults(
@@ -3473,6 +3487,7 @@ def schedule_pods_sweep(
 # replay service packs tune-differing jobs onto one compiled sweep.
 
 _SWEEP_MULTI_WRAP_CACHE = {}
+_SWEEP_MULTI_FAULT_WRAP_CACHE = {}
 _SWEEP_MULTI_METRICS_FN = None
 
 
@@ -3502,6 +3517,37 @@ def _sweep_engine_multi(engine, table: bool):
     return _SWEEP_MULTI_WRAP_CACHE[engine]
 
 
+def _sweep_multi_fault_engine(engine, table: bool):
+    """The chaos x tune lift (ISSUE 12): jit(vmap(engine)) over per-lane
+    (specs, type_id, MERGED fault streams, key, weights, rank, fault
+    ops) — the union of _sweep_engine_multi's per-lane trace operands
+    and _sweep_fault_engine's per-lane fault operands. Cluster state,
+    the distinct type set, typical pods, the shared tables, and the
+    initial fault carry broadcast, so mixed fault/tune/weight jobs share
+    ONE compiled scan."""
+    from tpusim.sim.fault_lane import FaultOps
+    from tpusim.sim.table_engine import PodTypes
+    from tpusim.types import PodSpec
+
+    if engine not in _SWEEP_MULTI_FAULT_WRAP_CACHE:
+        spec0 = PodSpec(0, 0, 0, 0, 0, 0)
+        none_spec = PodSpec(*(None,) * 6)
+        fops_axes = FaultOps(0, 0, 0, 0, 0, None)
+        if table:
+            # (state, pods, types, evk, evp, tp, key, wts, rank, tables,
+            #  fault_ops, fault_carry0)
+            in_axes = (None, spec0, PodTypes(none_spec, none_spec, 0),
+                       0, 0, None, 0, 0, 0, None, fops_axes, None)
+        else:
+            # (state, pods, evk, evp, tp, key, wts, rank, fault_ops,
+            #  fault_carry0)
+            in_axes = (None, spec0, 0, 0, None, 0, 0, 0, fops_axes, None)
+        _SWEEP_MULTI_FAULT_WRAP_CACHE[engine] = jax.jit(
+            jax.vmap(engine, in_axes=in_axes)
+        )
+    return _SWEEP_MULTI_FAULT_WRAP_CACHE[engine]
+
+
 def _sweep_multi_metrics_fn():
     """compute_event_metrics vmapped over per-lane specs/events (the
     _batched_metrics_fn axes): ONE cluster, per-lane workloads."""
@@ -3521,7 +3567,7 @@ def _sweep_multi_metrics_fn():
 
 def schedule_pods_sweep_multi(
     sim: "Simulator", pods_list, weights, seeds=None, bucket: int = 512,
-    min_pods: int = 0, min_events: int = 0,
+    min_pods: int = 0, min_events: int = 0, fault_specs=None,
 ) -> List[SweepLane]:
     """Evaluate B what-if configurations that may each carry their OWN
     workload (tuned trace variants of one cluster — the tune-factor
@@ -3535,7 +3581,18 @@ def schedule_pods_sweep_multi(
     concat-dedup across lanes (the schedule_pods_batch discipline, which
     pins that a shared sorted type set replays identically) and the
     weight-independent score tables are built once and broadcast.
-    Engine selection mirrors schedule_pods_sweep."""
+    Engine selection mirrors schedule_pods_sweep.
+
+    `fault_specs` (ISSUE 12, the chaos x tune lift): an optional
+    length-B list of per-lane fault schedules — FaultConfig /
+    (FaultConfig, events) per resolve_fault_spec, or None for a
+    fault-free lane riding the faulted build under an empty schedule.
+    Each lane's schedule is compiled against ITS OWN tuned base stream
+    (the merged per-lane streams replace the base event operands), so
+    mixed fault/tune/weight jobs share one compiled scan and each lane
+    stays bit-identical to the standalone run_with_faults run over that
+    tuned trace (given the sweep's unified retry-queue capacity —
+    explicit queue_capacity pins it, the chaos-sweep contract)."""
     from tpusim.ops.frag import cluster_frag_amounts
     from tpusim.sim.table_engine import (
         build_pod_types,
@@ -3552,6 +3609,18 @@ def schedule_pods_sweep_multi(
             f"pods_list has {len(pods_list)} traces for {b} weight rows "
             "(want one workload per config lane)"
         )
+    if fault_specs is not None:
+        if len(fault_specs) != b:
+            raise ValueError(
+                f"fault_specs has {len(fault_specs)} entries for {b} "
+                "weight rows (want one fault schedule — or None — per "
+                "lane)"
+            )
+        if cfg.use_timestamps:
+            raise ValueError(
+                "the chaos sweep replays creation-ordered traces "
+                "(use_timestamps=False)"
+            )
     if sim.typical is None:
         sim.set_typical_pods()
 
@@ -3607,12 +3676,6 @@ def schedule_pods_sweep_multi(
     padded = [
         _pad_specs(s, p2, tid, xp=np) for s, tid in zip(specs_list, tids)
     ]
-    padded_ev = [
-        _pad_events(
-            np.asarray(kk, np.int32), np.asarray(pp, np.int32), e2, xp=np
-        )
-        for kk, pp in ev_list
-    ]
     specs_b = PodSpec(
         *(
             jnp.asarray(np.stack([np.asarray(getattr(sp, f))
@@ -3620,8 +3683,6 @@ def schedule_pods_sweep_multi(
             for f in PodSpec._fields
         )
     )
-    ev_kind_b = jnp.asarray(np.stack([kk for kk, _ in padded_ev]))
-    ev_pod_b = jnp.asarray(np.stack([pp for _, pp in padded_ev]))
     keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
     ranks = jnp.stack(
         [jnp.asarray(tiebreak_rank(len(sim.nodes), s)) for s in seeds]
@@ -3629,6 +3690,27 @@ def schedule_pods_sweep_multi(
     weights_d = jnp.asarray(w)
     state = sim.init_state
     true_events = sum(len(kk) for kk, _ in ev_list)
+
+    if fault_specs is not None:
+        if use_table:
+            types = types._replace(
+                type_id=jnp.asarray(np.stack([tid for _, tid in padded]))
+            )
+            types = pad_pod_types(types)
+        return _dispatch_sweep_multi_faults(
+            sim, fault_specs, specs_list, ev_list, specs_b, types,
+            use_table, keys, weights_d, ranks, w, seeds, state, p2,
+            bucket,
+        )
+
+    padded_ev = [
+        _pad_events(
+            np.asarray(kk, np.int32), np.asarray(pp, np.int32), e2, xp=np
+        )
+        for kk, pp in ev_list
+    ]
+    ev_kind_b = jnp.asarray(np.stack([kk for kk, _ in padded_ev]))
+    ev_pod_b = jnp.asarray(np.stack([pp for _, pp in padded_ev]))
 
     if use_table:
         types = types._replace(
@@ -3712,6 +3794,153 @@ def schedule_pods_sweep_multi(
         )
         for i in range(b)
     ]
+
+
+def _dispatch_sweep_multi_faults(
+    sim, fault_specs, specs_list, ev_list, specs_b, types, use_table,
+    keys, weights_d, ranks, w, seeds, state, p2, bucket,
+):
+    """The fault tail of schedule_pods_sweep_multi (ISSUE 12): per-lane
+    fault plans compiled against each lane's OWN tuned base stream, the
+    merged streams replacing the base event operands. The sticky
+    per-Simulator chaos shape floors (`sim._chaos_hw` — merged-stream
+    length, draw rows, queue capacity, frag flag) are shared with
+    schedule_pods_sweep_faults, so a service family's consecutive mixed
+    fault/tune waves hold one compiled executable."""
+    from tpusim.ops.frag import cluster_frag_amounts
+    from tpusim.sim import fault_lane
+    from tpusim.sim.engine import make_replay
+    from tpusim.sim.faults import FaultConfig
+    from tpusim.sim.table_engine import make_table_replay
+
+    cfg = sim.cfg
+    b = len(specs_list)
+    resolved = []
+    for spec, (kinds_l, _) in zip(fault_specs, ev_list):
+        if spec is None:
+            # a fault-free lane of a mixed batch: an empty schedule is
+            # an exact no-op on the fault lane (no merged steps beyond
+            # the base stream, the carry never moves)
+            resolved.append((FaultConfig(), []))
+        else:
+            resolved.append(
+                resolve_fault_spec(spec, len(sim.nodes), len(kinds_l))
+            )
+    hw_em, hw_rows, hw_cap, hw_rec = getattr(
+        sim, "_chaos_hw", (0, 0, 0, False)
+    )
+    capacity = max(
+        max(
+            fault_lane.resolve_capacity(fcfg, int(s.cpu.shape[0]))
+            for (fcfg, _), s in zip(resolved, specs_list)
+        ),
+        hw_cap,
+    )
+    plan_cache: dict = {}
+    plans = []
+    for (fcfg, events), (kinds_l, pods_l) in zip(resolved, ev_list):
+        key = (repr(fcfg), tuple(events), len(kinds_l))
+        plan = plan_cache.get(key)
+        if plan is None:
+            plan = fault_lane.compile_fault_plan(
+                kinds_l, pods_l, events, fcfg, len(sim.nodes),
+                int(specs_b.cpu.shape[1]), capacity=capacity,
+            )
+            plan_cache[key] = plan
+        plans.append(plan)
+    (kinds, idxs, poss, args, auxs, draws, params, capacity, has_rec) = (
+        fault_lane.pad_fault_plans(
+            plans, bucket=bucket, min_stream=hw_em, min_rows=hw_rows,
+        )
+    )
+    e_m = int(kinds.shape[1])
+    has_rec = bool(has_rec or hw_rec)
+    sim._chaos_hw = (e_m, int(draws.shape[1]), capacity, has_rec)
+
+    ops = fault_lane.FaultOps(
+        pos=jnp.asarray(poss), arg=jnp.asarray(args),
+        aux=jnp.asarray(auxs), draws=jnp.asarray(draws),
+        params=jnp.asarray(params), gcnt=jnp.asarray(state.gpu_cnt),
+    )
+    fc0 = fault_lane.init_fault_carry(p2, state.num_nodes, capacity)
+    kinds_d, idxs_d = jnp.asarray(kinds), jnp.asarray(idxs)
+    true_events = sum(len(kk) for kk, _ in ev_list)
+
+    if use_table:
+        key0 = jax.random.PRNGKey(seeds[0])
+        table_fn = make_table_replay(
+            sim._policy_fns, gpu_sel=cfg.gpu_sel_method, report=False,
+            block_size=cfg.block_size, faults=True, fault_frag=has_rec,
+        )
+        tables = sim._cached_tables(state, types, key0)
+        if tables is None:
+            with sim.obs.span("init_tables", cache="sweep-shared") as h:
+                tables = table_fn.engine.build_tables(
+                    state, types, sim.typical, key0
+                )
+                h.dispatched()
+        fn = _sweep_multi_fault_engine(table_fn.engine.replay, table=True)
+        sim._last_sweep_fn = fn  # executables() tracking (svc worker)
+        sim._last_engine = f"table ({b}-lane chaos x trace sweep)"
+        out = sim._dispatch_span(
+            lambda: fn(
+                state, specs_b, types, kinds_d, idxs_d, sim.typical,
+                keys, weights_d, ranks, tables, ops, fc0,
+            ),
+            engine=sim._last_engine, events=true_events,
+        )
+    else:
+        seq_fn = make_replay(
+            sim._policy_fns, gpu_sel=cfg.gpu_sel_method, report=False,
+            faults=True, fault_frag=has_rec,
+        )
+        fn = _sweep_multi_fault_engine(seq_fn.engine, table=False)
+        sim._last_sweep_fn = fn  # executables() tracking (svc worker)
+        sim._last_engine = f"sequential ({b}-lane chaos x trace sweep)"
+        out = sim._dispatch_span(
+            lambda: fn(
+                state, specs_b, kinds_d, idxs_d, sim.typical, keys,
+                weights_d, ranks, ops, fc0,
+            ),
+            engine=sim._last_engine, events=true_events,
+        )
+    sim.obs.note_scan(sim._last_engine, counters=None, events=true_events)
+    sim.log.info(
+        f"[Engine] chaos x trace sweep of {b} lanes (merged stream "
+        f"{e_m}) ran on: {sim._last_engine}"
+    )
+    amounts = jax.jit(
+        jax.vmap(
+            lambda s, tp: cluster_frag_amounts(s, tp).sum(0),
+            in_axes=(0, None),
+        )
+    )(out.state, sim.typical)
+    with sim.obs.span("fetch", events=true_events):
+        out = device_fetch(out)
+        amounts = np.asarray(amounts)
+
+    gcnt_h = np.asarray(state.gpu_cnt)
+    lanes = []
+    for i in range(b):
+        ys_i = jax.tree.map(lambda a: np.asarray(a)[i], out.fault_ys)
+        fc_i = jax.tree.map(lambda a: np.asarray(a)[i], out.fault_carry)
+        dm, dead, attempts_run = fault_lane.assemble_disruption(
+            plans[i], ys_i, fc_i, gcnt_h
+        )
+        p_i = int(specs_list[i].cpu.shape[0])
+        e_i = plans[i].num_events
+        lane = _slice_sweep_lane(
+            out, amounts, i, w[i], seeds[i], p_i, e_i,
+            e_m - e_i - attempts_run,
+        )
+        lane.disruption = dm
+        lane.events = e_i + attempts_run
+        lane.unscheduled = int(
+            ((lane.placed_node < 0)
+             & (lane.ever_failed | dead[:p_i])).sum()
+        )
+        lanes.append(lane)
+    return lanes
 
 
 # ---------------------------------------------------------------------------

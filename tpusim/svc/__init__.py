@@ -1,24 +1,49 @@
-"""tpusim.svc — the queueing what-if replay service (ISSUE 7).
+"""tpusim.svc — the queueing what-if replay service (ISSUE 7), grown
+into a kill-tolerant worker fleet (ISSUE 12).
 
 Fuses the pieces the earlier rounds landed into simulation-as-a-service:
-POSTed what-if jobs (policy weights x seed x tune factor over a hosted
-trace) are content-digest-dedup'd (svc.jobs), grouped into compatible
-batches by jaxpr identity (svc.batcher), and served by ONE worker thread
-through the vmapped multi-trace sweep — one compiled scan per batch,
-zero recompiles across batches differing only in operands (svc.worker)
-— with an HTTP plane grown onto the PR 5 MonitorServer (svc.api) and a
-backpressure-honoring client (svc.client, `tpusim submit`).
+POSTed what-if jobs (policy weights x seed x tune factor x fault
+schedule over a hosted trace) are content-digest-dedup'd (svc.jobs),
+grouped into compatible batches by jaxpr identity (svc.batcher), and
+served through the vmapped multi-trace sweep — one compiled scan per
+batch, zero recompiles across batches differing only in operands
+(svc.worker) — with an HTTP plane grown onto the PR 5 MonitorServer
+(svc.api) and a backpressure-honoring client (svc.client, `tpusim
+submit`). The fleet layer (ISSUE 12): many worker PROCESSES drain the
+one queue under leased job ownership (svc.leases — signed lease files,
+renew-on-heartbeat, clock-skew-tolerant expiry) with orphan stealing
+(svc.batcher claim/steal, svc.fleet coordinator + `tpusim worker
+--join`); results are at-least-once but digest-idempotent, so a
+`kill -9` mid-batch costs a lease timeout, never a wrong or lost
+answer.
 """
 
 from tpusim.svc.api import JobService, start_job_server  # noqa: F401
-from tpusim.svc.batcher import Job, JobQueue, QueueFull  # noqa: F401
+from tpusim.svc.batcher import (  # noqa: F401
+    Job,
+    JobQueue,
+    QueueFull,
+    QuotaFull,
+)
+from tpusim.svc.fleet import (  # noqa: F401
+    FleetService,
+    WorkerRegistry,
+    run_worker,
+    spawn_local_workers,
+)
 from tpusim.svc.jobs import (  # noqa: F401
     JobSpec,
     docs_from_payload,
     find_result,
     job_digest,
     jobs_from_grid,
+    spec_to_payload,
     validate_job,
     write_result,
 )
-from tpusim.svc.worker import TraceRef, Worker, load_trace  # noqa: F401
+from tpusim.svc.worker import (  # noqa: F401
+    LeaseKeeper,
+    TraceRef,
+    Worker,
+    load_trace,
+)

@@ -30,7 +30,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from tpusim.svc import jobs as svc_jobs
-from tpusim.svc.batcher import JobQueue, QueueFull
+from tpusim.svc.batcher import JobQueue, QueueFull, QuotaFull
 from tpusim.svc.worker import TraceRef, Worker
 
 _JSON = "application/json"
@@ -46,17 +46,30 @@ def _json_body(code: int, doc, headers: Optional[dict] = None):
 class JobService:
     """The extension app MonitorServer routes /jobs and /queue to."""
 
-    def __init__(self, queue: JobQueue, worker: Worker,
+    def __init__(self, queue: JobQueue, worker: Optional[Worker],
                  traces: Dict[str, TraceRef], artifact_dir: str,
                  monitor=None):
         self.queue = queue
-        self.worker = worker
+        self.worker = worker  # in-process Worker, or None in fleet mode
         self.traces = dict(traces)
         self.artifact_dir = artifact_dir
         self.monitor = monitor
+        # the fleet coordinator app (svc.fleet.FleetService) when
+        # `serve --jobs --workers N` runs; None for the single
+        # in-process worker of PR 7
+        self.fleet = None
         # submit path serializes digest lookup + enqueue so concurrent
         # duplicate POSTs dedup instead of double-running
         self._submit_lock = threading.Lock()
+
+    def publish_job(self, job) -> None:
+        """Push a job's lifecycle change into the monitor's per-job
+        /progress map (the fleet completion path publishes here on the
+        worker's behalf)."""
+        if self.monitor is not None:
+            self.monitor.publish_job_progress(
+                job.id, {"status": job.status, "worker": job.worker or ""}
+            )
 
     # ---- submission (shared by HTTP and in-process callers) ----
 
@@ -115,7 +128,9 @@ class JobService:
                 400, {"error": 'want a job object or {"jobs": [...]}'}
             )
         accepted = []
-        for doc in docs:
+        first_429: Optional[QueueFull] = None
+        rejected_indices = []
+        for i, doc in enumerate(docs):
             try:
                 accepted.append(self.submit_payload(doc))
             except ValueError as err:
@@ -126,15 +141,28 @@ class JobService:
                     400, {"error": str(err), "accepted": accepted}
                 )
             except QueueFull as err:
-                # backpressure: whatever was accepted stands (dedup makes
-                # the client's retry of the full list safe), the rest
-                # should come back after Retry-After
-                return _json_body(
-                    429,
-                    {"error": str(err), "accepted": accepted,
-                     "retry_after_s": err.retry_after_s},
-                    headers={"Retry-After": str(err.retry_after_s)},
-                )
+                # backpressure: the rejected doc waits, but the REST of
+                # the batch still gets its admission attempt — a hot
+                # family at its quota must not block a cold family's
+                # jobs riding the same POST (the ISSUE 12 quota goal),
+                # and even on a full queue a later duplicate can still
+                # answer from the digest cache. The 429 body lists the
+                # rejected docs' indices so the client retries exactly
+                # those; a QuotaFull additionally names the family.
+                if first_429 is None:
+                    first_429 = err
+                rejected_indices.append(i)
+        if first_429 is not None:
+            body = {"error": str(first_429), "accepted": accepted,
+                    "rejected_indices": rejected_indices,
+                    "retry_after_s": first_429.retry_after_s}
+            if isinstance(first_429, QuotaFull):
+                body["family"] = first_429.family
+                body["family_quota"] = first_429.quota
+            return _json_body(
+                429, body,
+                headers={"Retry-After": str(first_429.retry_after_s)},
+            )
         all_cached = all(d["status"] == "done" for d in accepted)
         doc = {"jobs": accepted} if is_batch else accepted[0]
         return _json_body(200 if all_cached else 202, doc)
@@ -162,9 +190,16 @@ class JobService:
         return _json_body(200, job.result)
 
     def _get_queue(self):
+        """The aggregated /queue document (ISSUE 12): queue + quota
+        stats, plus — in fleet mode — the per-worker rows (depth served,
+        leases held, steals benefited, executables) and fleet totals;
+        in single-worker mode, the in-process worker's numbers."""
         stats = self.queue.stats()
-        stats["sweep_executables"] = self.worker.sweep_executables()
-        stats["batches_run"] = self.worker.batches_run
+        if self.worker is not None:
+            stats["sweep_executables"] = self.worker.sweep_executables()
+            stats["batches_run"] = self.worker.batches_run
+        if self.fleet is not None:
+            stats.update(self.fleet.queue_fields())
         stats["traces"] = sorted(self.traces)
         return _json_body(200, stats)
 
@@ -206,32 +241,53 @@ def start_job_server(
     lane_width: int = 8, queue_size: int = 64, bucket: int = 512,
     table_cache_dir: str = "", compile_cache_dir: str = "",
     start_worker: bool = True, recover: bool = True, out=None,
-) -> Tuple[object, JobService, Worker]:
+    fleet: bool = False, lease_s: float = 0.0, family_quota: int = 0,
+) -> Tuple[object, JobService, Optional[Worker]]:
     """Wire the full service: MonitorServer (+ heartbeat-fed /progress)
-    with the JobService app, a bounded JobQueue, and the single Worker
-    thread. Returns (server, service, worker); caller owns shutdown
-    (srv.begin_drain(); worker.stop(); srv.stop()). start_worker=False
-    leaves batch dispatch to the caller (deterministic tests);
-    recover=True requeues crash-interrupted jobs from the artifact dir
-    before the worker starts."""
+    with the JobService app, a bounded JobQueue, and either the single
+    in-process Worker thread (PR 7) or — fleet=True (ISSUE 12) — the
+    FleetService coordinator app (/workers/register|claim|renew|
+    complete) that external worker PROCESSES drain the queue through.
+    Returns (server, service, worker); worker is None in fleet mode.
+    Caller owns shutdown (srv.begin_drain(); worker.stop(); srv.stop()).
+    start_worker=False leaves batch dispatch to the caller
+    (deterministic tests); recover=True requeues crash-interrupted jobs
+    from the artifact dir before serving — in fleet mode it additionally
+    ADOPTS still-live lease files (a coordinator restart under live
+    workers must not double-hand-out their batches). `family_quota`
+    arms the per-family admission cap; `lease_s` overrides the lease
+    duration (svc.leases.DEFAULT_LEASE_S)."""
     from tpusim.obs.server import MonitorServer
 
     srv = MonitorServer(listen)
-    queue = JobQueue(maxsize=queue_size, lane_width=lane_width)
-    worker = Worker(
-        queue, traces, artifact_dir, bucket=bucket, monitor=srv,
-        table_cache_dir=table_cache_dir,
-        compile_cache_dir=compile_cache_dir,
-    )
+    queue = JobQueue(maxsize=queue_size, lane_width=lane_width,
+                     family_quota=family_quota, lease_s=lease_s)
+    worker = None
+    if not fleet:
+        worker = Worker(
+            queue, traces, artifact_dir, bucket=bucket, monitor=srv,
+            table_cache_dir=table_cache_dir,
+            compile_cache_dir=compile_cache_dir,
+        )
     service = JobService(queue, worker, traces, artifact_dir, monitor=srv)
+    service.bucket = bucket  # the register handshake hands it to workers
     srv.add_app(service)
+    if fleet:
+        from tpusim.svc.fleet import FleetService
+
+        service.fleet = FleetService(service, lease_s=lease_s, out=out)
+        srv.add_app(service.fleet)
+        # fleet /healthz: 503 only when NO worker is live
+        srv.health_hook = service.fleet.health
     if recover:
         # before start(): recovered jobs must be queued before the first
         # client request can observe the service
         recover_pending_jobs(service, out=out)
+        if service.fleet is not None:
+            service.fleet.adopt_leases(out=out)
     srv.start()
     srv.attach_heartbeat()
     srv.publish_progress(phase="serving-jobs")
-    if start_worker:
+    if start_worker and worker is not None:
         worker.start()
     return srv, service, worker

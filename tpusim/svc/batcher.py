@@ -1,20 +1,40 @@
-"""Batcher: the bounded FIFO job queue + batch formation (ISSUE 7).
+"""Batcher: the bounded FIFO job queue + batch formation (ISSUE 7),
+grown into the fleet's claim/steal plane (ISSUE 12).
 
 The queue is the service's backpressure boundary: `submit` on a full
 queue raises QueueFull, which the HTTP plane answers as 429 with a
 Retry-After header — the same contract tpusim.io.kube_client's retry
 loop already honors client-side (capped-exponential backoff, the
 server-provided delay wins), so a tpusim-built client dogpiles neither
-the service nor, transitively, the device.
+the service nor, transitively, the device. Per-family admission quotas
+(ISSUE 12 satellite) add a second 429 surface: `family_quota > 0` caps
+how deep any ONE job family may queue, so a hot trace cannot starve the
+rest — a quota overflow raises QuotaFull (a QueueFull subclass carrying
+the family label), distinguishable in the 429 body.
 
-Batch formation is FIFO with compatibility grouping: the next batch is
-the OLDEST queued job plus every other queued job sharing its family
-key (JobSpec.family_key — the jaxpr-identity rule: same trace + policy
+Batch formation is FIFO with compatibility grouping — the queue is
+logically SHARDED by family key: the next batch is the OLDEST queued
+job plus every other queued job sharing its family key
+(JobSpec.family_key — the jaxpr-identity rule: same trace + policy
 family + scoring methods + engine), in submission order, up to the
 worker's lane width. Jobs whose family differs ride later batches —
 possibly singleton lanes — so one incompatible job can delay but never
-starve the stream. Everything here is host-side bookkeeping under one
-lock; the single Worker thread is the only consumer.
+starve the stream.
+
+The fleet operations (ISSUE 12): `claim_batch(worker)` is batch
+formation with OWNERSHIP — claimed jobs carry the worker id and an
+in-memory lease deadline (mirroring the signed lease FILES the worker
+writes, svc.leases). `steal_expired()` is the orphan reaper: any job
+whose lease deadline passed without completion is requeued at the FRONT
+of its family shard in original submission order (steal ordering: an
+orphan never loses its place to younger work), so the next live
+worker's claim re-runs it. `renew()` pushes a live worker's deadlines
+out; `release_worker()` requeues everything a deregistered/dead worker
+held. Duplicate completions — a stolen job finished by BOTH the thief
+and a not-actually-dead original owner — are a silent dedup
+(`dup_completions` counter), never a conflict: job digests pin the
+trajectory, so both results are byte-identical. Everything here is
+host-side bookkeeping under one lock.
 """
 
 from __future__ import annotations
@@ -26,7 +46,8 @@ from typing import Dict, List, Optional
 
 from tpusim.svc.jobs import JobSpec
 
-# job lifecycle: queued -> batched -> running -> done | failed
+# job lifecycle: queued -> batched (claimed/leased) -> running ->
+# done | failed, with batched/running -> queued again on a steal
 # (dedup'd submissions adopt the original job — same id, same record)
 STATUSES = ("queued", "batched", "running", "done", "failed")
 
@@ -40,6 +61,26 @@ class QueueFull(RuntimeError):
             f"{retry_after_s}s"
         )
         self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+class QuotaFull(QueueFull):
+    """Per-family admission-quota overflow (ISSUE 12 satellite): the
+    queue has room, but THIS family's shard is at its cap — a hot trace
+    must not starve the rest. Same 429 + Retry-After surface, with the
+    family label in the body so clients can tell backpressure kinds
+    apart."""
+
+    def __init__(self, family: str, depth: int, quota: int,
+                 retry_after_s: int):
+        RuntimeError.__init__(
+            self,
+            f"family quota full ({depth}/{quota} queued for "
+            f"{family}); retry after {retry_after_s}s"
+        )
+        self.family = family
+        self.depth = depth
+        self.quota = quota
         self.retry_after_s = retry_after_s
 
 
@@ -58,6 +99,10 @@ class Job:
     error: str = ""
     submitted_unix: float = field(default_factory=time.time)
     finished_unix: float = 0.0
+    seq: int = 0  # submission order (steal requeue preserves it)
+    worker: str = ""  # owning worker id while claimed (ISSUE 12)
+    lease_deadline_unix: float = 0.0  # in-memory lease mirror
+    stolen: int = 0  # times this job was reclaimed from a dead worker
 
     def describe(self) -> dict:
         """The GET /jobs/<id> document."""
@@ -74,26 +119,40 @@ class Job:
         if self.batch >= 0:
             out["batch"] = self.batch
             out["lane"] = self.lane
+        if self.worker:
+            out["worker"] = self.worker
+        if self.stolen:
+            out["stolen"] = self.stolen
         if self.error:
             out["error"] = self.error
         return out
 
 
 class JobQueue:
-    """Bounded FIFO queue + job registry (thread-safe)."""
+    """Bounded, family-sharded FIFO queue + job registry (thread-safe).
+    `family_quota > 0` caps any one family's queued depth (QuotaFull);
+    `lease_s` is the in-memory lease duration claim_batch stamps on
+    claimed jobs (mirrored by the signed lease files, svc.leases)."""
 
     def __init__(self, maxsize: int = 64, lane_width: int = 8,
-                 retry_after_s: int = 2):
+                 retry_after_s: int = 2, family_quota: int = 0,
+                 lease_s: float = 0.0):
+        from tpusim.svc.leases import DEFAULT_LEASE_S
+
         if maxsize < 1 or lane_width < 1:
             raise ValueError(
                 f"maxsize and lane_width must be >= 1 "
                 f"(got {maxsize}, {lane_width})"
             )
+        if family_quota < 0:
+            raise ValueError(f"family_quota must be >= 0, got {family_quota}")
         self.maxsize = int(maxsize)
         self.lane_width = int(lane_width)
         self.retry_after_s = int(retry_after_s)
+        self.family_quota = int(family_quota)
+        self.lease_s = float(lease_s) if lease_s > 0 else DEFAULT_LEASE_S
         self._cond = threading.Condition()
-        self._queue: List[Job] = []  # submission order
+        self._queue: List[Job] = []  # submission order within shards
         self._jobs: Dict[str, Job] = {}  # id -> Job (all lifecycles)
         self._by_digest: Dict[str, Job] = {}  # digest -> canonical Job
         self._seq = 0
@@ -101,6 +160,11 @@ class JobQueue:
         self.stats_counters = {
             "submitted": 0, "dedup_hits": 0, "rejected": 0,
             "done": 0, "failed": 0,
+            # the fleet counters (ISSUE 12): quota 429s, orphan steals,
+            # lease expiries observed, and silently-dedup'd duplicate
+            # completions of stolen jobs
+            "quota_rejected": 0, "steals": 0, "lease_expired": 0,
+            "dup_completions": 0,
         }
 
     # ---- submission / lookup ----
@@ -111,7 +175,8 @@ class JobQueue:
         done) dedups to the existing Job — the duplicate never touches
         the queue or the device. `cached_result` short-circuits a fresh
         digest straight to done (the disk-cache hit). Raises QueueFull
-        when a genuinely new job meets a full queue."""
+        when a genuinely new job meets a full queue, QuotaFull when its
+        FAMILY shard is at the per-family admission cap."""
         with self._cond:
             existing = self._by_digest.get(digest)
             if existing is not None and existing.status != "failed":
@@ -129,6 +194,17 @@ class JobQueue:
             if len(self._queue) >= self.maxsize:
                 self.stats_counters["rejected"] += 1
                 raise QueueFull(len(self._queue), self.retry_after_s)
+            if self.family_quota > 0:
+                fam = spec.family_key()
+                depth = sum(
+                    1 for j in self._queue if j.spec.family_key() == fam
+                )
+                if depth >= self.family_quota:
+                    self.stats_counters["quota_rejected"] += 1
+                    raise QuotaFull(
+                        spec.family_label(), depth, self.family_quota,
+                        self.retry_after_s,
+                    )
             job = self._new_job(spec, digest)
             self._queue.append(job)
             self.stats_counters["submitted"] += 1
@@ -138,7 +214,7 @@ class JobQueue:
     def _new_job(self, spec: JobSpec, digest: str) -> Job:
         self._seq += 1
         job = Job(id=f"j{self._seq:05d}-{digest[:10]}", spec=spec,
-                  digest=digest)
+                  digest=digest, seq=self._seq)
         self._jobs[job.id] = job
         self._by_digest[digest] = job
         return job
@@ -147,22 +223,32 @@ class JobQueue:
         with self._cond:
             return self._jobs.get(job_id)
 
+    def get_by_digest(self, digest: str) -> Optional[Job]:
+        """The canonical Job of a digest (the fleet completion path is
+        digest-keyed: job IDs do not survive a coordinator restart,
+        digests do)."""
+        with self._cond:
+            return self._by_digest.get(digest)
+
     def depth(self) -> int:
         with self._cond:
             return len(self._queue)
 
-    # ---- batch formation (the single Worker thread's pop) ----
+    # ---- batch formation: the claim side of the lease protocol ----
 
-    def next_batch(self, timeout: Optional[float] = None,
-                   linger_s: float = 0.0) -> List[Job]:
-        """Pop the next batch: the oldest queued job + every queued job
-        sharing its family key, FIFO order, up to lane_width. Blocks up
-        to `timeout` for work; an empty list means none arrived.
-        `linger_s` is the batching window: once work exists, wait up to
-        that long for the rest of a concurrent submission wave to land
-        (a wave split across two batches costs two scans — and, when the
-        stragglers carry bigger tuned traces, a recompile the one-batch
-        form would have amortized)."""
+    def claim_batch(self, worker: str, timeout: Optional[float] = None,
+                    linger_s: float = 0.0,
+                    now: Optional[float] = None) -> List[Job]:
+        """Pop the next batch FOR `worker`: the oldest queued job + every
+        queued job sharing its family key (the family shard), FIFO
+        order, up to lane_width — each claimed job stamped with the
+        worker id and an in-memory lease deadline (now + lease_s).
+        Blocks up to `timeout` for work; an empty list means none
+        arrived. `linger_s` is the batching window: once work exists,
+        wait up to that long for the rest of a concurrent submission
+        wave to land (a wave split across two batches costs two scans —
+        and, when the stragglers carry bigger tuned traces, a recompile
+        the one-batch form would have amortized)."""
         with self._cond:
             if not self._queue:
                 self._cond.wait(timeout)
@@ -182,31 +268,172 @@ class JobQueue:
             taken = set(id(j) for j in batch)
             self._queue = [j for j in self._queue if id(j) not in taken]
             self._batches += 1
+            lease_deadline = (now if now is not None else time.time()) \
+                + self.lease_s
             for lane, job in enumerate(batch):
                 job.status = "batched"
                 job.batch = self._batches
                 job.lane = lane
+                job.worker = str(worker)
+                job.lease_deadline_unix = lease_deadline
             self._cond.notify_all()
             return batch
+
+    def next_batch(self, timeout: Optional[float] = None,
+                   linger_s: float = 0.0) -> List[Job]:
+        """Back-compat single-worker pop: claim_batch as 'local'."""
+        return self.claim_batch("local", timeout=timeout, linger_s=linger_s)
+
+    # ---- the steal/renew side (ISSUE 12) ----
+
+    def steal_expired(self, now: Optional[float] = None) -> List[Job]:
+        """The orphan reaper: every claimed-but-unfinished job whose
+        in-memory lease deadline has passed is requeued at the FRONT of
+        the queue in ORIGINAL submission order (steal ordering: an
+        orphan outranks younger queued work — it was admitted first and
+        has already waited a full lease), cleared of its owner, and
+        counted. Any live worker's next claim re-runs it; its result is
+        byte-identical by the digest argument, so even a not-actually-
+        dead owner racing the thief is harmless. Returns the stolen
+        jobs. In-memory deadlines share one clock, so no skew margin
+        applies here (the FILE judgement in svc.leases adds one)."""
+        if now is None:
+            now = time.time()
+        with self._cond:
+            stolen = [
+                j for j in self._jobs.values()
+                if j.status in ("batched", "running") and j.worker
+                and now > j.lease_deadline_unix
+            ]
+            if not stolen:
+                return []
+            stolen.sort(key=lambda j: j.seq)
+            for job in stolen:
+                job.status = "queued"
+                job.worker = ""
+                job.lease_deadline_unix = 0.0
+                job.batch = -1
+                job.lane = -1
+                job.stolen += 1
+            self.stats_counters["lease_expired"] += len(stolen)
+            self.stats_counters["steals"] += len(stolen)
+            self._queue = stolen + self._queue
+            self._cond.notify_all()
+            return stolen
+
+    def renew(self, worker: str, digests,
+              now: Optional[float] = None) -> "tuple":
+        """Push out the lease deadlines of `worker`'s in-flight jobs.
+        Returns (renewed digests, lost digests): a digest the worker no
+        longer owns — stolen after an expiry, or finished by a thief —
+        lands in `lost`, telling a slow-but-alive worker to stop
+        renewing (finishing the batch anyway is safe, just wasted
+        work)."""
+        if now is None:
+            now = time.time()
+        renewed, lost = [], []
+        with self._cond:
+            for digest in digests:
+                job = self._by_digest.get(digest)
+                if (job is not None and job.worker == str(worker)
+                        and job.status in ("batched", "running")):
+                    job.lease_deadline_unix = now + self.lease_s
+                    renewed.append(digest)
+                else:
+                    lost.append(digest)
+        return renewed, lost
+
+    def release_worker(self, worker: str) -> List[Job]:
+        """Requeue everything `worker` holds — the explicit form of
+        steal_expired for a worker KNOWN to be gone (deregistration, a
+        reaped child process): no need to wait out the lease. Counts as
+        steals, not lease expiries."""
+        with self._cond:
+            held = [
+                j for j in self._jobs.values()
+                if j.status in ("batched", "running")
+                and j.worker == str(worker)
+            ]
+            if not held:
+                return []
+            held.sort(key=lambda j: j.seq)
+            for job in held:
+                job.status = "queued"
+                job.worker = ""
+                job.lease_deadline_unix = 0.0
+                job.batch = -1
+                job.lane = -1
+                job.stolen += 1
+            self.stats_counters["steals"] += len(held)
+            self._queue = held + self._queue
+            self._cond.notify_all()
+            return held
+
+    def claim_specific(self, worker: str, digests,
+                       deadline_unix: float) -> List[Job]:
+        """Assign SPECIFIC queued jobs to a worker with an explicit
+        deadline — the coordinator-restart lease-adoption path (a live
+        lease file proves a worker already owns these jobs; handing
+        them out again would double-run). Returns the jobs actually
+        claimed (queued ones only)."""
+        with self._cond:
+            claimed = []
+            for digest in digests:
+                job = self._by_digest.get(digest)
+                if job is None or job.status != "queued":
+                    continue
+                self._queue = [j for j in self._queue if j is not job]
+                job.status = "batched"
+                job.worker = str(worker)
+                job.lease_deadline_unix = float(deadline_unix)
+                claimed.append(job)
+            return claimed
+
+    def jobs_of_worker(self, worker: str) -> List[Job]:
+        """The claimed/running jobs a worker currently owns (its live
+        leases — the /queue per-worker `leases_held` view)."""
+        with self._cond:
+            return [
+                j for j in self._jobs.values()
+                if j.status in ("batched", "running")
+                and j.worker == str(worker)
+            ]
 
     # ---- worker-side lifecycle transitions ----
 
     def mark_running(self, batch: List[Job]) -> None:
         with self._cond:
             for job in batch:
-                job.status = "running"
+                if job.status == "batched":
+                    job.status = "running"
 
     def mark_done(self, job: Job, result: dict) -> None:
+        """Complete a job. Completing an ALREADY-done job — the stolen-
+        job race: thief and presumed-dead owner both finish — is a
+        silent dedup (the results are byte-identical by construction;
+        the first completion stands)."""
         with self._cond:
+            if job.status == "done":
+                self.stats_counters["dup_completions"] += 1
+                return
             job.status = "done"
             job.result = result
+            job.worker = ""
+            job.lease_deadline_unix = 0.0
             job.finished_unix = time.time()
             self.stats_counters["done"] += 1
 
     def mark_failed(self, job: Job, error: str) -> None:
         with self._cond:
+            if job.status == "done":
+                # a late failure report for a job a thief already
+                # completed: the success stands (same dedup rule)
+                self.stats_counters["dup_completions"] += 1
+                return
             job.status = "failed"
             job.error = str(error)
+            job.worker = ""
+            job.lease_deadline_unix = 0.0
             job.finished_unix = time.time()
             self.stats_counters["failed"] += 1
             # a failed digest must not swallow future submissions of the
@@ -217,13 +444,26 @@ class JobQueue:
 
     # ---- introspection (the GET /queue document) ----
 
+    def family_depths(self) -> Dict[str, int]:
+        """Queued depth per family label — the admission-quota view."""
+        with self._cond:
+            out: Dict[str, int] = {}
+            for j in self._queue:
+                label = j.spec.family_label()
+                out[label] = out.get(label, 0) + 1
+            return out
+
     def stats(self) -> dict:
+        fams = self.family_depths()
         with self._cond:
             return {
                 "depth": len(self._queue),
                 "capacity": self.maxsize,
                 "lane_width": self.lane_width,
                 "batches_formed": self._batches,
+                "family_quota": self.family_quota,
+                "families": fams,
+                "lease_s": self.lease_s,
                 **self.stats_counters,
             }
 

@@ -131,13 +131,27 @@ def submit_jobs(url: str, docs: Sequence[dict], max_retries: int = 8,
         if code == 429:
             got = doc.get("accepted") or []
             accepted.extend(got)
-            pending = pending[len(got):]
+            rej = doc.get("rejected_indices")
+            if rej is not None:
+                # the service names exactly which docs it turned away
+                # (ISSUE 12: quota rejections can be non-prefix — a
+                # cold-family doc AFTER a quota-full one is accepted)
+                pending = [pending[i] for i in rej if i < len(pending)]
+            else:
+                pending = pending[len(got):]
             if attempt >= max_retries:
                 break
             delay = _retry_delay_s(attempt, headers.get("Retry-After"))
             if out is not None:
+                # a per-family admission quota 429 (ISSUE 12) names the
+                # hogging family — say so, it's actionable ("your trace
+                # is hot", not "the service is overloaded")
+                what = (
+                    f"family quota full for {doc['family']}"
+                    if doc.get("family") else "queue full"
+                )
                 print(
-                    f"[submit] queue full ({len(pending)} left), "
+                    f"[submit] {what} ({len(pending)} left), "
                     f"retrying in {delay:.1f}s", file=out,
                 )
             time.sleep(delay)

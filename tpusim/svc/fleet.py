@@ -1,0 +1,683 @@
+"""The worker fleet: many processes draining one JobQueue (ISSUE 12).
+
+PR 10 made ONE worker crash-safe (spec persistence, SIGTERM drain); this
+module promotes that per-worker lifecycle into a fleet protocol. The
+coordinator — `tpusim serve --jobs --workers N` — owns the HTTP plane,
+the bounded JobQueue, and the artifact dir; worker PROCESSES (spawned
+locally, or joined from other hosts with `tpusim worker --join URL`
+against a shared filesystem) pull batches over four POST endpoints:
+
+  /workers/register   identity + the hosting handshake: lease duration,
+                      lane width, artifact dir, and the hosted traces'
+                      CSV paths + content digests (the worker re-loads
+                      and digest-verifies them — version/trace skew
+                      fails loudly at join time, not as wrong results)
+  /workers/claim      the queue pop with OWNERSHIP: a family-sharded
+                      FIFO batch stamped with the worker id and a lease
+                      deadline; every claim first runs the orphan
+                      reaper (JobQueue.steal_expired), so ANY live
+                      worker's poll reclaims a dead worker's jobs —
+                      no operator action, no dedicated janitor
+  /workers/renew      deadline extension while a batch is in flight
+                      (the worker ALSO rewrites its signed lease files,
+                      svc.leases — the on-disk mirror that survives a
+                      coordinator restart)
+  /workers/complete   digest-keyed completion: the coordinator loads
+                      the signed result the worker wrote into the
+                      shared artifact dir; completing an already-done
+                      job (the stolen-job race) is a silent dedup
+
+At-least-once + idempotent = exactly-once results: a `kill -9` mid-batch
+loses nothing — the specs are on disk (PR 10), the lease expires, a live
+worker steals, and the re-run's result is byte-identical because the job
+digest pins the whole trajectory and result writes are atomic whole-file
+replaces. The shared warm state (the PR 6 persistent compile cache +
+content-keyed table cache) means a freshly joined worker's first batch
+skips the ~5 s compile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpusim.svc import jobs as svc_jobs
+from tpusim.svc import leases as svc_leases
+from tpusim.svc.api import _json_body
+from tpusim.svc.batcher import Job, JobQueue
+
+
+# ---------------------------------------------------------------------------
+# Worker registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class WorkerInfo:
+    """One registered worker's coordinator-side record."""
+
+    id: str
+    pid: int = 0
+    host: str = ""
+    joined_unix: float = field(default_factory=time.time)
+    last_seen_unix: float = field(default_factory=time.time)
+    claims: int = 0
+    batches: int = 0
+    jobs_done: int = 0
+    jobs_failed: int = 0
+    first_dispatch_s: float = 0.0
+    last_dispatch_s: float = 0.0
+    sweep_executables: int = 0
+    steals_benefited: int = 0  # stolen jobs this worker re-ran
+
+    def live(self, now: float, window_s: float) -> bool:
+        return (now - self.last_seen_unix) <= window_s
+
+
+class WorkerRegistry:
+    """The fleet roster. MonitorServer is a ThreadingHTTPServer, so
+    register/claim/renew/complete handlers run CONCURRENTLY — the
+    roster map and the auto-id counter are lock-guarded; the per-worker
+    stat fields are scalar writes only ever made by that worker's own
+    requests."""
+
+    def __init__(self, lease_s: float):
+        import threading
+
+        self.lease_s = float(lease_s)
+        self.workers: Dict[str, WorkerInfo] = {}
+        self._auto = 0
+        self._lock = threading.Lock()
+
+    @property
+    def live_window_s(self) -> float:
+        # three missed renewals = presumed dead for the LIVENESS view
+        # (lease expiry is judged per job, not per worker)
+        return max(3.0 * self.lease_s, 3.0)
+
+    def register(self, worker_id: str, pid: int, host: str) -> WorkerInfo:
+        with self._lock:
+            if not worker_id:
+                self._auto += 1
+                worker_id = f"w{self._auto:03d}-{pid or 0}"
+            info = self.workers.get(worker_id)
+            if info is None:
+                info = WorkerInfo(id=worker_id, pid=int(pid or 0),
+                                  host=str(host or ""))
+                self.workers[worker_id] = info
+            else:  # re-register after a coordinator restart or reconnect
+                info.pid = int(pid or info.pid)
+                info.host = str(host or info.host)
+                info.last_seen_unix = time.time()
+            return info
+
+    def touch(self, worker_id: str) -> Optional[WorkerInfo]:
+        with self._lock:
+            info = self.workers.get(worker_id)
+        if info is not None:
+            info.last_seen_unix = time.time()
+        return info
+
+    def live_count(self, now: Optional[float] = None) -> int:
+        if now is None:
+            now = time.time()
+        with self._lock:
+            snapshot = list(self.workers.values())
+        return sum(
+            1 for w in snapshot if w.live(now, self.live_window_s)
+        )
+
+    def describe(self, queue: Optional[JobQueue] = None) -> dict:
+        now = time.time()
+        rows = {}
+        with self._lock:
+            snapshot = list(self.workers.values())
+        for w in snapshot:
+            rows[w.id] = {
+                "pid": w.pid,
+                "host": w.host,
+                "live": w.live(now, self.live_window_s),
+                "last_seen_s": round(now - w.last_seen_unix, 2),
+                "claims": w.claims,
+                "batches": w.batches,
+                "jobs_done": w.jobs_done,
+                "jobs_failed": w.jobs_failed,
+                "steals_benefited": w.steals_benefited,
+                "sweep_executables": w.sweep_executables,
+                "first_dispatch_s": round(w.first_dispatch_s, 3),
+                "last_dispatch_s": round(w.last_dispatch_s, 3),
+                "leases_held": (
+                    len(queue.jobs_of_worker(w.id)) if queue else 0
+                ),
+            }
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# Coordinator-side HTTP app
+# ---------------------------------------------------------------------------
+
+
+class FleetService:
+    """The /workers/* extension app (MonitorServer.add_app) the job
+    coordinator mounts beside JobService. Holds the registry and the
+    steal/adopt logic; the JobQueue it drives is JobService's."""
+
+    def __init__(self, service, lease_s: float = 0.0, out=None):
+        self.service = service  # svc.api.JobService
+        self.queue: JobQueue = service.queue
+        if lease_s > 0:
+            self.queue.lease_s = float(lease_s)
+        self.registry = WorkerRegistry(self.queue.lease_s)
+        self.out = out
+        self.total_steals_cleaned = 0
+
+    # ---- request routing ----
+
+    def handle(self, method: str, path: str, body: bytes):
+        if not path.startswith("/workers"):
+            return None
+        if path == "/workers" and method == "GET":
+            return _json_body(
+                200, {"workers": self.registry.describe(self.queue),
+                      "live": self.registry.live_count()}
+            )
+        if method != "POST":
+            return _json_body(405, {"error": "method not allowed"})
+        try:
+            doc = json.loads(body.decode() or "null")
+        except (json.JSONDecodeError, UnicodeDecodeError) as err:
+            return _json_body(400, {"error": f"bad JSON body: {err}"})
+        if not isinstance(doc, dict):
+            return _json_body(400, {"error": "want a JSON object"})
+        if path == "/workers/register":
+            return self._register(doc)
+        if path == "/workers/claim":
+            return self._claim(doc)
+        if path == "/workers/renew":
+            return self._renew(doc)
+        if path == "/workers/complete":
+            return self._complete(doc)
+        return _json_body(404, {"error": f"unknown fleet path {path}"})
+
+    def _known(self, doc):
+        wid = str(doc.get("worker") or "")
+        info = self.registry.touch(wid)
+        if info is None:
+            # a coordinator restart wiped the roster: tell the worker to
+            # re-register (409 — the run_worker loop handles it)
+            return None, _json_body(
+                409, {"error": f"unknown worker {wid!r}", "register": True}
+            )
+        return info, None
+
+    def _register(self, doc):
+        info = self.registry.register(
+            str(doc.get("worker") or ""), doc.get("pid") or 0,
+            str(doc.get("host") or ""),
+        )
+        if self.out is not None:
+            print(f"[fleet] worker {info.id} joined (pid {info.pid})",
+                  file=self.out)
+        traces = {
+            name: {
+                "nodes_csv": t.nodes_csv, "pods_csv": t.pods_csv,
+                "max_pods": t.max_pods, "digest": t.digest,
+            }
+            for name, t in self.service.traces.items()
+        }
+        return _json_body(200, {
+            "worker": info.id,
+            "lease_s": self.queue.lease_s,
+            "lane_width": self.queue.lane_width,
+            "artifact_dir": os.path.abspath(self.service.artifact_dir),
+            "bucket": getattr(self.service, "bucket", 512),
+            "traces": traces,
+        })
+
+    def release_dead(self, pid: int) -> int:
+        """Instant reclaim for a worker KNOWN dead (the serve loop
+        reaped its child process): release everything it held — no
+        need to wait out the lease — and clean its lease files.
+        Returns the number of jobs released."""
+        with self.registry._lock:
+            wid = next(
+                (w.id for w in self.registry.workers.values()
+                 if w.pid == int(pid)), None,
+            )
+        if wid is None:
+            return 0
+        held = self.queue.release_worker(wid)
+        for job in held:
+            svc_leases.delete_lease(self.service.artifact_dir, job.digest)
+        if held and self.out is not None:
+            print(
+                f"[fleet] released {len(held)} job(s) of dead worker "
+                f"{wid} (pid {pid}) for immediate re-claim",
+                file=self.out,
+            )
+        return len(held)
+
+    def steal_sweep(self) -> List[Job]:
+        """Run the orphan reaper and clean the dead owners' lease files
+        (the coordinator's half of stealing; the re-claiming worker's
+        fresh lease write is the other half)."""
+        stolen = self.queue.steal_expired()
+        for job in stolen:
+            svc_leases.delete_lease(self.service.artifact_dir, job.digest)
+            if self.out is not None:
+                print(
+                    f"[fleet] lease expired on {job.id} "
+                    f"({job.digest[:12]}…) — requeued for stealing",
+                    file=self.out,
+                )
+        self.total_steals_cleaned += len(stolen)
+        return stolen
+
+    def _claim(self, doc):
+        info, err = self._known(doc)
+        if err is not None:
+            return err
+        self.steal_sweep()
+        info.claims += 1
+        batch = self.queue.claim_batch(info.id, timeout=0.0,
+                                       linger_s=0.05)
+        # stolen-but-already-finished shortcut: a thief's claim of a job
+        # whose (presumed dead, actually slow) owner DID write the
+        # signed result answers from disk — never re-runs the device
+        ready: List[Job] = []
+        for job in batch:
+            cached = svc_jobs.find_result(
+                self.service.artifact_dir, job.digest
+            )
+            if cached is not None:
+                self.queue.mark_done(job, cached)
+                svc_jobs.delete_job_spec(
+                    self.service.artifact_dir, job.digest
+                )
+                continue
+            if job.stolen:
+                info.steals_benefited += 1
+            ready.append(job)
+        deadline = time.time() + self.queue.lease_s
+        return _json_body(200, {
+            "jobs": [
+                {
+                    "id": j.id, "digest": j.digest,
+                    "spec": svc_jobs.spec_to_payload(j.spec),
+                    "stolen": j.stolen,
+                }
+                for j in ready
+            ],
+            "deadline_unix": deadline,
+            "lease_s": self.queue.lease_s,
+        })
+
+    def _renew(self, doc):
+        info, err = self._known(doc)
+        if err is not None:
+            return err
+        digests = doc.get("digests") or []
+        renewed, lost = self.queue.renew(info.id, digests)
+        return _json_body(200, {
+            "renewed": renewed, "lost": lost,
+            "deadline_unix": time.time() + self.queue.lease_s,
+        })
+
+    def _complete(self, doc):
+        info, err = self._known(doc)
+        if err is not None:
+            return err
+        done = doc.get("done") or []
+        failed = doc.get("failed") or {}
+        acked = dup = 0
+        for digest in done:
+            job = self.queue.get_by_digest(digest)
+            result = svc_jobs.find_result(
+                self.service.artifact_dir, digest
+            )
+            if job is None:
+                dup += 1  # finished after a restart reset the registry
+                continue
+            if result is None:
+                if job.worker != info.id:
+                    dup += 1  # a non-owner's resultless claim is noise
+                    continue
+                self.queue.mark_failed(
+                    job, "completion reported but no valid signed "
+                    "result on disk"
+                )
+                info.jobs_failed += 1
+                continue
+            before = self.queue.stats_counters["dup_completions"]
+            self.queue.mark_done(job, result)
+            if self.queue.stats_counters["dup_completions"] > before:
+                dup += 1
+            else:
+                acked += 1
+                info.jobs_done += 1
+            svc_jobs.delete_job_spec(self.service.artifact_dir, digest)
+            self.service.publish_job(job)
+        for digest, msg in failed.items():
+            job = self.queue.get_by_digest(digest)
+            if job is None:
+                continue
+            # only the CURRENT owner may fail a job: a stalled worker
+            # whose batch was stolen reports failures for jobs another
+            # worker is validly running (or that were requeued) — those
+            # reports are late noise, not verdicts. The done path needs
+            # no such guard (results are idempotent; failures are not).
+            if job.worker != info.id:
+                dup += 1
+                continue
+            self.queue.mark_failed(job, str(msg))
+            info.jobs_failed += 1
+            svc_jobs.delete_job_spec(
+                self.service.artifact_dir, digest
+            )
+            self.service.publish_job(job)
+        info.batches += 1
+        if doc.get("dispatch_s"):
+            info.last_dispatch_s = float(doc["dispatch_s"])
+            if not info.first_dispatch_s:
+                info.first_dispatch_s = float(doc["dispatch_s"])
+        if doc.get("sweep_executables") is not None:
+            info.sweep_executables = int(doc["sweep_executables"])
+        return _json_body(200, {"acked": acked, "dup": dup})
+
+    # ---- restart recovery (the lease-file half) ----
+
+    def adopt_leases(self, out=None) -> int:
+        """Coordinator-restart recovery (runs after recover_pending_jobs
+        requeued the pending specs): a job whose lease FILE is still
+        LIVE — within deadline + skew — belongs to a worker that may
+        well still be computing it, so re-attach the claim instead of
+        letting the queue hand it out twice; expired files are cleaned
+        (their jobs stay queued — already stolen, in effect). Returns
+        the number of adopted jobs."""
+        adopted = 0
+        for digest, lease in svc_leases.scan_leases(
+            self.service.artifact_dir
+        ):
+            job = self.queue.get_by_digest(digest)
+            if svc_leases.lease_expired(lease):
+                svc_leases.delete_lease(self.service.artifact_dir, digest)
+                self.queue.stats_counters["lease_expired"] += 1
+                continue
+            if job is None or job.status != "queued":
+                continue
+            wid = str(lease.get("worker") or "")
+            info = self.registry.register(
+                wid, lease.get("pid") or 0, ""
+            )
+            claimed = self.queue.claim_specific(
+                wid, [digest], float(lease["deadline_unix"])
+            )
+            adopted += len(claimed)
+            if claimed and out is not None:
+                print(
+                    f"[fleet] adopted live lease of {wid} on "
+                    f"{digest[:12]}… (deadline in "
+                    f"{lease['deadline_unix'] - time.time():.1f}s)",
+                    file=out,
+                )
+            info.last_seen_unix = time.time()
+        return adopted
+
+    # ---- the /queue aggregation fields ----
+
+    def queue_fields(self) -> dict:
+        rows = self.registry.describe(self.queue)
+        return {
+            "workers": rows,
+            "workers_live": self.registry.live_count(),
+            "batches_run": sum(r["batches"] for r in rows.values()),
+            "sweep_executables": sum(
+                r["sweep_executables"] for r in rows.values()
+            ),
+        }
+
+    def health(self):
+        """MonitorServer.health_hook: the fleet coordinator is healthy
+        while ANY worker is live; it degrades to 503 only when none
+        are (the ISSUE 12 /healthz contract)."""
+        live = self.registry.live_count()
+        return live > 0, {
+            "workers_live": live,
+            "workers_known": len(self.registry.workers),
+        }
+
+
+# ---------------------------------------------------------------------------
+# The worker process (`tpusim worker --join URL`)
+# ---------------------------------------------------------------------------
+
+
+def _post(url: str, path: str, doc: dict, timeout: float = 30.0):
+    from tpusim.svc.client import _request
+
+    return _request(
+        url.rstrip("/") + path,
+        json.dumps(doc).encode(), timeout=timeout,
+    )
+
+
+def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
+               max_batches: int = 0, table_cache_dir: str = "",
+               compile_cache_dir: str = "", out=None,
+               stop_event=None) -> int:
+    """The fleet worker's main loop: register, then claim/run/complete
+    until stopped (or `max_batches` served — the test/smoke bound).
+    Returns the number of batches served. SIGTERM handling is the
+    caller's (the CLI installs a drain flag via `stop_event`); a
+    `kill -9` needs no handling — that is what the leases are for."""
+    import http.client
+    import urllib.error
+
+    from tpusim.io.kube_client import _retry_delay_s
+    from tpusim.svc.client import ServiceError
+    from tpusim.svc.worker import Worker, load_trace
+
+    host = os.uname().nodename if hasattr(os, "uname") else ""
+    reg = None
+    for attempt in range(1, 9):
+        try:
+            code, _, reg = _post(url, "/workers/register", {
+                "worker": worker_id, "pid": os.getpid(), "host": host,
+            })
+        except (ConnectionResetError, ConnectionRefusedError,
+                http.client.RemoteDisconnected,
+                urllib.error.URLError):
+            # the coordinator may still be binding its socket
+            if attempt >= 8:
+                raise ServiceError(
+                    f"could not reach the coordinator at {url}"
+                )
+            time.sleep(_retry_delay_s(attempt))
+            continue
+        if code != 200:
+            raise ServiceError(
+                f"POST /workers/register -> HTTP {code}: {reg}"
+            )
+        break
+    wid = reg["worker"]
+    lease_s = float(reg["lease_s"])
+    artifact_dir = reg["artifact_dir"]
+
+    traces = {}
+    for name, meta in (reg.get("traces") or {}).items():
+        t = load_trace(
+            name, meta["nodes_csv"], meta["pods_csv"],
+            max_pods=int(meta.get("max_pods") or 0),
+        )
+        if t.digest != meta["digest"]:
+            # trace skew: this worker would compute results under a
+            # DIFFERENT digest vocabulary — refuse to serve
+            raise ServiceError(
+                f"hosted trace {name!r} digest mismatch: coordinator "
+                f"{meta['digest'][:12]}… vs local {t.digest[:12]}… "
+                "(differing CSVs or code version)"
+            )
+        traces[name] = t
+
+    queue = JobQueue(
+        maxsize=max(4 * int(reg["lane_width"]), 8),
+        lane_width=int(reg["lane_width"]), lease_s=lease_s,
+    )
+    worker = Worker(
+        queue, traces, artifact_dir, bucket=int(reg.get("bucket") or 512),
+        table_cache_dir=table_cache_dir,
+        compile_cache_dir=compile_cache_dir,
+        worker_id=wid, lease_files=True,
+    )
+
+    def renew_remote(digests):
+        code, _, doc = _post(url, "/workers/renew",
+                             {"worker": wid, "digests": list(digests)})
+        if code != 200:
+            return []
+        return doc.get("lost") or []
+
+    worker.renew_cb = renew_remote
+
+    from tpusim.sim.driver import enable_compile_cache
+
+    enable_compile_cache(compile_cache_dir)
+    if out is not None:
+        print(
+            f"[worker {wid}] joined {url} (pid {os.getpid()}, "
+            f"{len(traces)} trace(s), lease {lease_s:.1f}s)", file=out,
+        )
+
+    served = 0
+    while stop_event is None or not stop_event.is_set():
+        try:
+            code, _, doc = _post(url, "/workers/claim", {"worker": wid})
+        except (ConnectionResetError, ConnectionRefusedError,
+                http.client.RemoteDisconnected,
+                urllib.error.URLError):
+            # coordinator restarting: its recovery requeues everything;
+            # keep polling on the shared backoff schedule
+            time.sleep(max(poll_s, 0.5))
+            continue
+        if code == 409:
+            # roster wiped by a coordinator restart — re-register
+            _post(url, "/workers/register", {
+                "worker": wid, "pid": os.getpid(), "host": host,
+            })
+            continue
+        if code != 200:
+            time.sleep(max(poll_s, 0.5))
+            continue
+        jobs_docs = doc.get("jobs") or []
+        if not jobs_docs:
+            time.sleep(poll_s)
+            continue
+
+        batch, skew_failed = [], {}
+        for lane, jd in enumerate(jobs_docs):
+            try:
+                spec = svc_jobs.validate_job(jd["spec"])
+                digest = svc_jobs.job_digest(
+                    spec, traces[spec.trace].digest
+                )
+                if digest != jd["digest"]:
+                    raise ValueError(
+                        "job digest mismatch (coordinator/worker "
+                        "version skew)"
+                    )
+            except (KeyError, ValueError) as err:
+                skew_failed[jd.get("digest", "?")] = str(err)
+                continue
+            batch.append(Job(
+                id=jd["id"], spec=spec, digest=jd["digest"],
+                status="batched", batch=served + 1, lane=lane,
+                worker=wid,
+            ))
+        if batch:
+            worker.run_batch(batch)
+            served += 1
+        done = [j.digest for j in batch if j.status == "done"]
+        failed = {
+            j.digest: j.error for j in batch if j.status == "failed"
+        }
+        failed.update(skew_failed)
+        try:
+            _post(url, "/workers/complete", {
+                "worker": wid, "done": done, "failed": failed,
+                "dispatch_s": worker.last_dispatch_s,
+                "sweep_executables": worker.sweep_executables(),
+            })
+        except (ConnectionResetError, ConnectionRefusedError,
+                http.client.RemoteDisconnected,
+                urllib.error.URLError):
+            # results + spec deletions are already on disk — a restarted
+            # coordinator reconciles from there (its claim shortcut)
+            pass
+        if out is not None and batch:
+            print(
+                f"[worker {wid}] batch {served}: {len(done)} done, "
+                f"{len(failed)} failed "
+                f"({worker.last_dispatch_s:.2f}s dispatch)", file=out,
+            )
+        if max_batches and served >= max_batches:
+            break
+    worker.stop()
+    return served
+
+
+# ---------------------------------------------------------------------------
+# Local fleet spawning (`tpusim serve --jobs --workers N`)
+# ---------------------------------------------------------------------------
+
+
+def spawn_local_workers(url: str, n: int, table_cache_dir: str = "",
+                        compile_cache_dir: str = "",
+                        out=None) -> List[subprocess.Popen]:
+    """Spawn N `tpusim worker --join` processes against this
+    coordinator. They inherit the environment (JAX_PLATFORMS etc.) and
+    share the persistent compile cache + table cache dirs — the warm
+    state that makes a joiner's first batch skip the compile."""
+    procs = []
+    for _ in range(int(n)):
+        # no --id: the coordinator assigns pid-scoped ids, so a joiner
+        # spawned later can never collide with (and inherit the stats
+        # of) an earlier worker's roster entry
+        cmd = [sys.executable, "-m", "tpusim", "worker", "--join", url]
+        if table_cache_dir:
+            cmd += ["--table-cache-dir", table_cache_dir]
+        if compile_cache_dir:
+            cmd += ["--compile-cache-dir", compile_cache_dir]
+        procs.append(subprocess.Popen(cmd))
+        if out is not None:
+            print(f"[fleet] spawned worker process pid {procs[-1].pid}",
+                  file=out)
+    return procs
+
+
+def stop_workers(procs, timeout: float = 10.0, out=None) -> None:
+    """Drain the spawned fleet: SIGTERM each child (graceful — the
+    CLI's stop flag finishes the in-flight batch), escalate to SIGKILL
+    past the timeout (leases make even that safe)."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+    deadline = time.time() + timeout
+    for p in procs:
+        remaining = max(deadline - time.time(), 0.1)
+        try:
+            p.wait(remaining)
+        except subprocess.TimeoutExpired:
+            if out is not None:
+                print(f"[fleet] worker pid {p.pid} ignored SIGTERM — "
+                      "killing (leases cover it)", file=out)
+            p.kill()

@@ -80,18 +80,27 @@ class JobSpec:
         """Batching compatibility key — everything that shapes the
         compiled sweep's jaxpr. Weights, seed, tune factor, and the
         fault schedule are traced operands (ISSUE 6/7/10), so jobs
-        differing only in them pack onto one compiled scan. Two
-        exceptions: fault jobs batch separately from fault-free ones
-        (the fault build is a different jaxpr), and a fault batch pins
-        one tune factor (the chaos sweep replays ONE base trace; its
-        fault plans are compiled against that stream)."""
+        differing only in them pack onto one compiled scan. One
+        exception remains: fault jobs batch separately from fault-free
+        ones (the fault build is a different jaxpr). The tune pinning
+        fault batches used to carry is gone (ISSUE 12): the merged
+        fault stream is a per-lane operand of the multi-trace sweep, so
+        mixed fault/tune/weight jobs ride one compiled scan."""
         return (
             self.trace, tuple(n for n, _ in self.policies),
             self.gpu_sel, self.norm, self.dim_ext, self.engine,
             bool(self.fault),
-            float(self.tune) if self.fault else 0.0,
-            self.tune_seed if self.fault else 0,
         )
+
+    def family_label(self) -> str:
+        """Human/JSON-friendly rendering of family_key — the per-family
+        admission-quota surface in /queue and the QuotaFull 429 body
+        (ISSUE 12)."""
+        return "|".join((
+            self.trace, "+".join(n for n, _ in self.policies),
+            self.gpu_sel, self.norm, self.dim_ext, self.engine,
+            "fault" if self.fault else "nofault",
+        ))
 
     def canonical(self) -> tuple:
         """The digest's canonical form: every field, deterministic order,
@@ -236,6 +245,34 @@ def _as_int(v, what: str) -> int:
     if isinstance(v, bool) or not isinstance(v, int):
         raise ValueError(f"{what} must be an integer, got {v!r}")
     return int(v)
+
+
+def spec_to_payload(spec: JobSpec) -> dict:
+    """JobSpec -> the job document that validates back to the IDENTICAL
+    spec (and therefore digest) — the fleet claim handshake's wire form
+    (ISSUE 12): the coordinator hands claimed jobs to workers as
+    documents, the worker revalidates and digest-verifies them, so a
+    version-skewed worker fails the job loudly instead of silently
+    running a different replay. validate_job(spec_to_payload(s)) == s
+    is pinned by tests/test_fleet.py."""
+    doc = {
+        "trace": spec.trace,
+        "policies": [[n, int(w)] for n, w in spec.policies],
+        "weights": [int(w) for w in spec.weights],
+        "seed": int(spec.seed),
+        "gpu_sel": spec.gpu_sel,
+        "norm": spec.norm,
+        "dim_ext": spec.dim_ext,
+        "tune": float(spec.tune),
+        "tune_seed": int(spec.tune_seed),
+        "engine": spec.engine,
+    }
+    if spec.fault:
+        doc["fault"] = {
+            f: (float(v) if f.endswith("_events") else int(v))
+            for f, v in zip(FAULT_FIELDS, spec.fault)
+        }
+    return doc
 
 
 # keys an apply-style grid document may carry: the per-row vectors plus

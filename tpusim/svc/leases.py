@@ -1,0 +1,162 @@
+"""Leased job ownership — the fleet's crash-recovery plane (ISSUE 12).
+
+A lease is the on-disk claim a worker stakes on the jobs of one batch:
+`<job digest>.lease.json` beside the job's spec/result files in the
+artifact dir, written through the io.storage signed-JSON discipline
+(atomic tmp+rename, payload-digest header), naming the worker, its pid,
+the deadline, and the batch's full member list. The protocol:
+
+  claim    the worker writes one lease per batch member BEFORE
+           dispatching (os.replace also atomically overwrites a dead
+           predecessor's stale lease — stealing IS re-claiming).
+  renew    while the batch is in flight the worker rewrites its leases
+           with a pushed-out deadline — on heartbeat ticks when the scan
+           emits them, and on a fallback timer (the vmapped sweep strips
+           in-scan heartbeats), every lease_s/3.
+  release  completion deletes the lease; the signed result file is the
+           durable record from then on.
+  steal    a lease whose deadline passed (plus the clock-skew margin,
+           below) marks its jobs orphaned: any live worker may re-claim
+           them. Results stay byte-identical because the job digest pins
+           the trajectory and result writes are atomic whole-file
+           replaces of identical bytes — a duplicate completion by a
+           worker that was presumed dead (hung, then resumed) is a
+           silent no-op, not a conflict.
+
+Expiry honors a clock-skew margin (`TPUSIM_LEASE_SKEW_S`, default 2 s —
+the TPUSIM_EXEC_CRED_SKEW_S pattern, ISSUE 1): lease files may be
+judged by a DIFFERENT host than the one that wrote them, and a lease
+must never be stolen merely because two clocks disagree by a second.
+
+Torn/edited/foreign lease files are skipped AND deleted with a
+`[Degrade]` warning (the io.storage.load_valid_checkpoint pattern): a
+lost lease only makes its jobs steal-eligible immediately, which is
+always safe — content addressing guarantees a re-run converges on the
+same bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import List, Optional, Tuple
+
+LEASE_SUFFIX = ".lease.json"
+LEASE_SCHEMA = "tpusim-svc-lease/1"
+
+# default lease duration; the serve CLI's --lease-s. Renewal runs at a
+# third of it, so one missed renewal never expires a healthy worker.
+DEFAULT_LEASE_S = 15.0
+
+
+def lease_skew_s() -> float:
+    """Clock-skew margin added to every expiry judgement (env
+    TPUSIM_LEASE_SKEW_S, default 2 s). Malformed values fall back to
+    the default — a bad env var must not turn every lease immortal or
+    instantly stealable."""
+    raw = os.environ.get("TPUSIM_LEASE_SKEW_S", "")
+    if raw:
+        try:
+            return max(float(raw), 0.0)
+        except ValueError:
+            pass
+    return 2.0
+
+
+def lease_path(artifact_dir: str, digest: str) -> str:
+    return os.path.join(artifact_dir, f"{digest}{LEASE_SUFFIX}")
+
+
+def write_lease(artifact_dir: str, digest: str, worker: str, pid: int,
+                deadline_unix: float, members) -> str:
+    """Stake (or renew, or steal — os.replace is the whole story) one
+    job's lease. `members` is the batch's full digest list, so a single
+    surviving lease file names every sibling a reaper should check."""
+    from tpusim.io.storage import write_signed_json
+
+    header = {"schema": LEASE_SCHEMA, "job": digest}
+    doc = {
+        "worker": str(worker),
+        "pid": int(pid),
+        "deadline_unix": float(deadline_unix),
+        "members": [str(m) for m in members],
+    }
+    return write_signed_json(lease_path(artifact_dir, digest), header, doc)
+
+
+def _degrade(path: str, err) -> None:
+    print(
+        f"[Degrade] skipping torn/foreign lease file {path} "
+        f"({type(err).__name__}: {err}); deleted — its jobs are "
+        "steal-eligible now",
+        file=sys.stderr,
+    )
+
+
+def read_lease(artifact_dir: str, digest: str,
+               on_skip=None) -> Optional[dict]:
+    """The lease document for one job digest, or None. A file that fails
+    the signed-JSON verification (torn write on a non-atomic filesystem,
+    a hand edit, a foreign header) is DELETED and reported through
+    `on_skip(path, err)` (default: a `[Degrade]` stderr line) — the
+    load_valid_checkpoint pattern: never crash, never trust, never let a
+    bad file shadow future claims."""
+    from tpusim.io.storage import read_signed_json
+
+    path = lease_path(artifact_dir, digest)
+    if not os.path.isfile(path):
+        return None
+    try:
+        header, doc = read_signed_json(path, LEASE_SCHEMA)
+        if header.get("job") != digest:
+            raise ValueError("foreign lease file (job digest mismatch)")
+        if not isinstance(doc.get("worker"), str) or "deadline_unix" not in doc:
+            raise ValueError("malformed lease document")
+        return doc
+    except (OSError, ValueError, json.JSONDecodeError) as err:
+        (on_skip or _degrade)(path, err)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+
+
+def delete_lease(artifact_dir: str, digest: str) -> None:
+    try:
+        os.unlink(lease_path(artifact_dir, digest))
+    except OSError:
+        pass
+
+
+def lease_expired(lease: dict, now: Optional[float] = None,
+                  skew_s: Optional[float] = None) -> bool:
+    """True when the lease's deadline has passed by MORE than the
+    clock-skew margin — the only condition under which stealing is
+    legitimate. A lease from a clock `skew_s` ahead of ours is still
+    honored until the margin is exhausted."""
+    if now is None:
+        now = time.time()
+    if skew_s is None:
+        skew_s = lease_skew_s()
+    return float(now) > float(lease.get("deadline_unix", 0.0)) + skew_s
+
+
+def scan_leases(artifact_dir: str,
+                on_skip=None) -> List[Tuple[str, dict]]:
+    """Every (digest, lease doc) in the artifact dir, torn files skipped
+    and deleted (read_lease semantics) — the reaper's and the restart
+    recovery's work list."""
+    if not os.path.isdir(artifact_dir):
+        return []
+    out = []
+    for fname in sorted(os.listdir(artifact_dir)):
+        if not fname.endswith(LEASE_SUFFIX):
+            continue
+        digest = fname[: -len(LEASE_SUFFIX)]
+        doc = read_lease(artifact_dir, digest, on_skip=on_skip)
+        if doc is not None:
+            out.append((digest, doc))
+    return out

@@ -1,4 +1,5 @@
-"""Worker: ONE thread owns the device and serves every tenant (ISSUE 7).
+"""Worker: one batch-serving loop per process (ISSUE 7, fleet-grown in
+ISSUE 12).
 
 The worker drains the JobQueue batch by batch and dispatches each batch
 through the multi-trace vmapped sweep (driver.schedule_pods_sweep_multi)
@@ -10,8 +11,18 @@ FIXED lane width (a 3-job batch repeats its tail job into the dead
 lanes — vmap's axis size is jaxpr structure), and per-family pod/event
 shape high-water marks are sticky (the driver's min_pods/min_events
 floors), so consecutive batches differing only in weights/seeds/tune
-factors reuse ONE compiled executable — `jit._cache_size()` stable, the
-acceptance criterion.
+factors — and, since ISSUE 12, fault schedules: the chaos dispatch
+folded into the one path — reuse ONE compiled executable —
+`jit._cache_size()` stable, the acceptance criterion.
+
+Every batch runs under the lease protocol (ISSUE 12): run_batch stakes
+signed lease files before dispatching, a LeaseKeeper renews them on
+heartbeat ticks plus a fallback timer, and completion releases them —
+so a `kill -9`'d worker's batch is steal-eligible after one lease. The
+same Worker class serves both deployments: the single in-process thread
+of PR 7 (claiming from the shared queue directly) and the fleet worker
+process (svc.fleet.run_worker, claiming over HTTP with `renew_cb`
+pointed at the coordinator).
 
 Results are summarized host-side (placements, counters, gpu_alloc,
 frag, a placements digest for cheap bit-identity checks), persisted as
@@ -22,25 +33,132 @@ A batch that raises marks its jobs failed and the worker keeps serving
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from tpusim.svc import jobs as svc_jobs
+from tpusim.svc import leases as svc_leases
 from tpusim.svc.batcher import Job, JobQueue
+
+
+class LeaseKeeper:
+    """Renews a batch's leases while it is in flight (ISSUE 12): a
+    fallback timer fires every lease_s/3, and heartbeat ticks from the
+    scan poke an immediate renewal (the ISSUE's renew-on-heartbeat —
+    the timer covers vmapped sweeps, whose builds strip the in-scan
+    heartbeat). Each renewal rewrites the signed lease files AND calls
+    `renew_cb(digests)` — the queue update in-process, an HTTP POST on
+    a fleet worker. A renewal learning its leases were LOST (stolen
+    after a stall) just logs: finishing anyway is harmless — the
+    completion dedups."""
+
+    def __init__(self, artifact_dir: str, worker_id: str, lease_s: float,
+                 members: Sequence[str], renew_cb=None, out=None):
+        self.artifact_dir = artifact_dir
+        self.worker_id = worker_id
+        self.lease_s = float(lease_s)
+        self.members = [str(m) for m in members]
+        self.renew_cb = renew_cb
+        self.out = out
+        self._stop = threading.Event()
+        self._poke = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.renewals = 0
+
+    def renew_now(self) -> None:
+        # ask the authority FIRST: a digest the coordinator reports lost
+        # (stolen after a stall) now belongs to a thief whose lease file
+        # this keeper must never overwrite again — nor delete at stop()
+        # — so lost members leave the set before any file write
+        if self.renew_cb is not None:
+            try:
+                lost = set(self.renew_cb(self.members))
+            except Exception:
+                lost = set()  # coordinator unreachable: keep staking;
+                # it will steal if we really stall
+            if lost:
+                self.members = [m for m in self.members if m not in lost]
+                if self.out is not None:
+                    print(
+                        f"[worker {self.worker_id}] lease(s) lost to a "
+                        f"steal: "
+                        f"{', '.join(str(x)[:12] for x in sorted(lost))}"
+                        " — finishing anyway (duplicate completion "
+                        "dedups)",
+                        file=self.out,
+                    )
+        deadline = time.time() + self.lease_s
+        for d in self.members:
+            svc_leases.write_lease(
+                self.artifact_dir, d, self.worker_id, os.getpid(),
+                deadline, self.members,
+            )
+        self.renewals += 1
+
+    def on_heartbeat(self, _info) -> None:
+        """obs.heartbeat listener: a live scan tick proves the worker is
+        healthy — renew without waiting for the timer."""
+        self._poke.set()
+
+    def _loop(self) -> None:
+        period = max(self.lease_s / 3.0, 0.05)
+        last = time.time()
+        while not self._stop.is_set():
+            if self._poke.wait(period):
+                self._poke.clear()
+            if self._stop.is_set():
+                return
+            # heartbeat ticks can arrive many times a second — renewing
+            # more often than period/3 is pure churn
+            if time.time() - last >= period / 3.0:
+                self.renew_now()
+                last = time.time()
+
+    def start(self) -> "LeaseKeeper":
+        from tpusim.obs import heartbeat
+
+        self.renew_now()  # the initial claim stake
+        heartbeat.add_listener(self.on_heartbeat)
+        self._thread = threading.Thread(
+            target=self._loop, name="tpusim-lease-keeper", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, release: bool = True) -> None:
+        from tpusim.obs import heartbeat
+
+        self._stop.set()
+        self._poke.set()
+        heartbeat.remove_listener(self.on_heartbeat)
+        if self._thread is not None:
+            self._thread.join(2.0)
+            self._thread = None
+        if release:
+            for d in self.members:
+                svc_leases.delete_lease(self.artifact_dir, d)
 
 
 @dataclass
 class TraceRef:
     """One hosted trace: the cluster + workload every job of this ref
-    replays, plus their content digest (part of every job digest)."""
+    replays, plus their content digest (part of every job digest). The
+    CSV source paths ride along when load_trace built it — the fleet
+    register handshake (ISSUE 12) hands them to joining workers, which
+    re-load and digest-verify the trace themselves."""
 
     name: str
     nodes: list
     pods: list
     digest: str
+    nodes_csv: str = ""
+    pods_csv: str = ""
+    max_pods: int = 0
 
 
 def load_trace(name: str, nodes_csv: str, pods_csv: str,
@@ -57,6 +175,9 @@ def load_trace(name: str, nodes_csv: str, pods_csv: str,
     return TraceRef(
         name=name, nodes=nodes, pods=pods,
         digest=svc_jobs.trace_digest(nodes, pods),
+        nodes_csv=os.path.abspath(nodes_csv),
+        pods_csv=os.path.abspath(pods_csv),
+        max_pods=int(max_pods),
     )
 
 
@@ -99,7 +220,8 @@ class Worker:
     def __init__(self, queue: JobQueue, traces: Dict[str, TraceRef],
                  artifact_dir: str, bucket: int = 512, monitor=None,
                  table_cache_dir: str = "", compile_cache_dir: str = "",
-                 linger_s: float = 0.05):
+                 linger_s: float = 0.05, worker_id: str = "",
+                 lease_files: bool = True):
         self.queue = queue
         self.traces = dict(traces)
         self.artifact_dir = artifact_dir
@@ -108,10 +230,23 @@ class Worker:
         self.table_cache_dir = table_cache_dir
         self.compile_cache_dir = compile_cache_dir
         self.linger_s = float(linger_s)  # batching window (JobQueue.next_batch)
+        # fleet identity (ISSUE 12): the id the lease files and the
+        # /queue per-worker rows carry; in-process workers default to a
+        # pid-scoped local id
+        self.worker_id = str(worker_id) or f"local-{os.getpid()}"
+        # lease files are the cross-process protocol; tests driving
+        # run_batch synchronously can switch them off
+        self.lease_files = bool(lease_files)
         self._sims: dict = {}  # family_key -> Simulator
         self._shape_hw: dict = {}  # family_key -> (max pods, max events)
         self._sweep_fns: set = set()  # jitted sweep wrappers dispatched
         self.batches_run = 0
+        self.last_dispatch_s = 0.0  # wall of the newest run_batch
+        self.first_dispatch_s = 0.0  # wall of the FIRST (compile) batch
+        # lease renewal sink: digests -> lost list. In-process workers
+        # renew the shared queue directly; a fleet worker (svc.fleet)
+        # swaps in the coordinator's POST /workers/renew.
+        self.renew_cb = lambda ds: self.queue.renew(self.worker_id, ds)[1]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -135,8 +270,11 @@ class Worker:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            batch = self.queue.next_batch(
-                timeout=0.2, linger_s=self.linger_s
+            # reap orphans first: with several in-process workers on one
+            # queue, any live worker's idle pass reclaims expired leases
+            self.queue.steal_expired()
+            batch = self.queue.claim_batch(
+                self.worker_id, timeout=0.2, linger_s=self.linger_s
             )
             if batch:
                 self.run_batch(batch)
@@ -168,15 +306,30 @@ class Worker:
             sim.set_workload_pods(trace.pods)
             sim.set_typical_pods()
             self._sims[key] = sim
+        # tag scans with this worker's id (obs.heartbeat, ISSUE 12): a
+        # fleet's /progress streams say WHICH worker is scanning
+        sim._hb_worker = self.worker_id
         return sim
 
     # ---- the batch dispatch ----
 
     def run_batch(self, batch: List[Job]) -> None:
-        """Serve one compatible batch through a single vmapped sweep.
-        Public so smoke/tests can drive it synchronously."""
+        """Serve one compatible batch through a single vmapped sweep,
+        under the lease protocol (ISSUE 12): signed lease files are
+        staked before dispatch, renewed while the scan runs (heartbeat
+        ticks + the fallback timer), and released on completion — a
+        `kill -9` mid-batch leaves expired leases any live worker can
+        steal. Public so smoke/tests can drive it synchronously."""
         self.queue.mark_running(batch)
         self._publish(batch, phase="running")
+        members = [j.digest for j in batch]
+        keeper = None
+        if self.lease_files:
+            keeper = LeaseKeeper(
+                self.artifact_dir, self.worker_id, self.queue.lease_s,
+                members, renew_cb=self.renew_cb,
+            ).start()
+        t0 = time.perf_counter()
         try:
             lanes = self._dispatch(batch)
         except Exception as err:  # poisoned family: fail the jobs, live on
@@ -186,25 +339,37 @@ class Worker:
                 # terminal: drop the persisted spec so restart recovery
                 # does not re-run the poisoned batch forever
                 svc_jobs.delete_job_spec(self.artifact_dir, job.digest)
+            if keeper is not None:
+                keeper.stop(release=True)
             self._publish(batch, phase="failed", error=msg)
             return
+        self.last_dispatch_s = time.perf_counter() - t0
+        if self.batches_run == 0:
+            self.first_dispatch_s = self.last_dispatch_s
         for job, lane in zip(batch, lanes):
             result = summarize_lane(lane, job)
             svc_jobs.write_result(self.artifact_dir, job.digest, result)
             self.queue.mark_done(job, result)
             # terminal: the signed result is the durable record now
             svc_jobs.delete_job_spec(self.artifact_dir, job.digest)
+        if keeper is not None:
+            keeper.stop(release=True)
         self.batches_run += 1
         self._publish(batch, phase="done")
 
     def _dispatch(self, batch: List[Job]):
+        """ONE dispatch path for fault-free AND fault batches (the
+        ISSUE 12 fold): every batch rides schedule_pods_sweep_multi, and
+        a fault family simply adds per-lane fault schedules — compiled
+        against each lane's OWN tuned stream — as operands. Mixed
+        fault/tune/weight jobs of one family therefore share one
+        compiled scan (the family key no longer pins a tune factor for
+        fault jobs)."""
         from tpusim.sim.driver import (
             _sweep_engine_multi,
             schedule_pods_sweep_multi,
         )
 
-        if batch[0].spec.fault:
-            return self._dispatch_chaos(batch)
         sim = self._sim_for(batch[0])
         key = batch[0].spec.family_key()
         # tag the shared heartbeat stream with this batch's lead job so
@@ -221,14 +386,22 @@ class Worker:
         ]
         weights = [list(j.spec.weights) for j in batch]
         seeds = [j.spec.seed for j in batch]
+        faulted = bool(batch[0].spec.fault)
+        fault_specs = (
+            [j.spec.fault_config() for j in batch] if faulted else None
+        )
         # pad to the FIXED lane width by repeating the tail job: vmap's
         # axis size is jaxpr structure, so a short batch must not compile
-        # its own executable; dead lanes are sliced off below
+        # its own executable; dead lanes are sliced off below. The tail's
+        # PREPARED pods (and compiled fault plan, via the driver's plan
+        # cache) are reused, not recomputed per dead lane.
         n = len(batch)
         while len(weights) < self.queue.lane_width:
             pods_list.append(pods_list[-1])
             weights.append(weights[-1])
             seeds.append(seeds[-1])
+            if fault_specs is not None:
+                fault_specs.append(fault_specs[-1])
 
         # sticky per-family shape floors (see module docstring): without
         # them a later batch of slightly smaller tuned traces would land
@@ -237,7 +410,9 @@ class Worker:
         # (sweep_multi builds the same streams right after — this extra
         # host-side O(P) pass per lane is noise next to the scan), not a
         # bound: an inflated floor would pad dead EV_SKIPs into every
-        # future scan
+        # future scan. Fault families additionally keep their merged-
+        # stream/draw-table/capacity floors on the Simulator itself
+        # (sim._chaos_hw, the schedule_pods_sweep_faults discipline).
         from tpusim.io.trace import build_events
 
         p_max = max(len(p) for p in pods_list)
@@ -250,52 +425,25 @@ class Worker:
         self._shape_hw[key] = (hw_p, hw_e)
 
         sim._reset_run_state()
+        if sim.typical is None:
+            sim.set_typical_pods()
         lanes = schedule_pods_sweep_multi(
             sim, pods_list, np.asarray(weights, np.int32), seeds=seeds,
             bucket=self.bucket, min_pods=hw_p, min_events=hw_e,
+            fault_specs=fault_specs,
         )[:n]
         # track the jitted sweep wrapper actually dispatched so /queue
         # can report the compiled-executable count (the PR 6
         # jit._cache_size() zero-recompile check, now a live metric)
-        used_table = sim._last_engine.startswith("table")
-        self._sweep_fns.add(_sweep_engine_multi(
-            sim._table_fn.engine.replay if used_table
-            else sim.replay_fn.engine,
-            table=used_table,
-        ))
-        return lanes
-
-    def _dispatch_chaos(self, batch: List[Job]):
-        """Fault-job batches (ISSUE 10): ONE compiled chaos sweep — the
-        family key pins one (trace, tune), so every lane replays the
-        same base stream under its own fault schedule/weights/seed.
-        Lane-vs-standalone bit-identity and the zero-recompile contract
-        are the driver's (schedule_pods_sweep_faults)."""
-        from tpusim.sim.driver import schedule_pods_sweep_faults
-
-        sim = self._sim_for(batch[0])
-        sim._hb_job = batch[0].id
-        pods = sim.prepare_pods(
-            tuning_ratio=batch[0].spec.tune,
-            tuning_seed=batch[0].spec.tune_seed,
-        )
-        jobs = list(batch)
-        n = len(batch)
-        while len(jobs) < self.queue.lane_width:
-            jobs.append(jobs[-1])  # tail-repeat padding (vmap axis size)
-        weights = np.asarray(
-            [list(j.spec.weights) for j in jobs], np.int32
-        )
-        seeds = [j.spec.seed for j in jobs]
-        fault_specs = [j.spec.fault_config() for j in jobs]
-        sim._reset_run_state()
-        if sim.typical is None:
-            sim.set_typical_pods()
-        lanes = schedule_pods_sweep_faults(
-            sim, pods, weights, fault_specs, seeds=seeds,
-            bucket=self.bucket,
-        )[:n]
-        self._sweep_fns.add(sim._last_sweep_fn)
+        if faulted:
+            self._sweep_fns.add(sim._last_sweep_fn)
+        else:
+            used_table = sim._last_engine.startswith("table")
+            self._sweep_fns.add(_sweep_engine_multi(
+                sim._table_fn.engine.replay if used_table
+                else sim.replay_fn.engine,
+                table=used_table,
+            ))
         return lanes
 
     # ---- introspection ----
