@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_transfer.py tests/test_supervisor.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -128,6 +128,21 @@ mesh-chaos-smoke:
 fleet-chaos-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --fleet-chaos-only
 
+# fleet-wan smoke (ENGINES.md "Round 17"): the wide-area fleet with NO
+# shared filesystem — a coordinator hosting TWO traces behind a flaky
+# HTTP shim (drops/delays ~20% of transfer requests), a supervisor
+# spawning remote-mode workers with fully isolated per-worker dirs
+# (digest-verified trace downloads, signed-result uploads, lease
+# POSTs), a random `kill -9` of a remote worker mid-batch, and a
+# forced crash loop. Hard checks: 100% completion with per-file byte
+# identity vs the single-worker reference, the supervisor's respawn
+# counter >= 1 in /queue, remote transfer counters live in /workers, a
+# torn upload rejected with nothing written, and the crash loop
+# tripping the circuit breaker into a loud degraded /healthz instead
+# of spinning.
+fleet-wan-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --fleet-wan-only
+
 # bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
 # measurement under profiling and diff it against the newest committed
 # BENCH_r*.json baseline — exact on events/placements/gpu_alloc
@@ -143,8 +158,10 @@ fleet-chaos-smoke:
 # zero recompiles across waves, lane-vs-standalone disruption
 # reconciliation), and the worker fleet (ISSUE 12, the
 # fleet-chaos-smoke check: kill -9 mid-batch, orphan stealing,
-# byte-identical results, warm-joiner compile skip). Exit 1 on
-# regression; artifacts land in .tpusim_obs/.
+# byte-identical results, warm-joiner compile skip), and the wide-area
+# fleet (ISSUE 13, the fleet-wan-smoke check: no-shared-fs workers
+# under injected transfer faults, supervisor respawn, circuit
+# breaker). Exit 1 on regression; artifacts land in .tpusim_obs/.
 bench-gate:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate
 

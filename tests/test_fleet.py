@@ -95,7 +95,11 @@ def _submit(queue, trace, i=0, **kw):
 # ---------------------------------------------------------------------------
 
 
-def test_lease_file_roundtrip(tmp_path):
+def test_lease_file_roundtrip_and_torn_degrade(tmp_path):
+    """Signed round-trip, then the degrade ladder: a torn/edited lease
+    is skipped AND deleted with a [Degrade] callback — never trusted,
+    never fatal, never shadowing re-claims. (Merged from two cases in
+    the ISSUE 13 tier-1 trim.)"""
     art = str(tmp_path)
     path = svc_leases.write_lease(
         art, "d" * 64, "w001", 1234, 1000.5, ["d" * 64, "e" * 64]
@@ -108,12 +112,6 @@ def test_lease_file_roundtrip(tmp_path):
     assert [d for d, _ in svc_leases.scan_leases(art)] == ["d" * 64]
     svc_leases.delete_lease(art, "d" * 64)
     assert svc_leases.read_lease(art, "d" * 64) is None
-
-
-def test_lease_torn_file_degrades(tmp_path):
-    """A torn/edited lease is skipped AND deleted with a [Degrade]
-    callback — never trusted, never fatal, never shadowing re-claims."""
-    art = str(tmp_path)
     svc_leases.write_lease(art, "a" * 64, "w001", 1, 99.0, ["a" * 64])
     path = svc_leases.lease_path(art, "a" * 64)
     with open(path) as f:
@@ -156,8 +154,37 @@ def test_lease_expiry_skew_margin(monkeypatch):
     assert svc_leases.lease_expired(lease, now=131.0)
     # explicit skew overrides the env
     assert svc_leases.lease_expired(lease, now=101.0, skew_s=0.5)
+
+
+def test_lease_env_knobs_fail_loudly(monkeypatch):
+    """ISSUE 13 satellite: an unparseable/out-of-range float env knob
+    raises at read with a message NAMING the variable — a typo'd skew
+    must not silently make every lease immortal or instantly
+    stealable."""
     monkeypatch.setenv("TPUSIM_LEASE_SKEW_S", "not-a-number")
-    assert svc_leases.lease_skew_s() == 2.0  # falls back, never raises
+    with pytest.raises(ValueError, match="TPUSIM_LEASE_SKEW_S"):
+        svc_leases.lease_skew_s()
+    monkeypatch.setenv("TPUSIM_LEASE_SKEW_S", "-3")
+    with pytest.raises(ValueError, match="TPUSIM_LEASE_SKEW_S"):
+        svc_leases.lease_skew_s()
+    monkeypatch.setenv("TPUSIM_LEASE_SKEW_S", "inf")
+    with pytest.raises(ValueError, match="TPUSIM_LEASE_SKEW_S"):
+        svc_leases.lease_skew_s()
+    monkeypatch.delenv("TPUSIM_LEASE_SKEW_S")
+    assert svc_leases.lease_skew_s() == 2.0
+
+    monkeypatch.setenv("TPUSIM_LEASE_S", "ten")
+    with pytest.raises(ValueError, match="TPUSIM_LEASE_S"):
+        svc_leases.default_lease_s()
+    monkeypatch.setenv("TPUSIM_LEASE_S", "0")
+    with pytest.raises(ValueError, match="TPUSIM_LEASE_S"):
+        svc_leases.default_lease_s()
+    monkeypatch.setenv("TPUSIM_LEASE_S", "7.5")
+    assert svc_leases.default_lease_s() == 7.5
+    # the queue picks the env default up (no --lease-s override)
+    assert JobQueue(maxsize=4).lease_s == 7.5
+    monkeypatch.delenv("TPUSIM_LEASE_S")
+    assert svc_leases.default_lease_s() == svc_leases.DEFAULT_LEASE_S
 
 
 # ---------------------------------------------------------------------------

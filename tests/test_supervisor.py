@@ -1,0 +1,238 @@
+"""The self-healing supervisor (ISSUE 13): respawn-on-exit under capped
+backoff, the crash-loop circuit breaker, and the queue-depth autoscale
+policy — the whole state machine driven with FAKE children and FAKE
+time (poll(now=...)), so tier-1 spawns no processes and sleeps never.
+The process-spawning acceptance (respawn + breaker over real kill -9'd
+workers) is the slow-marked WAN smoke in tests/test_transfer.py /
+`make fleet-wan-smoke`.
+"""
+
+import itertools
+import signal
+
+import pytest
+
+from tpusim.svc.supervisor import Supervisor
+
+_PIDS = itertools.count(1000)
+
+
+class FakeProc:
+    """A Popen stand-in whose death the test scripts."""
+
+    def __init__(self, ignore_term=False):
+        self.pid = next(_PIDS)
+        self.rc = None
+        self.signals = []
+        self.ignore_term = ignore_term
+
+    def poll(self):
+        return self.rc
+
+    def die(self, rc=1):
+        self.rc = rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        if sig == signal.SIGTERM and not self.ignore_term:
+            self.rc = -int(signal.SIGTERM)
+
+    def kill(self):
+        self.rc = -int(signal.SIGKILL)
+
+    def wait(self, timeout=None):
+        if self.rc is None:
+            raise TimeoutError("fake child ignoring SIGTERM")
+        return self.rc
+
+
+def _sup(n=2, **kw):
+    spawned = []
+
+    def spawn(_i):
+        p = FakeProc()
+        spawned.append(p)
+        return p
+
+    kw.setdefault("backoff_base_s", 0.5)
+    kw.setdefault("healthy_after_s", 5.0)
+    sup = Supervisor(spawn, n, **kw)
+    return sup, spawned
+
+
+def test_start_spawns_base_fleet():
+    sup, spawned = _sup(3)
+    sup.start(now=0.0)
+    assert len(spawned) == 3 and sup.alive() == 3
+    assert sup.counters["spawns"] == 3
+    assert sup.counters["respawns"] == 0  # initial spawns are not respawns
+    d = sup.describe()
+    assert d["workers"] == 3 and d["alive"] == 3
+    assert d["breaker"]["state"] == "closed"
+    ok, fields = sup.healthy()
+    assert ok and fields["supervisor_breaker"] == "closed"
+
+
+def test_respawn_with_capped_backoff():
+    sup, spawned = _sup(1, breaker_k=50)
+    sup.start(now=0.0)
+    # fast exit #1: respawned immediately, backoff armed at base
+    spawned[0].die(3)
+    ev = sup.poll(now=1.0)
+    assert ev["reaped"] == [spawned[0].pid]
+    assert len(ev["spawned"]) == 1 and sup.alive() == 1
+    assert sup.counters["respawns"] == 1
+    # fast exit #2 inside the backoff window: NOT respawned yet
+    spawned[1].die(3)
+    ev = sup.poll(now=1.2)
+    assert ev["spawned"] == [] and sup.alive() == 0
+    # past the backoff: respawned, delay doubled for the next one
+    ev = sup.poll(now=2.0)
+    assert len(ev["spawned"]) == 1 and sup.alive() == 1
+    assert sup.describe()["consecutive_fast_exits"] == 2
+    assert sup.describe()["respawn_backoff_s"] == 1.0  # 0.5 * 2^1
+    # a long-lived child resets the schedule
+    spawned[-1].die(0)
+    sup.poll(now=100.0)  # lived ~98s > healthy_after_s
+    assert sup.describe()["consecutive_fast_exits"] == 0
+    assert sup.describe()["respawn_backoff_s"] == 0.0
+
+
+def test_backoff_is_capped():
+    """Six consecutive fast exits: the respawn delay doubles 0.5 → 1 →
+    2 → 4 and pins at the cap. Poll times chosen so every cycle both
+    reaps a fast exit (lifetime < healthy_after_s) and lands past the
+    previous backoff gate."""
+    sup, spawned = _sup(1, breaker_k=500, backoff_cap_s=4.0)
+    sup.start(now=0.0)
+    for t in (1.0, 2.0, 4.0, 7.0, 11.5, 16.0):
+        spawned[-1].die(1)
+        sup.poll(now=t)
+        assert sup.alive() == 1, f"not respawned by t={t}"
+    assert sup.describe()["consecutive_fast_exits"] == 6
+    assert sup.describe()["respawn_backoff_s"] == 4.0  # capped
+
+
+def test_breaker_trips_and_resets():
+    sup, spawned = _sup(1, breaker_k=3, breaker_window_s=1000.0)
+    sup.start(now=0.0)
+    now = 0.0
+    # three fast crash/respawn cycles fill the window
+    for i in range(3):
+        spawned[-1].die(1)
+        now += 10.0
+        ev = sup.poll(now=now)
+        assert len(ev["spawned"]) == 1
+    assert sup.counters["respawns"] == 3
+    # the 4th crash meets an exhausted budget: breaker opens, NO spawn
+    spawned[-1].die(1)
+    now += 10.0
+    ev = sup.poll(now=now)
+    assert ev["breaker_open"] and ev["spawned"] == []
+    assert sup.alive() == 0
+    d = sup.describe()
+    assert d["breaker"]["state"] == "open" and d["breaker"]["trips"] == 1
+    assert "crash loop" in d["breaker"]["reason"]
+    ok, fields = sup.healthy()
+    assert not ok
+    assert fields["supervisor_breaker"] == "open"
+    assert "crash loop" in fields["supervisor_breaker_reason"]
+    # further polls stay quiet (no spinning)
+    ev = sup.poll(now=now + 100.0)
+    assert ev["spawned"] == [] and sup.counters["respawns"] == 3
+    # operator re-arms
+    sup.reset_breaker()
+    ev = sup.poll(now=now + 101.0)
+    assert len(ev["spawned"]) == 1 and sup.alive() == 1
+    assert sup.healthy()[0]
+
+
+def test_breaker_window_slides():
+    """Respawns spread WIDER than the window never trip the breaker."""
+    sup, spawned = _sup(1, breaker_k=3, breaker_window_s=5.0)
+    sup.start(now=0.0)
+    now = 0.0
+    for _ in range(10):
+        spawned[-1].die(1)
+        now += 10.0  # each respawn 10 s apart >> the 5 s window
+        ev = sup.poll(now=now)
+        assert len(ev["spawned"]) == 1, "breaker must not trip"
+    assert sup.describe()["breaker"]["state"] == "closed"
+    assert sup.counters["respawns"] == 10
+
+
+def test_autoscale_up_to_max_and_down_to_base():
+    depth = {"n": 0}
+    sup, spawned = _sup(
+        1, max_workers=3, load_fn=lambda: depth["n"],
+        depth_per_worker=2, scale_idle_s=10.0, scale_cooldown_s=1.0,
+    )
+    sup.start(now=0.0)
+    assert sup.alive() == 1
+    # backlog: 10 queued > 2/worker -> scale up one per cooldown, to max
+    depth["n"] = 10
+    sup.poll(now=1.0)
+    assert sup.alive() == 2 and sup.counters["scale_ups"] == 1
+    sup.poll(now=1.5)  # inside the cooldown: no change
+    assert sup.alive() == 2
+    sup.poll(now=3.0)
+    assert sup.alive() == 3
+    sup.poll(now=5.0)  # at max: never beyond
+    assert sup.alive() == 3 and sup.counters["scale_ups"] == 2
+    # idle queue: after scale_idle_s, drain ONE gracefully per cycle
+    depth["n"] = 0
+    sup.poll(now=6.0)  # idle clock starts
+    assert sup.alive() == 3
+    sup.poll(now=17.0)  # 11 s idle > 10 s
+    assert sup.counters["scale_downs"] == 1
+    draining = [p for p in spawned if signal.SIGTERM in p.signals]
+    assert len(draining) == 1
+    # the drained child exits; it is reaped WITHOUT a respawn
+    sup.poll(now=18.0)
+    assert sup.alive() == 2
+    assert sup.counters["respawns"] == 0
+    sup.poll(now=29.0)
+    sup.poll(now=30.0)
+    assert sup.alive() == 1  # back to base, never below
+    sup.poll(now=45.0)
+    assert sup.alive() == 1 and sup.counters["scale_downs"] == 2
+
+
+def test_on_exit_reports_crashes_not_drains():
+    released = []
+    depth = {"n": 5}
+    sup, spawned = _sup(
+        1, max_workers=2, load_fn=lambda: depth["n"],
+        depth_per_worker=2, scale_idle_s=1.0, scale_cooldown_s=0.5,
+        on_exit=released.append,
+    )
+    sup.start(now=0.0)
+    sup.poll(now=1.0)  # scale up
+    assert sup.alive() == 2
+    crash = spawned[0]
+    crash.die(9)
+    sup.poll(now=2.0)
+    assert released == [crash.pid]  # crashed child: leases released
+    depth["n"] = 0
+    sup.poll(now=3.0)
+    sup.poll(now=5.0)  # idle -> drain the surplus child
+    sup.poll(now=6.0)
+    assert sup.counters["scale_downs"] == 1
+    assert len(released) == 1  # the DRAINED child is not a crash
+
+
+def test_stop_escalates_to_kill():
+    sup, spawned = _sup(2)
+    sup.start(now=0.0)
+    spawned[0].ignore_term = True
+    sup.stop(timeout=0.3)
+    assert sup.alive() == 0
+    assert spawned[0].rc == -int(signal.SIGKILL)  # escalated
+    assert spawned[1].rc == -int(signal.SIGTERM)  # went gracefully
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        Supervisor(lambda i: FakeProc(), 0)
+    with pytest.raises(ValueError):
+        Supervisor(lambda i: FakeProc(), 3, max_workers=2)

@@ -1,0 +1,604 @@
+"""The no-shared-fs transfer plane (ISSUE 13): digest-verified trace
+download with partial-transfer resume, signed-result upload with
+torn/forged rejection, the remote lease mirror, retrying POSTs, and
+multi-trace hosting — the tier-1 slice is pure protocol over loopback
+HTTP (no device dispatch, no compiles). The process-spawning acceptance
+(remote workers + kill -9 + crash-loop under a flaky WAN shim) is the
+slow-marked `make fleet-wan-smoke` harness at the bottom.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tpusim.obs.gate import _write_fleet_trace
+from tpusim.svc import jobs as svc_jobs
+from tpusim.svc import leases as svc_leases
+from tpusim.svc.api import JobService, start_job_server
+from tpusim.svc.batcher import JobQueue
+from tpusim.svc.client import ServiceError, _request
+from tpusim.svc.fleet import (
+    _get_bytes,
+    _part_path,
+    _post,
+    _post_bytes,
+    ensure_local_trace,
+    fetch_trace_file,
+    new_transfer_counters,
+    resolve_worker_mode,
+)
+from tpusim.svc.worker import TraceRef, load_trace
+
+FAM = [["FGDScore", 1000], ["BestFitScore", 500]]
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    """A real-HTTP fleet coordinator hosting one file-backed trace, no
+    workers, no recovery — the transfer plane's server half."""
+    base = str(tmp_path)
+    nodes_csv, pods_csv = _write_fleet_trace(base)
+    trace = load_trace("default", nodes_csv, pods_csv)
+    art = os.path.join(base, "art")
+    os.makedirs(art)
+    srv, service, _ = start_job_server(
+        art, {"default": trace}, listen=":0", fleet=True,
+        start_worker=False, recover=False,
+    )
+    yield srv, service, trace, base
+    srv.stop()
+
+
+def _trace_meta(url, name):
+    code, _, meta = _request(f"{url}/traces/{name}")
+    assert code == 200
+    return meta
+
+
+# ---------------------------------------------------------------------------
+# trace download: cache, resume, re-download on mismatch
+# ---------------------------------------------------------------------------
+
+
+def test_trace_listing_and_meta(stack):
+    srv, service, trace, base = stack
+    code, _, doc = _request(srv.url + "/traces")
+    assert code == 200
+    meta = doc["traces"]["default"]
+    assert meta["digest"] == trace.digest
+    assert meta["nodes_sha256"] == trace.nodes_sha256
+    assert meta["pods_bytes"] == trace.pods_bytes > 0
+    # per-name meta matches the listing; unknown names 404 loudly
+    assert _trace_meta(srv.url, "default") == meta
+    code, _, err = _request(srv.url + "/traces/ghost")
+    assert code == 404 and "ghost" in err["error"]
+    code, _, err = _request(srv.url + "/traces/default/virus.exe")
+    assert code == 404
+
+
+def test_download_cache_and_digest_verify(stack):
+    srv, service, trace, base = stack
+    meta = _trace_meta(srv.url, "default")
+    cache = os.path.join(base, "wcache")
+    counters = new_transfer_counters()
+    t = ensure_local_trace(srv.url, "default", meta, cache,
+                           counters=counters)
+    assert t.digest == trace.digest
+    assert counters["downloads"] == 2  # nodes.csv + pods.csv
+    assert counters["download_bytes"] == (
+        trace.nodes_bytes + trace.pods_bytes
+    )
+    ddir = os.path.join(cache, "traces", trace.digest)
+    assert sorted(os.listdir(ddir)) == ["nodes.csv", "pods.csv"]
+    # second acquisition: pure cache hit, zero HTTP
+    c2 = new_transfer_counters()
+    t2 = ensure_local_trace(srv.url, "default", meta, cache,
+                            counters=c2)
+    assert t2.digest == trace.digest and c2["downloads"] == 0
+
+
+def test_partial_download_resumes(stack):
+    """A dead transfer's .part file is resumed with a Range request —
+    the re-download starts where the last one died, and the finished
+    file still sha-verifies."""
+    srv, service, trace, base = stack
+    cache = os.path.join(base, "wcache", "traces", trace.digest)
+    os.makedirs(cache)
+    dest = os.path.join(cache, "nodes.csv")
+    with open(trace.nodes_csv, "rb") as f:
+        full = f.read()
+    half = len(full) // 2
+    with open(_part_path(dest), "wb") as f:
+        f.write(full[:half])
+    counters = new_transfer_counters()
+    fetch_trace_file(
+        srv.url, "/traces/default/nodes.csv", dest,
+        trace.nodes_sha256, counters=counters,
+    )
+    assert counters["resumed"] == 1
+    # only the missing suffix crossed the wire
+    assert counters["download_bytes"] == len(full) - half
+    with open(dest, "rb") as f:
+        assert f.read() == full
+    assert not os.path.exists(_part_path(dest))
+
+
+def test_range_request_answers_206(stack):
+    srv, service, trace, base = stack
+    code, headers, data = _get_bytes(
+        srv.url, "/traces/default/nodes.csv", offset=10
+    )
+    assert code == 206
+    assert headers.get("Content-Range", "").startswith("bytes 10-")
+    with open(trace.nodes_csv, "rb") as f:
+        assert data == f.read()[10:]
+    # an offset past EOF is 416, not silent garbage
+    code, _, _ = _get_bytes(
+        srv.url, "/traces/default/nodes.csv", offset=10 ** 9
+    )
+    assert code == 416
+
+
+def test_corrupt_cache_forces_redownload(stack):
+    srv, service, trace, base = stack
+    meta = _trace_meta(srv.url, "default")
+    cache = os.path.join(base, "wcache")
+    ensure_local_trace(srv.url, "default", meta, cache)
+    dest = os.path.join(cache, "traces", trace.digest, "nodes.csv")
+    with open(dest, "w") as f:
+        f.write("sn,cpu_milli\nbitrot,1\n")  # corrupt the cached copy
+    counters = new_transfer_counters()
+    t = ensure_local_trace(srv.url, "default", meta, cache,
+                           counters=counters)
+    assert t.digest == trace.digest  # healed
+    assert counters["sha_retries"] == 1 and counters["downloads"] == 1
+
+
+def test_sha_skew_fails_loudly(stack):
+    """The coordinator advertising a sha its bytes do not match (version
+    skew, a lying proxy): one clean re-download, then a LOUD refusal —
+    never parsing unverified bytes."""
+    srv, service, trace, base = stack
+    dest = os.path.join(base, "skew", "nodes.csv")
+    os.makedirs(os.path.dirname(dest))
+    counters = new_transfer_counters()
+    with pytest.raises(ServiceError, match="sha256 still mismatches"):
+        fetch_trace_file(
+            srv.url, "/traces/default/nodes.csv", dest, "f" * 64,
+            counters=counters,
+        )
+    assert counters["sha_retries"] == 2
+    assert not os.path.exists(dest)  # nothing half-landed
+
+
+# ---------------------------------------------------------------------------
+# result upload: torn/forged rejected, atomic landing, restart retry
+# ---------------------------------------------------------------------------
+
+
+def _result_fixture(tmp_path, digest):
+    scratch = os.path.join(str(tmp_path), "scratch")
+    svc_jobs.write_result(scratch, digest, {
+        "job": digest, "placed": 7, "placed_node": [0, 1, 2],
+    })
+    data = svc_jobs.result_bytes(scratch, digest)
+    assert data is not None
+    return data
+
+
+def test_torn_upload_rejected_keeps_no_partial(stack, tmp_path):
+    srv, service, trace, base = stack
+    digest = "a" * 64
+    data = _result_fixture(tmp_path, digest)
+    art = service.artifact_dir
+
+    # truncated mid-transfer: 400, artifact dir untouched
+    code, _, err = _post_bytes(srv.url, f"/results/{digest}",
+                               data[:-20])
+    assert code == 400 and "rejected upload" in err["error"]
+    assert os.listdir(art) == []
+
+    # edited payload under the old header digest: forged, 400
+    lines = data.decode().split("\n")
+    doc = json.loads(lines[1])
+    doc["placed"] = 9999
+    forged = (lines[0] + "\n" + json.dumps(doc) + "\n").encode()
+    code, _, err = _post_bytes(srv.url, f"/results/{digest}", forged)
+    assert code == 400
+    # valid bytes under the WRONG digest: foreign, 400
+    code, _, err = _post_bytes(srv.url, f"/results/{'b' * 64}", data)
+    assert code == 400 and "foreign" in err["error"]
+    assert os.listdir(art) == []
+
+    # the real bytes land byte-identically and idempotently
+    code, _, ok = _post_bytes(srv.url, f"/results/{digest}", data)
+    assert code == 200 and ok["stored"] == digest
+    with open(svc_jobs.result_path(art, digest), "rb") as f:
+        assert f.read() == data
+    assert svc_jobs.find_result(art, digest)["placed"] == 7
+    code, _, _ = _post_bytes(srv.url, f"/results/{digest}", data)
+    assert code == 200  # duplicate upload: idempotent replace
+    with open(svc_jobs.result_path(art, digest), "rb") as f:
+        assert f.read() == data
+    assert [f for f in os.listdir(art) if f.endswith(".tmp")] == []
+
+    # the rejection counters are visible in /queue's transfer block
+    code, _, q = _request(srv.url + "/queue")
+    assert q["transfer"]["uploads_rejected"] == 3
+    assert q["transfer"]["uploads_ok"] == 2
+
+
+def test_upload_retried_across_coordinator_restart(stack, tmp_path):
+    """The satellite's restart case: an upload retried against a
+    RESTARTED coordinator (same artifact dir) yields byte-identical
+    signed results — content addressing makes the retry a no-op
+    replace."""
+    srv, service, trace, base = stack
+    digest = "c" * 64
+    data = _result_fixture(tmp_path, digest)
+    art = service.artifact_dir
+    code, _, _ = _post_bytes(srv.url, f"/results/{digest}", data)
+    assert code == 200
+    srv.stop()
+
+    # "restart": a fresh coordinator over the same artifact dir
+    srv2, service2, _ = start_job_server(
+        art, {"default": trace}, listen=":0", fleet=True,
+        start_worker=False, recover=False,
+    )
+    try:
+        code, _, _ = _post_bytes(srv2.url, f"/results/{digest}", data)
+        assert code == 200
+        with open(svc_jobs.result_path(art, digest), "rb") as f:
+            assert f.read() == data
+    finally:
+        srv2.stop()
+
+
+# ---------------------------------------------------------------------------
+# retrying POSTs + the lease mirror + mode resolution
+# ---------------------------------------------------------------------------
+
+
+class _DropFirst:
+    """Shim app: answer 503 + Retry-After: 0 for the first N matching
+    requests, then fall through to the real app."""
+
+    def __init__(self, path_prefix, n):
+        self.path_prefix = path_prefix
+        self.left = n
+        self.dropped = 0
+
+    def handle(self, method, path, body, headers=None):
+        if path.startswith(self.path_prefix) and self.left > 0:
+            self.left -= 1
+            self.dropped += 1
+            return (503, "application/json",
+                    b'{"error": "injected fault"}\n',
+                    {"Retry-After": "0"})
+        return None
+
+
+def test_post_rides_backoff_past_503(stack):
+    """Satellite 1: fleet POSTs retry 429/5xx on the shared backoff
+    schedule honoring Retry-After — three injected 503s cost three
+    zero-delay retries, not a dead worker."""
+    srv, service, trace, base = stack
+    shim = _DropFirst("/workers/register", 3)
+    srv._apps.insert(0, shim)
+    code, _, reg = _post(srv.url, "/workers/register",
+                         {"worker": "wx", "pid": 1, "host": "h"})
+    assert code == 200 and reg["worker"] == "wx"
+    assert shim.dropped == 3
+    # exhausted budget: the final 503 surfaces instead of hanging
+    shim2 = _DropFirst("/workers/claim", 99)
+    srv._apps.insert(0, shim2)
+    code, _, _ = _post(srv.url, "/workers/claim", {"worker": "wx"},
+                       max_attempts=2)
+    assert code == 503 and shim2.dropped == 2
+
+
+def test_post_backoff_aborts_on_stop_event(stack):
+    """A SIGTERM'd worker must not ride out the whole backoff schedule
+    against a draining coordinator's 503 + Retry-After answers — the
+    stop event surfaces the last answer at once (the drain-latency
+    regression of the retrying _post)."""
+    srv, service, trace, base = stack
+    srv.begin_drain()  # every POST now answers 503 + Retry-After: 2
+    try:
+        stop = threading.Event()
+        stop.set()
+        t0 = time.monotonic()
+        code, _, _ = _post(srv.url, "/workers/claim", {"worker": "wz"},
+                           stop_event=stop)
+        elapsed = time.monotonic() - t0
+        assert code == 503
+        assert elapsed < 1.0  # one request, zero 2 s Retry-After waits
+    finally:
+        srv._draining = False
+
+
+def test_lease_mirror_stake_release(stack):
+    srv, service, trace, base = stack
+    art = service.artifact_dir
+    members = ["d" * 64, "e" * 64]
+    code, _, doc = _post(srv.url, "/leases", {
+        "op": "stake", "worker": "w9", "pid": 321, "members": members,
+    })
+    assert code == 200 and doc["staked"] == 2
+    assert doc["deadline_unix"] > time.time()
+    got = dict(svc_leases.scan_leases(art))
+    assert sorted(got) == members
+    assert got["d" * 64]["worker"] == "w9"
+    assert got["d" * 64]["pid"] == 321
+    assert got["d" * 64]["members"] == members
+    code, _, doc = _post(srv.url, "/leases",
+                         {"op": "release", "worker": "w9",
+                          "members": members})
+    assert code == 200 and doc["released"] == 2
+    assert svc_leases.scan_leases(art) == []
+    # malformed bodies are loud
+    code, _, err = _post(srv.url, "/leases", {"op": "stake"})
+    assert code == 400
+    code, _, err = _post(srv.url, "/leases",
+                         {"op": "destroy", "members": ["x"]})
+    assert code == 400 and "stake|release" in err["error"]
+
+
+def test_wire_strings_cannot_traverse_paths(stack, tmp_path):
+    """Digests and lease members arrive off the wire and become file
+    stems under the artifact dir — traversal payloads must die at the
+    endpoint, and a non-object header must be a clean 400 (not a
+    retryable 500)."""
+    srv, service, trace, base = stack
+    art = service.artifact_dir
+    evil = "../" * 6 + "tmp/evil"
+    code, _, err = _post(srv.url, "/leases", {
+        "op": "stake", "worker": "w", "pid": 1, "members": [evil],
+    })
+    assert code == 400 and "not job digests" in err["error"]
+    code, _, _ = _post(srv.url, "/leases", {
+        "op": "release", "worker": "w", "members": [evil],
+    })
+    assert code == 400
+    # uppercase/semi-plausible stems are rejected too (digests are
+    # lowercase hex)
+    code, _, _ = _post(srv.url, "/leases", {
+        "op": "stake", "worker": "w", "pid": 1, "members": ["EVIL" * 16],
+    })
+    assert code == 400
+    assert os.listdir(art) == []
+
+    # a JSON-array header line: clean 400, counted as a rejection
+    code, _, err = _post_bytes(srv.url, f"/results/{'a' * 64}",
+                               b"[]\n{}\n")
+    assert code == 400 and "rejected upload" in err["error"]
+    assert os.listdir(art) == []
+
+
+def test_orphan_part_adopted_across_respawn(stack):
+    """A kill -9'd predecessor's .part (different, DEAD pid) is adopted
+    and resumed by the successor — crash-resume reaches across a
+    respawn instead of leaking parts and restarting from byte 0."""
+    from tpusim.svc.fleet import _adopt_orphan_part
+
+    srv, service, trace, base = stack
+    cache = os.path.join(base, "wcache", "traces", trace.digest)
+    os.makedirs(cache)
+    dest = os.path.join(cache, "nodes.csv")
+    with open(trace.nodes_csv, "rb") as f:
+        full = f.read()
+    # a dead pid's partial download (pids are bounded well below 2**22)
+    dead_pid = 2 ** 22 + 12345
+    orphan = f"{dest}.{dead_pid}.part"
+    with open(orphan, "wb") as f:
+        f.write(full[: len(full) // 2])
+    smaller = f"{dest}.{dead_pid + 1}.part"
+    with open(smaller, "wb") as f:
+        f.write(full[:4])
+    counters = new_transfer_counters()
+    fetch_trace_file(
+        srv.url, "/traces/default/nodes.csv", dest,
+        trace.nodes_sha256, counters=counters,
+    )
+    assert counters["resumed"] == 1
+    # only the adopted orphan's missing suffix crossed the wire
+    assert counters["download_bytes"] == len(full) - len(full) // 2
+    with open(dest, "rb") as f:
+        assert f.read() == full
+    # every .part is gone: adopted/renamed or cleaned
+    assert [p for p in os.listdir(cache) if p.endswith(".part")] == []
+
+    # a COMPLETE orphaned part (died between write and rename): zero
+    # bytes transferred, just renamed into place
+    os.unlink(dest)
+    with open(f"{dest}.{dead_pid}.part", "wb") as f:
+        f.write(full)
+    c2 = new_transfer_counters()
+    fetch_trace_file(
+        srv.url, "/traces/default/nodes.csv", dest,
+        trace.nodes_sha256, counters=c2,
+    )
+    assert c2["downloads"] == 0 and c2["download_bytes"] == 0
+    with open(dest, "rb") as f:
+        assert f.read() == full
+
+
+def test_resolve_worker_mode(stack):
+    srv, service, trace, base = stack
+    code, _, reg = _post(srv.url, "/workers/register",
+                         {"worker": "wm", "pid": 2, "host": "h"})
+    # same machine: every path readable -> auto picks shared-fs
+    assert resolve_worker_mode("auto", reg) == "shared-fs"
+    assert resolve_worker_mode("", reg) == "shared-fs"
+    # explicit modes pass through
+    assert resolve_worker_mode("remote", reg) == "remote"
+    assert resolve_worker_mode("shared-fs", reg) == "shared-fs"
+    # unreachable artifact dir or trace CSVs -> remote
+    gone = dict(reg, artifact_dir="/no/such/dir")
+    assert resolve_worker_mode("auto", gone) == "remote"
+    skew = dict(reg, traces={
+        "default": dict(reg["traces"]["default"],
+                        nodes_csv="/no/such/nodes.csv"),
+    })
+    assert resolve_worker_mode("auto", skew) == "remote"
+    with pytest.raises(ValueError, match="worker mode"):
+        resolve_worker_mode("wan", reg)
+
+
+def test_register_records_mode_and_transfers(stack):
+    srv, service, trace, base = stack
+    _post(srv.url, "/workers/register",
+          {"worker": "wr", "pid": 3, "host": "h", "mode": "remote"})
+    counters = new_transfer_counters()
+    counters["uploads"] = 4
+    _post(srv.url, "/workers/complete",
+          {"worker": "wr", "done": [], "failed": {},
+           "transfers": counters})
+    code, _, doc = _request(srv.url + "/workers")
+    row = doc["workers"]["wr"]
+    assert row["mode"] == "remote"
+    assert row["transfers"]["uploads"] == 4
+    # /queue's worker rows carry the same topology view
+    code, _, q = _request(srv.url + "/queue")
+    assert q["workers"]["wr"]["mode"] == "remote"
+
+
+# ---------------------------------------------------------------------------
+# multi-trace hosting (protocol level — no device)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_trace_arg():
+    from tpusim.cli import parse_trace_arg
+
+    assert parse_trace_arg("alt=n.csv:p.csv") == ("alt", "n.csv",
+                                                  "p.csv", 0)
+    assert parse_trace_arg("alt=n.csv:p.csv:500") == ("alt", "n.csv",
+                                                      "p.csv", 500)
+    for bad in ("alt", "=n.csv:p.csv", "alt=n.csv", "alt=:p.csv",
+                "alt=n.csv:p.csv:many"):
+        with pytest.raises(ValueError, match="--trace"):
+            parse_trace_arg(bad)
+
+
+def test_multi_trace_batching_stays_per_trace(tmp_path):
+    """Two hosted traces: jobs keep their (trace, family) shard — one
+    claim never mixes traces — and unknown trace names 400 loudly."""
+    import numpy as np
+
+    from tpusim.io.trace import NodeRow, PodRow
+
+    rng = np.random.default_rng(5)
+    mk = lambda tag, n: TraceRef(  # noqa: E731
+        tag,
+        [NodeRow(f"{tag}{i}", 32000, 131072, int(g),
+                 "V100M16" if g else "")
+         for i, g in enumerate(rng.choice([0, 2, 4], n))],
+        [PodRow(f"p{tag}{i}", 1000, 2048, 1, 500) for i in range(6)],
+        "",
+    )
+    a, b = mk("a", 6), mk("b", 8)
+    a = TraceRef(a.name, a.nodes, a.pods,
+                 svc_jobs.trace_digest(a.nodes, a.pods))
+    b = TraceRef(b.name, b.nodes, b.pods,
+                 svc_jobs.trace_digest(b.nodes, b.pods))
+    queue = JobQueue(maxsize=16, lane_width=8)
+    service = JobService(queue, None, {"a": a, "b": b}, str(tmp_path))
+    for i, tr in enumerate(["a", "b", "a", "b", "a"]):
+        service.submit_payload(
+            {"trace": tr, "policies": FAM,
+             "weights": [1000 + i, 500], "seed": 42}
+        )
+    batch1 = queue.claim_batch("w", timeout=0)
+    assert [j.spec.trace for j in batch1] == ["a", "a", "a"]
+    batch2 = queue.claim_batch("w", timeout=0)
+    assert [j.spec.trace for j in batch2] == ["b", "b"]
+    with pytest.raises(ValueError, match="unknown trace"):
+        service.submit_payload(
+            {"trace": "ghost", "policies": FAM,
+             "weights": [1, 1], "seed": 1}
+        )
+
+
+# ---------------------------------------------------------------------------
+# slow: remote worker end-to-end + the WAN chaos acceptance
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_remote_worker_end_to_end(tmp_path):
+    """One remote-mode worker joined over HTTP with NO shared paths:
+    trace downloaded + digest-verified, the batch dispatched, signed
+    results UPLOADED and landed on the coordinator's disk, lease files
+    mirrored coordinator-side, /workers showing mode=remote with live
+    transfer counters."""
+    from tpusim.svc.fleet import run_worker
+
+    base = str(tmp_path)
+    nodes_csv, pods_csv = _write_fleet_trace(base)
+    trace = load_trace("default", nodes_csv, pods_csv)
+    art = os.path.join(base, "art")
+    srv, service, _ = start_job_server(
+        art, {"default": trace}, listen=":0", fleet=True,
+        start_worker=False, recover=False, lane_width=2,
+    )
+    try:
+        # single-policy family on purpose: gate.fleet_chaos_smoke
+        # measures its COLD compile wall on the two-policy family over
+        # this same synthetic trace shape — this test must not pre-warm
+        # that jaxpr when both run in one process
+        accepted = [
+            service.submit_payload(
+                {"policies": [["FGDScore", 1000]],
+                 "weights": [1000 + i], "seed": 42,
+                 "engine": "sequential"}
+            )
+            for i in range(2)
+        ]
+        stop = threading.Event()
+        served = run_worker(
+            srv.url, poll_s=0.05, max_batches=1, mode="remote",
+            cache_dir=os.path.join(base, "wcache"), stop_event=stop,
+        )
+        assert served == 1
+        assert service.queue.wait_idle(timeout=10)
+        for a in accepted:
+            job = service.queue.get(a["id"])
+            assert job.status == "done", job.error
+            # the signed result landed on the COORDINATOR's disk via
+            # the upload path
+            with open(svc_jobs.result_path(art, job.digest), "rb") as f:
+                coord = f.read()
+            local = svc_jobs.result_bytes(
+                os.path.join(base, "wcache", "artifacts"), job.digest
+            )
+            assert coord == local  # byte-identical to the worker's copy
+        code, _, doc = _request(srv.url + "/workers")
+        [row] = doc["workers"].values()
+        assert row["mode"] == "remote"
+        assert row["transfers"]["uploads"] == 2
+        assert row["transfers"]["downloads"] >= 2
+        # the trace cache is digest-keyed
+        assert os.path.isdir(
+            os.path.join(base, "wcache", "traces", trace.digest)
+        )
+        # all leases released after completion
+        assert svc_leases.scan_leases(art) == []
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_fleet_wan_acceptance(tmp_path):
+    """The full ISSUE 13 acceptance: remote-mode workers with isolated
+    dirs under a flaky (drop/delay) HTTP shim, a mid-batch kill -9, the
+    supervisor respawning, a forced crash-loop tripping the breaker —
+    gate.fleet_wan_smoke IS the harness (also `make fleet-wan-smoke`)."""
+    from tpusim.obs.gate import fleet_wan_smoke
+
+    ok, msgs = fleet_wan_smoke(str(tmp_path))
+    assert ok, "\n".join(msgs)

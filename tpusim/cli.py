@@ -270,6 +270,16 @@ def _build_parser() -> argparse.ArgumentParser:
         "--max-pods", type=int, default=0, metavar="N",
         help="truncate the hosted workload to its first N pods (0 = all)",
     )
+    # multi-trace hosting (ISSUE 13): families already key by trace
+    # name, so batching stays per-(trace, family) with one compiled
+    # scan per family
+    p_serve.add_argument(
+        "--trace", action="append", default=[],
+        metavar="NAME=NODES.csv:PODS.csv[:MAX_PODS]",
+        help="host an ADDITIONAL named trace (repeatable); jobs select "
+        'it via their "trace" key. --nodes/--pods host the trace named '
+        "'default'; at least one trace must be given either way",
+    )
     p_serve.add_argument(
         "--lane-width", type=int, default=8, metavar="B",
         help="sweep lanes per batch: up to B compatible jobs share one "
@@ -289,6 +299,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "a kill -9'd worker's jobs are reclaimed by any live worker); "
         "0 keeps the single in-process worker thread. Remote hosts "
         "join the same fleet with `tpusim worker --join URL`",
+    )
+    p_serve.add_argument(
+        "--max-workers", type=int, default=0, metavar="M",
+        help="autoscale ceiling (ISSUE 13; needs --workers N, M >= N): "
+        "a queue backlog deeper than the live fleet can chew spawns "
+        "extra workers up to M; an idle queue drains back down to N "
+        "(graceful SIGTERM). The supervisor also respawns crashed "
+        "children under capped backoff, with a crash-loop circuit "
+        "breaker that degrades /healthz instead of spinning",
     )
     p_serve.add_argument(
         "--lease-s", type=float, default=0.0, metavar="SECONDS",
@@ -346,6 +365,22 @@ def _build_parser() -> argparse.ArgumentParser:
     p_worker.add_argument(
         "--compile-cache-dir", default="", metavar="DIR",
         help="shared JAX persistent compile cache",
+    )
+    # the no-shared-fs transport (ISSUE 13)
+    p_worker.add_argument(
+        "--mode", choices=("auto", "shared-fs", "remote"),
+        default="auto",
+        help="artifact-plane topology: shared-fs reads trace CSVs by "
+        "path and writes results into the shared artifact dir; remote "
+        "needs NO shared filesystem (digest-verified trace downloads "
+        "into a local cache, signed-result uploads, lease POSTs); "
+        "auto probes the handshake's paths and picks",
+    )
+    p_worker.add_argument(
+        "--cache-dir", default="", metavar="DIR",
+        help="remote-mode local cache root (downloaded traces keyed "
+        "by content digest + this worker's artifact scratch); default "
+        "a per-host tmp dir",
     )
 
     # the learned-scoring lane (ISSUE 9; README "Tune policy weights"):
@@ -651,28 +686,73 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def parse_trace_arg(entry: str):
+    """One `--trace NAME=NODES.csv:PODS.csv[:MAX_PODS]` entry ->
+    (name, nodes_csv, pods_csv, max_pods), failing loudly on anything
+    malformed (ISSUE 13 multi-trace hosting)."""
+    name, sep, rest = entry.partition("=")
+    name = name.strip()
+    if not sep or not name:
+        raise ValueError(
+            f"--trace {entry!r}: want NAME=NODES.csv:PODS.csv[:MAX_PODS]"
+        )
+    parts = rest.split(":")
+    if len(parts) not in (2, 3) or not parts[0] or not parts[1]:
+        raise ValueError(
+            f"--trace {entry!r}: want NAME=NODES.csv:PODS.csv[:MAX_PODS]"
+        )
+    max_pods = 0
+    if len(parts) == 3:
+        try:
+            max_pods = int(parts[2])
+        except ValueError:
+            raise ValueError(
+                f"--trace {entry!r}: MAX_PODS must be an integer, got "
+                f"{parts[2]!r}"
+            )
+    return name, parts[0], parts[1], max_pods
+
+
 def _serve_jobs(args) -> int:
     """`tpusim serve DIR --jobs`: the queueing what-if replay service
-    (ISSUE 7) — the monitor plane plus POST /jobs over a hosted trace;
-    signed results land in DIR, which is also watched/republished like
-    plain serve."""
+    (ISSUE 7) — the monitor plane plus POST /jobs over the hosted
+    trace(s); signed results land in DIR, which is also watched/
+    republished like plain serve. --workers N runs the self-healing
+    supervisor (ISSUE 13): respawn-on-exit with capped backoff, a
+    crash-loop circuit breaker, and --max-workers M autoscale."""
     import time
     import urllib.request
 
     from tpusim.obs.server import watch_dir
     from tpusim.svc import load_trace, start_job_server
 
-    if not (args.nodes and args.pods):
-        raise ValueError(
-            "serve --jobs hosts a trace: pass --nodes NODES.csv and "
-            "--pods PODS.csv"
+    traces = {}
+    if args.nodes or args.pods:
+        if not (args.nodes and args.pods):
+            raise ValueError(
+                "serve --jobs hosts a trace: pass BOTH --nodes "
+                "NODES.csv and --pods PODS.csv"
+            )
+        traces["default"] = load_trace(
+            "default", args.nodes, args.pods, max_pods=args.max_pods
         )
-    trace = load_trace(
-        "default", args.nodes, args.pods, max_pods=args.max_pods
-    )
+    for entry in args.trace:
+        name, nodes_csv, pods_csv, max_pods = parse_trace_arg(entry)
+        if name in traces:
+            raise ValueError(f"--trace {name!r} given twice")
+        traces[name] = load_trace(name, nodes_csv, pods_csv,
+                                  max_pods=max_pods)
+    if not traces:
+        raise ValueError(
+            "serve --jobs hosts at least one trace: pass --nodes/--pods "
+            "(the trace named 'default') and/or --trace NAME=..."
+        )
     fleet_n = int(getattr(args, "workers", 0) or 0)
+    max_n = int(getattr(args, "max_workers", 0) or 0)
+    if max_n and not fleet_n:
+        raise ValueError("--max-workers needs --workers N")
     srv, service, worker = start_job_server(
-        args.dir, {"default": trace}, listen=args.listen,
+        args.dir, traces, listen=args.listen,
         lane_width=args.lane_width, queue_size=args.queue_size,
         table_cache_dir=args.table_cache_dir,
         compile_cache_dir=args.compile_cache_dir,
@@ -680,16 +760,27 @@ def _serve_jobs(args) -> int:
         family_quota=args.family_quota,
         out=sys.stderr,
     )
-    procs = []
+    sup = None
     if fleet_n > 0:
-        from tpusim.svc.fleet import spawn_local_workers
+        import subprocess
 
-        procs = spawn_local_workers(
-            srv.url, fleet_n,
-            table_cache_dir=args.table_cache_dir,
+        from tpusim.svc.fleet import worker_command
+        from tpusim.svc.supervisor import Supervisor
+
+        cmd = worker_command(
+            srv.url, table_cache_dir=args.table_cache_dir,
             compile_cache_dir=args.compile_cache_dir,
+        )
+        sup = Supervisor(
+            lambda _n: subprocess.Popen(cmd), fleet_n,
+            max_workers=max_n,
+            load_fn=service.queue.depth,
+            depth_per_worker=args.lane_width,
+            on_exit=service.fleet.release_dead,
             out=sys.stderr,
         )
+        service.fleet.supervisor = sup
+        sup.start()
     # graceful shutdown (ISSUE 10): SIGTERM/SIGINT begin the drain —
     # /healthz flips to 503, POSTs answer 503 + Retry-After, the
     # in-flight batch finishes (worker.stop joins after it), and every
@@ -708,13 +799,17 @@ def _serve_jobs(args) -> int:
         signal.signal(signal.SIGINT, _graceful)
     except ValueError:
         pass  # non-main thread (tests drive _serve_jobs directly)
-    mode = (f"fleet of {fleet_n} worker processes" if fleet_n
-            else "single in-process worker")
+    mode = (f"supervised fleet of {fleet_n} worker processes"
+            + (f" (autoscale to {max_n})" if max_n else "")
+            if fleet_n else "single in-process worker")
+    hosted = "; ".join(
+        f"trace {name!r} = {len(t.nodes)} nodes x {len(t.pods)} pods"
+        for name, t in traces.items()
+    )
     print(
         f"[serve] job plane at {srv.url} (POST /jobs, GET "
-        f"/jobs/<id>[/result], /queue, /workers, /metrics, /healthz, "
-        f"/progress); {mode}; trace 'default' = {len(trace.nodes)} "
-        f"nodes x {len(trace.pods)} pods; results -> "
+        f"/jobs/<id>[/result], /queue, /workers, /traces, /metrics, "
+        f"/healthz, /progress); {mode}; {hosted}; results -> "
         f"{os.path.abspath(args.dir)}", file=sys.stderr,
     )
     try:
@@ -737,32 +832,20 @@ def _serve_jobs(args) -> int:
             record, progress = watch_dir(args.dir)
             if record is not None:
                 srv.publish_record(record)
-            for p in list(procs):
-                if p.poll() is not None:
-                    # a dead child is NOT an outage — and since WE
-                    # reaped it, its jobs can be released immediately
-                    # instead of waiting out the lease (a kill -9 from
-                    # outside still goes the lease-expiry route)
-                    released = (
-                        service.fleet.release_dead(p.pid)
-                        if service.fleet is not None else 0
-                    )
-                    print(
-                        f"[serve] worker pid {p.pid} exited "
-                        f"(rc {p.returncode}); released {released} "
-                        "held job(s) to the fleet", file=sys.stderr,
-                    )
-                    procs.remove(p)
+            if sup is not None:
+                # the supervision pass (ISSUE 13): reap (releasing held
+                # jobs immediately via release_dead — a kill -9 from
+                # outside still goes the lease-expiry route), respawn
+                # under backoff/breaker, autoscale
+                sup.poll()
             time.sleep(max(args.poll, 0.2))
         print("[serve] draining: finishing the in-flight batch",
               file=sys.stderr)
     except KeyboardInterrupt:
         srv.begin_drain()
     finally:
-        if procs:
-            from tpusim.svc.fleet import stop_workers
-
-            stop_workers(procs, out=sys.stderr)
+        if sup is not None:
+            sup.stop()
         if worker is not None:
             worker.stop()  # joins after the current batch — the drain
         srv.stop()
@@ -796,6 +879,7 @@ def cmd_worker(args) -> int:
             table_cache_dir=args.table_cache_dir,
             compile_cache_dir=args.compile_cache_dir,
             out=sys.stderr, stop_event=stop_event,
+            mode=args.mode, cache_dir=args.cache_dir,
         )
     except ServiceError as err:
         print(f"tpusim worker: {err}", file=sys.stderr)

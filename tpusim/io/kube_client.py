@@ -55,6 +55,27 @@ HTTP_RETRY_CAP_S = 8.0
 HTTP_RETRY_STATUSES = frozenset({429} | set(range(500, 600)))
 
 
+def retryable_conn_excs() -> tuple:
+    """The connection-level exception vocabulary every HTTP retry loop
+    in the tree shares (kube_client.get, svc.client, svc.fleet._post):
+    resets, refusals, half-closed keep-alives, and urllib's URLError
+    wrapper. A fleet worker retries REFUSED too — a restarting
+    coordinator refuses connections for a moment, and a worker must
+    treat that as a stall, not a death (the submit CLI, facing a human,
+    fails fast on refused instead; it filters before calling)."""
+    import http.client
+
+    return (ConnectionResetError, ConnectionRefusedError,
+            http.client.RemoteDisconnected, urllib.error.URLError,
+            TimeoutError)
+
+
+def is_retryable_status(code: int) -> bool:
+    """True for HTTP statuses the shared backoff schedule retries:
+    429 and every 5xx (the Retry-After-bearing family)."""
+    return int(code) in HTTP_RETRY_STATUSES
+
+
 def _retry_attempts() -> int:
     try:
         return max(1, int(os.environ.get("TPUSIM_HTTP_RETRIES",

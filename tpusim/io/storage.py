@@ -430,6 +430,40 @@ def read_signed_jsonl(path: str, schema: str = ""):
     return header, payload
 
 
+def file_sha256(path: str) -> str:
+    """sha256 hex of a file's raw bytes — the per-FILE integrity key of
+    the fleet transfer plane (ISSUE 13): the register handshake carries
+    it for every hosted trace CSV, so a no-shared-fs worker can verify
+    a downloaded (possibly resumed) file before parsing a single row."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_bytes_atomic(path: str, data: bytes) -> str:
+    """Write raw bytes atomically (tmp + os.replace — the checkpoint
+    discipline): a killed writer leaves the previous file intact, never
+    a torn one. The coordinator's result-upload landing path (ISSUE 13)
+    rides this so a half-received upload can never become a half-written
+    result file. The tmp name is pid AND thread scoped: the upload
+    handlers run on a ThreadingHTTPServer, so two concurrent duplicate
+    uploads of one digest share a pid — a pid-only tmp would let one
+    thread truncate the other's half-written file mid-rename."""
+    import threading
+
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return path
+
+
 def write_signed_json(path: str, header: dict, doc: dict) -> str:
     """Single-document convenience over write_signed_jsonl (ISSUE 12,
     the lease-file plane): one canonical-JSON payload line under the

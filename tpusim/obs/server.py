@@ -77,10 +77,12 @@ class MonitorServer:
         self._thread: Optional[threading.Thread] = None
         self._hb_listener = None
         # extension request handlers (tpusim.svc.api grows the POST side
-        # here, ISSUE 7): each app's handle(method, path, body) returns
-        # (code, content_type, body_bytes[, extra_headers]) or None to
-        # fall through; first non-None answer wins, built-ins serve as
-        # the GET fallback
+        # here, ISSUE 7): each app's handle(method, path, body,
+        # headers=None) returns (code, content_type, body_bytes[,
+        # extra_headers]) or None to fall through; first non-None answer
+        # wins, built-ins serve as the GET fallback. `headers` is the
+        # request's header map (the fleet transfer plane reads Range
+        # for resumable trace downloads, ISSUE 13).
         self._apps: list = []
         # graceful shutdown (ISSUE 10 satellite): once draining, POSTs
         # answer 503 + Retry-After (the client's connection-reset/503
@@ -141,9 +143,10 @@ class MonitorServer:
         self._apps.append(app)
         return self
 
-    def _dispatch_app(self, method: str, path: str, body: bytes):
+    def _dispatch_app(self, method: str, path: str, body: bytes,
+                      headers=None):
         for app in self._apps:
-            resp = app.handle(method, path, body)
+            resp = app.handle(method, path, body, headers)
             if resp is not None:
                 return resp
         return None
@@ -205,7 +208,10 @@ class MonitorServer:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length > 0 else b""
                 try:
-                    resp = srv._dispatch_app(method, path, body)
+                    # self.headers is an email.message.Message — apps
+                    # get case-insensitive .get() (Range, Retry-After)
+                    resp = srv._dispatch_app(method, path, body,
+                                             self.headers)
                 except Exception as err:
                     self._send(
                         500, "text/plain",
@@ -273,7 +279,20 @@ class MonitorServer:
                 else:
                     self._send(404, "text/plain", b"not found\n")
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        class QuietServer(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # a client that vanished mid-response (a kill -9'd
+                # fleet worker, a dropped WAN link) is ROUTINE for the
+                # service plane — not a stack trace
+                import sys as _sys
+
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (BrokenPipeError,
+                                    ConnectionResetError)):
+                    return
+                super().handle_error(request, client_address)
+
+        self._httpd = QuietServer((self.host, self.port), Handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]  # resolve port 0
         self._thread = threading.Thread(

@@ -28,9 +28,13 @@ from tpusim.svc.batcher import (  # noqa: F401
 from tpusim.svc.fleet import (  # noqa: F401
     FleetService,
     WorkerRegistry,
+    ensure_local_trace,
+    resolve_worker_mode,
     run_worker,
     spawn_local_workers,
+    worker_command,
 )
+from tpusim.svc.supervisor import Supervisor  # noqa: F401
 from tpusim.svc.jobs import (  # noqa: F401
     JobSpec,
     docs_from_payload,

@@ -102,7 +102,7 @@ class JobService:
 
     # ---- the MonitorServer app hook ----
 
-    def handle(self, method: str, path: str, body: bytes):
+    def handle(self, method: str, path: str, body: bytes, headers=None):
         if path == "/jobs" and method == "POST":
             return self._post_jobs(body)
         if path == "/queue" and method == "GET":

@@ -137,7 +137,7 @@ class JobQueue:
     def __init__(self, maxsize: int = 64, lane_width: int = 8,
                  retry_after_s: int = 2, family_quota: int = 0,
                  lease_s: float = 0.0):
-        from tpusim.svc.leases import DEFAULT_LEASE_S
+        from tpusim.svc.leases import default_lease_s
 
         if maxsize < 1 or lane_width < 1:
             raise ValueError(
@@ -150,7 +150,7 @@ class JobQueue:
         self.lane_width = int(lane_width)
         self.retry_after_s = int(retry_after_s)
         self.family_quota = int(family_quota)
-        self.lease_s = float(lease_s) if lease_s > 0 else DEFAULT_LEASE_S
+        self.lease_s = float(lease_s) if lease_s > 0 else default_lease_s()
         self._cond = threading.Condition()
         self._queue: List[Job] = []  # submission order within shards
         self._jobs: Dict[str, Job] = {}  # id -> Job (all lifecycles)

@@ -39,13 +39,16 @@ class JobsFailed(ServiceError):
 
 
 def _request(url: str, data: Optional[bytes] = None,
-             timeout: float = 30.0) -> Tuple[int, dict, dict]:
+             timeout: float = 30.0,
+             content_type: str = "application/json"
+             ) -> Tuple[int, dict, dict]:
     """(status, headers, parsed JSON body); HTTP errors with a JSON body
     (the service's 4xx/5xx answers) are returned, transport errors
-    raise."""
+    raise. `content_type` marks non-JSON request bodies (the fleet's
+    raw signed-result uploads, ISSUE 13); answers are always JSON."""
     req = urllib.request.Request(
         url, data=data,
-        headers={"Content-Type": "application/json"} if data else {},
+        headers={"Content-Type": content_type} if data else {},
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:

@@ -1,11 +1,34 @@
-"""The worker fleet: many processes draining one JobQueue (ISSUE 12).
+"""The worker fleet: many processes draining one JobQueue (ISSUE 12),
+grown wide-area in ISSUE 13 — workers need NO shared filesystem.
 
 PR 10 made ONE worker crash-safe (spec persistence, SIGTERM drain); this
 module promotes that per-worker lifecycle into a fleet protocol. The
 coordinator — `tpusim serve --jobs --workers N` — owns the HTTP plane,
 the bounded JobQueue, and the artifact dir; worker PROCESSES (spawned
-locally, or joined from other hosts with `tpusim worker --join URL`
-against a shared filesystem) pull batches over four POST endpoints:
+locally, or joined from ANY host with `tpusim worker --join URL`) pull
+batches over the /workers/* POST endpoints plus, for no-shared-fs
+("remote" mode) workers, the transfer plane (ISSUE 13):
+
+  GET  /traces/<name>[/nodes.csv|/pods.csv]
+                      digest-named trace download: the handshake
+                      carries per-file sha256 + the trace content
+                      digest; the worker caches by digest, resumes
+                      partial transfers (Range), re-downloads on
+                      mismatch, and refuses to serve on residual skew
+  POST /results/<digest>
+                      signed-result upload: the coordinator verifies
+                      the payload digest BEFORE the atomic rename — a
+                      torn or forged upload is a 400 + [Degrade]
+                      warning, never a half-written result file
+  POST /leases        the remote workers' lease mirror: the
+                      coordinator writes/deletes its own signed lease
+                      files (op=stake|release), keeping the on-disk
+                      recovery plane identical for both modes
+
+Every worker→coordinator request rides the shared kube_client
+capped-exponential-backoff-with-jitter schedule honoring Retry-After
+(`_with_backoff`), so a coordinator restart mid-claim is a stall, not a
+dead worker. The original shared-filesystem endpoints:
 
   /workers/register   identity + the hosting handshake: lease duration,
                       lane width, artifact dir, and the hosted traces'
@@ -75,6 +98,13 @@ class WorkerInfo:
     last_dispatch_s: float = 0.0
     sweep_executables: int = 0
     steals_benefited: int = 0  # stolen jobs this worker re-ran
+    # the topology view (ISSUE 13): how this worker reaches the
+    # artifact plane — "shared-fs" (reads trace CSVs by path, writes
+    # results directly) or "remote" (digest-verified download/upload
+    # over HTTP, no shared filesystem) — plus its reported transfer
+    # counters (downloads/uploads/bytes/resumes/sha retries)
+    mode: str = "shared-fs"
+    transfers: dict = field(default_factory=dict)
 
     def live(self, now: float, window_s: float) -> bool:
         return (now - self.last_seen_unix) <= window_s
@@ -101,7 +131,8 @@ class WorkerRegistry:
         # (lease expiry is judged per job, not per worker)
         return max(3.0 * self.lease_s, 3.0)
 
-    def register(self, worker_id: str, pid: int, host: str) -> WorkerInfo:
+    def register(self, worker_id: str, pid: int, host: str,
+                 mode: str = "") -> WorkerInfo:
         with self._lock:
             if not worker_id:
                 self._auto += 1
@@ -115,6 +146,8 @@ class WorkerRegistry:
                 info.pid = int(pid or info.pid)
                 info.host = str(host or info.host)
                 info.last_seen_unix = time.time()
+            if mode:
+                info.mode = str(mode)
             return info
 
     def touch(self, worker_id: str) -> Optional[WorkerInfo]:
@@ -142,6 +175,8 @@ class WorkerRegistry:
             rows[w.id] = {
                 "pid": w.pid,
                 "host": w.host,
+                "mode": w.mode,
+                "transfers": dict(w.transfers),
                 "live": w.live(now, self.live_window_s),
                 "last_seen_s": round(now - w.last_seen_unix, 2),
                 "claims": w.claims,
@@ -177,10 +212,34 @@ class FleetService:
         self.registry = WorkerRegistry(self.queue.lease_s)
         self.out = out
         self.total_steals_cleaned = 0
+        # the supervisor owning `--workers N` children (svc.supervisor,
+        # ISSUE 13), or None when workers join only from outside; /queue
+        # and /healthz surface its respawn/breaker state when set
+        self.supervisor = None
+        # coordinator-side transfer-plane counters (ISSUE 13)
+        self.transfers = {
+            "trace_requests": 0, "trace_bytes": 0,
+            "uploads_ok": 0, "uploads_rejected": 0, "lease_posts": 0,
+        }
 
     # ---- request routing ----
 
-    def handle(self, method: str, path: str, body: bytes):
+    def handle(self, method: str, path: str, body: bytes, headers=None):
+        # the transfer plane (ISSUE 13): trace download, result upload,
+        # and the remote workers' lease mirror — all digest-guarded
+        if path == "/traces" and method == "GET":
+            return _json_body(200, {
+                "traces": {
+                    name: self._trace_meta(t)
+                    for name, t in self.service.traces.items()
+                }
+            })
+        if path.startswith("/traces/") and method == "GET":
+            return self._get_trace(path, headers)
+        if path.startswith("/results/") and method == "POST":
+            return self._accept_result(path, body)
+        if path == "/leases" and method == "POST":
+            return self._leases(body)
         if not path.startswith("/workers"):
             return None
         if path == "/workers" and method == "GET":
@@ -206,6 +265,158 @@ class FleetService:
             return self._complete(doc)
         return _json_body(404, {"error": f"unknown fleet path {path}"})
 
+    # ---- the transfer plane (ISSUE 13) ----
+
+    @staticmethod
+    def _safe_digest(s: str) -> bool:
+        """True when `s` is usable as a file stem inside the artifact
+        dir: digests are lowercase sha256 hex, and anything else —
+        path separators, dot-dot, empty — must be rejected BEFORE it
+        reaches an os.path.join (the /leases and /results endpoints
+        take these strings off the wire)."""
+        s = str(s)
+        return bool(s) and all(c in "0123456789abcdef" for c in s) \
+            and len(s) <= 128
+
+    def _trace_meta(self, t) -> dict:
+        return {
+            "nodes_csv": t.nodes_csv, "pods_csv": t.pods_csv,
+            "max_pods": t.max_pods, "digest": t.digest,
+            "nodes_sha256": t.nodes_sha256, "pods_sha256": t.pods_sha256,
+            "nodes_bytes": t.nodes_bytes, "pods_bytes": t.pods_bytes,
+        }
+
+    def _get_trace(self, path: str, headers):
+        """GET /traces/<name> (meta JSON) and /traces/<name>/nodes.csv |
+        pods.csv (the raw file, Range-resumable) — the download half of
+        the no-shared-fs transport: the worker verifies each file
+        against the handshake's sha256 and the parsed trace against the
+        content digest, so a truncated or skewed transfer can only fail
+        loudly, never run the wrong trace."""
+        parts = path[len("/traces/"):].split("/")
+        trace = self.service.traces.get(parts[0])
+        if trace is None:
+            return _json_body(
+                404, {"error": f"unknown trace {parts[0]!r} (hosted: "
+                      f"{', '.join(sorted(self.service.traces))})"}
+            )
+        if len(parts) == 1:
+            return _json_body(200, self._trace_meta(trace))
+        which = parts[1] if len(parts) == 2 else ""
+        src = {"nodes.csv": trace.nodes_csv,
+               "pods.csv": trace.pods_csv}.get(which)
+        if not src:
+            return _json_body(
+                404, {"error": f"unknown trace file {which!r} "
+                      "(want nodes.csv or pods.csv)"}
+            )
+        sha = {"nodes.csv": trace.nodes_sha256,
+               "pods.csv": trace.pods_sha256}[which]
+        try:
+            size = os.path.getsize(src)
+            start = 0
+            rng = str((headers or {}).get("Range") or "").strip()
+            if rng:
+                import re as _re
+
+                m = _re.match(r"bytes=(\d+)-$", rng)
+                # >= : a Range at exactly EOF (a fully-written .part
+                # that died pre-rename) is 416, never an empty 206
+                # with an inverted Content-Range
+                if m is None or int(m.group(1)) >= size:
+                    return (416, "text/plain", b"",
+                            {"Content-Range": f"bytes */{size}"})
+                start = int(m.group(1))
+            # seek + read the suffix only: a resume of the last few
+            # bytes must not cost an O(file) read per retry
+            with open(src, "rb") as f:
+                if start:
+                    f.seek(start)
+                data = f.read()
+        except OSError as err:
+            return _json_body(
+                500, {"error": f"hosted trace file unreadable: {err}"}
+            )
+        self.transfers["trace_requests"] += 1
+        self.transfers["trace_bytes"] += len(data)
+        hdrs = {"X-Content-SHA256": sha, "Accept-Ranges": "bytes"}
+        if start > 0:
+            hdrs["Content-Range"] = f"bytes {start}-{size - 1}/{size}"
+            return (206, "text/csv", data, hdrs)
+        return (200, "text/csv", data, hdrs)
+
+    def _accept_result(self, path: str, body: bytes):
+        """POST /results/<digest> — the upload half: the bytes must
+        verify as a signed result for EXACTLY this digest before the
+        atomic rename lands them; a torn or forged upload is rejected
+        with a [Degrade] warning and the artifact dir keeps no partial
+        file (svc.jobs.accept_result_upload)."""
+        digest = path[len("/results/"):]
+        if not self._safe_digest(digest):
+            return _json_body(404, {"error": f"bad result path {path!r}"})
+        try:
+            svc_jobs.accept_result_upload(
+                self.service.artifact_dir, digest, body
+            )
+        except (ValueError, json.JSONDecodeError) as err:
+            self.transfers["uploads_rejected"] += 1
+            print(
+                f"[Degrade] rejected result upload for {digest[:12]}… "
+                f"({err}); nothing written — the worker retries or the "
+                "lease expires",
+                file=self.out if self.out is not None else sys.stderr,
+            )
+            return _json_body(400, {"error": f"rejected upload: {err}"})
+        self.transfers["uploads_ok"] += 1
+        return _json_body(200, {"stored": digest, "bytes": len(body)})
+
+    def _leases(self, body: bytes):
+        """POST /leases — the remote workers' lease mirror: the
+        COORDINATOR writes/deletes the signed lease files on their
+        behalf (op=stake|release), so the on-disk recovery plane
+        (adoption, reaping, skew-judged expiry) is identical for
+        shared-fs and remote workers. Lenient about roster membership:
+        the lease file itself is the proof that matters."""
+        try:
+            doc = json.loads(body.decode() or "null")
+        except (json.JSONDecodeError, UnicodeDecodeError) as err:
+            return _json_body(400, {"error": f"bad JSON body: {err}"})
+        if not isinstance(doc, dict):
+            return _json_body(400, {"error": "want a JSON object"})
+        members = [str(m) for m in doc.get("members") or []]
+        if not members:
+            return _json_body(400, {"error": "want a members list"})
+        op = str(doc.get("op") or "stake")
+        if op not in ("stake", "release"):
+            return _json_body(
+                400, {"error": f"op must be stake|release, got {op!r}"}
+            )
+        bad = [m for m in members if not self._safe_digest(m)]
+        if bad:
+            # members become file stems under the artifact dir — a
+            # traversal payload ("../../x") must die here, loudly,
+            # before any os.path.join sees it
+            return _json_body(
+                400, {"error": f"member(s) are not job digests: "
+                      f"{[b[:40] for b in bad]}"}
+            )
+        wid = str(doc.get("worker") or "")
+        self.transfers["lease_posts"] += 1
+        self.registry.touch(wid)
+        if op == "release":
+            for d in members:
+                svc_leases.delete_lease(self.service.artifact_dir, d)
+            return _json_body(200, {"released": len(members)})
+        deadline = time.time() + self.queue.lease_s
+        for d in members:
+            svc_leases.write_lease(
+                self.service.artifact_dir, d, wid,
+                int(doc.get("pid") or 0), deadline, members,
+            )
+        return _json_body(
+            200, {"staked": len(members), "deadline_unix": deadline}
+        )
+
     def _known(self, doc):
         wid = str(doc.get("worker") or "")
         info = self.registry.touch(wid)
@@ -220,16 +431,14 @@ class FleetService:
     def _register(self, doc):
         info = self.registry.register(
             str(doc.get("worker") or ""), doc.get("pid") or 0,
-            str(doc.get("host") or ""),
+            str(doc.get("host") or ""), mode=str(doc.get("mode") or ""),
         )
         if self.out is not None:
-            print(f"[fleet] worker {info.id} joined (pid {info.pid})",
+            print(f"[fleet] worker {info.id} joined (pid {info.pid}"
+                  f"{', ' + info.mode if doc.get('mode') else ''})",
                   file=self.out)
         traces = {
-            name: {
-                "nodes_csv": t.nodes_csv, "pods_csv": t.pods_csv,
-                "max_pods": t.max_pods, "digest": t.digest,
-            }
+            name: self._trace_meta(t)
             for name, t in self.service.traces.items()
         }
         return _json_body(200, {
@@ -389,6 +598,10 @@ class FleetService:
                 info.first_dispatch_s = float(doc["dispatch_s"])
         if doc.get("sweep_executables") is not None:
             info.sweep_executables = int(doc["sweep_executables"])
+        if isinstance(doc.get("transfers"), dict):
+            info.transfers = {
+                k: int(v) for k, v in doc["transfers"].items()
+            }
         return _json_body(200, {"acked": acked, "dup": dup})
 
     # ---- restart recovery (the lease-file half) ----
@@ -434,24 +647,38 @@ class FleetService:
 
     def queue_fields(self) -> dict:
         rows = self.registry.describe(self.queue)
-        return {
+        out = {
             "workers": rows,
             "workers_live": self.registry.live_count(),
             "batches_run": sum(r["batches"] for r in rows.values()),
             "sweep_executables": sum(
                 r["sweep_executables"] for r in rows.values()
             ),
+            "transfer": dict(self.transfers),
         }
+        if self.supervisor is not None:
+            # respawns, backoff, breaker state + reason, autoscale
+            # counters — /queue "says why" (ISSUE 13)
+            out["supervisor"] = self.supervisor.describe()
+        return out
 
     def health(self):
         """MonitorServer.health_hook: the fleet coordinator is healthy
-        while ANY worker is live; it degrades to 503 only when none
-        are (the ISSUE 12 /healthz contract)."""
+        while ANY worker is live (the ISSUE 12 contract) AND the
+        supervisor's crash-loop circuit breaker is closed (ISSUE 13):
+        a breaker held open means the fleet cannot self-heal — that is
+        a loud 503, not three quiet respawn attempts per second."""
         live = self.registry.live_count()
-        return live > 0, {
+        ok = live > 0
+        extra = {
             "workers_live": live,
             "workers_known": len(self.registry.workers),
         }
+        if self.supervisor is not None:
+            sup_ok, sup_fields = self.supervisor.healthy()
+            extra.update(sup_fields)
+            ok = ok and sup_ok
+        return ok, extra
 
 
 # ---------------------------------------------------------------------------
@@ -459,72 +686,386 @@ class FleetService:
 # ---------------------------------------------------------------------------
 
 
-def _post(url: str, path: str, doc: dict, timeout: float = 30.0):
+def _with_backoff(call, max_attempts: int = 8, stop_event=None):
+    """Drive one HTTP call on the SHARED kube_client capped-exponential-
+    backoff-with-jitter schedule (ISSUE 13 satellite — register used to
+    be the only fleet POST that retried; now every worker→coordinator
+    request rides this): `call()` returns (code, headers, body);
+    connection-level errors (including REFUSED — a restarting
+    coordinator refuses for a moment, and to a worker that is a stall,
+    not a death) and 429/5xx answers are retried honoring a server
+    Retry-After; the final attempt's answer (or exception) surfaces.
+
+    `stop_event` aborts the RETRY schedule (the last answer surfaces
+    at once and backoff sleeps wake early) — a SIGTERM'd worker whose
+    draining coordinator answers 503 + Retry-After must exit its idle
+    claim loop promptly, not ride out eight 2-second retries first."""
+    from tpusim.io.kube_client import (
+        _retry_delay_s,
+        is_retryable_status,
+        retryable_conn_excs,
+    )
+
+    def stopped():
+        return stop_event is not None and stop_event.is_set()
+
+    def wait(delay):
+        if stop_event is not None:
+            stop_event.wait(delay)
+        else:
+            time.sleep(delay)
+
+    for attempt in range(1, max_attempts + 1):
+        try:
+            code, headers, body = call()
+        except retryable_conn_excs():
+            if attempt >= max_attempts or stopped():
+                raise
+            wait(_retry_delay_s(attempt))
+            continue
+        if (is_retryable_status(code) and attempt < max_attempts
+                and not stopped()):
+            wait(_retry_delay_s(
+                attempt, (headers or {}).get("Retry-After")
+            ))
+            continue
+        return code, headers, body
+
+
+def _post(url: str, path: str, doc: dict, timeout: float = 30.0,
+          max_attempts: int = 8, stop_event=None):
     from tpusim.svc.client import _request
 
-    return _request(
-        url.rstrip("/") + path,
-        json.dumps(doc).encode(), timeout=timeout,
+    full = url.rstrip("/") + path
+    data = json.dumps(doc).encode()
+    return _with_backoff(
+        lambda: _request(full, data, timeout=timeout),
+        max_attempts=max_attempts, stop_event=stop_event,
     )
+
+
+def _post_bytes(url: str, path: str, data: bytes, timeout: float = 60.0,
+                max_attempts: int = 8):
+    """POST raw bytes (the signed-result upload) on the same backoff
+    schedule as _post."""
+    from tpusim.svc.client import _request
+
+    full = url.rstrip("/") + path
+    return _with_backoff(
+        lambda: _request(full, data, timeout=timeout,
+                         content_type="application/octet-stream"),
+        max_attempts=max_attempts,
+    )
+
+
+def _get_bytes(url: str, path: str, offset: int = 0,
+               timeout: float = 60.0):
+    """(code, headers, raw bytes) of one coordinator GET; offset > 0
+    sends a Range header (the partial-transfer resume)."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url.rstrip("/") + path)
+    if offset > 0:
+        req.add_header("Range", f"bytes={int(offset)}-")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers or {}), e.read()
+
+
+def new_transfer_counters() -> dict:
+    """The worker-side transfer counters reported on every complete
+    POST and surfaced per worker in /workers (ISSUE 13)."""
+    return {
+        "downloads": 0, "download_bytes": 0, "resumed": 0,
+        "sha_retries": 0, "uploads": 0, "upload_bytes": 0,
+        "upload_failed": 0,
+    }
+
+
+def _part_path(dest: str) -> str:
+    # pid-scoped so two workers sharing one trace cache never append
+    # into each other's partial transfer
+    return f"{dest}.{os.getpid()}.part"
+
+
+def _adopt_orphan_part(dest: str) -> None:
+    """Claim a DEAD predecessor's partial download so crash-resume
+    actually reaches across a respawn: pid-scoped .part names keep live
+    writers apart, but a worker that was kill -9'd mid-transfer leaves
+    a part its respawned successor (new pid) could neither resume nor
+    clean. Adopt the largest part whose pid no longer exists (a dead
+    pid cannot write again, so the rename is race-free against its
+    owner); unlink the other dead ones."""
+    mine = _part_path(dest)
+    if os.path.isfile(mine):
+        return
+    d, base = os.path.split(dest)
+    dead = []
+    try:
+        names = os.listdir(d or ".")
+    except OSError:
+        return
+    for fname in names:
+        if not (fname.startswith(base + ".") and fname.endswith(".part")):
+            continue
+        pid_s = fname[len(base) + 1:-len(".part")]
+        if not pid_s.isdigit() or int(pid_s) == os.getpid():
+            continue
+        try:
+            os.kill(int(pid_s), 0)
+            continue  # owner still alive: hands off
+        except ProcessLookupError:
+            pass
+        except (PermissionError, OSError):
+            continue  # exists (other uid) or unknowable: hands off
+        path = os.path.join(d, fname)
+        try:
+            dead.append((os.path.getsize(path), path))
+        except OSError:
+            pass
+    if not dead:
+        return
+    dead.sort(reverse=True)
+    try:
+        os.replace(dead[0][1], mine)
+    except OSError:
+        return
+    for _, path in dead[1:]:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def fetch_trace_file(url: str, rel: str, dest: str, sha256: str,
+                     counters: Optional[dict] = None, out=None,
+                     max_attempts: int = 8) -> str:
+    """Download one hosted trace file to `dest`, resuming a partial
+    transfer (Range from the .part file's size) and verifying the raw
+    bytes against the handshake's sha256. A verification miss wipes the
+    partial file and re-downloads from byte 0 ONCE; a second miss is a
+    loud failure (the coordinator is serving different bytes than it
+    advertised — version skew, never something to paper over). The
+    completed file lands by atomic rename, so a cached dest is always
+    whole."""
+    from tpusim.io.storage import file_sha256
+    from tpusim.svc.client import ServiceError
+
+    if counters is None:
+        counters = new_transfer_counters()
+    _adopt_orphan_part(dest)
+    part = _part_path(dest)
+    for round_ in (1, 2):
+        offset = os.path.getsize(part) if os.path.isfile(part) else 0
+        if offset > 0 and sha256 and file_sha256(part) == sha256:
+            # the predecessor had actually finished the bytes and died
+            # between write and rename — nothing left to transfer
+            os.replace(part, dest)
+            return dest
+        if offset > 0:
+            counters["resumed"] += 1
+        code, headers, data = _with_backoff(
+            lambda: _get_bytes(url, rel, offset=offset),
+            max_attempts=max_attempts,
+        )
+        if code == 416:
+            # stale oversized .part (the file shrank server-side):
+            # restart clean
+            try:
+                os.unlink(part)
+            except OSError:
+                pass
+            offset = 0
+            code, headers, data = _with_backoff(
+                lambda: _get_bytes(url, rel, offset=0),
+                max_attempts=max_attempts,
+            )
+        if code not in (200, 206):
+            raise ServiceError(f"GET {rel} -> HTTP {code}")
+        mode = "ab" if (code == 206 and offset > 0) else "wb"
+        with open(part, mode) as f:
+            f.write(data)
+        counters["downloads"] += 1
+        counters["download_bytes"] += len(data)
+        got = file_sha256(part)
+        want = sha256 or (headers or {}).get("X-Content-SHA256") or ""
+        if not want or got == want:
+            os.replace(part, dest)
+            return dest
+        counters["sha_retries"] += 1
+        if out is not None:
+            print(
+                f"[worker] {rel}: sha256 mismatch after download "
+                f"(got {got[:12]}…, want {want[:12]}…) — "
+                f"{'re-downloading from byte 0' if round_ == 1 else 'giving up'}",
+                file=out,
+            )
+        try:
+            os.unlink(part)
+        except OSError:
+            pass
+    raise ServiceError(
+        f"downloaded {rel} twice and the sha256 still mismatches the "
+        "register handshake (coordinator/worker version or content "
+        "skew) — refusing to parse it"
+    )
+
+
+def ensure_local_trace(url: str, name: str, meta: dict, cache_dir: str,
+                       counters: Optional[dict] = None, out=None):
+    """The remote worker's trace acquisition: a local cache keyed by
+    the trace CONTENT digest (`<cache>/traces/<digest>/{nodes,pods}.csv`)
+    — a cache hit (file present, sha256 matching the handshake) costs
+    zero HTTP; a miss/mismatch re-downloads with resume; and the parsed
+    trace must reproduce the coordinator's content digest exactly or
+    the worker refuses to serve (the ISSUE 12 skew contract, now over
+    the wire). Returns a TraceRef."""
+    from tpusim.io.storage import file_sha256
+    from tpusim.svc.client import ServiceError
+    from tpusim.svc.worker import load_trace
+
+    ddir = os.path.join(cache_dir, "traces", str(meta["digest"]))
+    os.makedirs(ddir, exist_ok=True)
+    paths = {}
+    for which, sha_key in (("nodes.csv", "nodes_sha256"),
+                           ("pods.csv", "pods_sha256")):
+        dest = os.path.join(ddir, which)
+        sha = str(meta.get(sha_key) or "")
+        if os.path.isfile(dest) and sha and file_sha256(dest) == sha:
+            paths[which] = dest
+            continue
+        if os.path.isfile(dest):
+            # cached bytes no longer match the handshake: force a
+            # fresh download (the re-download-on-mismatch contract)
+            if counters is not None:
+                counters["sha_retries"] += 1
+            try:
+                os.unlink(dest)
+            except OSError:
+                pass
+        fetch_trace_file(
+            url, f"/traces/{name}/{which}", dest, sha,
+            counters=counters, out=out,
+        )
+        paths[which] = dest
+    t = load_trace(
+        name, paths["nodes.csv"], paths["pods.csv"],
+        max_pods=int(meta.get("max_pods") or 0),
+    )
+    if t.digest != meta["digest"]:
+        raise ServiceError(
+            f"hosted trace {name!r} content-digest mismatch after a "
+            f"verified download: coordinator {meta['digest'][:12]}… vs "
+            f"local parse {t.digest[:12]}… (code version skew)"
+        )
+    return t
+
+
+def resolve_worker_mode(mode: str, reg: dict) -> str:
+    """auto → shared-fs iff the coordinator's artifact dir AND every
+    hosted trace CSV are readable from this host (same machine or a
+    genuinely shared filesystem — the digest checks still guard
+    content skew); anything unreachable means this worker runs in
+    remote mode: digest-verified downloads, result uploads, lease
+    POSTs. Explicit modes pass through untouched."""
+    if mode in ("shared-fs", "remote"):
+        return mode
+    if mode not in ("", "auto"):
+        raise ValueError(
+            f"worker mode must be auto | shared-fs | remote, got {mode!r}"
+        )
+    if not os.path.isdir(reg.get("artifact_dir") or ""):
+        return "remote"
+    for meta in (reg.get("traces") or {}).values():
+        if not (os.path.isfile(meta.get("nodes_csv") or "")
+                and os.path.isfile(meta.get("pods_csv") or "")):
+            return "remote"
+    return "shared-fs"
 
 
 def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
                max_batches: int = 0, table_cache_dir: str = "",
                compile_cache_dir: str = "", out=None,
-               stop_event=None) -> int:
+               stop_event=None, mode: str = "auto",
+               cache_dir: str = "") -> int:
     """The fleet worker's main loop: register, then claim/run/complete
     until stopped (or `max_batches` served — the test/smoke bound).
     Returns the number of batches served. SIGTERM handling is the
     caller's (the CLI installs a drain flag via `stop_event`); a
-    `kill -9` needs no handling — that is what the leases are for."""
-    import http.client
-    import urllib.error
+    `kill -9` needs no handling — that is what the leases are for.
 
-    from tpusim.io.kube_client import _retry_delay_s
+    `mode` (ISSUE 13): "shared-fs" reads the coordinator's trace CSVs
+    by path and writes results straight into the shared artifact dir
+    (the ISSUE 12 behavior); "remote" needs NO shared filesystem —
+    traces are downloaded into a digest-keyed local cache, results are
+    written locally then UPLOADED (the coordinator digest-verifies
+    before the atomic rename), and leases are staked/released via POST
+    /leases; "auto" (default) probes the handshake's paths and picks.
+    Every POST rides the shared capped-backoff-with-jitter schedule
+    honoring Retry-After, so a coordinator restart mid-claim is a
+    stall, not a dead worker."""
+    from tpusim.io.kube_client import retryable_conn_excs
     from tpusim.svc.client import ServiceError
     from tpusim.svc.worker import Worker, load_trace
 
     host = os.uname().nodename if hasattr(os, "uname") else ""
-    reg = None
-    for attempt in range(1, 9):
-        try:
-            code, _, reg = _post(url, "/workers/register", {
-                "worker": worker_id, "pid": os.getpid(), "host": host,
-            })
-        except (ConnectionResetError, ConnectionRefusedError,
-                http.client.RemoteDisconnected,
-                urllib.error.URLError):
-            # the coordinator may still be binding its socket
-            if attempt >= 8:
-                raise ServiceError(
-                    f"could not reach the coordinator at {url}"
-                )
-            time.sleep(_retry_delay_s(attempt))
-            continue
-        if code != 200:
-            raise ServiceError(
-                f"POST /workers/register -> HTTP {code}: {reg}"
-            )
-        break
+    try:
+        code, _, reg = _post(url, "/workers/register", {
+            "worker": worker_id, "pid": os.getpid(), "host": host,
+        }, stop_event=stop_event)
+    except retryable_conn_excs() as err:
+        raise ServiceError(
+            f"could not reach the coordinator at {url} "
+            f"({type(err).__name__}: {err})"
+        )
+    if code != 200:
+        raise ServiceError(
+            f"POST /workers/register -> HTTP {code}: {reg}"
+        )
     wid = reg["worker"]
     lease_s = float(reg["lease_s"])
-    artifact_dir = reg["artifact_dir"]
+    counters = new_transfer_counters()
+
+    mode = resolve_worker_mode(mode, reg)
+    # record the resolved topology in the roster (register is an
+    # idempotent update — /workers shows mode per worker)
+    _post(url, "/workers/register", {
+        "worker": wid, "pid": os.getpid(), "host": host, "mode": mode,
+    })
 
     traces = {}
-    for name, meta in (reg.get("traces") or {}).items():
-        t = load_trace(
-            name, meta["nodes_csv"], meta["pods_csv"],
-            max_pods=int(meta.get("max_pods") or 0),
-        )
-        if t.digest != meta["digest"]:
-            # trace skew: this worker would compute results under a
-            # DIFFERENT digest vocabulary — refuse to serve
-            raise ServiceError(
-                f"hosted trace {name!r} digest mismatch: coordinator "
-                f"{meta['digest'][:12]}… vs local {t.digest[:12]}… "
-                "(differing CSVs or code version)"
+    if mode == "remote":
+        if not cache_dir:
+            import tempfile
+
+            cache_dir = os.path.join(
+                tempfile.gettempdir(), "tpusim-worker-cache"
             )
-        traces[name] = t
+        artifact_dir = os.path.join(cache_dir, "artifacts")
+        os.makedirs(artifact_dir, exist_ok=True)
+        for name, meta in (reg.get("traces") or {}).items():
+            traces[name] = ensure_local_trace(
+                url, name, meta, cache_dir, counters=counters, out=out,
+            )
+    else:
+        artifact_dir = reg["artifact_dir"]
+        for name, meta in (reg.get("traces") or {}).items():
+            t = load_trace(
+                name, meta["nodes_csv"], meta["pods_csv"],
+                max_pods=int(meta.get("max_pods") or 0),
+            )
+            if t.digest != meta["digest"]:
+                # trace skew: this worker would compute results under a
+                # DIFFERENT digest vocabulary — refuse to serve
+                raise ServiceError(
+                    f"hosted trace {name!r} digest mismatch: coordinator "
+                    f"{meta['digest'][:12]}… vs local {t.digest[:12]}… "
+                    "(differing CSVs or code version)"
+                )
+            traces[name] = t
 
     queue = JobQueue(
         maxsize=max(4 * int(reg["lane_width"]), 8),
@@ -545,31 +1086,51 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
         return doc.get("lost") or []
 
     worker.renew_cb = renew_remote
+    if mode == "remote":
+        # the lease FILES live on the coordinator's disk (adoption and
+        # reaping are unchanged) — a no-shared-fs worker mirrors them
+        # over POST /leases; short retry budgets keep the keeper thread
+        # from stalling a whole renewal period on a flaky link
+        worker.lease_stake_cb = lambda members: _post(
+            url, "/leases",
+            {"op": "stake", "worker": wid, "pid": os.getpid(),
+             "members": list(members)},
+            max_attempts=3,
+        )
+        worker.lease_release_cb = lambda members: _post(
+            url, "/leases",
+            {"op": "release", "worker": wid, "members": list(members)},
+            max_attempts=3,
+        )
 
     from tpusim.sim.driver import enable_compile_cache
 
     enable_compile_cache(compile_cache_dir)
     if out is not None:
         print(
-            f"[worker {wid}] joined {url} (pid {os.getpid()}, "
+            f"[worker {wid}] joined {url} ({mode}, pid {os.getpid()}, "
             f"{len(traces)} trace(s), lease {lease_s:.1f}s)", file=out,
         )
 
     served = 0
     while stop_event is None or not stop_event.is_set():
         try:
-            code, _, doc = _post(url, "/workers/claim", {"worker": wid})
-        except (ConnectionResetError, ConnectionRefusedError,
-                http.client.RemoteDisconnected,
-                urllib.error.URLError):
-            # coordinator restarting: its recovery requeues everything;
-            # keep polling on the shared backoff schedule
+            # the IDLE path carries the stop_event: a drain must not
+            # wait out the whole backoff schedule against a draining
+            # coordinator's 503s (uploads/completions below finish
+            # regardless — that is the graceful half)
+            code, _, doc = _post(url, "/workers/claim", {"worker": wid},
+                                 stop_event=stop_event)
+        except retryable_conn_excs():
+            # coordinator down longer than the whole backoff schedule:
+            # its recovery requeues everything; keep polling
             time.sleep(max(poll_s, 0.5))
             continue
         if code == 409:
             # roster wiped by a coordinator restart — re-register
             _post(url, "/workers/register", {
                 "worker": wid, "pid": os.getpid(), "host": host,
+                "mode": mode,
             })
             continue
         if code != 200:
@@ -608,15 +1169,61 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
             j.digest: j.error for j in batch if j.status == "failed"
         }
         failed.update(skew_failed)
+        if mode == "remote" and done:
+            # the upload half (ISSUE 13): ship each signed result's
+            # BYTES to the coordinator, which digest-verifies before
+            # the atomic rename — completion below then finds them on
+            # ITS disk. An upload the coordinator rejects (impossible
+            # for bytes our own read just verified, short of a forged
+            # proxy) demotes the job to failed so the loud complete
+            # path reports it.
+            still_done = []
+            for d in done:
+                data = svc_jobs.result_bytes(artifact_dir, d)
+                if data is None:
+                    failed[d] = "local signed result vanished/torn"
+                    continue
+                try:
+                    code, _, up = _post_bytes(url, f"/results/{d}", data)
+                except retryable_conn_excs():
+                    code, up = 0, {"error": "coordinator unreachable"}
+                if code == 200:
+                    counters["uploads"] += 1
+                    counters["upload_bytes"] += len(data)
+                    still_done.append(d)
+                elif 400 <= code < 500:
+                    # a definitive rejection (torn/forged verdict from
+                    # the coordinator) is terminal — report it loudly
+                    counters["upload_failed"] += 1
+                    failed[d] = (
+                        f"result upload -> HTTP {code}: "
+                        f"{(up or {}).get('error', up)}"
+                    )
+                else:
+                    # transport failure / 5xx after the whole backoff
+                    # schedule: the result is correct and sitting in
+                    # local scratch — do NOT report the job at all, so
+                    # the lease expires and a steal either re-runs it
+                    # or (after our later re-upload) answers from disk.
+                    # Demoting to failed here would make a transient
+                    # partition terminal.
+                    counters["upload_failed"] += 1
+                    if out is not None:
+                        print(
+                            f"[worker {wid}] result upload for "
+                            f"{d[:12]}… failed transiently (HTTP "
+                            f"{code}); leaving the job to lease "
+                            "expiry", file=out,
+                        )
+            done = still_done
         try:
             _post(url, "/workers/complete", {
                 "worker": wid, "done": done, "failed": failed,
                 "dispatch_s": worker.last_dispatch_s,
                 "sweep_executables": worker.sweep_executables(),
+                "transfers": counters,
             })
-        except (ConnectionResetError, ConnectionRefusedError,
-                http.client.RemoteDisconnected,
-                urllib.error.URLError):
+        except retryable_conn_excs():
             # results + spec deletions are already on disk — a restarted
             # coordinator reconciles from there (its claim shortcut)
             pass
@@ -637,6 +1244,26 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
 # ---------------------------------------------------------------------------
 
 
+def worker_command(url: str, table_cache_dir: str = "",
+                   compile_cache_dir: str = "", mode: str = "",
+                   cache_dir: str = "") -> List[str]:
+    """The `tpusim worker --join` argv for one spawned child — shared
+    by spawn_local_workers and the supervisor's spawn_fn (ISSUE 13).
+    No --id: the coordinator assigns pid-scoped ids, so a respawned or
+    later-joined child can never collide with (and inherit the stats
+    of) an earlier worker's roster entry."""
+    cmd = [sys.executable, "-m", "tpusim", "worker", "--join", url]
+    if table_cache_dir:
+        cmd += ["--table-cache-dir", table_cache_dir]
+    if compile_cache_dir:
+        cmd += ["--compile-cache-dir", compile_cache_dir]
+    if mode:
+        cmd += ["--mode", mode]
+    if cache_dir:
+        cmd += ["--cache-dir", cache_dir]
+    return cmd
+
+
 def spawn_local_workers(url: str, n: int, table_cache_dir: str = "",
                         compile_cache_dir: str = "",
                         out=None) -> List[subprocess.Popen]:
@@ -646,14 +1273,10 @@ def spawn_local_workers(url: str, n: int, table_cache_dir: str = "",
     state that makes a joiner's first batch skip the compile."""
     procs = []
     for _ in range(int(n)):
-        # no --id: the coordinator assigns pid-scoped ids, so a joiner
-        # spawned later can never collide with (and inherit the stats
-        # of) an earlier worker's roster entry
-        cmd = [sys.executable, "-m", "tpusim", "worker", "--join", url]
-        if table_cache_dir:
-            cmd += ["--table-cache-dir", table_cache_dir]
-        if compile_cache_dir:
-            cmd += ["--compile-cache-dir", compile_cache_dir]
+        cmd = worker_command(
+            url, table_cache_dir=table_cache_dir,
+            compile_cache_dir=compile_cache_dir,
+        )
         procs.append(subprocess.Popen(cmd))
         if out is not None:
             print(f"[fleet] spawned worker process pid {procs[-1].pid}",

@@ -520,6 +520,82 @@ def write_result(artifact_dir: str, digest: str, result: dict) -> str:
     )
 
 
+def verify_result_bytes(digest: str, data: bytes) -> dict:
+    """Validate UPLOADED signed-result bytes before a single byte lands
+    on disk (ISSUE 13, the no-shared-fs upload path): the bytes must
+    parse as a signed-JSONL result file whose header names THIS job
+    digest and whose payload digest verifies — a torn (truncated
+    mid-transfer) or forged (wrong job, edited payload) upload raises
+    ValueError and the coordinator answers 400 without writing
+    anything. Returns the parsed result document."""
+    try:
+        raw = [
+            ln for ln in data.decode("utf-8").split("\n") if ln.strip()
+        ]
+    except UnicodeDecodeError as err:
+        raise ValueError(f"result upload is not UTF-8 text: {err}")
+    if not raw:
+        raise ValueError("empty result upload")
+    header = json.loads(raw[0])
+    if not isinstance(header, dict):
+        # a non-object first line must be a clean 400 rejection, not an
+        # AttributeError that the HTTP plane answers as a retryable 500
+        raise ValueError(
+            f"header line is {type(header).__name__}, want a JSON object"
+        )
+    if header.get("schema") != RESULT_SCHEMA:
+        raise ValueError(
+            f"not a {RESULT_SCHEMA} document "
+            f"(schema={header.get('schema')!r})"
+        )
+    if header.get("job") != digest:
+        raise ValueError(
+            f"foreign result upload: header names job "
+            f"{str(header.get('job'))[:12]}…, URL names {digest[:12]}…"
+        )
+    payload = raw[1:]
+    from tpusim.io.storage import payload_digest
+
+    got = payload_digest(payload)
+    if got != header.get("digest"):
+        raise ValueError(
+            "payload digest mismatch (torn or forged upload): header "
+            f"{header.get('digest')} != computed {got}"
+        )
+    if len(payload) != 1:
+        raise ValueError(
+            f"want exactly one payload document, found {len(payload)}"
+        )
+    return json.loads(payload[0])
+
+
+def accept_result_upload(artifact_dir: str, digest: str,
+                         data: bytes) -> dict:
+    """Land one verified result upload atomically: verify_result_bytes
+    first (raises on torn/forged bytes — nothing is written), then an
+    atomic whole-file replace, so the artifact dir only ever holds
+    complete, digest-valid result files. Re-uploading identical bytes
+    (the duplicate-completion race over the wire) is an idempotent
+    overwrite. Returns the parsed result document."""
+    result = verify_result_bytes(digest, data)
+    from tpusim.io.storage import write_bytes_atomic
+
+    # normalize to exactly what write_result would have produced
+    # locally: content already verified, so the bytes ARE the file
+    write_bytes_atomic(result_path(artifact_dir, digest), data)
+    return result
+
+
+def result_bytes(artifact_dir: str, digest: str) -> Optional[bytes]:
+    """Raw bytes of a job's VALID signed result file, or None — the
+    worker side of the upload path reads these (validity via
+    find_result first, so a torn local file is never uploaded)."""
+    if find_result(artifact_dir, digest) is None:
+        return None
+    with open(result_path(artifact_dir, digest), "rb") as f:
+        return f.read()
+
+
 def find_result(artifact_dir: str, digest: str) -> Optional[dict]:
     """Load a persisted result for this job digest, or None. Torn /
     digest-mismatched / foreign files are DELETED and treated as a miss

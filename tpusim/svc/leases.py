@@ -51,18 +51,53 @@ LEASE_SCHEMA = "tpusim-svc-lease/1"
 DEFAULT_LEASE_S = 15.0
 
 
+def _float_env(name: str, default: float, minimum: float = 0.0) -> float:
+    """Read one float env knob, failing LOUDLY on an unparseable or
+    out-of-range value (ISSUE 13 satellite): a typo'd
+    TPUSIM_LEASE_SKEW_S used to fall back silently — a mis-set margin
+    can make every lease either immortal or instantly stealable across
+    a whole fleet, and the operator deserves to hear about it at the
+    first read, with the variable named, not as a bare ValueError deep
+    in the expiry path (or worse, not at all)."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid number (want seconds as a "
+            f"float, e.g. {name}={default}); unset it to use the "
+            f"default {default}"
+        )
+    if val != val or val in (float("inf"), float("-inf")) \
+            or val < minimum:
+        raise ValueError(
+            f"{name}={raw!r} must be a finite number >= {minimum} "
+            f"(got {val}); unset it to use the default {default}"
+        )
+    return val
+
+
 def lease_skew_s() -> float:
     """Clock-skew margin added to every expiry judgement (env
-    TPUSIM_LEASE_SKEW_S, default 2 s). Malformed values fall back to
-    the default — a bad env var must not turn every lease immortal or
-    instantly stealable."""
-    raw = os.environ.get("TPUSIM_LEASE_SKEW_S", "")
-    if raw:
-        try:
-            return max(float(raw), 0.0)
-        except ValueError:
-            pass
-    return 2.0
+    TPUSIM_LEASE_SKEW_S, default 2 s). Unparseable values fail loudly
+    at read (`_float_env`) — never silently, never deep in the expiry
+    path."""
+    return _float_env("TPUSIM_LEASE_SKEW_S", 2.0)
+
+
+def default_lease_s() -> float:
+    """The lease duration used when no --lease-s override is given:
+    env TPUSIM_LEASE_S (same fail-loud validation; must be > 0) or
+    DEFAULT_LEASE_S. A whole-fleet knob — workers learn the value from
+    the register handshake, so only the coordinator reads it."""
+    val = _float_env("TPUSIM_LEASE_S", DEFAULT_LEASE_S)
+    if val <= 0.0:
+        raise ValueError(
+            f"TPUSIM_LEASE_S must be > 0 seconds, got {val}"
+        )
+    return val
 
 
 def lease_path(artifact_dir: str, digest: str) -> str:

@@ -1,0 +1,348 @@
+"""The self-healing fleet supervisor (ISSUE 13).
+
+PR 12's `serve --jobs --workers N` spawned N children once and merely
+REAPED the dead (released their leases, printed a line) — a fleet that
+only ever shrinks. This controller owns the children end to end:
+
+  respawn     a reaped child is replaced, under CAPPED EXPONENTIAL
+              backoff keyed to consecutive fast exits — a child that
+              lived a while resets the schedule, a child that dies at
+              startup doubles it, so a broken worker binary costs
+              seconds of spawn attempts per minute, not a fork bomb.
+  breaker     the crash-loop circuit breaker: K respawns inside a
+              W-second window opens it — respawning STOPS, /healthz
+              degrades to 503 (FleetService.health folds `healthy()`
+              in), and /queue says exactly why (`describe()` rides
+              FleetService.queue_fields). A crash loop is an outage to
+              report, not a treadmill to run.
+  autoscale   `--workers N --max-workers M`: a queue backlog deeper
+              than the live fleet can chew (depth > alive x
+              depth_per_worker) spawns an extra child up to M; a queue
+              idle past `scale_idle_s` drains one back down to N —
+              gracefully, via SIGTERM (the worker CLI's drain flag
+              finishes the in-flight batch), and a draining child is
+              never respawned.
+
+Everything is poll-driven (the serve loop calls `poll()` on its watch
+cadence) and clock-injectable (`now` params), so the whole state
+machine is testable with fake children and fake time — no processes,
+no sleeps (tests/test_supervisor.py). The spawn callable is injected
+too: the CLI passes a `tpusim worker --join` Popen factory
+(svc.fleet.worker_command), the WAN smoke passes one that gives each
+worker an isolated cache dir, and the crash-loop drill passes one that
+exits immediately.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+@dataclass
+class Child:
+    """One supervised worker process (or a test fake exposing pid,
+    poll(), send_signal(), kill(), wait())."""
+
+    proc: object
+    spawned_unix: float
+    draining: bool = False  # SIGTERM'd by scale-down: exit expected,
+    # never respawned
+
+    @property
+    def pid(self) -> int:
+        return int(getattr(self.proc, "pid", 0))
+
+
+@dataclass
+class BreakerState:
+    open: bool = False
+    reason: str = ""
+    opened_unix: float = 0.0
+    trips: int = 0
+    respawn_times: List[float] = field(default_factory=list)
+
+
+class Supervisor:
+    """See module docstring. Thread-safety: `poll()` runs on ONE thread
+    (the serve loop); `describe()`/`healthy()` are read by HTTP handler
+    threads — all state mutations hold `_lock`."""
+
+    def __init__(self, spawn_fn: Callable[[int], object], workers: int,
+                 max_workers: int = 0, *,
+                 backoff_base_s: float = 0.5, backoff_cap_s: float = 30.0,
+                 breaker_k: int = 5, breaker_window_s: float = 30.0,
+                 healthy_after_s: float = 5.0,
+                 load_fn: Optional[Callable[[], int]] = None,
+                 depth_per_worker: int = 8,
+                 scale_idle_s: float = 10.0,
+                 scale_cooldown_s: float = 2.0,
+                 on_exit: Optional[Callable[[int], object]] = None,
+                 out=None):
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
+        if max_workers and max_workers < workers:
+            raise ValueError(
+                f"--max-workers {max_workers} must be >= --workers "
+                f"{workers}"
+            )
+        self.spawn_fn = spawn_fn
+        self.base = int(workers)  # the floor the respawner maintains
+        self.max = int(max_workers) if max_workers else int(workers)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.breaker_k = int(breaker_k)
+        self.breaker_window_s = float(breaker_window_s)
+        self.healthy_after_s = float(healthy_after_s)
+        self.load_fn = load_fn  # () -> queued depth (autoscale signal)
+        self.depth_per_worker = int(depth_per_worker)
+        self.scale_idle_s = float(scale_idle_s)
+        self.scale_cooldown_s = float(scale_cooldown_s)
+        self.on_exit = on_exit  # pid -> ignored (fleet.release_dead)
+        self.out = out
+        self.children: List[Child] = []
+        self.breaker = BreakerState()
+        self._failures = 0  # consecutive fast exits (backoff key)
+        self._next_spawn_unix = 0.0
+        self._next_scale_unix = 0.0
+        self._idle_since: Optional[float] = None
+        self._spawned_total = 0
+        self.counters = {
+            "spawns": 0, "respawns": 0, "exits": 0,
+            "scale_ups": 0, "scale_downs": 0,
+        }
+        self._lock = threading.Lock()
+
+    # ---- lifecycle ----
+
+    def _spawn(self, now: float) -> Child:
+        proc = self.spawn_fn(self._spawned_total)
+        self._spawned_total += 1
+        self.counters["spawns"] += 1
+        child = Child(proc=proc, spawned_unix=now)
+        self.children.append(child)
+        if self.out is not None:
+            print(f"[supervisor] spawned worker pid {child.pid} "
+                  f"({len(self.children)} alive)", file=self.out)
+        return child
+
+    def start(self, now: Optional[float] = None) -> "Supervisor":
+        now = time.time() if now is None else now
+        with self._lock:
+            while len(self.children) < self.base:
+                self._spawn(now)
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Drain every child: SIGTERM (graceful — the worker CLI's stop
+        flag finishes the in-flight batch), escalate to kill past the
+        timeout (leases make even that safe)."""
+        with self._lock:
+            children = list(self.children)
+            self.children = []
+        for c in children:
+            if c.proc.poll() is None:
+                try:
+                    c.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.time() + timeout
+        for c in children:
+            remaining = max(deadline - time.time(), 0.1)
+            try:
+                c.proc.wait(remaining)
+            except Exception:
+                if self.out is not None:
+                    print(f"[supervisor] worker pid {c.pid} ignored "
+                          "SIGTERM — killing (leases cover it)",
+                          file=self.out)
+                try:
+                    c.proc.kill()
+                except OSError:
+                    pass
+
+    # ---- the state machine ----
+
+    def _backoff_s(self) -> float:
+        if self._failures <= 0:
+            return 0.0
+        return min(
+            self.backoff_base_s * (2 ** (self._failures - 1)),
+            self.backoff_cap_s,
+        )
+
+    def _trip_breaker(self, now: float) -> None:
+        self.breaker.open = True
+        self.breaker.trips += 1
+        self.breaker.opened_unix = now
+        self.breaker.reason = (
+            f"crash loop: {self.breaker_k} respawns within "
+            f"{self.breaker_window_s:.0f}s — respawning stopped; fix "
+            "the worker (see its stderr) and restart the coordinator "
+            "or call reset_breaker()"
+        )
+        if self.out is not None:
+            print(f"[supervisor] CIRCUIT BREAKER OPEN: "
+                  f"{self.breaker.reason}", file=self.out)
+
+    def reset_breaker(self) -> None:
+        """Re-arm after the operator fixed the crash cause."""
+        with self._lock:
+            self.breaker.open = False
+            self.breaker.reason = ""
+            self.breaker.respawn_times = []
+            self._failures = 0
+            self._next_spawn_unix = 0.0
+
+    def poll(self, now: Optional[float] = None) -> dict:
+        """One supervision pass: reap exited children (releasing their
+        leases via on_exit), respawn under backoff/breaker, and apply
+        the autoscale policy. Returns the events of THIS pass (reaped
+        pids, spawned pids, breaker flag) for the caller's logging."""
+        now = time.time() if now is None else now
+        events = {"reaped": [], "spawned": [], "breaker_open": False}
+        with self._lock:
+            # 1. reap
+            for child in list(self.children):
+                rc = child.proc.poll()
+                if rc is None:
+                    continue
+                self.children.remove(child)
+                self.counters["exits"] += 1
+                events["reaped"].append(child.pid)
+                lifetime = now - child.spawned_unix
+                if child.draining:
+                    # a scale-down drain completing is the plan working
+                    if self.out is not None:
+                        print(f"[supervisor] drained worker pid "
+                              f"{child.pid} (scale-down)", file=self.out)
+                elif lifetime < self.healthy_after_s:
+                    self._failures += 1
+                else:
+                    self._failures = 0
+                if self.on_exit is not None and not child.draining:
+                    try:
+                        self.on_exit(child.pid)
+                    except Exception:
+                        pass
+                if not child.draining and self.out is not None:
+                    print(
+                        f"[supervisor] worker pid {child.pid} exited "
+                        f"(rc {rc}, lived {lifetime:.1f}s); "
+                        f"{'respawn pending' if not self.breaker.open else 'breaker open — NOT respawning'}",
+                        file=self.out,
+                    )
+
+            alive = [c for c in self.children if not c.draining]
+
+            # 2. respawn toward the floor (breaker + backoff gated)
+            while (len(alive) < self.base and not self.breaker.open
+                   and now >= self._next_spawn_unix):
+                window = [
+                    t for t in self.breaker.respawn_times
+                    if t > now - self.breaker_window_s
+                ]
+                self.breaker.respawn_times = window
+                if len(window) >= self.breaker_k:
+                    self._trip_breaker(now)
+                    events["breaker_open"] = True
+                    break
+                child = self._spawn(now)
+                alive.append(child)
+                self.counters["respawns"] += 1
+                self.breaker.respawn_times.append(now)
+                self._next_spawn_unix = now + self._backoff_s()
+                events["spawned"].append(child.pid)
+
+            # 3. autoscale (only armed when max > base and a load
+            # signal exists)
+            if (self.load_fn is not None and self.max > self.base
+                    and not self.breaker.open):
+                try:
+                    depth = int(self.load_fn())
+                except Exception:
+                    depth = 0
+                if depth > 0:
+                    self._idle_since = None
+                if (depth > len(alive) * self.depth_per_worker
+                        and len(alive) < self.max
+                        and now >= self._next_scale_unix):
+                    child = self._spawn(now)
+                    self.counters["scale_ups"] += 1
+                    self._next_scale_unix = now + self.scale_cooldown_s
+                    events["spawned"].append(child.pid)
+                    if self.out is not None:
+                        print(
+                            f"[supervisor] scale-up: depth {depth} > "
+                            f"{self.depth_per_worker}/worker across "
+                            f"{len(alive)} worker(s)", file=self.out,
+                        )
+                elif depth == 0 and len(alive) > self.base:
+                    if self._idle_since is None:
+                        self._idle_since = now
+                    elif (now - self._idle_since >= self.scale_idle_s
+                          and now >= self._next_scale_unix):
+                        # drain the NEWEST surplus child gracefully
+                        victim = max(alive, key=lambda c: c.spawned_unix)
+                        victim.draining = True
+                        try:
+                            victim.proc.send_signal(signal.SIGTERM)
+                        except OSError:
+                            pass
+                        self.counters["scale_downs"] += 1
+                        self._next_scale_unix = now + self.scale_cooldown_s
+                        self._idle_since = now
+                        if self.out is not None:
+                            print(
+                                f"[supervisor] scale-down: draining pid "
+                                f"{victim.pid} (idle "
+                                f"{self.scale_idle_s:.0f}s)",
+                                file=self.out,
+                            )
+        return events
+
+    # ---- introspection (the /queue + /healthz surfaces) ----
+
+    def alive(self) -> int:
+        with self._lock:
+            return len([c for c in self.children if not c.draining])
+
+    def describe(self) -> dict:
+        """The /queue `supervisor` block — including WHY respawning
+        stopped when the breaker is open (ISSUE 13: '/queue says
+        why')."""
+        with self._lock:
+            alive = [c for c in self.children if not c.draining]
+            return {
+                "workers": self.base,
+                "max_workers": self.max,
+                "alive": len(alive),
+                "draining": len(self.children) - len(alive),
+                "pids": [c.pid for c in self.children],
+                **self.counters,
+                "consecutive_fast_exits": self._failures,
+                "respawn_backoff_s": round(self._backoff_s(), 3),
+                "breaker": {
+                    "state": "open" if self.breaker.open else "closed",
+                    "trips": self.breaker.trips,
+                    "threshold": self.breaker_k,
+                    "window_s": self.breaker_window_s,
+                    "reason": self.breaker.reason,
+                },
+            }
+
+    def healthy(self):
+        """(ok, fields) for the fleet /healthz hook: an open breaker is
+        a degraded service — the fleet cannot self-heal."""
+        with self._lock:
+            ok = not self.breaker.open
+            return ok, {
+                "supervisor_breaker": (
+                    "open" if self.breaker.open else "closed"
+                ),
+                **({"supervisor_breaker_reason": self.breaker.reason}
+                   if self.breaker.open else {}),
+            }

@@ -58,12 +58,21 @@ class LeaseKeeper:
     completion dedups."""
 
     def __init__(self, artifact_dir: str, worker_id: str, lease_s: float,
-                 members: Sequence[str], renew_cb=None, out=None):
+                 members: Sequence[str], renew_cb=None, out=None,
+                 stake_cb=None, release_cb=None):
         self.artifact_dir = artifact_dir
         self.worker_id = worker_id
         self.lease_s = float(lease_s)
         self.members = [str(m) for m in members]
         self.renew_cb = renew_cb
+        # remote-mode callbacks (ISSUE 13): a no-shared-fs worker cannot
+        # write lease FILES into the coordinator's artifact dir, so
+        # stake_cb(members)/release_cb(members) POST /leases instead and
+        # the COORDINATOR writes/deletes its own signed files — the
+        # on-disk mirror (adoption, reaping) is unchanged. None = the
+        # shared-fs local file writes.
+        self.stake_cb = stake_cb
+        self.release_cb = release_cb
         self.out = out
         self._stop = threading.Event()
         self._poke = threading.Event()
@@ -92,12 +101,20 @@ class LeaseKeeper:
                         "dedups)",
                         file=self.out,
                     )
-        deadline = time.time() + self.lease_s
-        for d in self.members:
-            svc_leases.write_lease(
-                self.artifact_dir, d, self.worker_id, os.getpid(),
-                deadline, self.members,
-            )
+        if self.stake_cb is not None:
+            try:
+                self.stake_cb(self.members)
+            except Exception:
+                pass  # coordinator unreachable mid-renewal: same story
+                # as a lost renew_cb — keep computing, it will steal if
+                # we really stall, and completion dedups
+        else:
+            deadline = time.time() + self.lease_s
+            for d in self.members:
+                svc_leases.write_lease(
+                    self.artifact_dir, d, self.worker_id, os.getpid(),
+                    deadline, self.members,
+                )
         self.renewals += 1
 
     def on_heartbeat(self, _info) -> None:
@@ -140,8 +157,16 @@ class LeaseKeeper:
             self._thread.join(2.0)
             self._thread = None
         if release:
-            for d in self.members:
-                svc_leases.delete_lease(self.artifact_dir, d)
+            if self.release_cb is not None:
+                try:
+                    self.release_cb(self.members)
+                except Exception:
+                    pass  # the coordinator's reaper cleans expired
+                    # files anyway; a lost release is a timeout, not
+                    # a leak
+            else:
+                for d in self.members:
+                    svc_leases.delete_lease(self.artifact_dir, d)
 
 
 @dataclass
@@ -159,6 +184,13 @@ class TraceRef:
     nodes_csv: str = ""
     pods_csv: str = ""
     max_pods: int = 0
+    # per-FILE integrity (ISSUE 13): sha256 + size of the raw CSV bytes,
+    # so a no-shared-fs worker can verify a (possibly resumed) download
+    # before parsing, and resume partial transfers against a known size
+    nodes_sha256: str = ""
+    pods_sha256: str = ""
+    nodes_bytes: int = 0
+    pods_bytes: int = 0
 
 
 def load_trace(name: str, nodes_csv: str, pods_csv: str,
@@ -166,6 +198,7 @@ def load_trace(name: str, nodes_csv: str, pods_csv: str,
     """Load a hosted trace from node/pod CSVs (`tpusim serve --jobs
     --nodes ... --pods ...`); max_pods > 0 truncates the workload (the
     smoke/prefix knob)."""
+    from tpusim.io.storage import file_sha256
     from tpusim.io.trace import load_node_csv, load_pod_csv
 
     nodes = load_node_csv(nodes_csv)
@@ -178,6 +211,10 @@ def load_trace(name: str, nodes_csv: str, pods_csv: str,
         nodes_csv=os.path.abspath(nodes_csv),
         pods_csv=os.path.abspath(pods_csv),
         max_pods=int(max_pods),
+        nodes_sha256=file_sha256(nodes_csv),
+        pods_sha256=file_sha256(pods_csv),
+        nodes_bytes=os.path.getsize(nodes_csv),
+        pods_bytes=os.path.getsize(pods_csv),
     )
 
 
@@ -247,6 +284,11 @@ class Worker:
         # renew the shared queue directly; a fleet worker (svc.fleet)
         # swaps in the coordinator's POST /workers/renew.
         self.renew_cb = lambda ds: self.queue.renew(self.worker_id, ds)[1]
+        # remote-mode lease plane (ISSUE 13): svc.fleet.run_worker wires
+        # these at POST /leases when the worker shares no filesystem
+        # with the coordinator; None keeps the local signed-file writes
+        self.lease_stake_cb = None
+        self.lease_release_cb = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -328,6 +370,8 @@ class Worker:
             keeper = LeaseKeeper(
                 self.artifact_dir, self.worker_id, self.queue.lease_s,
                 members, renew_cb=self.renew_cb,
+                stake_cb=self.lease_stake_cb,
+                release_cb=self.lease_release_cb,
             ).start()
         t0 = time.perf_counter()
         try:
