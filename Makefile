@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke policy-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_transfer.py tests/test_supervisor.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_transfer.py tests/test_supervisor.py tests/test_policy_learned.py tests/test_blocked_engine.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -94,6 +94,18 @@ svc-smoke:
 # byte-identical no-op.
 tune-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --tune-only
+
+# learned-policy smoke (ENGINES.md "Round 18"): the LearnedScore lane
+# end-to-end on a tiny synthetic trace with a forced 2-device virtual
+# mesh — imitation round-trip off a recorded FGD teacher (dataset
+# builder feasibility cross-check + train + i32 export), the signed
+# artifact replaying BIT-identically on the sequential/flat/blocked/
+# shard engines, one-executable ES policy search (hard
+# jit._cache_size() check), signed-artifact round-trip + torn-file
+# rejection, and a served policy preset answering a submit job with
+# the exact local placements.
+policy-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --policy-only
 
 # chaos-sweep smoke (ENGINES.md "Round 14"): a tiny B-lane fault sweep
 # (one trace, varying fault seed/MTBF/evict cadence as per-lane

@@ -196,6 +196,13 @@ def main():
         "blocked-vs-flat rows in ENGINES.md compare 0 against -1",
     )
     ap.add_argument(
+        "--unswitched", action="store_true",
+        help="flat-path select layout A/B (ENGINES.md Round 18): run "
+        "the unconditional-select form instead of the event switch "
+        "(SimulatorConfig.unswitched_select); bit-identical, throughput "
+        "differs per backend",
+    )
+    ap.add_argument(
         "--chunk",
         type=int,
         default=200_000,
@@ -286,6 +293,12 @@ def main():
     if cache_dir:
         print(f"[obs] compile cache at {cache_dir}", file=sys.stderr)
 
+    if args.unswitched and args.block_size >= 0:
+        # unswitched_select only alters the FLAT scan body; under the
+        # auto/blocked layouts the knob is inert and the A/B would read
+        # as a bogus "layout is neutral"
+        ap.error("--unswitched measures the flat select layout: pass "
+                 "--block-size -1")
     nodes = synth_cluster(args.nodes, args.seed)
     pods = synth_pods(args.pods, args.seed + 1)
     profiling = bool(args.profile or args.metrics_out or args.trace_out)
@@ -296,6 +309,7 @@ def main():
         report_per_event=False,
         engine=args.engine,
         block_size=args.block_size,
+        unswitched_select=args.unswitched,
         profile=profiling,
         heartbeat_every=args.heartbeat,
         series_every=args.series_every,

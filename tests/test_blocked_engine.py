@@ -55,9 +55,12 @@ def _assert_equal(r0, r1):
 @pytest.mark.parametrize(
     "policies,gpu_sel,blocks",
     [
-        # normalize: none — full {8, 128, N} sweep
-        ([("FGDScore", 1000)], "FGDScore", (8, 128, NUM_NODES)),
-        ([("BestFitScore", 1000)], "best", (8, NUM_NODES)),  # minmax
+        # normalize: none — {8, 128, N} dedup'd to the boundary sizes
+        # (tier-1 trim, ISSUE 14: each block size is its own compile;
+        # 128 is exercised by the BestFit minmax row below and the
+        # openb-prefix acceptance in resume-smoke)
+        ([("FGDScore", 1000)], "FGDScore", (8, NUM_NODES)),
+        ([("BestFitScore", 1000)], "best", (128,)),  # minmax
         ([("PWRScore", 1000)], "PWRScore", (8,)),  # pwr
         # weighted mix with per-policy normalization (the reference's
         # PWR+FGD rows): totals combine a stored-extrema normalized plane
@@ -68,7 +71,7 @@ def _assert_equal(r0, r1):
         # RandomScore configs; gpu_sel=random stays blocked with the same
         # k_sel draw)
         ([("RandomScore", 1000)], "random", (8,)),
-        ([("FGDScore", 1000)], "random", (8, 128)),
+        ([("FGDScore", 1000)], "random", (8,)),
     ],
     ids=lambda p: "+".join(n for n, _ in p) if isinstance(p, list) else str(p),
 )
@@ -90,6 +93,7 @@ def test_blocked_matches_flat(policies, gpu_sel, blocks):
         _assert_equal(r0, r1)
 
 
+@pytest.mark.slow
 def test_blocked_matches_flat_openb_prefix():
     """The pinned cross-engine equality contract on real trace data: an
     openb cluster prefix replay must come out bit-identical between the

@@ -26,10 +26,17 @@ needs_traces = pytest.mark.skipif(
 def test_pod_csv_yaml_roundtrip(tmp_path):
     """CSV → YAML → pod_from_k8s equals load_pod_csv on every
     scheduling-relevant field, including the creation/deletion times the
-    reference converter drops (pod_csv_to_yaml.py:117-118)."""
-    out = pod_csv_to_yaml(POD_CSV, tmp_path / "pods.yaml")
+    reference converter drops (pod_csv_to_yaml.py:117-118). A 600-row
+    prefix of the openb gpuspec10 list covers every column/annotation
+    shape the full file does (tier-1 trim, ISSUE 14: the full-list
+    round-trip cost ~21 s for no added coverage)."""
+    prefix_csv = tmp_path / "pods_prefix.csv"
+    with open(POD_CSV) as f:
+        head = [next(f) for _ in range(601)]
+    prefix_csv.write_text("".join(head))
+    out = pod_csv_to_yaml(str(prefix_csv), tmp_path / "pods.yaml")
     via_yaml = [pod_from_k8s(o) for o in load_objects([str(out)])]
-    direct = load_pod_csv(POD_CSV)
+    direct = load_pod_csv(str(prefix_csv))
     assert len(via_yaml) == len(direct)
     for y, d in zip(via_yaml, direct):
         assert y.name == f"paib-gpu/{d.name}"

@@ -230,3 +230,90 @@ def test_bucketed_padding_equivalence():
     _assert_equal(r0, r1)
     for a, b in zip(r0.metrics, r1.metrics):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_unswitched_flat_bit_identity():
+    """Round 18 A/B pin: the flat body's unconditional-select layout
+    (`unswitched=True` — the shard engine's Round-15 form ported back)
+    is bit-identical to the default event-switch layout across
+    create/delete mixes, a policy mix with normalization, and
+    per-event randomness (RandomScore recomputes its draw from the same
+    pre-split k_rand either way)."""
+    rng = np.random.default_rng(23)
+    state, tp = random_cluster(rng, num_nodes=20)
+    pods = random_pods(rng, num_pods=50)
+    ev_kind, ev_pod = _events_with_deletes(50, rng)
+    key = jax.random.PRNGKey(5)
+    rank = jnp.asarray(rng.permutation(20).astype(np.int32))
+    types = build_pod_types(pods)
+    for policies, gpu_sel in (
+        ([("FGDScore", 1000)], "FGDScore"),
+        ([("PWRScore", 500), ("BestFitScore", 500)], "best"),
+        ([("RandomScore", 1000)], "random"),
+    ):
+        pol = [(make_policy(n), w) for n, w in policies]
+        switched = make_table_replay(pol, gpu_sel=gpu_sel, block_size=-1)
+        unswitched = make_table_replay(
+            pol, gpu_sel=gpu_sel, block_size=-1, unswitched=True
+        )
+        r0 = switched(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+        r1 = unswitched(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+        _assert_equal(r0, r1)
+
+    # the user-reachable compositions exercise the unswitched merge code
+    # the plain path does not: the decision-pytree where-merge, and the
+    # fault build's kc clipping (fault kinds must fall through to skip
+    # in both layouts)
+    pol = [(make_policy("FGDScore"), 1000)]
+    for kw in (dict(decisions=True),):
+        r0 = make_table_replay(pol, gpu_sel="FGDScore", block_size=-1, **kw)(
+            state, pods, types, ev_kind, ev_pod, tp, key, rank
+        )
+        r1 = make_table_replay(
+            pol, gpu_sel="FGDScore", block_size=-1, unswitched=True, **kw
+        )(state, pods, types, ev_kind, ev_pod, tp, key, rank)
+        _assert_equal(r0, r1)
+        for a, b in zip(jax.tree.leaves(r0.decisions),
+                        jax.tree.leaves(r1.decisions)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_unswitched_fault_lane_bit_identity():
+    """The unswitched layout under the in-scan fault plane: the driver's
+    run_with_faults scan lane threads SimulatorConfig.unswitched_select,
+    so the full fault trajectory (placements, DisruptionMetrics) must be
+    bit-identical to the default switch layout."""
+    from tpusim.io.trace import NodeRow, PodRow
+    from tpusim.sim.driver import Simulator, SimulatorConfig
+    from tpusim.sim.faults import FaultConfig
+
+    rng = np.random.default_rng(13)
+    nodes = [
+        NodeRow(f"n{i}", 32000, 131072, int(g), "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([2, 4, 8], 10))
+    ]
+    pods = [
+        PodRow(f"p{i}", int(rng.choice([1000, 4000])), 1024, 1,
+               int(rng.choice([300, 500, 1000])))
+        for i in range(40)
+    ]
+    fcfg = FaultConfig(mtbf_events=12, mttr_events=10,
+                       evict_every_events=9, seed=3)
+    results = []
+    for unswitched in (False, True):
+        sim = Simulator(nodes, SimulatorConfig(
+            policies=(("FGDScore", 1000),), gpu_sel_method="FGDScore",
+            engine="table", block_size=-1, seed=7,
+            report_per_event=False, fault_mode="scan",
+            unswitched_select=unswitched,
+        ))
+        sim.set_workload_pods(list(pods))
+        results.append(sim.run_with_faults(fcfg))
+    r0, r1 = results
+    assert sim._last_engine == "table (fault lane)"
+    np.testing.assert_array_equal(
+        np.asarray(r0.placed_node), np.asarray(r1.placed_node)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(r0.dev_mask), np.asarray(r1.dev_mask)
+    )

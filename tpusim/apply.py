@@ -101,6 +101,14 @@ class ApplyOptions:
     # wired before the first dispatch so re-runs skip the scan compile;
     # the obs record notes the probable hit/miss.
     compile_cache_dir: str = ""
+    # score-plugin override (ISSUE 14): 'LearnedScore:FILE.json' replays
+    # a signed learned-policy artifact as the (only) scoring family,
+    # 'learned'/'learned-bucketed' the default-parameter families, or a
+    # built-in name at weight 1000. Empty = the scheduler config's
+    # plugins. A gpuSelMethod delegating to a policy the override
+    # removed falls back to 'best' (the learned family carries no
+    # Reserve-phase device pick of its own).
+    policy: str = ""
 
 
 class Applier:
@@ -121,9 +129,29 @@ class Applier:
 
     def _simulator_config(self) -> SimulatorConfig:
         cc = self.cr.custom_config
+        policies = self.sched_cfg.policy_tuple()
+        gpu_sel = self.sched_cfg.gpu_sel_method
+        if self.options.policy:
+            # --policy override (ISSUE 14): replace the scheduler
+            # config's plugin family wholesale; a policy-delegated
+            # gpuSelMethod whose plugin is no longer enabled would
+            # silently degrade inside the step, so resolve it to 'best'
+            # loudly here
+            from tpusim.learn.policy import parse_policy_spec
+
+            policies = tuple(parse_policy_spec(self.options.policy))
+            if gpu_sel not in ("best", "worst", "random") and gpu_sel not in {
+                n for n, _ in policies
+            }:
+                print(
+                    f"[policy] gpuSelMethod {gpu_sel!r} delegates to a "
+                    "plugin the --policy override removed; using 'best'",
+                    file=sys.stderr,
+                )
+                gpu_sel = "best"
         return SimulatorConfig(
-            policies=self.sched_cfg.policy_tuple(),
-            gpu_sel_method=self.sched_cfg.gpu_sel_method,
+            policies=policies,
+            gpu_sel_method=gpu_sel,
             dim_ext_method=self.sched_cfg.dim_ext_method,
             norm_method=self.sched_cfg.norm_method,
             shuffle_pod=cc.shuffle_pod,

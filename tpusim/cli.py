@@ -189,6 +189,16 @@ def _build_parser() -> argparse.ArgumentParser:
         "load the compiled scan from disk instead of re-compiling; the "
         "obs record notes the probable hit/miss",
     )
+    # the learned policy as a drop-in scorer (ISSUE 14)
+    p_apply.add_argument(
+        "--policy", default="", metavar="SPEC",
+        help="override the scheduler-config score plugins: "
+        "'LearnedScore:FILE.json' replays a signed learned-policy "
+        "artifact (trained via `tpusim imitate` / `tpusim tune "
+        "--policy learned`), 'learned'/'learned-bucketed' the "
+        "default-parameter families, or a built-in policy name at "
+        "weight 1000",
+    )
 
     p_explain = sub.add_parser(
         "explain",
@@ -320,6 +330,16 @@ def _build_parser() -> argparse.ArgumentParser:
         "job family (a hot trace can't starve the rest); overflow "
         "answers 429 + Retry-After naming the family (0 = no cap)",
     )
+    # named learned-policy presets (ISSUE 14): the fleet serves a
+    # trained artifact exactly like a built-in policy family
+    p_serve.add_argument(
+        "--policy-preset", action="append", default=[],
+        metavar="NAME=ARTIFACT.json",
+        help="register a named learned-policy preset from a signed "
+        "artifact (repeatable); submit jobs reference it via "
+        '{"policy_preset": "NAME"} and replay byte-identically to the '
+        "artifact run locally",
+    )
     p_serve.add_argument(
         "--table-cache-dir", default="", metavar="DIR",
         help="content-keyed init-table cache shared by the fleet "
@@ -412,6 +432,19 @@ def _build_parser() -> argparse.ArgumentParser:
         "default weights seed the optimizer AND are the held-out "
         "report's baseline",
     )
+    # the learned policy as the tuned family (ISSUE 14): the parameter
+    # vector IS the weight vector, so ES/CMA search over it reuses the
+    # whole one-compile sweep machinery unchanged
+    p_tune.add_argument(
+        "--policy", default="", metavar="SPEC",
+        help="tune a LEARNED policy instead of --policies: 'learned' "
+        "(the linear feature vocabulary, FGD-equivalent init), "
+        "'learned-bucketed' (plus the 10 occupancy-bucket table "
+        "features), or 'LearnedScore:FILE.json' (resume search from a "
+        "signed artifact, e.g. an imitation-trained one); --best-out "
+        "then writes a signed policy ARTIFACT, and the weight bounds "
+        "default to the symmetric [-4000, 4000] parameter space",
+    )
     p_tune.add_argument(
         "--algo", choices=("es", "cma"), default="es",
         help="optimizer: antithetic OpenAI-ES or diagonal CMA-ES",
@@ -434,8 +467,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "--eval-seed", type=int, default=42,
         help="replay seed every candidate shares (common random numbers)",
     )
-    p_tune.add_argument("--w-min", type=int, default=0)
-    p_tune.add_argument("--w-max", type=int, default=4000)
+    p_tune.add_argument(
+        "--w-min", type=int, default=None,
+        help="weight lower bound (default 0; -4000 under --policy "
+        "learned — feature signs are meaningful)",
+    )
+    p_tune.add_argument(
+        "--w-max", type=int, default=None,
+        help="weight upper bound (default 4000)",
+    )
     p_tune.add_argument(
         "--obj-alloc", type=float, default=1.0,
         help="objective weight on gpu_alloc_pct",
@@ -517,6 +557,57 @@ def _build_parser() -> argparse.ArgumentParser:
         help="per-generation wait budget on the remote backend",
     )
 
+    # the imitation trainer (ISSUE 14; README "Train and serve a learned
+    # policy"): decision JSONL -> (feature-row, chosen, runner-up)
+    # tuples -> a trained, i32-exported, digest-signed policy artifact
+    p_imitate = sub.add_parser(
+        "imitate",
+        help="train a learned policy to imitate a recorded teacher: "
+        "teacher-force the trace through a --decisions-out JSONL, build "
+        "(winner, runner-up) feature pairs, fit the linear scorer, "
+        "export it into the engines' i32 vocabulary, and report "
+        "held-out top-1 agreement",
+    )
+    p_imitate.add_argument(
+        "--nodes", required=True, metavar="CSV",
+        help="node CSV of the recorded trace",
+    )
+    p_imitate.add_argument(
+        "--pods", required=True, metavar="CSV",
+        help="pod CSV of the recorded trace",
+    )
+    p_imitate.add_argument(
+        "--decisions", required=True, metavar="JSONL",
+        help="the teacher run's decision log (`tpusim apply "
+        "--decisions-out`) — digest-verified on load",
+    )
+    p_imitate.add_argument(
+        "--max-pods", type=int, default=0, metavar="N",
+        help="truncate the workload to its first N pods (must match the "
+        "recorded run)",
+    )
+    p_imitate.add_argument(
+        "--features", choices=("linear", "bucketed"), default="linear",
+        help="feature vocabulary: the 10 linear node/pod features, or "
+        "plus the 10 occupancy-bucket table features",
+    )
+    p_imitate.add_argument("--steps", type=int, default=500)
+    p_imitate.add_argument("--lr", type=float, default=0.15)
+    p_imitate.add_argument("--l2", type=float, default=1e-4)
+    p_imitate.add_argument("--seed", type=int, default=0)
+    p_imitate.add_argument(
+        "--holdout", type=float, default=0.2, metavar="FRAC",
+        help="trailing fraction of EVENTS held out of training; the "
+        "reported agreement is teacher-forced top-1 on this suffix",
+    )
+    p_imitate.add_argument(
+        "--out", default="", metavar="PATH",
+        help="write the trained policy as a digest-signed artifact "
+        "(the `apply --policy LearnedScore:FILE.json` / `serve "
+        "--policy-preset` / `tune --policy LearnedScore:FILE.json` "
+        "input)",
+    )
+
     p_submit = sub.add_parser(
         "submit",
         help="POST what-if jobs to a `tpusim serve --jobs` replay "
@@ -578,6 +669,7 @@ def cmd_apply(args) -> int:
         sweep_weights=args.sweep_weights,
         sweep_faults=args.sweep_faults,
         compile_cache_dir=args.compile_cache_dir,
+        policy=args.policy,
     )
     Applier(opts).run()
     return 0
@@ -751,6 +843,25 @@ def _serve_jobs(args) -> int:
     max_n = int(getattr(args, "max_workers", 0) or 0)
     if max_n and not fleet_n:
         raise ValueError("--max-workers needs --workers N")
+    # named learned-policy presets (ISSUE 14): NAME=artifact.json ->
+    # the [(name, weight)] pairs submit jobs reference by preset name
+    presets = {}
+    for entry in getattr(args, "policy_preset", []):
+        name, sep, path = entry.partition("=")
+        name = name.strip()
+        if not sep or not name or not path:
+            raise ValueError(
+                f"--policy-preset {entry!r}: want NAME=ARTIFACT.json"
+            )
+        if name in presets:
+            raise ValueError(f"--policy-preset {name!r} given twice")
+        from tpusim.learn.policy import policies_from_artifact
+
+        presets[name] = policies_from_artifact(path)
+        print(
+            f"[serve] policy preset {name!r} <- {path} "
+            f"({len(presets[name])} features)", file=sys.stderr,
+        )
     srv, service, worker = start_job_server(
         args.dir, traces, listen=args.listen,
         lane_width=args.lane_width, queue_size=args.queue_size,
@@ -758,6 +869,7 @@ def _serve_jobs(args) -> int:
         compile_cache_dir=args.compile_cache_dir,
         fleet=fleet_n > 0, lease_s=args.lease_s,
         family_quota=args.family_quota,
+        policy_presets=presets,
         out=sys.stderr,
     )
     sup = None
@@ -904,19 +1016,53 @@ def cmd_tune(args) -> int:
         make_robust_eval,
         run_tune,
     )
-    from tpusim.policies import POLICY_NAMES
+    from tpusim.policies import POLICY_NAMES, is_policy_name
     from tpusim.svc.client import ServiceError
     from tpusim.svc.worker import load_trace
 
     try:
-        policies = [
-            (str(n), int(w)) for n, w in json.loads(args.policies)
-        ]
+        learned = False
+        if args.policy:
+            # the --policy spec (ISSUE 14): for a LEARNED family the
+            # parameters ARE the weight vector, so the loop below is
+            # unchanged — only the bounds default (signs are meaningful)
+            # and the --best-out format (a signed policy artifact)
+            # differ. parse_policy_spec also accepts a built-in name
+            # (weight 1000), which tunes like a --policies run.
+            from tpusim.learn.policy import parse_learned_name, parse_policy_spec
+
+            policies = [
+                (n, int(w)) for n, w in parse_policy_spec(args.policy)
+            ]
+            learned = all(
+                parse_learned_name(n) is not None for n, _ in policies
+            )
+        else:
+            policies = [
+                (str(n), int(w)) for n, w in json.loads(args.policies)
+            ]
         for name, _ in policies:
-            if name not in POLICY_NAMES:
+            if not is_policy_name(name):
                 raise ValueError(
                     f"unknown policy {name!r} (known: "
-                    f"{', '.join(POLICY_NAMES)})"
+                    f"{', '.join(POLICY_NAMES)}, "
+                    "LearnedScore[<feature>])"
+                )
+        w_lo = args.w_min if args.w_min is not None else (
+            -4000 if learned else 0
+        )
+        w_hi = args.w_max if args.w_max is not None else 4000
+        if learned:
+            # fail BEFORE the (potentially hours-long) search, not at
+            # the artifact export: the i32 theta vocabulary is hard-
+            # bounded, and a best vector outside it cannot be saved
+            from tpusim.learn.policy import THETA_HI, THETA_LO
+
+            if w_lo < THETA_LO or w_hi > THETA_HI:
+                raise ValueError(
+                    f"--policy learned bounds [{w_lo}, {w_hi}] exceed "
+                    f"the i32 theta export range [{THETA_LO}, "
+                    f"{THETA_HI}]"
                 )
         if not 0.0 <= args.holdout < 1.0:
             raise ValueError(
@@ -934,7 +1080,7 @@ def cmd_tune(args) -> int:
             algo=args.algo, generations=args.generations,
             popsize=args.popsize, sigma=args.sigma, lr=args.lr,
             seed=args.seed, eval_seed=args.eval_seed,
-            w_lo=args.w_min, w_hi=args.w_max,
+            w_lo=w_lo, w_hi=w_hi,
             objective=ObjectiveConfig(
                 w_alloc=args.obj_alloc, w_frag=args.obj_frag,
                 w_unsched=args.obj_unsched,
@@ -1032,13 +1178,32 @@ def cmd_tune(args) -> int:
             )
             print(format_holdout_report(report, policies))
         if args.best_out:
-            from tpusim.apply import save_weights_payload
+            if learned:
+                # the learned lane exports a signed policy ARTIFACT —
+                # the apply --policy / serve --policy-preset input
+                from tpusim.learn.dataset import feature_names_of
+                from tpusim.learn.policy import save_policy_artifact
 
-            path = save_weights_payload(
-                args.best_out, [result.best_weights], policies=policies
-            )
-            print(f"[tune] wrote tuned weights payload {path}",
-                  file=sys.stderr)
+                path = save_policy_artifact(
+                    args.best_out, result.best_weights,
+                    features=feature_names_of(policies),
+                    meta={
+                        "trained": args.algo,
+                        "objective": result.best_objective,
+                        "source": "tune",
+                    },
+                )
+                print(f"[tune] wrote learned-policy artifact {path}",
+                      file=sys.stderr)
+            else:
+                from tpusim.apply import save_weights_payload
+
+                path = save_weights_payload(
+                    args.best_out, [result.best_weights],
+                    policies=policies,
+                )
+                print(f"[tune] wrote tuned weights payload {path}",
+                      file=sys.stderr)
     except ServiceError as err:
         # remote-backend failures (service down, job failed server-side,
         # wait timeout) exit 1 like `tpusim submit` — the run state is
@@ -1048,6 +1213,89 @@ def cmd_tune(args) -> int:
         return 1
     except (OSError, ValueError, json.JSONDecodeError) as err:
         print(f"tpusim tune: {err}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def cmd_imitate(args) -> int:
+    """`tpusim imitate`: the supervised-imitation trainer (ISSUE 14) —
+    decision JSONL -> teacher-forced feature extraction -> pairwise
+    ranking fit -> i32 export -> held-out top-1 agreement (+ optional
+    signed artifact)."""
+    import numpy as np
+
+    from tpusim.learn import (
+        ImitateConfig,
+        TeacherReplay,
+        imitate_with_mining,
+        load_teacher_log,
+        save_policy_artifact,
+    )
+    from tpusim.learn.policy import FEATURE_SETS
+    from tpusim.sim.workload import sort_cluster_pods
+    from tpusim.svc.worker import load_trace
+
+    try:
+        if not 0.0 <= args.holdout < 1.0:
+            raise ValueError(
+                f"--holdout must be in [0, 1), got {args.holdout}"
+            )
+        header, rows = load_teacher_log(args.decisions)
+        teacher = "+".join(
+            n for n, _ in header.get("policies", [])
+        ) or "?"
+        trace = load_trace(
+            "default", args.nodes, args.pods, max_pods=args.max_pods
+        )
+        # the driver's run() prep: stable (creation_time, name) sort,
+        # no shuffle/tuning — a log recorded under other prep options
+        # fails the replay's feasible-count cross-check loudly
+        pods = sort_cluster_pods(
+            list(trace.pods), False, np.random.default_rng(233)
+        )
+        features = FEATURE_SETS[args.features]
+        replay = TeacherReplay(
+            trace.nodes, pods, header, rows, features=features
+        )
+        cut = len(rows) - int(len(rows) * args.holdout)
+        print(
+            f"[imitate] teacher {teacher}: {len(rows)} events, training "
+            f"on [0, {cut}), holdout from event {cut}", file=sys.stderr,
+        )
+        _, theta, _hist = imitate_with_mining(
+            replay,
+            ImitateConfig(steps=args.steps, lr=args.lr, l2=args.l2,
+                          seed=args.seed),
+            end_event=cut, out=sys.stderr,
+        )
+        rep_train = replay.agreement(theta)
+        rep_held = replay.agreement(theta, start_event=cut)
+        print(
+            f"[imitate] exported theta "
+            f"{','.join(str(t) for t in theta)}"
+        )
+        print(
+            f"[imitate] teacher-forced top-1 agreement: "
+            f"{rep_train['matches']}/{rep_train['creates']} "
+            f"({100 * rep_train['agreement']:.2f}%) overall, "
+            f"{rep_held['matches']}/{rep_held['creates']} "
+            f"({100 * rep_held['agreement']:.2f}%) on the held-out "
+            "suffix"
+        )
+        if args.out:
+            path = save_policy_artifact(
+                args.out, theta, features=features,
+                meta={
+                    "trained": "imitation",
+                    "teacher": header.get("policies", []),
+                    "agreement_holdout": rep_held["agreement"],
+                    "source": "imitate",
+                },
+            )
+            print(f"[imitate] wrote learned-policy artifact {path}",
+                  file=sys.stderr)
+    except (OSError, ValueError, json.JSONDecodeError) as err:
+        print(f"tpusim imitate: {err}", file=sys.stderr)
         return 2
     return 0
 
@@ -1119,6 +1367,8 @@ def main(argv=None) -> int:
         return cmd_worker(args)
     if args.command == "tune":
         return cmd_tune(args)
+    if args.command == "imitate":
+        return cmd_imitate(args)
     if args.command == "submit":
         return cmd_submit(args)
     if args.command == "version":

@@ -101,6 +101,52 @@ def _retry_delay_s(attempt: int, retry_after=None) -> float:
     return delay * (0.5 + 0.5 * random.random())
 
 
+def with_backoff(call, max_attempts: int = 8, stop_event=None):
+    """Drive one HTTP call on the SHARED capped-exponential-backoff-
+    with-jitter schedule (ISSUE 14 satellite finishing what PR 13
+    started): the generic retry loop the fleet's worker POSTs / byte
+    uploads and the scheduler-extender round-trips ride. KubeClient.get
+    keeps its own loop over the SAME primitives (_retry_delay_s /
+    is_retryable_status) because its 404/403-are-answers semantics wrap
+    the status handling differently. `call()` returns (code, headers,
+    body); connection-level errors (retryable_conn_excs — including
+    REFUSED: a restarting server refuses for a moment, and to a retrying
+    client that is a stall, not a death) and 429/5xx answers
+    (is_retryable_status) are retried honoring a server Retry-After; the
+    final attempt's answer (or exception) surfaces.
+
+    `stop_event` aborts the RETRY schedule (the last answer surfaces at
+    once and backoff sleeps wake early) — a SIGTERM'd worker whose
+    draining coordinator answers 503 + Retry-After must exit its idle
+    claim loop promptly, not ride out eight 2-second retries first."""
+    import time
+
+    def stopped():
+        return stop_event is not None and stop_event.is_set()
+
+    def wait(delay):
+        if stop_event is not None:
+            stop_event.wait(delay)
+        else:
+            time.sleep(delay)
+
+    for attempt in range(1, max_attempts + 1):
+        try:
+            code, headers, body = call()
+        except retryable_conn_excs():
+            if attempt >= max_attempts or stopped():
+                raise
+            wait(_retry_delay_s(attempt))
+            continue
+        if (is_retryable_status(code) and attempt < max_attempts
+                and not stopped()):
+            wait(_retry_delay_s(
+                attempt, (headers or {}).get("Retry-After")
+            ))
+            continue
+        return code, headers, body
+
+
 class KubeClientError(RuntimeError):
     pass
 

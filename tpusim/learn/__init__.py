@@ -16,14 +16,35 @@ from tpusim.learn.cma import DiagonalCMA  # noqa: F401
 from tpusim.learn.es import OpenAIES, centered_ranks  # noqa: F401
 from tpusim.learn.loop import (  # noqa: F401
     LOG_SCHEMA,
+    ImitateConfig,
     TuneConfig,
     TuneResult,
     format_holdout_report,
     holdout_report,
     make_optimizer,
+    project_theta,
     read_log,
+    run_imitation,
     run_tune,
     write_log,
+)
+from tpusim.learn.dataset import (  # noqa: F401
+    ImitationPairs,
+    TeacherReplay,
+    feature_names_of,
+    imitate_with_mining,
+    load_teacher_log,
+)
+from tpusim.learn.policy import (  # noqa: F401
+    BUCKETED_FEATURES,
+    FEATURE_SETS,
+    LINEAR_FEATURES,
+    POLICY_SCHEMA,
+    learned_policies,
+    load_policy_artifact,
+    parse_policy_spec,
+    policies_from_artifact,
+    save_policy_artifact,
 )
 from tpusim.learn.objective import (  # noqa: F401
     ObjectiveConfig,

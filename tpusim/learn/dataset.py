@@ -1,0 +1,476 @@
+"""DecisionRecord JSONLs -> imitation data for the learned policy
+(ISSUE 14, `tpusim imitate`).
+
+A PR 4 decision log is ready-made credit-assignment data: per create
+event it names the teacher's chosen node AND the top-K runner-ups (with
+totals and tie-break ranks). What it does not carry is the per-node
+FEATURE rows the learned policy scores with — those are a function of
+the cluster state at the decision, which this module reconstructs by
+TEACHER-FORCING the trace: one compiled lax.scan walks the event
+stream, binds every create to the RECORDED node (reproducing the
+teacher's state trajectory exactly, including the Reserve-phase device
+choice under the recorded gpu_sel), and at each step emits
+
+  - the feature rows of the winner and the recorded runner-ups
+    -> (feature-row, chosen-node, runner-up) imitation tuples, and
+  - the LEARNED policy's own argmax at the teacher's state under a
+    traced theta operand -> teacher-forced top-1 agreement, evaluable
+    for many thetas on ONE compiled executable.
+
+The features come out of the same `sim.step.score_pod_rows` the engines
+select with (the learned kernels, weights = theta), so a projected i32
+theta's agreement HERE is exactly what a real engine replay would
+choose at those states — the imitation -> export -> replay chain has no
+approximation step.
+
+Sanity contract: at every create event the reconstructed Filter-phase
+feasible count must equal the recorded one; a mismatch means the trace
+or prep options do not match the log and raises instead of silently
+training on wrong features.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpusim.learn.policy import (
+    LINEAR_FEATURES,
+    learned_policies,
+    parse_learned_name,
+)
+
+DATASET_GPU_SEL = ("best", "worst") + tuple(
+    # policy-delegated device picks are reproduced by evaluating the
+    # selector kernel at the recorded node; per-event randomness is not
+    # (the log does not carry the PRNG chain's draws)
+    ("FGDScore", "PWRScore", "DotProductScore")
+)
+
+
+@dataclass
+class ImitationPairs:
+    """The (feature-row, chosen-node, runner-up) tuples of one log:
+    pair i says 'the teacher ranked pos[i] above neg[i]' — STRICTLY when
+    tie[i] is False (the teacher's totals differed), and 'the teacher
+    considered them EQUAL' when tie[i] is True (identical teacher totals,
+    decided by the tie-break rank). Tie pairs matter as much as strict
+    ones: a learned theta that breaks a teacher tie with an irrelevant
+    feature overrides the rank order the engines would otherwise
+    reproduce for free, so the trainer drives theta . (pos - neg) -> 0
+    on them. Rows whose winner and runner-up carry IDENTICAL features
+    appear in neither set (no constraint to learn)."""
+
+    features: Tuple[str, ...]
+    pos: np.ndarray  # f64[M, F] winner feature rows
+    neg: np.ndarray  # f64[M, F] runner-up feature rows
+    event: np.ndarray  # i64[M] source event index of each pair
+    tie: np.ndarray  # bool[M] teacher totals tied (rank-decided pair)
+
+
+class TeacherReplay:
+    """One decision log + its trace, compiled for feature extraction and
+    teacher-forced evaluation. theta is a traced operand of the scan, so
+    `agreement` over many candidate vectors reuses one executable."""
+
+    def __init__(self, nodes, pods, header: dict, rows: List[dict],
+                 features: Sequence[str] = LINEAR_FEATURES,
+                 gpu_sel: str = "", seed: Optional[int] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from tpusim.io.trace import (
+            build_events,
+            nodes_to_state,
+            pods_to_specs,
+            tiebreak_rank,
+        )
+        from tpusim.obs.decisions import DECISION_TOPK
+        from tpusim.policies import make_policy
+        from tpusim.sim.typical import (
+            TypicalPodsConfig,
+            get_typical_pods,
+            pad_typical_pods,
+        )
+
+        meta = header.get("meta") or {}
+        self.features = tuple(features)
+        self.policies = learned_policies(features=self.features)
+        self.gpu_sel = gpu_sel or str(meta.get("gpu_sel", "best"))
+        if self.gpu_sel not in DATASET_GPU_SEL:
+            raise ValueError(
+                f"gpu_sel {self.gpu_sel!r} cannot be replayed from a "
+                "decision log (per-event random device draws are not "
+                f"recorded); supported: {', '.join(DATASET_GPU_SEL)}"
+            )
+        self.seed = int(meta.get("seed", 42) if seed is None else seed)
+
+        node_index = {n.name: i for i, n in enumerate(nodes)}
+        self.state0 = nodes_to_state(nodes)
+        self.specs = pods_to_specs(pods, node_index)
+        ev_kind, ev_pod = build_events(pods, False)
+        if len(ev_kind) != len(rows):
+            raise ValueError(
+                f"decision log has {len(rows)} events but the trace "
+                f"builds {len(ev_kind)} — wrong trace or prep options "
+                "(max_pods / shuffle must match the recorded run)"
+            )
+        self.ev_kind = np.asarray(ev_kind, np.int32)
+        self.ev_pod = np.asarray(ev_pod, np.int32)
+        self.rec_node = np.asarray([r["node"] for r in rows], np.int32)
+        self.rec_feas = np.asarray([r["feasible"] for r in rows], np.int32)
+        topk = np.full((len(rows), DECISION_TOPK), -1, np.int32)
+        topk_total = np.zeros((len(rows), DECISION_TOPK), np.int64)
+        for i, r in enumerate(rows):
+            for j, (n, t, _rk) in enumerate(r.get("topk", [])):
+                if j < DECISION_TOPK:
+                    topk[i, j] = int(n)
+                    topk_total[i, j] = int(t)
+        self.topk = topk
+        self.topk_total = topk_total
+        # the recorded run's typical-pod distribution (the driver's
+        # set_typical_pods path: histogram + bucket padding — zero-freq
+        # pad rows are exact no-ops in every frag kernel)
+        self.typical = pad_typical_pods(
+            get_typical_pods(pods, TypicalPodsConfig())[0]
+        )
+        n = self.state0.num_nodes
+        self.rank = jnp.asarray(tiebreak_rank(n, self.seed))
+
+        pols = [
+            (make_policy(name), w) for name, w in self.policies
+        ]
+        sel_fn = (
+            make_policy(self.gpu_sel)
+            if self.gpu_sel not in ("best", "worst") else None
+        )
+        specs = self.specs
+        tp = self.typical
+        rank = self.rank
+        gpu_sel = self.gpu_sel
+        num_pods = int(specs.cpu.shape[0])
+        k = DECISION_TOPK
+
+        def body(carry, ev):
+            from tpusim.policies import ScoreContext
+            from tpusim.sim.step import (
+                bind_selected,
+                packed_argmax,
+                score_pod_rows,
+                unschedule,
+            )
+            from tpusim.sim.engine import Placement
+
+            state, placed, masks, key, theta = carry
+            kind, idx, rec, tk = ev
+            # the engines' per-event key-split discipline (unconsumed
+            # here unless the selector draws, which DATASET_GPU_SEL
+            # excludes — kept so the chain stays comparable)
+            key, sub = jax.random.split(key)
+            k_rand, k_sel = jax.random.split(sub)
+            pod = jax.tree.map(lambda a: a[idx], specs)
+
+            feasible, total, _, raws, _ = score_pod_rows(
+                state, pod, k_rand, pols, gpu_sel, tp, weights=theta
+            )
+            pick, _, ok = packed_argmax(total, feasible, rank)
+            pick = jnp.where(ok, pick, -1).astype(jnp.int32)
+            # feature rows of the recorded top-K candidates
+            sel = jnp.clip(tk, 0, state.num_nodes - 1)
+            feats = jnp.where(
+                (tk >= 0)[:, None], raws[:, sel].T, -1
+            ).astype(jnp.int32)  # [K, F]
+
+            # teacher-forced transition: bind the RECORDED winner
+            is_create = kind == 0
+            is_delete = kind == 1
+            node = jnp.clip(rec, 0, state.num_nodes - 1)
+            okb = is_create & (rec >= 0)
+            if sel_fn is not None:
+                from tpusim.sim.table_engine import _row_state
+
+                row = _row_state(state, node)
+                ctx1 = ScoreContext(
+                    tp=tp, feasible=jnp.ones(1, jnp.bool_), rng=k_rand
+                )
+                pdev = sel_fn(row, pod, ctx1).share_dev[0]
+            else:
+                pdev = jnp.int32(-1)
+            state, plc = bind_selected(
+                state, pod, node, okb, pdev, gpu_sel, k_sel
+            )
+            # delete: return the recorded placement's resources
+            del_node = jnp.where(is_delete, placed[idx], -1)
+            state = unschedule(
+                state, pod, Placement(del_node, masks[idx])
+            )
+            placed = placed.at[idx].set(
+                jnp.where(okb, plc.node,
+                          jnp.where(is_delete, -1, placed[idx]))
+            )
+            masks = masks.at[idx].set(
+                jnp.where(okb, plc.dev_mask,
+                          jnp.where(is_delete, False, masks[idx]))
+            )
+            # the learned pick's own feature row — hard-negative fuel
+            # for the mining rounds (mined_pairs)
+            pick_feats = raws[:, jnp.maximum(pick, 0)].astype(jnp.int32)
+            ys = (
+                feats, feasible.sum().astype(jnp.int32), pick, pick_feats,
+            )
+            return (state, placed, masks, key, theta), ys
+
+        from tpusim.constants import MAX_GPUS_PER_NODE
+
+        @jax.jit
+        def scan(theta):
+            placed0 = jnp.full(num_pods, -1, jnp.int32)
+            masks0 = jnp.zeros((num_pods, MAX_GPUS_PER_NODE), jnp.bool_)
+            carry0 = (
+                self.state0, placed0, masks0,
+                jax.random.PRNGKey(self.seed), theta,
+            )
+            _, ys = jax.lax.scan(
+                body, carry0,
+                (jnp.asarray(self.ev_kind), jnp.asarray(self.ev_pod),
+                 jnp.asarray(self.rec_node), jnp.asarray(self.topk)),
+            )
+            return ys
+
+        self._scan = scan
+        self._jnp = jnp
+        self._cache = None  # (theta tuple) -> host ys of the last scan
+
+    def _run(self, theta) -> tuple:
+        key = tuple(int(t) for t in theta)
+        if self._cache is None or self._cache[0] != key:
+            ys = self._scan(
+                self._jnp.asarray(np.asarray(theta, np.int32))
+            )
+            self._cache = (key, tuple(np.asarray(y) for y in ys))
+        return self._cache[1]
+
+    def _check_feasible(self, feas: np.ndarray):
+        creates = self.ev_kind == 0
+        bad = creates & (feas != self.rec_feas)
+        if bad.any():
+            i = int(np.flatnonzero(bad)[0])
+            raise ValueError(
+                f"event {i}: reconstructed feasible count {int(feas[i])} "
+                f"!= recorded {int(self.rec_feas[i])} — the trace/config "
+                "does not match the decision log"
+            )
+
+    def pairs(self) -> ImitationPairs:
+        """The imitation tuples: one (winner, runner-up) pair per
+        recorded runner-up of every successful create event. Pairs whose
+        teacher totals TIED carry tie=True — the teacher decided those
+        by rank, so the trainer preserves the tie instead of learning to
+        break it. Identical-feature rows are dropped (no constraint)."""
+        theta0 = [w for _, w in self.policies]
+        feats, feas, _, _ = self._run(theta0)
+        self._check_feasible(feas)
+        pos, neg, evs, ties = [], [], [], []
+        creates = np.flatnonzero((self.ev_kind == 0) & (self.rec_node >= 0))
+        for i in creates:
+            if self.topk[i, 0] < 0:
+                continue
+            win = feats[i, 0].astype(np.float64)
+            for j in range(1, self.topk.shape[1]):
+                if self.topk[i, j] < 0:
+                    continue
+                run = feats[i, j].astype(np.float64)
+                if np.array_equal(win, run):
+                    continue
+                pos.append(win)
+                neg.append(run)
+                evs.append(i)
+                ties.append(
+                    bool(self.topk_total[i, j] == self.topk_total[i, 0])
+                )
+        f = len(self.features)
+        return ImitationPairs(
+            features=self.features,
+            pos=(np.stack(pos) if pos else np.zeros((0, f))),
+            neg=(np.stack(neg) if neg else np.zeros((0, f))),
+            event=np.asarray(evs, np.int64),
+            tie=np.asarray(ties, bool),
+        )
+
+    def mined_pairs(self, theta, end_event: Optional[int] = None
+                    ) -> ImitationPairs:
+        """Hard-negative mining (the structured-perceptron move): replay
+        under candidate `theta`, and wherever the learned argmax differs
+        from the teacher's choice emit a (teacher-winner, learned-pick)
+        pair. The recorded top-K negatives alone cannot constrain nodes
+        outside the top-K; mining adds exactly the violated constraints,
+        so a few train->mine->retrain rounds converge the global argmax
+        onto the teacher's. Identical-feature mismatches are dropped
+        (unlearnable: the shared tie-break rank owns those)."""
+        feats, feas, pick, pick_feats = self._run(theta)
+        self._check_feasible(feas)
+        end = len(self.ev_kind) if end_event is None else int(end_event)
+        pos, neg, evs = [], [], []
+        creates = np.flatnonzero(
+            (self.ev_kind[:end] == 0) & (self.rec_node[:end] >= 0)
+        )
+        for i in creates:
+            if pick[i] < 0 or pick[i] == self.rec_node[i]:
+                continue
+            win = feats[i, 0].astype(np.float64)  # topk[0] IS the winner
+            run = pick_feats[i].astype(np.float64)
+            if self.topk[i, 0] < 0 or np.array_equal(win, run):
+                continue
+            pos.append(win)
+            neg.append(run)
+            evs.append(i)
+        f = len(self.features)
+        return ImitationPairs(
+            features=self.features,
+            pos=(np.stack(pos) if pos else np.zeros((0, f))),
+            neg=(np.stack(neg) if neg else np.zeros((0, f))),
+            event=np.asarray(evs, np.int64),
+            tie=np.zeros(len(evs), bool),
+        )
+
+    def agreement(self, theta, start_event: int = 0,
+                  end_event: Optional[int] = None) -> dict:
+        """Teacher-forced top-1 agreement of integer parameter vector
+        `theta` over events in [start_event, end_event): at each teacher
+        state, does the learned argmax (the engines' packed_argmax over
+        sum theta_f * feature_f with the shared tie-break rank) pick the
+        teacher's node? The ONE metric implementation — the training
+        loop scores its prefix with end_event, holdout reports with
+        start_event — and every call runs the feasible-count
+        cross-check. Returns {'matches', 'creates', 'agreement'}."""
+        feats, feas, pick, _ = self._run(theta)
+        self._check_feasible(feas)
+        creates = (self.ev_kind == 0) & (self.rec_node >= 0)
+        creates[:start_event] = False
+        if end_event is not None:
+            creates[int(end_event):] = False
+        n = int(creates.sum())
+        m = int((pick[creates] == self.rec_node[creates]).sum())
+        return {
+            "matches": m,
+            "creates": n,
+            "agreement": (m / n) if n else 1.0,
+        }
+
+
+def concat_pairs(parts: Sequence[ImitationPairs]) -> ImitationPairs:
+    parts = [p for p in parts if p.pos.shape[0]]
+    if not parts:
+        raise ValueError("no imitation pairs to train on")
+    return ImitationPairs(
+        features=parts[0].features,
+        pos=np.concatenate([p.pos for p in parts]),
+        neg=np.concatenate([p.neg for p in parts]),
+        event=np.concatenate([p.event for p in parts]),
+        tie=np.concatenate([p.tie for p in parts]),
+    )
+
+
+def imitate_with_mining(replay: TeacherReplay, cfg=None,
+                        end_event: Optional[int] = None,
+                        rounds: int = 6, out=None):
+    """The full imitation recipe (`tpusim imitate`): fit on the recorded
+    (winner, runner-up) pairs, then alternate train -> mine hard
+    negatives (events where the learned argmax still disagrees with the
+    teacher, restricted to the TRAINING prefix `end_event`) -> retrain,
+    until agreement stops improving or `rounds` is exhausted. Returns
+    (theta float64, theta_i32 list, per-round train agreement)."""
+    from tpusim.learn.loop import project_theta, run_imitation
+
+    end = len(replay.ev_kind) if end_event is None else int(end_event)
+    base = replay.pairs()
+    keep = base.event < end
+    pool = [ImitationPairs(base.features, base.pos[keep], base.neg[keep],
+                           base.event[keep], base.tie[keep])]
+    best = None  # (agreement, theta_f, theta_i32)
+    history = []
+    # the i32 export is evaluated at SEVERAL projection scales: a small
+    # scale rounds trained-to-near-zero nuisance weights to exactly 0
+    # (they would otherwise break teacher ties the rank owns), a large
+    # one keeps fine ranking resolution — the replay picks empirically
+    scales = (25, 100, 1000, 4000)
+    for r in range(max(rounds, 1)):
+        theta_f, _ = run_imitation(concat_pairs(pool), cfg)
+        round_best = None
+        for s in scales:
+            cand = project_theta(theta_f, s)
+            rep = replay.agreement(cand, end_event=end)
+            if round_best is None or rep["agreement"] > round_best[0][
+                    "agreement"]:
+                round_best = (rep, cand)
+        train_rep, theta = round_best
+        history.append(train_rep["agreement"])
+        if out is not None:
+            print(
+                f"[imitate] round {r}: {concat_pairs(pool).pos.shape[0]} "
+                f"pairs, train agreement "
+                f"{100 * train_rep['agreement']:.2f}%", file=out,
+            )
+        if best is None or train_rep["agreement"] > best[0]:
+            best = (train_rep["agreement"], theta_f, theta)
+        if train_rep["matches"] == train_rep["creates"]:
+            break
+        mined = replay.mined_pairs(theta, end_event=end)
+        if mined.pos.shape[0] == 0:
+            break  # every remaining miss is a feature-tie (rank-owned)
+        pool.append(mined)
+    # greedy sparsification: small integer residuals mostly encode noise
+    # that breaks teacher ties — zero each (ascending magnitude) and
+    # keep the zero whenever train agreement does not drop. <= F extra
+    # eval scans, all on the one compiled executable.
+    theta = list(best[2])
+    score = best[0]
+    order = sorted(
+        (j for j in range(len(theta)) if theta[j] != 0),
+        key=lambda j: abs(theta[j]),
+    )
+    for j in order:
+        cand = list(theta)
+        cand[j] = 0
+        if not any(cand):
+            continue
+        rep = replay.agreement(cand, end_event=end)
+        if rep["agreement"] >= score:
+            theta, score = cand, rep["agreement"]
+    if out is not None and score > best[0]:
+        print(
+            f"[imitate] sparsified: train agreement "
+            f"{100 * score:.2f}%", file=out,
+        )
+    return best[1], theta, history
+
+
+def load_teacher_log(path: str):
+    """(header, rows) of a decision JSONL, verified (digest, schema) and
+    checked to come from a learnable teacher: the log must carry create
+    events with runner-ups (DECISION_TOPK > 1 recording)."""
+    from tpusim.obs.decisions import read_decisions
+
+    header, rows = read_decisions(path)
+    if not any(r["kind"] == 0 and r["node"] >= 0 for r in rows):
+        raise ValueError(
+            f"{path}: no successful create events — nothing to imitate"
+        )
+    return header, rows
+
+
+def feature_names_of(policies) -> Tuple[str, ...]:
+    """The feature vocabulary of a learned policy family, failing on a
+    mixed or non-learned family."""
+    feats = []
+    for name, _ in policies:
+        f = parse_learned_name(str(name))
+        if f is None:
+            raise ValueError(
+                f"{name!r} is not a learned-policy member (want "
+                "LearnedScore[<feature>] names)"
+            )
+        feats.append(f)
+    return tuple(feats)

@@ -224,6 +224,104 @@ def run_tune(backend, policies, cfg: TuneConfig, log_path: str,
 
 
 # ---------------------------------------------------------------------------
+# Supervised imitation of a teacher policy (ISSUE 14, `tpusim imitate`)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ImitateConfig:
+    """Knobs of the imitation trainer: full-batch Adam on the pairwise
+    ranking loss over (winner, runner-up) feature rows. Pure numpy
+    float64 — deterministic for a fixed seed, no device round trips
+    (the data is a few thousand tiny rows).
+
+    tie_w weighs the TIE-preservation term: pairs the teacher decided
+    by rank (equal teacher totals) contribute (theta . d)^2 — breaking
+    a teacher tie with an irrelevant feature overrides the rank order
+    the engines reproduce for free, and is the dominant way a blended
+    theta loses top-1 agreement."""
+
+    steps: int = 500
+    lr: float = 0.15
+    l2: float = 1e-4
+    tie_w: float = 1.0
+    seed: int = 0
+    theta_hi: int = 4000  # |theta| bound of the i32 export
+
+
+def project_theta(theta, hi: int = 4000) -> List[int]:
+    """Float parameters -> the engines' i32 operand space. The argmax is
+    scale-invariant, so the vector is rescaled to fill [-hi, hi] before
+    rounding — the export keeps as much ranking resolution as the i32
+    vocabulary allows."""
+    theta = np.asarray(theta, np.float64)
+    m = float(np.max(np.abs(theta))) if theta.size else 0.0
+    scale = (hi / m) if m > 0 else 1.0
+    return [int(t) for t in
+            np.clip(np.rint(theta * scale), -hi, hi).astype(np.int64)]
+
+
+def run_imitation(pairs, cfg: ImitateConfig = None, out=None):
+    """Train theta on the pairwise constraints of a teacher log:
+
+      strict pairs (teacher totals differed)
+          softplus(-(theta . d))      -- rank pos above neg
+      tie pairs (teacher decided by rank)
+          tie_w * (theta . d)^2       -- PRESERVE the tie
+
+    with d = x_pos - x_neg, plus l2 |theta|^2. Returns (theta
+    float64[F], theta_i32 list) — the i32 export is what replays (and
+    what the agreement metric scores). Identical-feature rows never
+    reach here (TeacherReplay.pairs drops them; the engines' shared
+    tie-break rank reproduces those decisions for free)."""
+    cfg = cfg or ImitateConfig()
+    pos = np.asarray(pairs.pos, np.float64)
+    neg = np.asarray(pairs.neg, np.float64)
+    tie = np.asarray(
+        getattr(pairs, "tie", np.zeros(pos.shape[0], bool)), bool
+    )
+    if pos.shape[0] == 0:
+        raise ValueError(
+            "no trainable imitation pairs (every recorded runner-up "
+            "tied the winner feature-for-feature)"
+        )
+    # features live in [0, 100]; train at unit scale for conditioning
+    d = (pos[~tie] - neg[~tie]) / 100.0  # [Ms, F] strict
+    dt = (pos[tie] - neg[tie]) / 100.0  # [Mt, F] tie-preserving
+    f = pos.shape[1]
+    rng = np.random.default_rng(cfg.seed)
+    theta = 0.01 * rng.standard_normal(f)
+    m1 = np.zeros(f)
+    m2 = np.zeros(f)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t in range(1, cfg.steps + 1):
+        grad = 2.0 * cfg.l2 * theta
+        z = np.zeros(0)
+        if d.shape[0]:
+            z = d @ theta
+            sig = 1.0 / (1.0 + np.exp(np.clip(z, -60, 60)))  # sigma(-z)
+            grad = grad - (sig[:, None] * d).mean(0)
+        if dt.shape[0]:
+            zt = dt @ theta
+            grad = grad + 2.0 * cfg.tie_w * (zt[:, None] * dt).mean(0)
+        m1 = b1 * m1 + (1 - b1) * grad
+        m2 = b2 * m2 + (1 - b2) * grad * grad
+        mh = m1 / (1 - b1 ** t)
+        vh = m2 / (1 - b2 ** t)
+        theta = theta - cfg.lr * mh / (np.sqrt(vh) + eps)
+        if out is not None and (t % max(cfg.steps // 5, 1) == 0):
+            loss = float(np.mean(np.logaddexp(0.0, -z))) if z.size else 0.0
+            acc = float((z > 0).mean()) if z.size else 1.0
+            print(
+                f"[imitate] step {t:>5}: loss {loss:.4f}  pairwise "
+                f"acc {acc:.3f}", file=out,
+            )
+    # rescale back to the raw-feature space before the i32 export (the
+    # /100 training scale cancels in the argmax either way)
+    return theta / 100.0, project_theta(theta, cfg.theta_hi)
+
+
+# ---------------------------------------------------------------------------
 # Held-out report: tuned vs paper-default on the trace suffix
 # ---------------------------------------------------------------------------
 

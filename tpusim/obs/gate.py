@@ -1406,6 +1406,231 @@ def tune_smoke(out_dir: str, generations: int = 3) -> Tuple[bool, List[str]]:
     return True, msgs
 
 
+def policy_smoke(out_dir: str) -> Tuple[bool, List[str]]:
+    """ISSUE 14 satellite (`make policy-smoke`): the learned-policy lane
+    end-to-end on a tiny synthetic trace — (a) tiny-trace imitation
+    round-trip: record an FGD teacher's decisions, teacher-force the
+    dataset builder through the log (feasible counts cross-checked),
+    train + export, and require the i32 theta's teacher-forced
+    agreement to clear the smoke bar; (b) learned-vs-built-in engine
+    bit-identity: the exported theta replays identically on the
+    sequential, flat, and blocked engines — plus the shard_map engine
+    whenever >= 2 devices are visible (the `--policy-only` mode forces a
+    2-device virtual CPU mesh, the mesh-chaos pattern); (c) ES policy
+    search over theta adds ZERO compiled sweep executables after its
+    first generation (hard jit._cache_size() check via the backend's
+    tracked wrapper); (d) the signed artifact round-trips and a torn/
+    edited copy is rejected loudly; (e) a service-side policy preset
+    answers a submit job with the exact placements of the artifact run
+    locally. Any exception is a FAIL verdict, not a traceback."""
+    msgs: List[str] = []
+    try:
+        import json as _json
+
+        import jax
+        import numpy as np
+
+        from tpusim.io.trace import NodeRow, PodRow
+        from tpusim.learn import (
+            ImitateConfig,
+            LocalRollout,
+            TeacherReplay,
+            TuneConfig,
+            load_policy_artifact,
+            load_teacher_log,
+            make_family_sim,
+            policies_from_artifact,
+            run_tune,
+            save_policy_artifact,
+        )
+        from tpusim.learn.dataset import imitate_with_mining
+        from tpusim.learn.policy import learned_policies
+        from tpusim.obs import decisions as obs_dec
+        from tpusim.sim.driver import Simulator, SimulatorConfig
+
+        rng = np.random.default_rng(11)
+        nodes = [
+            NodeRow(f"n{i:03d}", 32000, 131072, int(g),
+                    "V100M16" if g else "")
+            for i, g in enumerate(rng.choice([0, 2, 4, 8], 16))
+        ]
+        pods = []
+        for i in range(48):
+            gpu = int(rng.choice([0, 1, 2]))
+            milli = 1000 if gpu > 1 else int(rng.choice([300, 500, 1000]))
+            if gpu == 0:
+                milli = 0
+            pods.append(PodRow(
+                f"p{i:04d}", int(rng.choice([1000, 2000, 4000])), 2048,
+                gpu, milli,
+            ))
+
+        def sim_for(policies, **kw):
+            kw.setdefault("gpu_sel_method", "best")
+            kw.setdefault("seed", 42)
+            kw.setdefault("report_per_event", False)
+            s = Simulator(nodes, SimulatorConfig(
+                policies=tuple(policies), **kw))
+            s.set_workload_pods(list(pods))
+            return s
+
+        # (a) imitation round-trip off a recorded FGD teacher
+        teacher = sim_for(
+            (("FGDScore", 1000),), gpu_sel_method="FGDScore",
+            record_decisions=True,
+        )
+        tres = teacher.run()
+        log_path = os.path.join(out_dir, "policy_smoke_teacher.jsonl")
+        obs_dec.write_decisions(
+            log_path, tres.decisions, policies=[("FGDScore", 1000)],
+            meta=teacher._telemetry_meta(),
+            pod_names=[p.name for p in tres.pods],
+        )
+        header, rows = load_teacher_log(log_path)
+        replay = TeacherReplay(nodes, teacher.prepare_pods(), header, rows)
+        cut = len(rows) - len(rows) // 5
+        _, theta, _hist = imitate_with_mining(
+            replay, ImitateConfig(steps=600, lr=0.3, l2=1e-6),
+            end_event=cut, rounds=4,
+        )
+        rep = replay.agreement(theta)
+        if rep["agreement"] < 0.7:
+            return False, [
+                f"[gate] policy: imitation agreement "
+                f"{100 * rep['agreement']:.1f}% below the 70% smoke bar "
+                f"(theta {theta}) (FAIL)"
+            ]
+
+        # (d) signed artifact round-trip + torn rejection
+        art = os.path.join(out_dir, "policy_smoke_artifact.json")
+        save_policy_artifact(art, theta, meta={"source": "policy-smoke"})
+        feats, theta2, _ = load_policy_artifact(art)
+        if list(theta2) != [int(t) for t in theta]:
+            return False, ["[gate] policy: artifact round-trip drifted "
+                           "(FAIL)"]
+        with open(art) as f:
+            lines = f.read().splitlines()
+        doc = _json.loads(lines[1])
+        doc["theta"][0] = int(doc["theta"][0]) + 1
+        torn = os.path.join(out_dir, "policy_smoke_torn.json")
+        with open(torn, "w") as f:
+            f.write(lines[0] + "\n")
+            f.write(_json.dumps(doc, sort_keys=True,
+                                separators=(",", ":")) + "\n")
+        try:
+            load_policy_artifact(torn)
+            return False, ["[gate] policy: a TORN artifact loaded "
+                           "cleanly (FAIL)"]
+        except ValueError:
+            pass
+
+        # (b) engine bit-identity of the exported theta
+        pol = policies_from_artifact(art)
+        engines = [
+            ("sequential", dict(engine="sequential")),
+            ("flat", dict(engine="table", block_size=-1)),
+            ("blocked", dict(engine="table", block_size=4)),
+        ]
+        if len(jax.devices()) >= 2:
+            engines.append(("shard", dict(engine="auto", mesh=2)))
+        ref = None
+        for label, kw in engines:
+            r = sim_for(pol, **kw).run()
+            if ref is None:
+                ref = (label, r)
+                continue
+            if not (np.array_equal(np.asarray(ref[1].placed_node),
+                                   np.asarray(r.placed_node))
+                    and np.array_equal(np.asarray(ref[1].dev_mask),
+                                       np.asarray(r.dev_mask))):
+                return False, [
+                    f"[gate] policy: {label} diverged from {ref[0]} "
+                    "replaying the learned artifact (FAIL)"
+                ]
+        placed = int((np.asarray(ref[1].placed_node) >= 0).sum())
+
+        # (c) one-executable ES generation: a second tuning run over the
+        # same family must add ZERO compiled sweep executables (counts
+        # read relative — the wrapper is process-global)
+        fam = learned_policies(theta2)
+        backend = LocalRollout(make_family_sim(nodes, pods, fam), width=4)
+        cfg = TuneConfig(algo="es", generations=2, popsize=4,
+                         sigma=300.0, lr=400.0, seed=3,
+                         w_lo=-4000, w_hi=4000)
+        run_tune(backend, fam, cfg,
+                 os.path.join(out_dir, "policy_smoke_tune.jsonl"))
+        before = backend.executables()
+        if before < 1:
+            return False, ["[gate] policy: ES backend tracked no "
+                           "compiled sweep executable (FAIL)"]
+        os.unlink(os.path.join(out_dir, "policy_smoke_tune.jsonl"))
+        run_tune(backend, fam,
+                 TuneConfig(algo="es", generations=2, popsize=4,
+                            sigma=300.0, lr=400.0, seed=4,
+                            w_lo=-4000, w_hi=4000),
+                 os.path.join(out_dir, "policy_smoke_tune.jsonl"))
+        if backend.executables() != before:
+            return False, [
+                f"[gate] policy: a second ES run grew the compiled "
+                f"sweep executables ({before} -> "
+                f"{backend.executables()}) (FAIL)"
+            ]
+
+        # (e) a served preset answers exactly like the local artifact
+        from tpusim.svc import jobs as svc_jobs
+        from tpusim.svc.api import JobService
+        from tpusim.svc.batcher import JobQueue
+        from tpusim.svc.worker import TraceRef, Worker
+
+        trace = TraceRef("default", nodes, pods,
+                         svc_jobs.trace_digest(nodes, pods))
+        art_dir = os.path.join(out_dir, "policy_smoke_svc")
+        os.makedirs(art_dir, exist_ok=True)
+        queue = JobQueue(maxsize=8, lane_width=4)
+        worker = Worker(queue, {"default": trace}, art_dir)
+        service = JobService(
+            queue, worker, {"default": trace}, art_dir,
+            policy_presets={"smoke": pol},
+        )
+        resp = service.handle(
+            "POST", "/jobs",
+            _json.dumps({"policy_preset": "smoke", "seed": 42}).encode(),
+        )
+        if resp[0] not in (200, 202):
+            return False, [f"[gate] policy: preset POST answered "
+                           f"{resp[0]} (FAIL)"]
+        job_id = _json.loads(resp[2].decode())["id"]
+        while True:
+            batch = queue.next_batch(timeout=0)
+            if not batch:
+                break
+            worker.run_batch(batch)
+        code, _, body = service.handle(
+            "GET", f"/jobs/{job_id}/result", b"")[:3]
+        got = _json.loads(body.decode())
+        local = sim_for(pol).run()
+        if code != 200 or not np.array_equal(
+            np.asarray(got["placed_node"]), np.asarray(local.placed_node)
+        ):
+            return False, [
+                "[gate] policy: the served preset's placements differ "
+                "from the local artifact run (FAIL)"
+            ]
+
+        msgs.append(
+            f"[gate] policy: imitation {rep['matches']}/"
+            f"{rep['creates']} agreement, artifact signed + torn copy "
+            f"rejected, {len(engines)}-engine bit-identity "
+            f"({placed} placements), ES zero-recompile held at "
+            f"{before} executable(s), served preset == local run"
+        )
+    except Exception as err:
+        return False, [
+            f"[gate] policy: FAIL ({type(err).__name__}: {err})"
+        ]
+    return True, msgs
+
+
 def metrics_scrape_check(record: dict, prom_path: str) -> Tuple[bool, str]:
     """ISSUE 5 satellite: publish the smoke record to an ephemeral
     MonitorServer, scrape /metrics over real HTTP, and require (a) the
@@ -1500,7 +1725,28 @@ def main(argv=None) -> int:
         "single-worker run, forced crash loop tripping the circuit "
         "breaker) — the `make fleet-wan-smoke` mode",
     )
+    ap.add_argument(
+        "--policy-only", action="store_true",
+        help="run only the learned-policy smoke (ISSUE 14: tiny-trace "
+        "imitation round-trip, learned-vs-built-in engine bit-identity "
+        "on a forced 2-device virtual mesh, one-executable ES "
+        "generation, signed-artifact round-trip + torn rejection, "
+        "served preset == local run) — the `make policy-smoke` mode",
+    )
     args = ap.parse_args(argv)
+
+    if args.policy_only:
+        # force a 2-device virtual CPU mesh BEFORE jax initializes so
+        # the bit-identity leg covers the shard_map engine too (the
+        # mesh-chaos pattern; no-ops on an already-up backend)
+        from tpusim.virtual_mesh import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(2, force=True)
+        os.makedirs(args.out, exist_ok=True)
+        ok, msgs = policy_smoke(args.out)
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
 
     if args.fleet_wan_only:
         ok, msgs = fleet_wan_smoke(args.out)
@@ -1611,6 +1857,10 @@ def main(argv=None) -> int:
     # zero-recompile check + standalone disruption reconciliation
     chaos_ok, chaos_msgs = chaos_smoke(nodes, pods)
     print("\n".join(chaos_msgs))
+    # learned-policy smoke (ISSUE 14): imitation round-trip, engine
+    # bit-identity of a signed artifact, ES zero-recompile, preset
+    pol_ok, pol_msgs = policy_smoke(args.out)
+    print("\n".join(pol_msgs))
     # mesh-chaos smoke (ISSUE 11 satellite): pipelined shard fault
     # replay + donated chunked replay — skips (PASS) on single-device
     # hosts; `make mesh-chaos-smoke` runs the forced-virtual-mesh form
@@ -1629,8 +1879,8 @@ def main(argv=None) -> int:
     mc_ok, mc_msgs = multichip_advisory(latest_multichip())
     print("\n".join(mc_msgs))
     smoke_ok = (dec_ok and scrape_ok and swp_ok and svc_ok and tune_ok
-                and chaos_ok and mesh_ok and fleet_ok and wan_ok
-                and mc_ok)
+                and chaos_ok and pol_ok and mesh_ok and fleet_ok
+                and wan_ok and mc_ok)
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

@@ -51,7 +51,21 @@ def jit_policy(fn):
 
 
 def make_policy(name: str, dim_ext_method: str = "share", norm_method: str = "max"):
-    """Plugin-name → kernel (names as in scheduler-config YAML)."""
+    """Plugin-name → kernel (names as in scheduler-config YAML).
+
+    Beside the built-ins, 'LearnedScore[<feature>]' names resolve to the
+    learned-policy feature kernels (ISSUE 14, tpusim.learn.policy): a
+    learned policy is a FAMILY of per-feature kernels whose weights are
+    the model parameters, so every engine replays it like any built-in.
+    Imported lazily — the policies package stays dependency-free for
+    built-in-only configs."""
+    if name.startswith("LearnedScore["):
+        from tpusim.learn.policy import feature_policy, parse_learned_name
+
+        feat = parse_learned_name(name)
+        if feat is None:
+            raise KeyError(f"malformed learned-policy name: {name!r}")
+        return feature_policy(feat)  # KeyError names the known features
     table = {
         "FGDScore": lambda: fgd_score,
         "PWRScore": lambda: pwr_score,
@@ -78,6 +92,20 @@ POLICY_NAMES = (
     "DotProductScore",
 )
 
+
+def is_policy_name(name: str) -> bool:
+    """Whether `name` resolves through make_policy — a built-in or a
+    learned feature kernel ('LearnedScore[<feature>]', ISSUE 14). The
+    validation predicate job documents / the tune CLI share, so the
+    learned family flows through every config surface the built-ins do."""
+    if name in POLICY_NAMES:
+        return True
+    if name.startswith("LearnedScore["):
+        from tpusim.learn.policy import is_learned_name
+
+        return is_learned_name(name)
+    return False
+
 # The normalizers decompose into a block-reducible reduction half
 # (feasible_min_max: associative min/max, so global extrema come exactly
 # from per-block extrema) and an elementwise apply half (minmax_scale_i32,
@@ -98,4 +126,5 @@ __all__ = [
     "pwr_normalize_i32",
     "NORMALIZE_DEGENERATE",
     "POLICY_NAMES",
+    "is_policy_name",
 ]

@@ -85,6 +85,15 @@ class SimulatorConfig:
     # are bit-identical either way; this is purely a throughput knob for
     # the 100k-node scale lane.
     block_size: int = 0
+    # Flat-path select layout A/B (ENGINES.md Round 18): True replaces
+    # the flat table engine's event switch with the shard engine's
+    # unconditional-select form (score rows never cross a branch
+    # boundary; small results merge by kind). Bit-identical either way;
+    # MEASURED slower on the CPU backend at N=100k (the switch's
+    # in-branch row reads lower as plain gathers there), so the default
+    # keeps the switch — the knob exists for accelerator backends and
+    # A/B measurement (bench_scale --unswitched).
+    unswitched_select: bool = False
     # HTTP scheduler extenders (tpusim.sim.extender.ExtenderConfig tuple).
     # When set, every replay runs the host-loop extender engine — the only
     # execution mode that can splice per-cycle HTTP round-trips between
@@ -268,6 +277,11 @@ def _engine_source_digest() -> bytes:
                 # the fault vocabulary shapes the fault-lane trajectory
                 # and the FaultCarry layout (ISSUE 10) — same discipline
                 "sim/fault_lane.py",
+                # the learned-policy feature kernels are score plugins
+                # like everything under policies/ (ISSUE 14): editing a
+                # feature must invalidate checkpoints and cached tables
+                # built from the old vocabulary
+                "learn/policy.py",
             )
         ]
         files += glob.glob(os.path.join(base, "policies", "*.py"))
@@ -476,6 +490,7 @@ class Simulator:
             heartbeat_every=self.cfg.heartbeat_every,
             decisions=self.cfg.record_decisions,
             series_every=self.cfg.series_every,
+            unswitched=self.cfg.unswitched_select,
         )
         # fused whole-replay Pallas engine (tpusim.sim.pallas_engine): one
         # kernel for the entire event loop, ~4x the table engine on chip;
@@ -2421,6 +2436,7 @@ class Simulator:
                 self._policy_fns, gpu_sel=self.cfg.gpu_sel_method,
                 report=False, block_size=self.cfg.block_size, faults=True,
                 fault_frag=plan.has_recover,
+                unswitched=self.cfg.unswitched_select,
             )
             self._last_engine = "table (fault lane)"
             out = self._dispatch_span(
@@ -3200,22 +3216,41 @@ class SweepLane:
     disruption: object = None
 
 
-def _sweep_engine(engine, table: bool):
+def _sweep_engine(engine, table: bool, donate: bool = True):
     """jit(vmap(engine)) over (key, weights, tiebreak_rank); everything
     else — cluster state, pod specs, types, events, typical pods, and
     the shared score tables — broadcasts (in_axes None). Cached per
     underlying weight-operand engine, which is itself shared across
-    weight configs (one jaxpr per job family)."""
-    if engine not in _SWEEP_WRAP_CACHE:
+    weight configs (one jaxpr per job family).
+
+    donate=True (the dispatched form, ISSUE 14 satellite — the PR 11
+    run_chunk_donated pattern applied to the batched surfaces): the
+    per-lane stacked tiebreak_rank operand — the [B, N] buffer, the one
+    whose shape/dtype matches output state leaves — is donated, so a
+    repeated-wave caller (the svc worker's batch loop, a tuning run's
+    generations) reuses it for a [B, N] output leaf instead of
+    reallocating per wave (keys/weights are byte-tiny and alias
+    nothing). Safe by construction at every dispatch site: the ranks
+    are built fresh inside the schedule_pods_sweep* call and never
+    read after dispatch. The
+    non-donating twin (donate=False) serves callers that drive the
+    wrapper directly with reusable buffers."""
+    ck = (engine, bool(donate))
+    if ck not in _SWEEP_WRAP_CACHE:
         if table:
             # (state, pods, types, ev_kind, ev_pod, tp, key, wts, rank,
             #  tables)
             in_axes = (None, None, None, None, None, None, 0, 0, 0, None)
+            dn = (8,)
         else:
             # (state, pods, ev_kind, ev_pod, tp, key, wts, rank)
             in_axes = (None, None, None, None, None, 0, 0, 0)
-        _SWEEP_WRAP_CACHE[engine] = jax.jit(jax.vmap(engine, in_axes=in_axes))
-    return _SWEEP_WRAP_CACHE[engine]
+            dn = (7,)
+        _SWEEP_WRAP_CACHE[ck] = jax.jit(
+            jax.vmap(engine, in_axes=in_axes),
+            donate_argnums=dn if donate else (),
+        )
+    return _SWEEP_WRAP_CACHE[ck]
 
 
 def _sweep_metrics_fn():
@@ -3491,16 +3526,19 @@ _SWEEP_MULTI_FAULT_WRAP_CACHE = {}
 _SWEEP_MULTI_METRICS_FN = None
 
 
-def _sweep_engine_multi(engine, table: bool):
+def _sweep_engine_multi(engine, table: bool, donate: bool = True):
     """jit(vmap(engine)) over per-lane (specs, type_id, events, key,
     weights, rank); cluster state, distinct type set, typical pods, and
     the shared score tables broadcast (in_axes None). The trace-operand
     generalization of _sweep_engine: lanes may replay different tuned
-    workloads and still share one compiled scan."""
+    workloads and still share one compiled scan. donate=True donates
+    the per-lane rank like _sweep_engine — per-lane specs/events are
+    NOT donated (the metrics postpass reads them after dispatch)."""
     from tpusim.sim.table_engine import PodTypes
     from tpusim.types import PodSpec
 
-    if engine not in _SWEEP_MULTI_WRAP_CACHE:
+    ck = (engine, bool(donate))
+    if ck not in _SWEEP_MULTI_WRAP_CACHE:
         spec0 = PodSpec(0, 0, 0, 0, 0, 0)
         none_spec = PodSpec(*(None,) * 6)
         if table:
@@ -3508,16 +3546,19 @@ def _sweep_engine_multi(engine, table: bool):
             #  tables) — type_id is per-lane, the distinct set broadcasts
             in_axes = (None, spec0, PodTypes(none_spec, none_spec, 0),
                        0, 0, None, 0, 0, 0, None)
+            dn = (8,)
         else:
             # (state, pods, ev_kind, ev_pod, tp, key, wts, rank)
             in_axes = (None, spec0, 0, 0, None, 0, 0, 0)
-        _SWEEP_MULTI_WRAP_CACHE[engine] = jax.jit(
-            jax.vmap(engine, in_axes=in_axes)
+            dn = (7,)
+        _SWEEP_MULTI_WRAP_CACHE[ck] = jax.jit(
+            jax.vmap(engine, in_axes=in_axes),
+            donate_argnums=dn if donate else (),
         )
-    return _SWEEP_MULTI_WRAP_CACHE[engine]
+    return _SWEEP_MULTI_WRAP_CACHE[ck]
 
 
-def _sweep_multi_fault_engine(engine, table: bool):
+def _sweep_multi_fault_engine(engine, table: bool, donate: bool = True):
     """The chaos x tune lift (ISSUE 12): jit(vmap(engine)) over per-lane
     (specs, type_id, MERGED fault streams, key, weights, rank, fault
     ops) — the union of _sweep_engine_multi's per-lane trace operands
@@ -3529,7 +3570,8 @@ def _sweep_multi_fault_engine(engine, table: bool):
     from tpusim.sim.table_engine import PodTypes
     from tpusim.types import PodSpec
 
-    if engine not in _SWEEP_MULTI_FAULT_WRAP_CACHE:
+    ck = (engine, bool(donate))
+    if ck not in _SWEEP_MULTI_FAULT_WRAP_CACHE:
         spec0 = PodSpec(0, 0, 0, 0, 0, 0)
         none_spec = PodSpec(*(None,) * 6)
         fops_axes = FaultOps(0, 0, 0, 0, 0, None)
@@ -3538,14 +3580,17 @@ def _sweep_multi_fault_engine(engine, table: bool):
             #  fault_ops, fault_carry0)
             in_axes = (None, spec0, PodTypes(none_spec, none_spec, 0),
                        0, 0, None, 0, 0, 0, None, fops_axes, None)
+            dn = (8,)
         else:
             # (state, pods, evk, evp, tp, key, wts, rank, fault_ops,
             #  fault_carry0)
             in_axes = (None, spec0, 0, 0, None, 0, 0, 0, fops_axes, None)
-        _SWEEP_MULTI_FAULT_WRAP_CACHE[engine] = jax.jit(
-            jax.vmap(engine, in_axes=in_axes)
+            dn = (7,)
+        _SWEEP_MULTI_FAULT_WRAP_CACHE[ck] = jax.jit(
+            jax.vmap(engine, in_axes=in_axes),
+            donate_argnums=dn if donate else (),
         )
-    return _SWEEP_MULTI_FAULT_WRAP_CACHE[engine]
+    return _SWEEP_MULTI_FAULT_WRAP_CACHE[ck]
 
 
 def _sweep_multi_metrics_fn():
@@ -3959,28 +4004,33 @@ def _dispatch_sweep_multi_faults(
 _SWEEP_FAULT_WRAP_CACHE = {}
 
 
-def _sweep_fault_engine(engine, table: bool):
+def _sweep_fault_engine(engine, table: bool, donate: bool = True):
     """jit(vmap(engine)) for the chaos sweep: per-lane (merged streams,
     key, weights, rank, fault ops); cluster state, pod specs, types,
     typical pods, tables, the initial fault carry, and the global
-    gpu-count row broadcast."""
+    gpu-count row broadcast. donate=True donates the per-lane rank like
+    _sweep_engine."""
     from tpusim.sim.fault_lane import FaultOps
 
-    if engine not in _SWEEP_FAULT_WRAP_CACHE:
+    ck = (engine, bool(donate))
+    if ck not in _SWEEP_FAULT_WRAP_CACHE:
         fops_axes = FaultOps(0, 0, 0, 0, 0, None)
         if table:
             # (state, pods, types, evk, evp, tp, key, wts, rank, tables,
             #  fault_ops, fault_carry0)
             in_axes = (None, None, None, 0, 0, None, 0, 0, 0, None,
                        fops_axes, None)
+            dn = (8,)
         else:
             # (state, pods, evk, evp, tp, key, wts, rank, fault_ops,
             #  fault_carry0)
             in_axes = (None, None, 0, 0, None, 0, 0, 0, fops_axes, None)
-        _SWEEP_FAULT_WRAP_CACHE[engine] = jax.jit(
-            jax.vmap(engine, in_axes=in_axes)
+            dn = (7,)
+        _SWEEP_FAULT_WRAP_CACHE[ck] = jax.jit(
+            jax.vmap(engine, in_axes=in_axes),
+            donate_argnums=dn if donate else (),
         )
-    return _SWEEP_FAULT_WRAP_CACHE[engine]
+    return _SWEEP_FAULT_WRAP_CACHE[ck]
 
 
 def resolve_fault_spec(spec, num_nodes: int, num_events: int):

@@ -145,14 +145,38 @@ def _node_json(node) -> dict:
 
 
 def _post(url: str, payload: dict, timeout: float) -> dict:
-    req = urllib.request.Request(
-        url,
-        data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"},
-        method="POST",
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return json.loads(resp.read().decode())
+    """POST one extender verb on the SHARED kube_client retry schedule
+    (ISSUE 14 satellite — this was the last bare-timeout HTTP call in
+    the tree): connection-level failures (retryable_conn_excs) and
+    429/5xx answers retry under capped-exponential-backoff-with-jitter
+    honoring Retry-After, with the TPUSIM_HTTP_RETRIES attempt budget
+    the rest client uses. After the schedule is exhausted the last
+    error surfaces unchanged, so the callers' ExtenderError wrapping
+    (and the `ignorable` policy) behave exactly as before."""
+    from tpusim.io.kube_client import _retry_attempts, with_backoff
+
+    data = json.dumps(payload).encode()
+
+    def call():
+        req = urllib.request.Request(
+            url,
+            data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, dict(resp.headers), resp.read()
+        except urllib.error.HTTPError as e:
+            # carry the error object through the schedule: retryable
+            # statuses re-attempt; anything else re-raises below with
+            # the original traceback semantics
+            return e.code, dict(e.headers or {}), e
+
+    code, _, body = with_backoff(call, max_attempts=_retry_attempts())
+    if isinstance(body, Exception):
+        raise body
+    return json.loads(body.decode())
 
 
 class ExtenderClient:

@@ -373,6 +373,7 @@ def make_table_replay(
     block_size: int = 0, heartbeat_every: int = 0,
     decisions: bool = False, series_every: int = 0,
     faults: bool = False, fault_frag: bool = False,
+    unswitched: bool = False,
 ):
     """Build the jitted incremental replayer for a static policy config.
 
@@ -480,17 +481,19 @@ def make_table_replay(
         )
     cache_key = (tuple((fn, w) for fn, w in policies), gpu_sel, report,
                  int(block_size), int(heartbeat_every), bool(decisions),
-                 int(series_every), bool(faults), bool(fault_frag))
+                 int(series_every), bool(faults), bool(fault_frag),
+                 bool(unswitched))
     if cache_key in _TABLE_REPLAY_CACHE:
         return _TABLE_REPLAY_CACHE[cache_key]
     engine_key = (tuple(fn for fn, _ in policies), gpu_sel,
                   int(block_size), int(heartbeat_every), bool(decisions),
-                  int(series_every), bool(faults), bool(fault_frag))
+                  int(series_every), bool(faults), bool(fault_frag),
+                  bool(unswitched))
     eng = _TABLE_ENGINE_CACHE.get(engine_key)
     if eng is None:
         eng = _make_table_engine(
             policies, gpu_sel, block_size, heartbeat_every, decisions,
-            series_every, faults, fault_frag,
+            series_every, faults, fault_frag, unswitched,
         )
         _TABLE_ENGINE_CACHE[engine_key] = eng
 
@@ -603,7 +606,7 @@ class _TableEngine(NamedTuple):
 def _make_table_engine(
     policies, gpu_sel: str, block_size: int, heartbeat_every: int,
     decisions: bool, series_every: int, faults: bool = False,
-    fault_frag: bool = False,
+    fault_frag: bool = False, unswitched: bool = False,
 ) -> _TableEngine:
     """Build the jitted weight-operand machinery make_table_replay wraps.
     The closed-over `policies` weights are deliberately never read — only
@@ -995,7 +998,26 @@ def _make_table_engine(
 
     def make_flat_body(pods, type_id, types, tp, tiebreak_rank, n, num_pods,
                        wts, fault_ops=None):
-        """Scan body of the flat O(N) select path."""
+        """Scan body of the flat O(N) select path.
+
+        Round 18 ports the shard engine's Round-15 unconditional-select
+        restructure back here as an A/B layout knob (`unswitched`, the
+        shard engine's `pipelined` pattern): with it ON, the select runs
+        UNCONDITIONALLY every event (score/feas rows never cross a
+        branch boundary — the branch-capture class the shard engine
+        shed) and only the small (node, dev[, dec]) results merge by
+        kind. Bit-identical to the switch form by construction — the
+        same create_result closure runs either inside the switch branch
+        or inline, with the same pre-split k_rand/k_sel (pinned by
+        tests/test_table_engine.py::test_unswitched_flat_bit_identity).
+        MEASURED at N=100k on the CPU backend (bench_scale --nodes
+        100000 --block-size -1, creates-only stream): the switch form
+        wins, ~5.3 vs ~6.8 ms/event — XLA:CPU lowers the in-branch row
+        reads as plain gathers (no whole-table copy), so removing the
+        branch only adds merge selects. The default therefore stays on
+        the switch; the unswitched layout exists for accelerator
+        backends where conditionals serialize the stream (the Round 15
+        motivation) and for A/B measurement."""
 
         def body(carry, ev):
             if faults:
@@ -1056,19 +1078,24 @@ def _make_table_engine(
                 if series_every else ()
             )
 
-            def do_create():
+            def create_result():
+                """The full create computation — ONE definition serving
+                both select layouts below (Round 18)."""
                 feasible = feas_tbl[t_id] & (
-                    (pod.pinned < 0) | (jnp.arange(n, dtype=jnp.int32) == pod.pinned)
+                    (pod.pinned < 0)
+                    | (jnp.arange(n, dtype=jnp.int32) == pod.pinned)
                 )
                 total = jnp.zeros(n, jnp.int32)
                 raw_rows, norm_rows = [], []
                 for i, (fn, _) in enumerate(policies):
                     if fn.policy_name == "RandomScore":
-                        # per-event draw, recomputed instead of table-read —
-                        # through the ONE canonical kernel (the oracle's
-                        # schedule_one calls the same fn with the same
-                        # feasible mask and k_rand)
-                        ctx = ScoreContext(tp=tp, feasible=feasible, rng=k_rand)
+                        # per-event draw, recomputed instead of
+                        # table-read — through the ONE canonical kernel
+                        # (the oracle's schedule_one calls the same fn
+                        # with the same feasible mask and k_rand)
+                        ctx = ScoreContext(
+                            tp=tp, feasible=feasible, rng=k_rand
+                        )
                         raw = fn(state, pod, ctx).raw_scores
                     else:
                         raw = score_tbl[i, t_id]
@@ -1083,11 +1110,11 @@ def _make_table_engine(
                         norm_rows.append(nrm)
                     total = total + wts[i] * nrm
                 # the oracle's selectHost + Reserve halves; the Bind
-                # scatter is deferred via PendingCommit, outside the switch
+                # scatter is deferred via PendingCommit
                 sel, _, ok = packed_argmax(total, feasible, tiebreak_rank)
                 dmask = choose_devices(
-                    state.gpu_left[sel], pod, sdev_tbl[t_id, sel], gpu_sel,
-                    k_sel,
+                    state.gpu_left[sel], pod, sdev_tbl[t_id, sel],
+                    gpu_sel, k_sel,
                 ) & ok
                 node_f = jnp.where(ok, sel, -1).astype(jnp.int32)
                 if not decisions:
@@ -1099,21 +1126,54 @@ def _make_table_engine(
                 )
                 return node_f, dmask, dec
 
-            def do_delete():
-                base = placed[idx], masks[idx]
-                return base + ((no_decision(num_pol),) if decisions else ())
-
-            def do_skip():
-                base = (
-                    jnp.int32(-1), jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_)
+            if unswitched:
+                # the shard engine's Round-15 form: the select runs
+                # UNCONDITIONALLY (table rows never cross a branch
+                # boundary) and only the small (node, dev[, dec])
+                # results merge by kind
+                outs_c = create_result()
+                is_create = kc == 0
+                is_delete = kc == 1
+                node = jnp.where(
+                    is_create, outs_c[0],
+                    jnp.where(is_delete, placed[idx], jnp.int32(-1)),
+                ).astype(jnp.int32)
+                dev = jnp.where(
+                    is_create, outs_c[1],
+                    jnp.where(is_delete, masks[idx],
+                              jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_)),
                 )
-                return base + ((no_decision(num_pol),) if decisions else ())
-
-            outs = jax.lax.switch(kc, [do_create, do_delete, do_skip])
-            if decisions:
-                node, dev, dec = outs
+                if decisions:
+                    dec = jax.tree.map(
+                        lambda a, b: jnp.where(is_create, a, b),
+                        outs_c[2], no_decision(num_pol),
+                    )
             else:
-                node, dev = outs
+                # the event switch (the measured-faster layout on the
+                # single-device CPU flat path — ENGINES.md Round 18)
+
+                def do_delete():
+                    base = placed[idx], masks[idx]
+                    return base + (
+                        (no_decision(num_pol),) if decisions else ()
+                    )
+
+                def do_skip():
+                    base = (
+                        jnp.int32(-1),
+                        jnp.zeros(MAX_GPUS_PER_NODE, jnp.bool_),
+                    )
+                    return base + (
+                        (no_decision(num_pol),) if decisions else ()
+                    )
+
+                outs = jax.lax.switch(
+                    kc, [create_result, do_delete, do_skip]
+                )
+                if decisions:
+                    node, dev, dec = outs
+                else:
+                    node, dev = outs
             # defer this event's scatters to the next iteration; arrived
             # counters accumulate per creation event regardless of outcome
             # (simulator.go:406-408)

@@ -48,12 +48,17 @@ class JobService:
 
     def __init__(self, queue: JobQueue, worker: Optional[Worker],
                  traces: Dict[str, TraceRef], artifact_dir: str,
-                 monitor=None):
+                 monitor=None, policy_presets: Optional[dict] = None):
         self.queue = queue
         self.worker = worker  # in-process Worker, or None in fleet mode
         self.traces = dict(traces)
         self.artifact_dir = artifact_dir
         self.monitor = monitor
+        # named learned-policy presets (ISSUE 14): preset name ->
+        # [(policy name, weight)] pairs, expanded at submit time so the
+        # queued/persisted/claimed spec is an ordinary policies job —
+        # workers and the digest vocabulary never see preset names
+        self.policy_presets = dict(policy_presets or {})
         # the fleet coordinator app (svc.fleet.FleetService) when
         # `serve --jobs --workers N` runs; None for the single
         # in-process worker of PR 7
@@ -77,6 +82,9 @@ class JobService:
         """Validate + dedup + enqueue one job document. Returns the job
         description (with `cached` marking digest-cache answers); raises
         ValueError (→ 400) or QueueFull (→ 429)."""
+        payload = svc_jobs.expand_policy_preset(
+            payload, self.policy_presets
+        )
         spec = svc_jobs.validate_job(payload)
         trace = self.traces.get(spec.trace)
         if trace is None:
@@ -201,6 +209,7 @@ class JobService:
         if self.fleet is not None:
             stats.update(self.fleet.queue_fields())
         stats["traces"] = sorted(self.traces)
+        stats["policy_presets"] = sorted(self.policy_presets)
         return _json_body(200, stats)
 
 
@@ -242,6 +251,7 @@ def start_job_server(
     table_cache_dir: str = "", compile_cache_dir: str = "",
     start_worker: bool = True, recover: bool = True, out=None,
     fleet: bool = False, lease_s: float = 0.0, family_quota: int = 0,
+    policy_presets: Optional[dict] = None,
 ) -> Tuple[object, JobService, Optional[Worker]]:
     """Wire the full service: MonitorServer (+ heartbeat-fed /progress)
     with the JobService app, a bounded JobQueue, and either the single
@@ -269,7 +279,8 @@ def start_job_server(
             table_cache_dir=table_cache_dir,
             compile_cache_dir=compile_cache_dir,
         )
-    service = JobService(queue, worker, traces, artifact_dir, monitor=srv)
+    service = JobService(queue, worker, traces, artifact_dir, monitor=srv,
+                         policy_presets=policy_presets)
     service.bucket = bucket  # the register handshake hands it to workers
     srv.add_app(service)
     if fleet:

@@ -687,49 +687,15 @@ class FleetService:
 
 
 def _with_backoff(call, max_attempts: int = 8, stop_event=None):
-    """Drive one HTTP call on the SHARED kube_client capped-exponential-
-    backoff-with-jitter schedule (ISSUE 13 satellite — register used to
-    be the only fleet POST that retried; now every worker→coordinator
-    request rides this): `call()` returns (code, headers, body);
-    connection-level errors (including REFUSED — a restarting
-    coordinator refuses for a moment, and to a worker that is a stall,
-    not a death) and 429/5xx answers are retried honoring a server
-    Retry-After; the final attempt's answer (or exception) surfaces.
+    """The shared kube_client.with_backoff schedule (ISSUE 14 satellite:
+    the loop moved INTO kube_client beside retryable_conn_excs /
+    is_retryable_status so the fleet, the extender client, and the rest
+    client all ride one implementation; this thin alias keeps the fleet's
+    internal call sites and test monkeypatch points stable)."""
+    from tpusim.io.kube_client import with_backoff
 
-    `stop_event` aborts the RETRY schedule (the last answer surfaces
-    at once and backoff sleeps wake early) — a SIGTERM'd worker whose
-    draining coordinator answers 503 + Retry-After must exit its idle
-    claim loop promptly, not ride out eight 2-second retries first."""
-    from tpusim.io.kube_client import (
-        _retry_delay_s,
-        is_retryable_status,
-        retryable_conn_excs,
-    )
-
-    def stopped():
-        return stop_event is not None and stop_event.is_set()
-
-    def wait(delay):
-        if stop_event is not None:
-            stop_event.wait(delay)
-        else:
-            time.sleep(delay)
-
-    for attempt in range(1, max_attempts + 1):
-        try:
-            code, headers, body = call()
-        except retryable_conn_excs():
-            if attempt >= max_attempts or stopped():
-                raise
-            wait(_retry_delay_s(attempt))
-            continue
-        if (is_retryable_status(code) and attempt < max_attempts
-                and not stopped()):
-            wait(_retry_delay_s(
-                attempt, (headers or {}).get("Retry-After")
-            ))
-            continue
-        return code, headers, body
+    return with_backoff(call, max_attempts=max_attempts,
+                        stop_event=stop_event)
 
 
 def _post(url: str, path: str, doc: dict, timeout: float = 30.0,

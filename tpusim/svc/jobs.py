@@ -32,7 +32,7 @@ import os
 from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
-from tpusim.policies import POLICY_NAMES
+from tpusim.policies import POLICY_NAMES, is_policy_name
 
 RESULT_SCHEMA = "tpusim-svc-result/1"
 RESULT_SUFFIX = ".result.jsonl"
@@ -127,6 +127,17 @@ def validate_job(payload: dict) -> JobSpec:
         raise ValueError(
             f"job must be a JSON object, got {type(payload).__name__}"
         )
+    if "policy_preset" in payload:
+        # presets are a SERVICE-side vocabulary (ISSUE 14): the serving
+        # JobService expands the name into policies before validation
+        # (expand_policy_preset), so a preset key reaching here means
+        # the service has no such preset registered — or the caller
+        # bypassed the service entirely
+        raise ValueError(
+            "policy_preset is expanded by the serving endpoint (serve "
+            "--policy-preset NAME=artifact.json); this service has no "
+            f"preset named {payload.get('policy_preset')!r}"
+        )
     unknown = set(payload) - JOB_KEYS
     if unknown:
         raise ValueError(
@@ -148,9 +159,10 @@ def validate_job(payload: dict) -> JobSpec:
         )
     policies = []
     for name, w in raw_pol:
-        if name not in POLICY_NAMES:
+        if not is_policy_name(name):
             raise ValueError(
-                f"unknown policy {name!r} (known: {', '.join(POLICY_NAMES)})"
+                f"unknown policy {name!r} (known: "
+                f"{', '.join(POLICY_NAMES)}, LearnedScore[<feature>])"
             )
         policies.append((name, _as_int(w, f"policies[{name}] weight")))
 
@@ -239,6 +251,40 @@ def validate_job(payload: dict) -> JobSpec:
         tune_seed=_as_int(payload.get("tune_seed", 233), "tune_seed"),
         engine=engine,
     )
+
+
+def expand_policy_preset(payload: dict, presets: dict) -> dict:
+    """Replace a job document's `policy_preset` reference with the named
+    preset's [(name, weight)] pairs (ISSUE 14, `serve --policy-preset`).
+    Returns a NEW payload (the caller's document is not mutated — it may
+    be persisted/retried verbatim). A preset excludes explicit policies/
+    weights: the preset IS the scoring family, and letting weights
+    override it would serve a different model under the preset's name."""
+    if not isinstance(payload, dict) or "policy_preset" not in payload:
+        return payload
+    name = payload["policy_preset"]
+    if not isinstance(name, str):
+        # a list/dict here would TypeError out of dict.get -> a 500 the
+        # retry vocabulary treats as transient; malformed shapes must be
+        # clean 400s like every other bad-job field
+        raise ValueError(
+            f"policy_preset must be a preset NAME string, got "
+            f"{type(name).__name__}"
+        )
+    pairs = (presets or {}).get(name)
+    if pairs is None:
+        raise ValueError(
+            f"unknown policy preset {name!r} (registered: "
+            f"{', '.join(sorted(presets or {})) or 'none'})"
+        )
+    if "policies" in payload or "weights" in payload:
+        raise ValueError(
+            "policy_preset excludes explicit policies/weights (the "
+            "preset IS the scoring family)"
+        )
+    out = {k: v for k, v in payload.items() if k != "policy_preset"}
+    out["policies"] = [[str(n), int(w)] for n, w in pairs]
+    return out
 
 
 def _as_int(v, what: str) -> int:
