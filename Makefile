@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke policy-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke policy-smoke pallas-hbm-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_transfer.py tests/test_supervisor.py tests/test_policy_learned.py tests/test_blocked_engine.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_transfer.py tests/test_supervisor.py tests/test_policy_learned.py tests/test_blocked_engine.py tests/test_pallas_hbm.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -106,6 +106,18 @@ tune-smoke:
 # the exact local placements.
 policy-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --policy-only
+
+# HBM-residency pallas smoke (ENGINES.md "Round 19"): the fused Pallas
+# engine past the old N <= 4096 VMEM ceiling — a synthetic N=8192/K=151
+# trace replayed by the HBM-resident-table kernel in interpreter mode,
+# WITHOUT degrading to the blocked table engine, bit-identical
+# placements/devices to it; the two-tier residency auto-select pinned
+# at both tiers (vmem below the ceiling, hbm above, degrade only when
+# neither fits), the documented HBM ceiling >= 256k nodes at K=151,
+# and the kernel's exact in-kernel DMA counters (waits == starts — no
+# leaked transfers) present in the obs run record.
+pallas-hbm-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --pallas-hbm-only
 
 # chaos-sweep smoke (ENGINES.md "Round 14"): a tiny B-lane fault sweep
 # (one trace, varying fault seed/MTBF/evict cadence as per-lane

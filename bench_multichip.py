@@ -106,6 +106,7 @@ def scale_lane(args):
         gpu_sel_method="FGDScore",
         seed=args.seed,
         report_per_event=False,
+        table_residency=args.pallas_residency,
         typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
     )
     pods = synth_pods_pooled(args.events, args.seed + 1, args.pod_pool)
@@ -339,6 +340,14 @@ def main():
         "(fault-lane merged stream through the shard engine)",
     )
     ap.add_argument(
+        "--pallas-residency", default="auto", metavar="auto|vmem|hbm",
+        help="fused-Pallas table residency for any single-device "
+        "reference dispatch this bench makes (SimulatorConfig."
+        "table_residency, ENGINES.md Round 19); the shard rows "
+        "themselves run the shard_map engine and ignore it — the knob "
+        "exists so mixed captures stay comparable with bench_scale's",
+    )
+    ap.add_argument(
         "--json-out", default="",
         help="scale-lane capture path (e.g. MULTICHIP_r06.json)",
     )
@@ -385,6 +394,7 @@ def main():
         gpu_sel_method="FGDScore",
         seed=args.seed,
         report_per_event=False,
+        table_residency=args.pallas_residency,
         typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
     )
     sim = Simulator(nodes, cfg)

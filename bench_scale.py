@@ -203,6 +203,22 @@ def main():
         "differs per backend",
     )
     ap.add_argument(
+        "--pallas-residency", default="auto", metavar="auto|vmem|hbm",
+        help="fused-Pallas table residency (SimulatorConfig."
+        "table_residency, ENGINES.md Round 19): where the [K, N] score "
+        "tables live — 'vmem' is the all-resident kernel (ceiling "
+        "N <= 4096 at K = 151), 'hbm' the HBM-resident-table kernel "
+        "with per-event double-buffered DMA (ceiling >= 256k), 'auto' "
+        "the two-tier footprint select; bit-identical either way",
+    )
+    ap.add_argument(
+        "--pallas-ceiling", action="store_true",
+        help="print the two-tier Pallas residency ceiling sweep instead "
+        "of running: for each tier the max N whose footprint fits the "
+        "TPUSIM_PALLAS_VMEM_BYTES budget at this run's K/policy shape "
+        "(the ENGINES.md Round 19 capture), then exit",
+    )
+    ap.add_argument(
         "--chunk",
         type=int,
         default=200_000,
@@ -301,6 +317,29 @@ def main():
                  "--block-size -1")
     nodes = synth_cluster(args.nodes, args.seed)
     pods = synth_pods(args.pods, args.seed + 1)
+
+    if args.pallas_ceiling:
+        # the ceiling-sweep capture (ISSUE 15): pure footprint math at
+        # this run's K/policy shape — no replay, no device
+        from tpusim.io.trace import pods_to_specs as _pts
+        from tpusim.sim import pallas_engine as _pe
+        from tpusim.sim.table_engine import build_pod_types as _bpt
+
+        _types = _bpt(_pts(pods))
+        _k = int(_types.share.cpu.shape[0]) + int(_types.whole.cpu.shape[0])
+        budget = _pe.vmem_budget()
+        print(f"[pallas-ceiling] budget {budget} bytes, K={_k}, "
+              f"num_pol=1, P={args.pods}, E={args.pods}")
+        for n_probe in (2048, 4096, 8192, 65536, 262144, 1048576):
+            tier = _pe.select_residency(n_probe, _k, 1, args.pods,
+                                        args.pods)
+            print(f"[pallas-ceiling] N={n_probe:>8}: "
+                  f"{tier or 'degrade (blocked table engine)'}")
+        print(f"[pallas-ceiling] HBM-tier max N at this shape: "
+              f"{_pe.hbm_ceiling_nodes(_k, 1, 1, args.pods, args.pods)}")
+        print(f"[pallas-ceiling] reference (K=151, small workload): "
+              f"{_pe.hbm_ceiling_nodes(151, 1, 1)}")
+        return
     profiling = bool(args.profile or args.metrics_out or args.trace_out)
     cfg = SimulatorConfig(
         policies=(("FGDScore", 1000),),
@@ -314,6 +353,7 @@ def main():
         heartbeat_every=args.heartbeat,
         series_every=args.series_every,
         table_cache_dir=args.table_cache,
+        table_residency=args.pallas_residency,
         typical_pods=TypicalPodsConfig(pod_popularity_threshold=95),
     )
     sim = Simulator(nodes, cfg)

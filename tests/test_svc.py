@@ -399,9 +399,11 @@ def test_post_path_lane_vs_standalone(trace, tmp_path):
     # must not grow the jitted sweep wrapper's executable cache (counts
     # are read RELATIVE to the first batch — the wrapper is process-
     # global, so sibling tests may have compiled other shapes into it)
+    # the service lane runs report_per_event=False, so the dispatch
+    # resolves the STREAM-DONATING twin (ISSUE 15) — ask for that one
     fn = _sweep_engine_multi(
         worker._sims[list(worker._sims)[0]]._table_fn.engine.replay,
-        table=True,
+        table=True, donate_streams=True,
     )
     before = fn._cache_size()
     _post(service, {"policies": FAM, "weights": [555, 111], "tune": 1.1,

@@ -591,3 +591,66 @@ def test_openb_sweep_acceptance():
     assert marginal <= bound * sw, (marginal, sw, jax.default_backend())
     # and the whole 16-config batch beats 16 standalone warm replays
     assert w16 < b * sw, (w16, sw)
+
+
+def test_sweep_multi_stream_donation(monkeypatch):
+    """ISSUE 15 satellite: the multi-trace sweep's per-lane event-stream
+    buffer is DONATED when nothing reads it after dispatch (the
+    sweep/service lane runs report_per_event=False), finishing the PR 11
+    donation story for the batched surfaces. Pins: (1) the donating
+    wrapper is the one the dispatch resolves for report-off configs and
+    carries the ev_pod argnum; (2) two waves of different tuned traces
+    produce bit-identical lanes to fresh standalone runs AND add zero
+    executables (the zero-recompile bookkeeping is donation-invariant —
+    the (engine, donate, donate_streams) cache key keeps one wrapper per
+    family); (3) a report-ON config keeps the non-donating wrapper (the
+    metrics postpass re-reads the streams)."""
+    from tpusim.sim.driver import _sweep_engine_multi
+
+    rng = np.random.default_rng(29)
+    nodes, pods = _mk_cluster(rng), _mk_pods(rng, 24)
+    # engine="table" pins the table-form wrapper (the service lane's
+    # path) regardless of the events-per-type heuristic
+    sim = Simulator(nodes, _cfg(42, engine="table"))
+    sim.set_workload_pods(pods)
+    grid = np.asarray([[1000], [1000]], np.int32)
+
+    fn_don = _sweep_engine_multi(
+        sim._table_fn.engine.replay, table=True, donate_streams=True
+    )
+    fn_plain = _sweep_engine_multi(
+        sim._table_fn.engine.replay, table=True, donate_streams=False
+    )
+    assert fn_don is not fn_plain  # distinct wrappers, one cache each
+    # counts are read RELATIVE to this point — the wrappers are
+    # process-global, so sibling tests may have compiled other shapes
+    # into either one (the test_svc.py discipline)
+    don0 = fn_don._cache_size()
+    plain0 = fn_plain._cache_size()
+
+    lanes1 = sim.run_sweep(grid, tunes=[0.0, 0.3])
+    before = fn_don._cache_size()
+    assert before == don0 + 1  # report-off dispatch resolved the donor
+    assert fn_plain._cache_size() == plain0  # ...never the other
+    lanes2 = sim.run_sweep(grid, tunes=[0.0, 0.3])
+    assert fn_don._cache_size() == before  # second wave: zero recompiles
+    for l1, l2 in zip(lanes1, lanes2):
+        assert np.array_equal(l1.placed_node, l2.placed_node)
+
+    # lane 0 (tune 0.0) == the plain standalone run
+    single = Simulator(nodes, _cfg(42, engine="table"))
+    single.set_workload_pods(pods)
+    res = single.run()
+    assert np.array_equal(
+        lanes1[0].placed_node, res.placed_node[:len(lanes1[0].placed_node)]
+    )
+
+    # report-on config: the metrics postpass reads the streams after
+    # dispatch, so the dispatch must resolve the NON-donating twin
+    sim_r = Simulator(nodes, _cfg(42, engine="table", report_per_event=True))
+    sim_r.set_workload_pods(pods)
+    plain_before = fn_plain._cache_size()
+    don_before = fn_don._cache_size()
+    sim_r.run_sweep(grid, tunes=[0.0, 0.3])
+    assert fn_plain._cache_size() == plain_before + 1
+    assert fn_don._cache_size() == don_before

@@ -1,0 +1,60 @@
+"""Fail-loud environment-knob parsing, shared across subsystems.
+
+One helper family for every `TPUSIM_*` tuning variable: an unparseable
+or out-of-range value raises a ValueError NAMING THE VARIABLE at the
+first read instead of silently falling back to the default (ISSUE 15
+satellite, generalizing the svc/leases.py `_float_env` pattern from
+ISSUE 13). A typo'd knob that silently reverts is worse than a crash:
+a mis-set lease skew can make a whole fleet's leases instantly
+stealable, and a mis-set Pallas VMEM budget silently re-opens the
+graceful-degradation path the operator thought they had widened.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def float_env(name: str, default: float, minimum: float = 0.0) -> float:
+    """Read one float env knob, failing LOUDLY on an unparseable or
+    out-of-range value, with the variable named in the message."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid number (want a float, e.g. "
+            f"{name}={default}); unset it to use the default {default}"
+        )
+    if val != val or val in (float("inf"), float("-inf")) \
+            or val < minimum:
+        raise ValueError(
+            f"{name}={raw!r} must be a finite number >= {minimum} "
+            f"(got {val}); unset it to use the default {default}"
+        )
+    return val
+
+
+def int_env(name: str, default: int, minimum: int = 0) -> int:
+    """Read one integer env knob, failing LOUDLY on a non-integer or
+    out-of-range value, with the variable named in the message. The
+    float twin's contract, for byte/count knobs (int() also accepts
+    '  16777216 ' but rejects '14MB' and '1.5e7' — sizes are exact)."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid integer (want e.g. "
+            f"{name}={default}); unset it to use the default {default}"
+        )
+    if val < minimum:
+        raise ValueError(
+            f"{name}={raw!r} must be an integer >= {minimum} "
+            f"(got {val}); unset it to use the default {default}"
+        )
+    return val

@@ -1406,6 +1406,123 @@ def tune_smoke(out_dir: str, generations: int = 3) -> Tuple[bool, List[str]]:
     return True, msgs
 
 
+def pallas_hbm_smoke(out_dir: str) -> Tuple[bool, List[str]]:
+    """ISSUE 15 (`make pallas-hbm-smoke`): the HBM-residency fused
+    Pallas engine above the old VMEM ceiling — (a) a synthetic
+    N = 8192 / K = 151 trace replayed by a forced pallas engine in
+    interpreter mode must NOT degrade: the two-tier residency select
+    routes the HBM kernel ("pallas (hbm)") and the placements/devices
+    reconcile the blocked table engine BIT-exactly; (b) the residency
+    auto-select is pinned at both tiers (old-ceiling shapes -> vmem,
+    above-ceiling -> hbm, genuinely impossible -> degrade None) and the
+    documented HBM ceiling clears 256k nodes at K = 151; (c) the run
+    record carries the residency and the kernel's exact in-kernel DMA
+    counters, with every started DMA waited. Any exception is a FAIL
+    verdict, not a traceback."""
+    msgs: List[str] = []
+    try:
+        import numpy as np
+
+        from tpusim.io.trace import NodeRow, PodRow
+        from tpusim.sim import pallas_engine
+        from tpusim.sim.driver import Simulator, SimulatorConfig
+        from tpusim.sim.typical import TypicalPodsConfig
+
+        # (b) the two-tier footprint math, pinned first (no compiles)
+        sel = pallas_engine.select_residency
+        if sel(512, 151, 1, 2048, 4096) != "vmem":
+            return False, ["[pallas-hbm] FAIL: old-ceiling shape did not "
+                           "auto-select the VMEM tier"]
+        if sel(8192, 151, 1, 2048, 4096) != "hbm":
+            return False, ["[pallas-hbm] FAIL: above-ceiling shape did "
+                           "not auto-select the HBM tier"]
+        if sel(10**6, 151, 1, 2048, 4096) is not None:
+            return False, ["[pallas-hbm] FAIL: an impossible shape did "
+                           "not degrade"]
+        ceiling = pallas_engine.hbm_ceiling_nodes(151, 1, 1)
+        if ceiling < 256 * 1024:
+            return False, [f"[pallas-hbm] FAIL: HBM ceiling {ceiling} < "
+                           "256k at K = 151"]
+        msgs.append(f"[pallas-hbm] residency select pinned at both tiers; "
+                    f"HBM ceiling {ceiling} nodes at K=151")
+
+        # (a) N = 8192, K = 151, above the old ceiling, interpreter mode
+        rng = np.random.default_rng(7)
+        nodes = [
+            NodeRow(
+                f"n{i:05d}", int(rng.choice([32000, 64000, 96000])),
+                131072, int(g),
+                ["2080", "T4", "V100M16"][i % 3] if g else "",
+            )
+            for i, g in enumerate(rng.choice([0, 2, 4, 8], 8192))
+        ]
+        kinds = rng.integers(0, 3, 151)
+        pods = [
+            PodRow(
+                f"p{i:04d}", 1000 + 100 * i, 2048,
+                (0 if kinds[i] == 0 else 1 if kinds[i] == 1
+                 else int(rng.choice([1, 2]))),
+                (0 if kinds[i] == 0
+                 else int(rng.choice([250, 500])) if kinds[i] == 1
+                 else 1000),
+            )
+            for i in range(151)
+        ]
+
+        def run(engine):
+            sim = Simulator(nodes, SimulatorConfig(
+                policies=(("FGDScore", 1000),),
+                gpu_sel_method="FGDScore", seed=42,
+                report_per_event=False, engine=engine,
+                typical_pods=TypicalPodsConfig(
+                    pod_popularity_threshold=95),
+            ))
+            sim.set_workload_pods(pods)
+            return sim, sim.run()
+
+        s_h, r_h = run("pallas")
+        if s_h._last_engine != "pallas (hbm)":
+            return False, msgs + [
+                f"[pallas-hbm] FAIL: N=8192 dispatched "
+                f"{s_h._last_engine!r}, not the HBM-residency kernel"]
+        if any("[Degrade]" in l for l in s_h.log.lines):
+            return False, msgs + [
+                "[pallas-hbm] FAIL: the N=8192 run printed [Degrade]"]
+        s_t, r_t = run("table")
+        if not np.array_equal(r_t.placed_node, r_h.placed_node) or \
+                not np.array_equal(r_t.dev_mask, r_h.dev_mask):
+            return False, msgs + [
+                "[pallas-hbm] FAIL: HBM-kernel placements diverge from "
+                "the blocked table engine"]
+        msgs.append("[pallas-hbm] N=8192 K=151 replay: pallas (hbm), no "
+                    "degrade, bit-identical to the table engine "
+                    f"({int((r_h.placed_node >= 0).sum())} placed)")
+
+        # (c) residency + exact DMA counters in the run record
+        det = s_h.run_telemetry().to_record()["deterministic"]
+        if det.get("pallas_residency") != "hbm":
+            return False, msgs + [
+                "[pallas-hbm] FAIL: run record lacks "
+                "pallas_residency=hbm"]
+        waits = det["counts"].get("pallas_dma_waits", 0)
+        starts = det["counts"].get("pallas_dma_starts", -1)
+        if waits <= 0 or waits != starts:
+            return False, msgs + [
+                f"[pallas-hbm] FAIL: DMA counters absent or leaking "
+                f"(waits={waits}, starts={starts})"]
+        msgs.append(f"[pallas-hbm] run record: residency=hbm, "
+                    f"dma_waits={waits} == dma_starts, "
+                    f"rebuilds={det['counts'].get('pallas_hbm_rebuilds')}")
+        return True, msgs
+    except Exception as err:  # the gate reports, never tracebacks
+        import traceback
+
+        return False, msgs + [
+            f"[pallas-hbm] FAIL: {type(err).__name__}: {err}",
+            traceback.format_exc(limit=3),
+        ]
+
+
 def policy_smoke(out_dir: str) -> Tuple[bool, List[str]]:
     """ISSUE 14 satellite (`make policy-smoke`): the learned-policy lane
     end-to-end on a tiny synthetic trace — (a) tiny-trace imitation
@@ -1726,6 +1843,14 @@ def main(argv=None) -> int:
         "breaker) — the `make fleet-wan-smoke` mode",
     )
     ap.add_argument(
+        "--pallas-hbm-only", action="store_true",
+        help="run only the HBM-residency pallas smoke (ISSUE 15: "
+        "N=8192/K=151 interpreter replay above the old VMEM ceiling "
+        "reconciled bit-exactly against the table engine, two-tier "
+        "residency auto-select pinned, DMA-wait counters in the run "
+        "record) — the `make pallas-hbm-smoke` mode",
+    )
+    ap.add_argument(
         "--policy-only", action="store_true",
         help="run only the learned-policy smoke (ISSUE 14: tiny-trace "
         "imitation round-trip, learned-vs-built-in engine bit-identity "
@@ -1734,6 +1859,13 @@ def main(argv=None) -> int:
         "served preset == local run) — the `make policy-smoke` mode",
     )
     args = ap.parse_args(argv)
+
+    if args.pallas_hbm_only:
+        os.makedirs(args.out, exist_ok=True)
+        ok, msgs = pallas_hbm_smoke(args.out)
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
 
     if args.policy_only:
         # force a 2-device virtual CPU mesh BEFORE jax initializes so
@@ -1861,6 +1993,11 @@ def main(argv=None) -> int:
     # bit-identity of a signed artifact, ES zero-recompile, preset
     pol_ok, pol_msgs = policy_smoke(args.out)
     print("\n".join(pol_msgs))
+    # HBM-residency pallas smoke (ISSUE 15): above-the-old-ceiling
+    # interpreter replay vs the table engine, residency select, DMA
+    # counters
+    hbm_ok, hbm_msgs = pallas_hbm_smoke(args.out)
+    print("\n".join(hbm_msgs))
     # mesh-chaos smoke (ISSUE 11 satellite): pipelined shard fault
     # replay + donated chunked replay — skips (PASS) on single-device
     # hosts; `make mesh-chaos-smoke` runs the forced-virtual-mesh form
@@ -1879,8 +2016,8 @@ def main(argv=None) -> int:
     mc_ok, mc_msgs = multichip_advisory(latest_multichip())
     print("\n".join(mc_msgs))
     smoke_ok = (dec_ok and scrape_ok and swp_ok and svc_ok and tune_ok
-                and chaos_ok and pol_ok and mesh_ok and fleet_ok
-                and wan_ok and mc_ok)
+                and chaos_ok and pol_ok and hbm_ok and mesh_ok
+                and fleet_ok and wan_ok and mc_ok)
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

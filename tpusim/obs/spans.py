@@ -106,6 +106,11 @@ class Recorder:
         self.engines: List[str] = []
         self.disruption: Dict[str, int] = {}
         self.table_cache = "off"  # off | miss | hit
+        # fused-Pallas table residency the last pallas dispatch ran
+        # under (ENGINES.md Round 19): off | vmem | hbm — set by the
+        # driver's residency select; lands in the run record's
+        # deterministic block beside table_cache
+        self.pallas_residency = "off"
         # persistent-compilation-cache note (ISSUE 6 satellite): set by
         # note_compile_cache after the run; None = never assessed
         self.compile_cache: Optional[dict] = None
@@ -170,6 +175,7 @@ class Recorder:
             engines=list(self.engines),
             events=self.scan_events,
             table_cache=self.table_cache,
+            pallas_residency=self.pallas_residency,
             meta=dict(meta or {}),
             compile_cache=(
                 dict(self.compile_cache) if self.compile_cache else None
@@ -218,6 +224,9 @@ class RunTelemetry:
     events: int
     table_cache: str
     meta: Dict[str, object]
+    # fused-Pallas residency tier of this run's pallas dispatches
+    # (off | vmem | hbm) — deterministic, like table_cache
+    pallas_residency: str = "off"
     # persistent-compilation-cache note (note_compile_cache): enabled /
     # dir / first-scan dispatch wall / probable_hit heuristic. None when
     # never assessed; machine-dependent, so it reports under `timing`.
@@ -243,6 +252,7 @@ class RunTelemetry:
                 "disruption": self.disruption,
                 "engines": self.engines,
                 "table_cache": self.table_cache,
+                "pallas_residency": self.pallas_residency,
                 "meta": self.meta,
             },
             "timing": {

@@ -94,6 +94,22 @@ class SimulatorConfig:
     # keeps the switch — the knob exists for accelerator backends and
     # A/B measurement (bench_scale --unswitched).
     unswitched_select: bool = False
+    # Fused-Pallas table residency (ENGINES.md Round 19): where the
+    # [K, N] score/sdev/feas tables live across the kernel's grid steps.
+    # "vmem" is the original all-resident layout (fastest, zero DMA,
+    # ceiling N <= 4096 at K = 151); "hbm" keeps the tables (and the
+    # mutable node state) HBM-resident and crosses only the event's
+    # active working set into VMEM by per-event double-buffered async
+    # DMA, with selectHost running over VMEM-resident block summaries —
+    # ceiling HBM-bounded (>= 256k at K = 151). "auto" (default) picks
+    # the first tier whose footprint fits the budget
+    # (pallas_engine.select_residency); only when NEITHER fits does the
+    # dispatch degrade to the blocked table engine — the [Degrade] path,
+    # narrowed from "any table set over ~14 MiB" to genuinely
+    # VMEM-impossible shapes. Placements are bit-identical across all
+    # three (the interpreter-mode oracle tests pin it); this is purely a
+    # capacity/throughput knob.
+    table_residency: str = "auto"
     # HTTP scheduler extenders (tpusim.sim.extender.ExtenderConfig tuple).
     # When set, every replay runs the host-loop extender engine — the only
     # execution mode that can splice per-cycle HTTP round-trips between
@@ -501,6 +517,12 @@ class Simulator:
                 f"unknown engine {self.cfg.engine!r}: expected auto | "
                 "sequential | table | pallas"
             )
+        if self.cfg.table_residency not in ("auto", "vmem", "hbm"):
+            raise ValueError(
+                f"unknown table_residency {self.cfg.table_residency!r}: "
+                "expected auto | vmem | hbm (the fused-Pallas table "
+                "placement, ENGINES.md Round 19)"
+            )
         from tpusim.sim import pallas_engine
 
         # report configs are no longer a pallas blocker: the engine replays
@@ -515,6 +537,9 @@ class Simulator:
                 "(see tpusim.sim.pallas_engine.supports)"
             )
         self._pallas_fn = None
+        # HBM-residency twin (ENGINES.md Round 19), built lazily on the
+        # first dispatch the residency select routes to it
+        self._pallas_hbm_fn = None
         self._extender_fn = None  # built lazily on first extender replay
         self._shard_fn = None
         if self.cfg.mesh:
@@ -856,40 +881,73 @@ class Simulator:
                                key):
         """Run the fused Pallas engine behind the degradation guards.
         Returns its ReplayResult, or None after a [Degrade] log line when
-        the replay must fall back to the (blocked) table engine: VMEM
-        overflow is predicted BEFORE dispatch (pallas_engine.fits_vmem —
-        the measured ceiling is N ≤ 4096 at K = 151), and a kernel that
-        dies mid-scan or returns out-of-range telemetry (the observable
-        shadow of NaN/inf contaminating its f32 score tables) is caught
-        AFTER. The table engine replays the identical schedule, so
+        the replay must fall back to the (blocked) table engine.
+
+        Residency is two-tier (ENGINES.md Round 19): tier 1 is the
+        all-VMEM-resident kernel (pallas_engine.fits_vmem — the measured
+        ceiling N ≤ 4096 at K = 151), tier 2 the HBM-resident-table
+        kernel whose VMEM working set is O(K·B + row scratch)
+        (fits_hbm — HBM-bounded, ≥ 256k nodes at K = 151).
+        cfg.table_residency forces a tier or lets select_residency pick;
+        only when the chosen tier's footprint cannot fit does the
+        dispatch degrade — the [Degrade] path is narrowed to genuinely
+        VMEM-impossible shapes. A kernel that dies mid-scan or returns
+        out-of-range telemetry (the observable shadow of NaN/inf
+        contaminating its f32 score tables) is still caught AFTER
+        dispatch. The table engine replays the identical schedule, so
         degradation changes throughput, never results."""
         from tpusim.sim import pallas_engine
 
         n = state.num_nodes
         k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
-        if not pallas_engine.fits_vmem(
-            n, k, len(self._policy_fns), int(specs.cpu.shape[0]),
-            int(ev_kind.shape[0]),
-        ):
+        num_pol = len(self._policy_fns)
+        p = int(specs.cpu.shape[0])
+        e = int(ev_kind.shape[0])
+        n_norm = pallas_engine.num_normalized(self._policy_fns)
+        res = self.cfg.table_residency
+        if res == "auto":
+            res = pallas_engine.select_residency(n, k, num_pol, p, e, n_norm)
+        elif res == "vmem" and not pallas_engine.fits_vmem(
+                n, k, num_pol, p, e):
+            res = None
+        elif res == "hbm" and not pallas_engine.fits_hbm(
+                n, k, num_pol, p, e, n_norm):
+            res = None
+        if res is None:
             # every [Degrade] channel also lands in an obs counter so a
             # degraded run is machine-detectable from the JSONL record,
             # not just greppable from stdout prose
             self.obs.count("degrade_vmem")
             self.log.info(
                 f"[Degrade] fused pallas kernel would overflow VMEM at "
-                f"N={n}, K={k} (ENGINES.md spill list): falling back to "
-                "the blocked table engine"
+                f"N={n}, K={k} under table_residency="
+                f"{self.cfg.table_residency!r} (neither the VMEM- nor "
+                "the HBM-residency tier fits the budget): falling back "
+                "to the blocked table engine"
             )
             return None
-        self._last_engine = "pallas"
+        if res == "hbm" and self._pallas_hbm_fn is None:
+            self._pallas_hbm_fn = pallas_engine.make_pallas_replay(
+                self._policy_fns, gpu_sel=self.cfg.gpu_sel_method,
+                interpret=jax.default_backend() != "tpu",
+                residency="hbm",
+            )
+        fn = self._pallas_fn if res == "vmem" else self._pallas_hbm_fn
+        self._last_engine = "pallas" if res == "vmem" else "pallas (hbm)"
+        dma_stats = None
         try:
             out = self._dispatch_span(
-                lambda: self._pallas_fn(
+                lambda: fn(
                     state, specs, types, ev_kind, ev_pod, self.typical,
                     key, self.rank,
                 ),
-                engine="pallas", events=int(ev_kind.shape[0]),
+                engine=self._last_engine, events=e,
             )
+            if res == "hbm":
+                # the kernel's exact in-kernel DMA counters (semaphore
+                # waits, DMA starts, extrema-drift summary rebuilds) —
+                # surfaced in the obs run record below
+                out, dma_stats = out
             bad = self._pallas_result_suspect(out, n)
         except (AttributeError, NameError, ImportError):
             # definite programming errors in the pallas path — degradation
@@ -911,6 +969,16 @@ class Simulator:
                 "to the blocked table engine"
             )
             return None
+        # the residency note/counters land only on a COMPLETED pallas
+        # replay — a mid-scan death or corrupt-telemetry degrade ran the
+        # blocked table engine, and the run record must say so
+        self.obs.pallas_residency = res
+        self.obs.count(f"pallas_residency_{res}")
+        if dma_stats is not None:
+            waits, starts, rebuilds = (int(v) for v in np.asarray(dma_stats))
+            self.obs.count("pallas_dma_waits", waits)
+            self.obs.count("pallas_dma_starts", starts)
+            self.obs.count("pallas_hbm_rebuilds", rebuilds)
         return out
 
     def _pallas_result_suspect(self, out, num_nodes: int):
@@ -3526,18 +3594,31 @@ _SWEEP_MULTI_FAULT_WRAP_CACHE = {}
 _SWEEP_MULTI_METRICS_FN = None
 
 
-def _sweep_engine_multi(engine, table: bool, donate: bool = True):
+def _sweep_engine_multi(engine, table: bool, donate: bool = True,
+                        donate_streams: bool = False):
     """jit(vmap(engine)) over per-lane (specs, type_id, events, key,
     weights, rank); cluster state, distinct type set, typical pods, and
     the shared score tables broadcast (in_axes None). The trace-operand
     generalization of _sweep_engine: lanes may replay different tuned
     workloads and still share one compiled scan. donate=True donates
-    the per-lane rank like _sweep_engine — per-lane specs/events are
-    NOT donated (the metrics postpass reads them after dispatch)."""
+    the per-lane rank like _sweep_engine.
+
+    donate_streams=True additionally donates the per-lane ev_pod stream
+    (ISSUE 15 satellite — the PR 11 run_chunk_donated pattern finishing
+    the ROADMAP's "sweep/service lane carries reallocate per wave"
+    leftover): the [B, E] i32 buffer's shape/dtype matches the
+    event_node output leaf exactly, so a repeated-wave caller (the svc
+    worker's batch loop) reuses it instead of reallocating per wave.
+    Only legal when nothing reads the stream after dispatch — the
+    metrics postpass does, so schedule_pods_sweep_multi passes it as
+    `not report_per_event`. The (engine, donate, donate_streams) cache
+    key keeps the zero-recompile bookkeeping intact: consecutive waves
+    of one family resolve to the same jitted wrapper, donation being
+    part of the executable's aliasing contract, not its jaxpr."""
     from tpusim.sim.table_engine import PodTypes
     from tpusim.types import PodSpec
 
-    ck = (engine, bool(donate))
+    ck = (engine, bool(donate), bool(donate_streams))
     if ck not in _SWEEP_MULTI_WRAP_CACHE:
         spec0 = PodSpec(0, 0, 0, 0, 0, 0)
         none_spec = PodSpec(*(None,) * 6)
@@ -3546,11 +3627,11 @@ def _sweep_engine_multi(engine, table: bool, donate: bool = True):
             #  tables) — type_id is per-lane, the distinct set broadcasts
             in_axes = (None, spec0, PodTypes(none_spec, none_spec, 0),
                        0, 0, None, 0, 0, 0, None)
-            dn = (8,)
+            dn = (8,) + ((4,) if donate_streams else ())
         else:
             # (state, pods, ev_kind, ev_pod, tp, key, wts, rank)
             in_axes = (None, spec0, 0, 0, None, 0, 0, 0)
-            dn = (7,)
+            dn = (7,) + ((3,) if donate_streams else ())
         _SWEEP_MULTI_WRAP_CACHE[ck] = jax.jit(
             jax.vmap(engine, in_axes=in_axes),
             donate_argnums=dn if donate else (),
@@ -3558,19 +3639,23 @@ def _sweep_engine_multi(engine, table: bool, donate: bool = True):
     return _SWEEP_MULTI_WRAP_CACHE[ck]
 
 
-def _sweep_multi_fault_engine(engine, table: bool, donate: bool = True):
+def _sweep_multi_fault_engine(engine, table: bool, donate: bool = True,
+                              donate_streams: bool = True):
     """The chaos x tune lift (ISSUE 12): jit(vmap(engine)) over per-lane
     (specs, type_id, MERGED fault streams, key, weights, rank, fault
     ops) — the union of _sweep_engine_multi's per-lane trace operands
     and _sweep_fault_engine's per-lane fault operands. Cluster state,
     the distinct type set, typical pods, the shared tables, and the
     initial fault carry broadcast, so mixed fault/tune/weight jobs share
-    ONE compiled scan."""
+    ONE compiled scan. donate_streams donates the per-lane merged pod
+    stream like _sweep_engine_multi — default ON here because the chaos
+    tail computes no metrics postpass and never re-reads it (the
+    disruption assembly reads out.fault_ys, not the operands)."""
     from tpusim.sim.fault_lane import FaultOps
     from tpusim.sim.table_engine import PodTypes
     from tpusim.types import PodSpec
 
-    ck = (engine, bool(donate))
+    ck = (engine, bool(donate), bool(donate_streams))
     if ck not in _SWEEP_MULTI_FAULT_WRAP_CACHE:
         spec0 = PodSpec(0, 0, 0, 0, 0, 0)
         none_spec = PodSpec(*(None,) * 6)
@@ -3580,12 +3665,12 @@ def _sweep_multi_fault_engine(engine, table: bool, donate: bool = True):
             #  fault_ops, fault_carry0)
             in_axes = (None, spec0, PodTypes(none_spec, none_spec, 0),
                        0, 0, None, 0, 0, 0, None, fops_axes, None)
-            dn = (8,)
+            dn = (8,) + ((4,) if donate_streams else ())
         else:
             # (state, pods, evk, evp, tp, key, wts, rank, fault_ops,
             #  fault_carry0)
             in_axes = (None, spec0, 0, 0, None, 0, 0, 0, fops_axes, None)
-            dn = (7,)
+            dn = (7,) + ((3,) if donate_streams else ())
         _SWEEP_MULTI_FAULT_WRAP_CACHE[ck] = jax.jit(
             jax.vmap(engine, in_axes=in_axes),
             donate_argnums=dn if donate else (),
@@ -3790,7 +3875,11 @@ def schedule_pods_sweep_multi(
                     state, types, sim.typical, key0
                 )
                 h.dispatched()
-        fn = _sweep_engine_multi(table_fn.engine.replay, table=True)
+        fn = _sweep_engine_multi(
+            table_fn.engine.replay, table=True,
+            donate_streams=not cfg.report_per_event,
+        )
+        sim._last_sweep_fn = fn  # executables() tracking (svc worker)
         sim._last_engine = f"table ({b}-trace vmap sweep)"
         out = sim._dispatch_span(
             lambda: fn(
@@ -3800,7 +3889,11 @@ def schedule_pods_sweep_multi(
             engine=sim._last_engine, events=true_events,
         )
     else:
-        fn = _sweep_engine_multi(sim.replay_fn.engine, table=False)
+        fn = _sweep_engine_multi(
+            sim.replay_fn.engine, table=False,
+            donate_streams=not cfg.report_per_event,
+        )
+        sim._last_sweep_fn = fn  # executables() tracking (svc worker)
         sim._last_engine = f"sequential ({b}-trace vmap sweep)"
         out = sim._dispatch_span(
             lambda: fn(

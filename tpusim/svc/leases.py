@@ -51,32 +51,15 @@ LEASE_SCHEMA = "tpusim-svc-lease/1"
 DEFAULT_LEASE_S = 15.0
 
 
-def _float_env(name: str, default: float, minimum: float = 0.0) -> float:
-    """Read one float env knob, failing LOUDLY on an unparseable or
-    out-of-range value (ISSUE 13 satellite): a typo'd
-    TPUSIM_LEASE_SKEW_S used to fall back silently — a mis-set margin
-    can make every lease either immortal or instantly stealable across
-    a whole fleet, and the operator deserves to hear about it at the
-    first read, with the variable named, not as a bare ValueError deep
-    in the expiry path (or worse, not at all)."""
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        val = float(raw)
-    except ValueError:
-        raise ValueError(
-            f"{name}={raw!r} is not a valid number (want seconds as a "
-            f"float, e.g. {name}={default}); unset it to use the "
-            f"default {default}"
-        )
-    if val != val or val in (float("inf"), float("-inf")) \
-            or val < minimum:
-        raise ValueError(
-            f"{name}={raw!r} must be a finite number >= {minimum} "
-            f"(got {val}); unset it to use the default {default}"
-        )
-    return val
+# Fail-loud env parsing (ISSUE 13 satellite): a typo'd
+# TPUSIM_LEASE_SKEW_S used to fall back silently — a mis-set margin
+# can make every lease either immortal or instantly stealable across
+# a whole fleet, and the operator deserves to hear about it at the
+# first read, with the variable named. The helper moved to
+# tpusim.envutil (ISSUE 15 satellite) so the Pallas VMEM budget and
+# future knobs share ONE validation path; the local alias keeps the
+# svc-side call sites and tests stable.
+from tpusim.envutil import float_env as _float_env
 
 
 def lease_skew_s() -> float:

@@ -409,10 +409,7 @@ class Worker:
         fault/tune/weight jobs of one family therefore share one
         compiled scan (the family key no longer pins a tune factor for
         fault jobs)."""
-        from tpusim.sim.driver import (
-            _sweep_engine_multi,
-            schedule_pods_sweep_multi,
-        )
+        from tpusim.sim.driver import schedule_pods_sweep_multi
 
         sim = self._sim_for(batch[0])
         key = batch[0].spec.family_key()
@@ -478,16 +475,12 @@ class Worker:
         )[:n]
         # track the jitted sweep wrapper actually dispatched so /queue
         # can report the compiled-executable count (the PR 6
-        # jit._cache_size() zero-recompile check, now a live metric)
-        if faulted:
-            self._sweep_fns.add(sim._last_sweep_fn)
-        else:
-            used_table = sim._last_engine.startswith("table")
-            self._sweep_fns.add(_sweep_engine_multi(
-                sim._table_fn.engine.replay if used_table
-                else sim.replay_fn.engine,
-                table=used_table,
-            ))
+        # jit._cache_size() zero-recompile check, now a live metric).
+        # Both paths record the wrapper on the sim (the fault tail
+        # always did; the plain path joined it when donate_streams made
+        # the wrapper choice depend on the report flag, ISSUE 15) — so
+        # the count follows the wrapper ACTUALLY dispatched
+        self._sweep_fns.add(sim._last_sweep_fn)
         return lanes
 
     # ---- introspection ----
