@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke tune-smoke policy-smoke pallas-hbm-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke serve-latency-smoke tune-smoke policy-smoke pallas-hbm-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_transfer.py tests/test_supervisor.py tests/test_policy_learned.py tests/test_blocked_engine.py tests/test_pallas_hbm.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_fork.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_svc_fork.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_transfer.py tests/test_supervisor.py tests/test_policy_learned.py tests/test_blocked_engine.py tests/test_pallas_hbm.py tests/test_table_engine.py tests/test_parallel.py tests/test_pallas_engine.py tests/test_batch.py tests/test_kube_client.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -84,6 +84,18 @@ serve-smoke: profile-smoke
 # wave adding ZERO executables (jit._cache_size() stable).
 svc-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --svc-only
+
+# interactive what-if serving smoke (ENGINES.md "Round 20"): the
+# warm-state fork plane over real HTTP — a base job leaves its
+# checkpoint ladder + fork-index entry, then a wave of warm forks and
+# their from-event-0 "full" twins (more jobs than lanes: late arrivals
+# JOIN the running wave at chunk boundaries). Hard checks: every fork
+# bit-identical to its twin, every fork executed <= tail + one chunk
+# events, wave executables UNCHANGED across the join wave
+# (jit._cache_size() live), and the warm forks' admission->result p99
+# under the hard SLO AND >= 3x faster than the full-replay p99.
+serve-latency-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --serve-latency-only
 
 # learned-scoring smoke (ENGINES.md "Round 13"): run `tpusim tune`'s
 # loop on a tiny synthetic trace for 3 generations on the local backend
@@ -176,7 +188,10 @@ fleet-wan-smoke:
 # record parses and is byte-equal to the emitted textfile (ISSUE 5),
 # the one-compile sweep contract (ISSUE 6), the replay-service POST
 # path — dedup + zero recompiles (ISSUE 7, the svc-smoke check) — and
-# the learned-scoring loop (ISSUE 9, the tune-smoke check: one
+# the interactive what-if serving plane (ISSUE 16, the
+# serve-latency-smoke check: warm forks bit-identical to from-0 twins,
+# boundary joins with zero recompiles, hard admission->result p99
+# SLO), and the learned-scoring loop (ISSUE 9, the tune-smoke check: one
 # executable across generations, signed resumable log), and the chaos
 # sweep (ISSUE 10, the chaos-smoke check: fault schedules as operands —
 # zero recompiles across waves, lane-vs-standalone disruption

@@ -81,6 +81,9 @@ def _assert_same_run(ra, dma, rb, dmb, frag_tol=0.0):
 # ---- the acceptance pin: scan == segmented host loop ----
 
 
+@pytest.mark.slow  # pays BOTH the segmented and the in-scan fault
+# compiles (~14s); resume-smoke runs it, tier-1 keeps the cheaper
+# engine-invariance pin (ISSUE 16 budget buy-back)
 def test_scan_equals_segmented_mixed_schedule():
     """run_with_faults (now the in-scan lane) is bit-identical to the
     PR 2 segmented path under one seed: an MTBF schedule with fails,
@@ -135,6 +138,8 @@ def test_fault_lane_engine_invariant_blocked():
     ))
 
 
+@pytest.mark.slow  # a third fault-engine compile (shard_map mesh);
+# resume-smoke runs it (ISSUE 16 budget buy-back)
 def test_fault_lane_shard_engine():
     """The shard_map fault lane: owner-masked row resets/requeues under
     a 2-device mesh match the segmented path (frag-delta list excepted —
@@ -168,6 +173,8 @@ def test_fault_lane_shard_engine():
 # ---- retry-queue carry semantics ----
 
 
+@pytest.mark.slow  # compiles per-cut chunk variants on top of the
+# unsplit scan (~16s); resume-smoke runs it (ISSUE 16 budget buy-back)
 def test_retry_carry_kill_resume_continuity():
     """Splitting the merged stream across run_chunk calls (the
     checkpoint surface) is bit-identical to one unsplit scan — the

@@ -147,6 +147,7 @@ def test_run_events_rejects_fault_kinds_and_bad_indices():
 # ---- end-to-end fault replay ----
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_nodefail_retry_reschedules_on_different_node():
     """The acceptance scenario: a pod placed on host-a loses its node,
     waits out its backoff in the retry queue while the trace continues,
@@ -320,6 +321,7 @@ def test_fault_replay_composes_with_checkpointing(tmp_path):
     assert sim_a.last_disruption.as_dict() == sim_b.last_disruption.as_dict()
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_retry_budget_resets_on_successful_reschedule():
     """max_retries bounds CONSECUTIVE failures: a pod evicted more than
     max_retries separate times, rescheduling successfully in between, must

@@ -1,0 +1,254 @@
+"""Warm-state forking of chunked replays (ISSUE 16 tentpole).
+
+The contract under test: `schedule_pods_fork` replays the spliced stream
+`base[:fork_event] + tail` resumed from the base run's persisted
+mid-trace checkpoint ladder, bit-identical to the same stream replayed
+from event 0 — table and shard engines alike. Around it: the
+nearest-at-or-before walk-back rule, the loud degrade on a missing
+source, the weight-change digest rejection (the carry embeds the weight
+vector), the `checkpoint_keep` retention knob, and the EV_SKIP trailing
+-pad inertness the serving wave's lane geometry leans on.
+`make resume-smoke` runs this file as part of the fast CI gate.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.fixtures import random_cluster, random_pods
+from tpusim.io.trace import NodeRow, PodRow, build_events
+from tpusim.policies import make_policy
+from tpusim.sim.driver import Simulator, SimulatorConfig
+from tpusim.sim.engine import EV_CREATE, EV_DELETE, EV_SKIP
+from tpusim.sim.table_engine import build_pod_types, make_table_replay
+
+
+def _driver_inputs():
+    rng = np.random.default_rng(11)
+    nodes = [
+        NodeRow(f"n{i}", 32000, 131072, int(g), "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], 10))
+    ]
+    pods = [
+        PodRow(f"p{i}", int(rng.choice([1000, 4000])), 1024,
+               int(rng.choice([0, 1])), 500)
+        for i in range(24)
+    ]
+    return nodes, pods
+
+
+def _sim(nodes, ckdir, every=4, keep=-1, mesh=0, weight=1000, seed=42):
+    return Simulator(nodes, SimulatorConfig(
+        policies=(("FGDScore", weight),), gpu_sel_method="FGDScore",
+        checkpoint_every=every, checkpoint_keep=keep,
+        checkpoint_dir=str(ckdir), mesh=mesh, seed=seed,
+    ))
+
+
+# a divergent tail over the base workload's pod vocabulary: kill two
+# placed pods, re-create one of them
+_TAIL_KIND = [EV_DELETE, EV_DELETE, EV_CREATE]
+_TAIL_POD = [0, 3, 0]
+
+
+def _assert_equal(r0, r1):
+    assert np.array_equal(np.asarray(r0.placed_node),
+                          np.asarray(r1.placed_node))
+    assert np.array_equal(np.asarray(r0.dev_mask), np.asarray(r1.dev_mask))
+    assert np.array_equal(np.asarray(r0.creation_rank),
+                          np.asarray(r1.creation_rank))
+    for a, b in zip(jax.tree.leaves(r0.state), jax.tree.leaves(r1.state)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def _base_and_fork(nodes, pods, warm_dir, cold_dir, fev, mesh=0,
+                   every=4):
+    """Run the base (persisting its ladder under warm_dir), then the
+    same fork twice: warm (fresh Simulator over the ladder) and cold
+    (fresh Simulator over an empty dir — the from-event-0 reference)."""
+    base = _sim(nodes, warm_dir, every=every, mesh=mesh)
+    base.schedule_pods(pods)
+    warm = _sim(nodes, warm_dir, every=every, mesh=mesh)
+    rw = warm.schedule_pods_fork(pods, fev, _TAIL_KIND, _TAIL_POD)
+    cold = _sim(nodes, cold_dir, every=every, mesh=mesh)
+    rc = cold.schedule_pods_fork(pods, fev, _TAIL_KIND, _TAIL_POD)
+    return warm, rw, cold, rc
+
+
+def test_fork_warm_equals_cold_table(tmp_path):
+    """The headline: a warm fork (resumed mid-trace from the base
+    ladder) is bit-identical to the from-event-0 replay of the spliced
+    stream — and actually warm (source_cursor > 0, device executed only
+    the divergent tail plus at most one chunk of shared prefix)."""
+    nodes, pods = _driver_inputs()
+    e = len(build_events(pods, False)[0])
+    fev = e - 2
+    warm, rw, cold, rc = _base_and_fork(
+        nodes, pods, tmp_path / "a", tmp_path / "b", fev
+    )
+    _assert_equal(rw, rc)
+
+    assert warm.last_fork["degrade"] is False
+    assert warm.last_fork["source_cursor"] > 0
+    # the latency win the serving plane measures: tail + walk-back
+    assert warm.last_fork["events_executed"] <= len(_TAIL_KIND) + 4
+    assert warm.last_fork["events_total"] == fev + len(_TAIL_KIND)
+    # the cold twin degraded LOUDLY (no source in an empty dir)
+    assert cold.last_fork["degrade"] is True
+    assert cold.last_fork["source_cursor"] == 0
+    assert any("[Degrade]" in l and "fork source" in l
+               for l in cold.log.lines)
+
+
+def test_fork_boundary_and_midchunk_walkback(tmp_path):
+    """The nearest-at-or-before rule: forking exactly ON a checkpoint
+    rung resumes at that rung; forking mid-chunk walks BACK to the rung
+    below (never forward — a newer carry has consumed post-divergence
+    events), and both replays stay exact."""
+    nodes, pods = _driver_inputs()
+    base = _sim(nodes, tmp_path / "a", every=4)
+    base.schedule_pods(pods)
+
+    at_rung = _sim(nodes, tmp_path / "a", every=4)
+    r1 = at_rung.schedule_pods_fork(pods, 8, _TAIL_KIND, _TAIL_POD)
+    assert at_rung.last_fork["source_cursor"] == 8
+
+    mid = _sim(nodes, tmp_path / "a", every=4)
+    r2 = mid.schedule_pods_fork(pods, 10, _TAIL_KIND, _TAIL_POD)
+    assert mid.last_fork["source_cursor"] == 8  # walked back, not up
+
+    cold1 = _sim(nodes, tmp_path / "b", every=4)
+    _assert_equal(r1, cold1.schedule_pods_fork(
+        pods, 8, _TAIL_KIND, _TAIL_POD
+    ))
+    cold2 = _sim(nodes, tmp_path / "c", every=4)
+    _assert_equal(r2, cold2.schedule_pods_fork(
+        pods, 10, _TAIL_KIND, _TAIL_POD
+    ))
+
+
+def test_weight_change_fork_finds_no_source(tmp_path):
+    """The carry embeds the weight vector (blocked summaries), so a
+    weight-changing fork can NEVER match a base checkpoint: the run
+    digest differs, the lookup misses, and the run degrades loudly to a
+    (correct, cold) full replay under ITS weights — the driver-level
+    fact behind the svc layer's 400 rejection."""
+    nodes, pods = _driver_inputs()
+    base = _sim(nodes, tmp_path / "a", weight=1000)
+    base.schedule_pods(pods)
+
+    other = _sim(nodes, tmp_path / "a", weight=500)
+    ro = other.schedule_pods_fork(pods, 8, _TAIL_KIND, _TAIL_POD)
+    assert other.last_fork["degrade"] is True
+    assert other.last_fork["source_cursor"] == 0
+    cold = _sim(nodes, tmp_path / "b", weight=500)
+    _assert_equal(ro, cold.schedule_pods_fork(
+        pods, 8, _TAIL_KIND, _TAIL_POD
+    ))
+
+
+def test_checkpoint_keep_retention(tmp_path):
+    """SimulatorConfig.checkpoint_keep: 0 prunes the ladder on
+    completion (the historical resume-only behavior), -1 keeps every
+    rung (the fork-source mode), N > 0 keeps the newest N."""
+    from tpusim.io.storage import iter_checkpoints
+    from tpusim.sim.driver import _bucket_sizes
+
+    nodes, pods = _driver_inputs()
+    e = len(build_events(pods, False)[0])
+    # the chunked path runs the BUCKET-padded stream (pow2 adaptation
+    # for small runs); saves land at every, 2*every, ... < e2
+    _, e2 = _bucket_sizes(len(pods), e, 512)
+    rungs = (e2 - 1) // 4
+
+    def _ladder(keep, d):
+        sim = _sim(nodes, d, every=4, keep=keep)
+        sim.schedule_pods(pods)
+        return iter_checkpoints(str(d), sim.last_run_digest)
+
+    assert _ladder(0, tmp_path / "k0") == []
+    full = _ladder(-1, tmp_path / "kall")
+    assert len(full) == rungs
+    assert [c for c, _ in full] == sorted(
+        (c for c, _ in full), reverse=True
+    )
+    assert len(_ladder(2, tmp_path / "k2")) == 2
+
+
+@pytest.mark.slow
+def test_fork_shard_engine(tmp_path):
+    """Warm-vs-cold bit-identity on the shard engine (mesh=4): the
+    gather-to-host checkpoint snapshot round-trips through the fork
+    path exactly like the single-device carry — and agrees with the
+    table engine's fork result."""
+    nodes, pods = _driver_inputs()
+    e = len(build_events(pods, False)[0])
+    fev = e - 3
+    warm, rw, cold, rc = _base_and_fork(
+        nodes, pods, tmp_path / "a", tmp_path / "b", fev, mesh=4
+    )
+    _assert_equal(rw, rc)
+    assert warm.last_fork["degrade"] is False
+    assert warm.last_fork["source_cursor"] > 0
+
+    tbl = _sim(nodes, tmp_path / "c")
+    _assert_equal(rw, tbl.schedule_pods_fork(
+        pods, fev, _TAIL_KIND, _TAIL_POD
+    ))
+
+
+def test_fork_ev_kinds_pin():
+    """The svc fork-tail vocabulary is the engine's event vocabulary:
+    a tail entry's kind field IS EV_CREATE/EV_DELETE. If the engine
+    constants ever move, the wire format must be versioned, not
+    silently re-pointed."""
+    from tpusim.svc.jobs import FORK_EV_KINDS
+
+    assert FORK_EV_KINDS == (EV_CREATE, EV_DELETE)
+
+
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
+def test_trailing_skip_pad_inertness():
+    """The wave-lane geometry contract (sim.driver.ChunkWave): the scan
+    body splits the PRNG key BEFORE branching on kind, so trailing
+    EV_SKIP padding advances only the key and the skip counter — state,
+    placements, masks, failures are byte-identical with and without the
+    pad, and the counters differ ONLY in the skip slot by exactly the
+    pad count."""
+    from tpusim.obs.counters import COUNTER_FIELDS
+
+    rng = np.random.default_rng(7)
+    state, tp = random_cluster(rng, num_nodes=16)
+    pods = random_pods(rng, num_pods=20)
+    ev_kind = jnp.zeros(20, jnp.int32)
+    ev_pod = jnp.arange(20, dtype=jnp.int32)
+    key = jax.random.PRNGKey(3)
+    rank = jnp.asarray(rng.permutation(16).astype(np.int32))
+    types = build_pod_types(pods)
+    fn = make_table_replay([(make_policy("FGDScore"), 1000)],
+                           gpu_sel="FGDScore")
+
+    def _run(pad):
+        ek = jnp.concatenate(
+            [ev_kind, jnp.full(pad, EV_SKIP, ev_kind.dtype)]
+        )
+        ep = jnp.concatenate([ev_pod, jnp.zeros(pad, ev_pod.dtype)])
+        carry = fn.init_carry(state, pods, types, tp, key, rank)
+        carry, _ = fn.run_chunk(carry, pods, types, ek, ep, tp, rank)
+        st, placed, masks, failed = fn.finish(carry)
+        return st, placed, masks, failed, np.asarray(carry.ctr)
+
+    s0, p0, m0, f0, c0 = _run(0)
+    s1, p1, m1, f1, c1 = _run(6)
+    assert np.array_equal(np.asarray(p0), np.asarray(p1))
+    assert np.array_equal(np.asarray(m0), np.asarray(m1))
+    assert np.array_equal(np.asarray(f0), np.asarray(f1))
+    for a, b in zip(jax.tree.leaves(s0), jax.tree.leaves(s1)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    skip_i = COUNTER_FIELDS.index("skips")
+    diff = c1 - c0
+    assert diff[skip_i] == 6
+    assert not np.any(np.delete(diff, skip_i))

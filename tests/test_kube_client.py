@@ -230,6 +230,7 @@ def test_is_kubeconfig_file(tmp_path, api_server):
     assert not is_kubeconfig_file(str(dump))
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_is_kubeconfig_file_large_files(tmp_path, api_server):
     """Size alone must not route a file: a multi-MB multi-cluster
     kubeconfig still goes to the client path, while a multi-MB dump skips

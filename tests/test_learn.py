@@ -231,6 +231,7 @@ CFG = dict(algo="es", generations=3, popsize=4, sigma=300.0, lr=400.0,
            seed=9)
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_local_tune_log_resume_and_zero_recompile(synth, tmp_path):
     """One small tuning run pins four contracts at once (one compile
     family — the tier-1 budget): (a) the signed log round-trips with

@@ -130,6 +130,7 @@ def _run_driver(nodes, pods, every=0, ckdir="", seed=42, profile=False,
     return sim, out
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_counters_survive_kill_resume(tmp_path):
     """Telemetry continuity across checkpoint kill/resume: the counters
     ride the carry, so a resumed run's final vector is bit-identical to
@@ -328,6 +329,46 @@ def test_heartbeat_ticks_from_scan():
     assert np.array_equal(
         np.asarray(ref.placed_node), np.asarray(hb.placed_node)
     )
+
+
+def test_heartbeat_tail_relative_resume():
+    """The honest-progress satellite (ISSUE 16): a scan resumed from a
+    checkpoint (or a fork restored from a base carry) reports rate and
+    ETA over the events THIS process actually executed — note_resume's
+    done0 never counts toward ev/s, and the fault path's `base` offset
+    shifts the run-level done counter without inflating the rate."""
+    from tpusim.obs import heartbeat
+
+    infos = []
+    heartbeat.add_listener(infos.append)
+    try:
+        heartbeat.configure(100, "test", sink=lambda _line: None)
+        heartbeat.note_resume(90)
+        t0 = heartbeat._STATE["t0"]
+        heartbeat._STATE["t0"] = t0 - 2.0  # a deterministic 2s clock
+        heartbeat.tick(95)
+        info = infos[-1]
+        assert info["done"] == 95 and info["total"] == 100
+        # 5 fresh events over ~2s — never 95/2
+        assert 2.0 <= info["rate"] <= 3.0
+        assert info["eta"] == pytest.approx(5 / info["rate"], rel=0.05)
+
+        # the fault-segment offset: device counts restart at 0, the
+        # run-level done is base + raw, the rate is still fresh-only
+        heartbeat.configure(100, "test", sink=lambda _line: None,
+                            base=40)
+        heartbeat._STATE["t0"] -= 2.0
+        heartbeat.tick(10)
+        info = infos[-1]
+        assert info["done"] == 50 and 4.0 <= info["rate"] <= 6.0
+
+        # complete() disarms with the same fresh-only mean
+        heartbeat.complete()
+        assert infos[-1]["final"] is True
+        heartbeat.complete()  # second call is a no-op
+    finally:
+        heartbeat.remove_listener(infos.append)
+        heartbeat._STATE["total"] = 0
 
 
 def test_gate_parse_and_compare(tmp_path):

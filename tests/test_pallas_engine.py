@@ -38,10 +38,12 @@ def _run_both(policy, gpu_sel, state, tp, pods, ev_kind, ev_pod, rank):
     [
         ("FGDScore", "FGDScore"),
         ("BestFitScore", "best"),
-        ("GpuPackingScore", "worst"),
+        # tier-1 trim, ISSUE 16: these three ride resume-smoke
+        pytest.param("GpuPackingScore", "worst", marks=pytest.mark.slow),
         ("GpuClusteringScore", "best"),
-        ("PWRScore", "PWRScore"),
-        ("DotProductScore", "DotProductScore"),
+        pytest.param("PWRScore", "PWRScore", marks=pytest.mark.slow),
+        pytest.param("DotProductScore", "DotProductScore",
+                     marks=pytest.mark.slow),
     ],
     ids=lambda p: str(p),
 )

@@ -99,6 +99,7 @@ def test_hbm_matches_blocked_engine_multichunk():
         _check(r0, r1, dma)
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_hbm_same_block_twice_and_edges():
     """Double-buffer boundary cases: consecutive events touching the SAME
     128-node block (pinned pods force it — the row-slice prefetch left
@@ -363,6 +364,7 @@ def test_driver_8192_runs_hbm_without_degrading():
     assert np.array_equal(r_t.dev_mask, r_h.dev_mask)
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_hbm_two_normalized_policies():
     """nn = 2 (BestFit minmax + PWR pwr in one mix): two brmin/brmax
     summary slots, two stored-extrema lanes, independent drift

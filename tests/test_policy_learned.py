@@ -203,6 +203,7 @@ def test_learned_four_engine_bit_identity(synth):
         )
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_learned_kill_resume_bit_identity(synth, tmp_path):
     """A checkpointed learned replay cut mid-trace resumes
     bit-identically (the carry embeds the feature tables + theta via
@@ -227,6 +228,7 @@ def test_learned_kill_resume_bit_identity(synth, tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_explain_per_feature_attribution(synth, tmp_path):
     """`tpusim explain` renders per-FEATURE contribution rows whose
     weighted sum format_explain checks against the recorded selectHost
@@ -447,6 +449,7 @@ def test_learned_sweep_lane_vs_standalone(synth):
     assert not np.array_equal(lanes[0].placed_node, lanes[2].placed_node)
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_policy_preset_answers_like_local(synth, tmp_path):
     """`serve --policy-preset` end-to-end (in-process): a submit job
     referencing the preset replays byte-identically to the artifact run

@@ -350,6 +350,7 @@ def test_batch_formation_and_queue_full():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_post_path_lane_vs_standalone(trace, tmp_path):
     """The marquee contract: results served through the POST path are
     bit-identical to standalone baked-config runs — across weight,

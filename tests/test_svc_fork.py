@@ -1,0 +1,288 @@
+"""The interactive what-if serving plane (tpusim.svc; ISSUE 16).
+
+Pins the serving-side fork contracts around tests/test_fork.py's
+driver-level bit-identity:
+
+  1. the job vocabulary: {"base": true} and {"fork": {...}} specs,
+     their validation errors, and the family keys that put a fork and
+     its from-event-0 "full" twin on ONE wave while keeping base and
+     plain jobs apart;
+  2. the fork index: signed base entries round-trip, torn/foreign
+     entries read as missing (and are deleted), nearest-checkpoint
+     walk-back is a pure directory listing;
+  3. the latency plane: claim_family's targeted non-blocking claim,
+     per-kind admission->result percentiles, the ForkWave's
+     tail-relative progress publishing;
+  4. (slow, `make resume-smoke`) the POST path end-to-end: premature
+     forks 400, a base run leaves a discoverable ladder, a warm fork's
+     result doc is field-identical to its full twin while executing
+     only the divergent tail, weight-changing forks 400 loudly, and a
+     second wave of forks adds ZERO compiled wave executables.
+"""
+
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from tests.test_svc import _mk_cluster, _mk_pods
+from tpusim.svc import forks as svc_forks
+from tpusim.svc import jobs as svc_jobs
+from tpusim.svc.api import JobService
+from tpusim.svc.batcher import JobQueue
+from tpusim.svc.worker import TraceRef, Worker
+
+FAM = [["FGDScore", 1000], ["BestFitScore", 500]]
+
+
+# ---------------------------------------------------------------------------
+# 1. vocabulary + family keys (no device)
+# ---------------------------------------------------------------------------
+
+
+def test_fork_spec_vocabulary():
+    spec = svc_jobs.validate_job({"base": True})
+    assert spec.base is True and spec.fork == ()
+
+    fork = {"base": "a" * 64, "event": 5, "tail": [[0, 1], [1, 2]]}
+    spec = svc_jobs.validate_job({"fork": dict(fork)})
+    assert spec.fork == ("a" * 64, 5, "fork", ((0, 1), (1, 2)))
+    spec = svc_jobs.validate_job({"fork": dict(fork, mode="full")})
+    assert spec.fork[2] == "full"
+
+    for bad in (
+        {"base": "zz"},  # not a run digest
+        {"base": "a" * 64, "event": -1, "tail": []},
+        {"base": "a" * 64, "event": 1, "tail": [[7, 0]]},  # bad kind
+        {"base": "a" * 64, "event": 1, "tail": [], "mode": "warm"},
+    ):
+        with pytest.raises(ValueError):
+            svc_jobs.validate_job({"fork": bad})
+    with pytest.raises(ValueError, match="base excludes fork"):
+        svc_jobs.validate_job({"base": True, "fork": dict(fork)})
+    with pytest.raises(ValueError, match="exclude fault"):
+        svc_jobs.validate_job(
+            {"base": True, "fault": {"mtbf_events": 10}}
+        )
+    with pytest.raises(ValueError, match="chunked carry"):
+        svc_jobs.validate_job({"base": True, "engine": "sequential"})
+
+
+def test_fork_family_keys():
+    """A fork and its full twin share one family (one wave, one set of
+    compiled entries); forks of DIFFERENT bases don't; base and plain
+    jobs batch apart from both."""
+    fork = {"base": "a" * 64, "event": 5, "tail": [[1, 0]]}
+    f = svc_jobs.validate_job({"fork": dict(fork)})
+    v = svc_jobs.validate_job({"fork": dict(fork, mode="full")})
+    other = svc_jobs.validate_job({"fork": dict(fork, base="b" * 64)})
+    base = svc_jobs.validate_job({"base": True})
+    plain = svc_jobs.validate_job({})
+    assert f.family_key() == v.family_key()
+    assert f.family_key() != other.family_key()
+    assert len({f.family_key(), base.family_key(),
+                plain.family_key()}) == 3
+
+
+# ---------------------------------------------------------------------------
+# 2. the fork index
+# ---------------------------------------------------------------------------
+
+
+def test_base_entry_roundtrip(tmp_path):
+    d = str(tmp_path)
+    digest, run = "c" * 64, "d" * 64
+    payload = {"policies": [["FGDScore", 1000]], "weights": [1000]}
+    path = svc_forks.write_base_entry(d, digest, run, 3, 40, 24, payload)
+    doc = svc_forks.load_base_entry(d, digest)
+    assert doc["run_digest"] == run and doc["checkpoint_every"] == 3
+    assert doc["events"] == 40 and doc["spec"] == payload
+    assert svc_forks.load_base_entry(d, "e" * 64) is None
+
+    # a torn entry reads as missing AND is deleted (never served)
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    assert svc_forks.load_base_entry(d, digest) is None
+    assert not os.path.isfile(path)
+
+
+def test_nearest_checkpoint_is_a_listing(tmp_path):
+    from tpusim.io.storage import save_checkpoint
+
+    d, run = str(tmp_path), "f" * 64
+    for cur in (4, 8, 12):
+        save_checkpoint(d, run, cur, {"x": np.zeros(2)})
+    near = svc_forks.nearest_checkpoint
+    assert near(d, run, 12) == 12
+    assert near(d, run, 11) == 8  # walk back, never forward
+    assert near(d, run, 3) is None
+    assert near(d, "0" * 64, 12) is None
+
+
+# ---------------------------------------------------------------------------
+# 3. the latency plane, host side
+# ---------------------------------------------------------------------------
+
+
+def test_claim_family_targeted_nonblocking():
+    queue = JobQueue(maxsize=16, lane_width=4)
+    fork = {"base": "a" * 64, "event": 5, "tail": [[1, 0]]}
+    fspec = svc_jobs.validate_job({"fork": dict(fork)})
+    pspec = svc_jobs.validate_job({})
+    jobs = [
+        queue.submit(fspec, f"{i:064x}") for i in range(3)
+    ] + [queue.submit(pspec, f"{99:064x}")]
+    got = queue.claim_family("w1", fspec.family_key(), max_n=2)
+    assert [j.id for j in got] == [jobs[0].id, jobs[1].id]  # FIFO
+    assert all(j.worker == "w1" and j.claimed_unix > 0 for j in got)
+    assert queue.claim_family("w1", fspec.family_key(), max_n=0) == []
+    # the plain job is NOT claimable through the fork family
+    rest = queue.claim_family("w1", fspec.family_key(), max_n=8)
+    assert [j.id for j in rest] == [jobs[2].id]
+    assert queue.depth() == 1
+
+
+def test_latency_percentiles_by_kind():
+    queue = JobQueue(maxsize=16, lane_width=4)
+    fork = {"base": "a" * 64, "event": 5, "tail": [[1, 0]]}
+    kinds = {
+        "base": {"base": True}, "fork": {"fork": dict(fork)},
+        "full": {"fork": dict(fork, mode="full")}, "plain": {},
+    }
+    for i, (kind, doc) in enumerate(kinds.items()):
+        spec = svc_jobs.validate_job(doc)
+        job = queue.submit(spec, f"{i:064x}")
+        assert job.kind() == kind
+        queue.mark_done(job, {"ok": True})
+        d = job.describe()
+        assert d["latency_s"] >= 0 and d["digest"] == job.digest
+    lat = queue.latency_percentiles()
+    assert sorted(lat) == ["base", "fork", "full", "plain"]
+    for v in lat.values():
+        assert v["count"] == 1 and v["p99_s"] >= v["p50_s"] >= 0
+
+
+def test_forkwave_tail_relative_progress():
+    """The honest-progress satellite at the wave layer: a restored
+    lane's published done/total/rate cover ITS divergent tail — the
+    base prefix the checkpoint skipped never inflates them."""
+    from tpusim.svc.waves import ForkWave
+
+    seen = []
+    monitor = SimpleNamespace(
+        publish_job_progress=lambda jid, info: seen.append((jid, info))
+    )
+    fw = ForkWave(wave=None, monitor=monitor)
+    lane = {
+        "job": SimpleNamespace(id="j1"), "cursor": 30, "real": 33,
+        "c0": 27, "joined": time.time() - 1.0, "degrade": False,
+        "mode": "fork",
+    }
+    fw._publish(lane)
+    jid, info = seen[-1]
+    assert jid == "j1"
+    assert info["done"] == 3 and info["total"] == 6  # tail-relative
+    assert 0 < info["ev_per_s"] < 10  # ~3 events over ~1s, never ~30
+    assert info["source_cursor"] == 27 and info["mode"] == "fork"
+
+
+# ---------------------------------------------------------------------------
+# 4. the POST path end-to-end (slow; `make resume-smoke`)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fork_serving_end_to_end(tmp_path):
+    rng = np.random.default_rng(3)
+    nodes, pods = _mk_cluster(rng), _mk_pods(rng)
+    trace = TraceRef(
+        "default", nodes, pods, svc_jobs.trace_digest(nodes, pods)
+    )
+    queue = JobQueue(maxsize=16, lane_width=4)
+    worker = Worker(queue, {"default": trace}, str(tmp_path),
+                    lease_files=False)
+    service = JobService(queue, worker, {"default": trace},
+                         str(tmp_path))
+
+    def post(doc):
+        code, _, body = service.handle(
+            "POST", "/jobs", json.dumps(doc).encode()
+        )[:3]
+        return code, json.loads(body.decode())
+
+    def drain():
+        while True:
+            batch = queue.next_batch(timeout=0)
+            if not batch:
+                return
+            worker.run_batch(batch)
+
+    # a fork of a base nobody ran answers 400, not a silent cold run
+    code, body = post({"fork": {"base": "0" * 64, "event": 5,
+                                "tail": []}})
+    assert code == 400 and "no finished base run" in body["error"]
+
+    code, body = post({"policies": FAM, "weights": [1000, 500],
+                       "seed": 7, "base": True})
+    assert code == 202
+    base_digest = body["digest"]
+    drain()
+    bjob = queue.get(body["id"])
+    assert bjob.status == "done", (bjob.status, bjob.error)
+    br = bjob.result["base_run"]
+    E, every = br["events"], br["checkpoint_every"]
+    assert E > 0 and every > 0
+    # the run left a discoverable ladder + index entry behind
+    assert svc_forks.load_base_entry(
+        str(tmp_path), base_digest
+    )["run_digest"] == br["run_digest"]
+    from tpusim.io.storage import iter_checkpoints
+
+    ck = svc_forks.checkpoint_dir(str(tmp_path))
+    assert len(iter_checkpoints(ck, br["run_digest"])) >= E // every - 1
+
+    # warm fork vs its from-event-0 twin: one wave, identical docs
+    F = (E * 3) // 4
+    tail = [[1, 3], [1, 5], [0, 3]]
+    code, fb = post({"fork": {"base": base_digest, "event": F,
+                              "tail": tail}})
+    assert code == 202
+    code, vb = post({"fork": {"base": base_digest, "event": F,
+                              "tail": tail, "mode": "full"}})
+    assert code == 202 and fb["digest"] != vb["digest"]
+    drain()
+    fj, vj = queue.get(fb["id"]), queue.get(vb["id"])
+    assert fj.status == "done", (fj.status, fj.error)
+    assert vj.status == "done", (vj.status, vj.error)
+    for k in ("placements_sha256", "counters", "gpu_alloc_pct",
+              "frag_gpu_milli", "placed_node", "placed", "failed"):
+        assert fj.result[k] == vj.result[k], k
+    fm, vm = fj.result["fork"], vj.result["fork"]
+    assert fm["mode"] == "fork" and fm["degrade"] is False
+    assert fm["source_cursor"] > 0
+    assert fm["events_executed"] <= len(tail) + every  # the warm win
+    assert vm["source_cursor"] == 0
+    assert vm["events_executed"] == F + len(tail)
+
+    # weights are baked into the restored carry: changing them must be
+    # a loud submit-time rejection, never a silently-cold fork
+    code, body = post({"fork": {"base": base_digest, "event": F,
+                                "tail": tail}, "weights": [999, 500]})
+    assert code == 400 and "weight" in body["error"]
+
+    # a second wave at different divergence points reuses every
+    # compiled wave entry (step/scatter/finish) — zero recompiles
+    x0 = worker.wave_executables()
+    for i in (1, 2, 3):
+        code, _ = post({"fork": {"base": base_digest,
+                                 "event": F - i * every, "tail": tail}})
+        assert code == 202
+    drain()
+    assert worker.wave_executables() == x0
+    stats = worker.wave_stats()
+    assert stats["waves_run"] >= 2 and stats["degrades"] == 0
+    lat = queue.latency_percentiles()
+    assert {"base", "fork", "full"} <= set(lat)

@@ -593,6 +593,7 @@ def test_openb_sweep_acceptance():
     assert w16 < b * sw, (w16, sw)
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: rides resume-smoke
 def test_sweep_multi_stream_donation(monkeypatch):
     """ISSUE 15 satellite: the multi-trace sweep's per-lane event-stream
     buffer is DONATED when nothing reads it after dispatch (the

@@ -158,8 +158,11 @@ def test_pod_type_partition():
     [
         ("FGDScore", "FGDScore"),
         ("BestFitScore", "best"),
-        ("PWRScore", "PWRScore"),
-        ("GpuPackingScore", "worst"),
+        # tier-1 trim, ISSUE 16: per-event report rows are policy-agnostic
+        # plumbing — two policies pin the contract; the rest ride
+        # resume-smoke
+        pytest.param("PWRScore", "PWRScore", marks=pytest.mark.slow),
+        pytest.param("GpuPackingScore", "worst", marks=pytest.mark.slow),
     ],
     ids=lambda p: str(p),
 )
@@ -232,6 +235,7 @@ def test_bucketed_padding_equivalence():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: the unswitched-select A/B knob's big compile; rides resume-smoke
 def test_unswitched_flat_bit_identity():
     """Round 18 A/B pin: the flat body's unconditional-select layout
     (`unswitched=True` — the shard engine's Round-15 form ported back)
@@ -278,6 +282,7 @@ def test_unswitched_flat_bit_identity():
             assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # tier-1 trim, ISSUE 16: same knob through the fault lane; rides resume-smoke
 def test_unswitched_fault_lane_bit_identity():
     """The unswitched layout under the in-scan fault plane: the driver's
     run_with_faults scan lane threads SimulatorConfig.unswitched_select,

@@ -231,6 +231,9 @@ def test_torn_upload_rejected_keeps_no_partial(stack, tmp_path):
     assert q["transfer"]["uploads_ok"] == 2
 
 
+@pytest.mark.slow  # tears down and respawns the HTTP stack mid-test —
+# the slowest transfer slice; resume-smoke runs it (ISSUE 16 budget
+# buy-back)
 def test_upload_retried_across_coordinator_restart(stack, tmp_path):
     """The satellite's restart case: an upload retried against a
     RESTARTED coordinator (same artifact dir) yields byte-identical

@@ -48,6 +48,9 @@ class ApplyOptions:
     # "Checkpoint/resume"): segment length in events, 0 = off
     checkpoint_every: int = 0
     checkpoint_dir: str = ""
+    # retention (ISSUE 16): 0 = prune behind the run (resume-only),
+    # -1 = keep every segment carry (the warm-fork ladder), N>0 = newest N
+    checkpoint_keep: int = 0
     # fault injection (README "Fault injection"): MTBF-style schedule
     # knobs, all in EVENTS; mtbf 0 = no node failures, evict 0 = no
     # preemptions. Any non-zero rate routes the main schedule through
@@ -168,6 +171,7 @@ class Applier:
             extenders=self.sched_cfg.extenders,
             checkpoint_every=self.options.checkpoint_every,
             checkpoint_dir=self.options.checkpoint_dir,
+            checkpoint_keep=self.options.checkpoint_keep,
             profile=bool(
                 self.options.profile_out or self.options.metrics_out
                 or self.options.trace_out

@@ -95,6 +95,12 @@ def _build_parser() -> argparse.ArgumentParser:
         help="checkpoint directory (default: $TPUSIM_CHECKPOINT_DIR or "
         "<repo>/.tpusim_checkpoints)",
     )
+    p_apply.add_argument(
+        "--checkpoint-keep", type=int, default=0, metavar="N",
+        help="checkpoint retention: 0 prunes behind the run (resume-only,"
+        " the default), -1 keeps every segment carry (the warm-state "
+        "fork ladder), N>0 keeps the newest N",
+    )
     # fault injection (README "Fault injection"); all rates in EVENTS
     p_apply.add_argument(
         "--fault-mtbf", type=float, default=0.0, metavar="EVENTS",
@@ -654,6 +660,7 @@ def cmd_apply(args) -> int:
         report_tables=args.report,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
+        checkpoint_keep=args.checkpoint_keep,
         fault_mtbf=args.fault_mtbf,
         fault_mttr=args.fault_mttr,
         fault_evict_every=args.fault_evict_every,

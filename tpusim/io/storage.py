@@ -273,7 +273,8 @@ def find_checkpoint(cache_dir: str, digest: str) -> Optional[Tuple[int, str]]:
 
 
 def load_valid_checkpoint(cache_dir: str, digest: str, validate=None,
-                          on_skip=None):
+                          on_skip=None, max_cursor: Optional[int] = None,
+                          delete_invalid: bool = True):
     """(cursor, arrays, path) of the NEWEST checkpoint that loads AND
     passes `validate(arrays)` (ISSUE 10 torn-checkpoint tolerance): a
     corrupt/truncated `.ckpt.npz` — a machine killed mid-write on a
@@ -282,8 +283,21 @@ def load_valid_checkpoint(cache_dir: str, digest: str, validate=None,
     `on_skip(path, err)` callback instead of crashing the resume, and
     the run continues from the newest VALID predecessor. Returns None
     when no usable checkpoint exists (a fresh start is always safe —
-    content addressing guarantees it)."""
+    content addressing guarantees it).
+
+    `max_cursor` bounds the search to cursors <= that event — the fork
+    index's nearest-checkpoint-at-or-before-divergence walk (ISSUE 16):
+    newer checkpoints of the base run are NOT candidates (their carries
+    already consumed post-divergence events) and are left untouched, not
+    deleted — they still serve later-diverging forks.
+
+    `delete_invalid=False` skips unusable files without unlinking them —
+    a fork reader probing ANOTHER run's checkpoint ladder must never
+    destroy files it merely failed to interpret (a layout mismatch from
+    different padded geometry is the reader's problem, not corruption)."""
     for cursor, path in iter_checkpoints(cache_dir, digest):
+        if max_cursor is not None and cursor > max_cursor:
+            continue
         try:
             cur, arrays = load_checkpoint(path)
             if cur != cursor:
@@ -296,10 +310,11 @@ def load_valid_checkpoint(cache_dir: str, digest: str, validate=None,
         except Exception as err:
             if on_skip is not None:
                 on_skip(path, err)
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            if delete_invalid:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
     return None
 
 
@@ -486,22 +501,28 @@ def read_signed_json(path: str, schema: str = ""):
     return header, json.loads(payload[0])
 
 
-def prune_checkpoints(cache_dir: str, digest: str, keep_cursor: int) -> None:
+def prune_checkpoints(cache_dir: str, digest: str, keep_cursor: int,
+                      keep: int = 0) -> None:
     """Drop a run's checkpoints below `keep_cursor` (each save supersedes
     its predecessors; only the newest is ever resumed from). Missing files
-    are fine — concurrent resumers may race here."""
-    if not cache_dir or not os.path.isdir(cache_dir):
+    are fine — concurrent resumers may race here.
+
+    `keep` is the retention knob (ISSUE 16, SimulatorConfig.
+    checkpoint_keep): 0 keeps the historical resume-only behavior
+    (delete everything below keep_cursor), < 0 retains EVERY checkpoint
+    (the warm-state fork-source mode — the svc fork index needs the
+    whole mid-trace ladder, not just the newest), and N > 0 retains the
+    newest N checkpoints and drops the rest (bounded disk for long base
+    runs whose forks only ever diverge near the tail)."""
+    if keep < 0 or not cache_dir or not os.path.isdir(cache_dir):
         return
-    prefix = digest + ".e"
-    for fname in os.listdir(cache_dir):
-        if not (fname.startswith(prefix) and fname.endswith(CHECKPOINT_SUFFIX)):
-            continue
+    cands = iter_checkpoints(cache_dir, digest)  # newest first
+    doomed = (
+        cands[keep:] if keep > 0
+        else [(c, p) for c, p in cands if c < keep_cursor]
+    )
+    for _, path in doomed:
         try:
-            cursor = int(fname[len(prefix):-len(CHECKPOINT_SUFFIX)])
-        except ValueError:
-            continue
-        if cursor < keep_cursor:
-            try:
-                os.unlink(os.path.join(cache_dir, fname))
-            except OSError:
-                pass
+            os.unlink(path)
+        except OSError:
+            pass

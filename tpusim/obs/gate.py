@@ -407,6 +407,207 @@ def svc_smoke(nodes, pods, out_dir: str, b: int = 4) -> Tuple[bool, List[str]]:
     return True, msgs
 
 
+# hard admission->result p99 SLO for WARM forks on the gate's tiny
+# trace (ISSUE 16): generous against poll jitter, far below a cold
+# compile or a silent full replay — either blows straight through it
+SERVE_P99_SLO_S = 2.5
+
+
+def _p99(xs):
+    s = sorted(xs)
+    return s[min(len(s) - 1, max(0, int(0.99 * len(s) + 0.999999) - 1))]
+
+
+def serve_latency_smoke(nodes, pods, out_dir: str, b: int = 4,
+                        n_pods: int = 2000, k: int = 5
+                        ) -> Tuple[bool, List[str]]:
+    """ISSUE 16: the interactive what-if serving plane end-to-end over
+    real HTTP. Runs a base job (checkpoint ladder + fork index entry),
+    then a warmup fork/full pair (compiles the wave's three entries),
+    then a timed wave of k warm forks + their k from-event-0 "full"
+    twins through ONE POST — more jobs than lanes, so late arrivals
+    JOIN the running wave at chunk boundaries. Hard checks:
+
+      - every fork's result is field-identical to its full twin
+        (placements sha256, counters, gpu_alloc, frag) — warm-state
+        bit-identity through the POST path;
+      - every warm fork executed <= tail + one chunk events, and every
+        full twin replayed from event 0;
+      - the wave executable count is UNCHANGED by the timed wave
+        (zero recompiles across joins — jit._cache_size() live);
+      - admission->result p99 of the warm forks meets the hard SLO
+        AND beats the full-replay p99 by >= 3x (the latency win).
+    """
+    msgs: List[str] = []
+    try:
+        import shutil
+
+        from tpusim.svc import TraceRef, start_job_server
+        from tpusim.svc.client import (
+            _request, fetch_results, submit_and_wait, submit_jobs,
+            wait_jobs,
+        )
+        from tpusim.svc.jobs import trace_digest
+
+        art = os.path.join(out_dir, "serve_latency_smoke")
+        if os.path.isdir(art):
+            shutil.rmtree(art)
+        os.makedirs(art)
+        sub_nodes, sub_pods = nodes[:200], pods[:n_pods]
+        trace = TraceRef(
+            "default", sub_nodes, sub_pods,
+            trace_digest(sub_nodes, sub_pods),
+        )
+        srv, service, worker = start_job_server(
+            art, {"default": trace}, listen=":0", lane_width=b,
+            queue_size=8 * b,
+        )
+        try:
+            fam = [["FGDScore", 1000]]
+            (base_res,) = submit_and_wait(
+                srv.url,
+                [{"policies": fam, "weights": [1000], "seed": 42,
+                  "base": True}],
+                timeout=600, poll_s=0.05,
+            )
+            br = base_res.get("base_run") or {}
+            E = int(br.get("events", 0))
+            chunk = int(br.get("checkpoint_every", 0))
+            if not (E and chunk):
+                return False, [
+                    f"[gate] serve-latency: base result carries no "
+                    f"base_run meta ({sorted(base_res)}) (FAIL)"
+                ]
+            base_digest = base_res["job"]
+
+            def fork_doc(event, tail, mode="fork"):
+                doc = {"fork": {"base": base_digest, "event": int(event),
+                                "tail": [[int(a), int(p)]
+                                         for a, p in tail]}}
+                if mode != "fork":
+                    doc["fork"]["mode"] = mode
+                return doc
+
+            # warmup pair: compiles the wave's step/scatter/finish
+            wtail = [[1, 0], [0, 0]]
+            submit_and_wait(
+                srv.url,
+                [fork_doc(E // 2, wtail),
+                 fork_doc(E // 2, wtail, "full")],
+                timeout=600, poll_s=0.05,
+            )
+            _, _, q1 = _request(srv.url + "/queue")
+            execs = (q1.get("waves") or {}).get("executables", -1)
+            if execs < 0:
+                return False, [
+                    f"[gate] serve-latency: /queue carries no wave "
+                    f"executable census ({sorted(q1)}) (FAIL)"
+                ]
+
+            # the timed wave: k warm forks near the end of the base
+            # stream + their from-0 twins, one POST, tight poll (a
+            # millisecond fork must not be measured through a
+            # second-scale poll schedule). Forks FIRST, fulls after:
+            # claim order is FIFO, so each class's p99 measures its own
+            # replay cost — a fork queued BEHIND a 32-chunk full replay
+            # would measure the lane wait, not the warm-state win
+            docs, tails = [], []
+            for j in range(k):
+                tail = [[1, 2 * j], [1, 2 * j + 1], [0, 2 * j]]
+                tails.append(tail)
+                docs.append(fork_doc(E - 1 - (j % 3) * chunk, tail))
+            for j in range(k):
+                docs.append(
+                    fork_doc(E - 1 - (j % 3) * chunk, tails[j], "full")
+                )
+            acc = submit_jobs(srv.url, docs, timeout=60)
+            ids = [a["id"] for a in acc]
+            final = wait_jobs(srv.url, ids, timeout=600, poll_s=0.02)
+            results = fetch_results(srv.url, ids)
+
+            fork_lat, full_lat = [], []
+            for j in range(k):
+                fr, vr = results[j], results[k + j]
+                for f in ("placements_sha256", "counters",
+                          "gpu_alloc_pct", "frag_gpu_milli", "placed",
+                          "failed"):
+                    if fr[f] != vr[f]:
+                        return False, [
+                            f"[gate] serve-latency: fork pair {j} "
+                            f"diverged on {f}: {fr[f]!r} != {vr[f]!r} "
+                            f"(FAIL)"
+                        ]
+                fm, vm = fr["fork"], vr["fork"]
+                if fm["degrade"] or fm["source_cursor"] <= 0:
+                    return False, [
+                        f"[gate] serve-latency: fork {j} replayed COLD "
+                        f"({fm}) — the warm-state path is broken (FAIL)"
+                    ]
+                if fm["events_executed"] > 3 + chunk:
+                    return False, [
+                        f"[gate] serve-latency: fork {j} executed "
+                        f"{fm['events_executed']} events > tail(3) + "
+                        f"chunk({chunk}) (FAIL)"
+                    ]
+                if vm["source_cursor"] != 0:
+                    return False, [
+                        f"[gate] serve-latency: full twin {j} did not "
+                        f"replay from event 0 ({vm}) (FAIL)"
+                    ]
+                fork_lat.append(float(final[j]["latency_s"]))
+                full_lat.append(float(final[k + j]["latency_s"]))
+
+            _, _, q2 = _request(srv.url + "/queue")
+            w = q2.get("waves") or {}
+            if w.get("executables") != execs:
+                return False, [
+                    f"[gate] serve-latency: the timed wave RECOMPILED "
+                    f"({execs} -> {w.get('executables')} wave "
+                    f"executables) (FAIL)"
+                ]
+            if w.get("joins", 0) < 1:
+                return False, [
+                    f"[gate] serve-latency: {2 * k} jobs over {b} lanes "
+                    f"produced no boundary join ({w}) — continuous "
+                    f"batching is not engaging (FAIL)"
+                ]
+            if "fork" not in (q2.get("latency") or {}):
+                return False, [
+                    f"[gate] serve-latency: /queue latency plane "
+                    f"missing fork percentiles ({q2.get('latency')}) "
+                    f"(FAIL)"
+                ]
+            p99f, p99v = _p99(fork_lat), _p99(full_lat)
+            if p99f > SERVE_P99_SLO_S:
+                return False, [
+                    f"[gate] serve-latency: warm-fork p99 {p99f:.3f}s "
+                    f"breaks the {SERVE_P99_SLO_S}s SLO (FAIL)"
+                ]
+            if p99f * 3.0 > p99v:
+                return False, [
+                    f"[gate] serve-latency: warm-fork p99 {p99f:.3f}s "
+                    f"is not >=3x faster than full-replay p99 "
+                    f"{p99v:.3f}s (FAIL)"
+                ]
+            msgs.append(
+                f"[gate] serve-latency: base {E} ev (chunk {chunk}), "
+                f"{k} warm forks bit-identical to their from-0 twins; "
+                f"p99 fork {p99f * 1000:.0f}ms vs full "
+                f"{p99v * 1000:.0f}ms ({p99v / max(p99f, 1e-9):.1f}x, "
+                f"SLO {SERVE_P99_SLO_S}s), {w['joins']} boundary "
+                f"join(s), wave executables stable at {execs} "
+                f"(zero recompiles)"
+            )
+        finally:
+            worker.stop()
+            srv.stop()
+    except Exception as err:
+        return False, [
+            f"[gate] serve-latency: FAIL ({type(err).__name__}: {err})"
+        ]
+    return True, msgs
+
+
 def chaos_smoke(nodes, pods, b: int = 8) -> Tuple[bool, List[str]]:
     """ISSUE 10 satellite: the chaos sweep end-to-end on a tiny trace
     prefix — B fault schedules (varying seed/MTBF/evict cadence) in ONE
@@ -1812,6 +2013,13 @@ def main(argv=None) -> int:
         "`make svc-smoke` mode",
     )
     ap.add_argument(
+        "--serve-latency-only", action="store_true",
+        help="run only the interactive what-if serving smoke (ISSUE 16: "
+        "real-HTTP base run + warm fork wave with boundary joins, fork "
+        "vs from-0 bit-identity, zero recompiles, hard admission->"
+        "result p99 SLO) — the `make serve-latency-smoke` mode",
+    )
+    ap.add_argument(
         "--tune-only", action="store_true",
         help="run only the learned-scoring smoke (ISSUE 9) — the "
         "`make tune-smoke` mode",
@@ -1929,6 +2137,11 @@ def main(argv=None) -> int:
         print("\n".join(msgs))
         print(f"[gate] {'PASS' if ok else 'FAIL'}")
         return 0 if ok else 1
+    if args.serve_latency_only:
+        ok, msgs = serve_latency_smoke(nodes, pods, args.out)
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
     if args.chaos_only:
         ok, msgs = chaos_smoke(nodes, pods)
         print("\n".join(msgs))
@@ -1981,6 +2194,11 @@ def main(argv=None) -> int:
     # across a weights+tune wave
     svc_ok, svc_msgs = svc_smoke(nodes, pods, args.out)
     print("\n".join(svc_msgs))
+    # interactive what-if serving smoke (ISSUE 16): warm-state fork wave
+    # over real HTTP — bit-identity vs from-0 twins, boundary joins with
+    # zero recompiles, hard admission->result p99 SLO
+    serve_ok, serve_msgs = serve_latency_smoke(nodes, pods, args.out)
+    print("\n".join(serve_msgs))
     # learned-scoring smoke (ISSUE 9 satellite): the tuning loop on one
     # compiled sweep — zero recompiles, signed resumable log
     tune_ok, tune_msgs = tune_smoke(args.out)
@@ -2015,9 +2233,9 @@ def main(argv=None) -> int:
     # MULTICHIP_r*.json, like the BENCH_r*.json baselines
     mc_ok, mc_msgs = multichip_advisory(latest_multichip())
     print("\n".join(mc_msgs))
-    smoke_ok = (dec_ok and scrape_ok and swp_ok and svc_ok and tune_ok
-                and chaos_ok and pol_ok and hbm_ok and mesh_ok
-                and fleet_ok and wan_ok and mc_ok)
+    smoke_ok = (dec_ok and scrape_ok and swp_ok and svc_ok and serve_ok
+                and tune_ok and chaos_ok and pol_ok and hbm_ok
+                and mesh_ok and fleet_ok and wan_ok and mc_ok)
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

@@ -129,6 +129,15 @@ class SimulatorConfig:
     # non-empty, else $TPUSIM_CHECKPOINT_DIR, else
     # <repo>/.tpusim_checkpoints. Only consulted when checkpoint_every > 0.
     checkpoint_dir: str = ""
+    # Checkpoint retention (ISSUE 16, `--checkpoint-keep`): 0 keeps the
+    # PR 2 resume-only discipline — each save prunes its predecessors and
+    # run completion prunes everything (checkpoints exist only to survive
+    # a kill). -1 retains EVERY mid-trace checkpoint: the warm-state fork
+    # mode, where the svc fork index maps a what-if job to the nearest
+    # checkpoint at-or-before its divergence point — pruning would delete
+    # exactly what the index needs. N > 0 bounds disk instead: the newest
+    # N checkpoints survive, older fork points degrade to full replay.
+    checkpoint_keep: int = 0
     # ---- observability (tpusim.obs; ENGINES.md "Round 8") ----
     # profile=True switches the always-on span recorder into profiling
     # mode: the driver blocks on each phase result so spans carry the
@@ -675,7 +684,7 @@ class Simulator:
 
     def run_events(
         self, state, specs, ev_kind, ev_pod, key, bucket: int = 512,
-        types=None, pod_rows=None
+        types=None, pod_rows=None, fork=None
     ):
         """Run the compiled replay on prepared arrays, auto-selecting the
         fastest engine that supports the configuration. Small batches
@@ -724,6 +733,36 @@ class Simulator:
 
         p, e = int(specs.cpu.shape[0]), int(ev_kind.shape[0])
         p2, e2 = _bucket_sizes(p, e, bucket)
+        if fork is not None:
+            # warm-state what-if (ISSUE 16): `fork = (base_ev_kind,
+            # base_ev_pod, fork_event)` — this stream shares the base
+            # run's prefix up to fork_event; _run_chunked resumes from
+            # the base's nearest checkpoint at-or-before it. Only the
+            # chunked table/shard paths can honor a fork; anything else
+            # would silently full-replay, so fail loudly instead.
+            if not (0 < self.cfg.checkpoint_every < e):
+                raise ValueError(
+                    "forked replay needs the chunked path: set "
+                    "checkpoint_every in (0, num_events) "
+                    f"(got {self.cfg.checkpoint_every} for {e} events)"
+                )
+            if self.cfg.engine not in ("table", "auto") and not self.cfg.mesh:
+                raise ValueError(
+                    f"forked replay needs the table or shard engine, "
+                    f"not {self.cfg.engine!r}"
+                )
+            bk, bp, fev = fork
+            if not 0 <= int(fev) <= int(np.asarray(bk).shape[0]):
+                raise ValueError(
+                    f"fork_event {fev} outside the base stream "
+                    f"(0..{int(np.asarray(bk).shape[0])})"
+                )
+            # the base streams must carry the identical padding
+            # discipline — the fork lookup's digest math is byte-exact
+            _, be2 = _bucket_sizes(p, int(np.asarray(bk).shape[0]), bucket)
+            bk, bp = _pad_events(jnp.asarray(bk), jnp.asarray(bp), be2,
+                                 xp=jnp)
+            fork = (bk, bp, int(fev))
         if self.cfg.heartbeat_every:
             # arm the host side of the in-scan progress ticks for this
             # dispatch (ETA needs the event total; the engine only ships
@@ -776,7 +815,7 @@ class Simulator:
                 out = self._dispatch_span(
                     lambda: self._run_chunked(
                         self._shard_fn, state_p, specs, types, ev_kind,
-                        ev_pod, key, rank_p,
+                        ev_pod, key, rank_p, fork=fork,
                     ),
                     engine=self._last_engine, events=e,
                 )
@@ -802,7 +841,8 @@ class Simulator:
         if types is not None:
             k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
             big = k > 0 and e >= 2 * k
-            if big or (self.cfg.engine in ("table", "pallas") and k > 0):
+            if (big or (self.cfg.engine in ("table", "pallas") and k > 0)
+                    or (fork is not None and k > 0)):
                 if p2 != p or e2 != e:  # bucketed run: stabilize K too
                     types = pad_pod_types(types)
                 # the fused Pallas engine wins whenever it applies; its
@@ -813,6 +853,7 @@ class Simulator:
                 # provenance; a forced engine: pallas raised at init)
                 use_pallas = (
                     self._pallas_fn is not None
+                    and fork is None  # fused kernel has no carry surface
                     and not self.cfg.record_decisions
                     and not self.cfg.series_every
                     and (
@@ -847,6 +888,7 @@ class Simulator:
                                 tables_thunk=lambda: self._cached_tables(
                                     state, types, key
                                 ),
+                                fork=fork,
                             ),
                             engine="table", events=e,
                         )
@@ -862,6 +904,12 @@ class Simulator:
                             engine="table", events=e,
                         )
         if out is None:
+            if fork is not None:
+                raise ValueError(
+                    "forked replay fell through to the sequential engine "
+                    "(no pod types / carry surface) — run the base and "
+                    "fork on the table or shard engine"
+                )
             self._last_engine = "sequential"
             out = self._dispatch_span(
                 lambda: self.replay_fn(
@@ -1145,7 +1193,7 @@ class Simulator:
         return checkpoint_digest(chunks())
 
     def _run_chunked(self, fn, state, specs, types, ev_kind, ev_pod, key,
-                     rank, tables_thunk=None):
+                     rank, tables_thunk=None, fork=None):
         """Chunked replay with exact checkpoint/resume: cut the event scan
         into checkpoint_every-event segments via the engine's carry surface
         (fn.init_carry / run_chunk / finish), snapshot the full carry to
@@ -1155,7 +1203,19 @@ class Simulator:
         the newest matching checkpoint. Chaining segments is bit-identical
         to one unsegmented scan (see table_engine.FlatTableCarry), so a
         killed-and-resumed run reproduces the uninterrupted run's
-        placements, telemetry, metrics, and final tables exactly."""
+        placements, telemetry, metrics, and final tables exactly.
+
+        `fork = (base_ev_kind, base_ev_pod, fork_event)` is the
+        warm-state what-if mode (ISSUE 16): this run's stream shares the
+        base run's prefix up to `fork_event`, so when no checkpoint of
+        THIS run exists, resume instead from the base run's nearest
+        checkpoint at-or-before the divergence point (the base streams
+        must already carry this run's padding — the digest math demands
+        byte-equal inputs) and replay only the divergent tail. A carry
+        restored at cursor c <= fork_event has consumed only shared
+        events, so the continuation is bit-identical to the from-event-0
+        replay of the forked stream. Missing/torn fork sources degrade
+        loudly to a full replay — correct, just cold."""
         from tpusim.io import storage as ckpt
         from tpusim.obs import heartbeat as obs_heartbeat
         from tpusim.obs.decisions import DecisionRecord
@@ -1166,6 +1226,11 @@ class Simulator:
         every = max(1, int(self.cfg.checkpoint_every))
         cache_dir = self._checkpoint_dir()
         digest = self._run_digest(state, specs, ev_kind, ev_pod, key, rank)
+        # expose the run's content identity: the svc fork index persists
+        # it so what-if jobs can find this run's checkpoints later
+        self.last_run_digest = digest
+        self.last_checkpoint_dir = cache_dir
+        self._fork_stats = None
         template = jax.eval_shape(
             fn.init_carry, state, specs, types, self.typical, key, rank
         )
@@ -1214,8 +1279,39 @@ class Simulator:
         found = ckpt.load_valid_checkpoint(
             cache_dir, digest, validate=_validate, on_skip=_on_skip
         )
+        if fork is not None:
+            base_kind, base_pod, fork_event = fork
+            fork_event = int(fork_event)
+            # the base run's content identity: same inputs except its
+            # OWN event stream (identical prefix, different tail)
+            base_digest = self._run_digest(
+                state, specs, base_kind, base_pod, key, rank
+            )
+            self._fork_stats = {
+                "base_digest": base_digest, "fork_event": fork_event,
+                "source_cursor": 0, "degrade": False,
+            }
+            if found is None:
+                # nearest base checkpoint at-or-before the divergence
+                # point: its carry consumed only the SHARED prefix, so
+                # continuing it with the forked stream is exact
+                found = ckpt.load_valid_checkpoint(
+                    cache_dir, base_digest, validate=_validate,
+                    on_skip=_on_skip, max_cursor=fork_event,
+                    delete_invalid=False,
+                )
+                if found is None:
+                    self.obs.count("degrade_fork")
+                    self._fork_stats["degrade"] = True
+                    self.log.info(
+                        f"[Degrade] no usable fork source at-or-before "
+                        f"event {fork_event} for base "
+                        f"{base_digest[:12]}…; full replay from event 0"
+                    )
         if found is not None:
             cursor, arrays, path = found
+            if self._fork_stats is not None:
+                self._fork_stats["source_cursor"] = cursor
             leaves = [arrays[f"c{i:03d}"] for i in range(len(tleaves))]
             carry = jax.tree.unflatten(
                 tdef, [jnp.asarray(a) for a in leaves]
@@ -1303,10 +1399,16 @@ class Simulator:
                             [np.asarray(getattr(p, f)) for p in ser_parts]
                         )
                 ckpt.save_checkpoint(cache_dir, digest, cursor, arrays)
-                ckpt.prune_checkpoints(cache_dir, digest, cursor)
+                ckpt.prune_checkpoints(
+                    cache_dir, digest, cursor, keep=self.cfg.checkpoint_keep
+                )
 
         state_f, placed, masks, failed = fn.finish(carry)
-        ckpt.prune_checkpoints(cache_dir, digest, e + 1)  # run completed
+        # run completed: retention-gated (checkpoint_keep != 0 preserves
+        # the mid-trace ladder the svc fork index references)
+        ckpt.prune_checkpoints(
+            cache_dir, digest, e + 1, keep=self.cfg.checkpoint_keep
+        )
         nodes = (
             np.concatenate(node_parts) if node_parts
             else np.zeros(0, np.int32)
@@ -1512,6 +1614,62 @@ class Simulator:
             time.perf_counter() - t0,
         )
 
+    def schedule_pods_fork(self, pods: Sequence[PodRow], fork_event: int,
+                           tail_kind, tail_pod) -> SimulateResult:
+        """Warm-state what-if replay (ISSUE 16): run the event stream
+        `base[:fork_event] + tail` over the SAME prepared pods, resuming
+        from the base run's nearest checkpoint at-or-before fork_event
+        instead of event 0 — bit-identical to schedule_pods over the
+        spliced stream, but the device only executes the divergent tail
+        (plus at most one chunk of shared prefix to reach the fork
+        point). The base run must have executed on this Simulator's
+        config with checkpoint_every > 0 and checkpoint_keep != 0 so its
+        mid-trace carry ladder survives; a missing/torn source degrades
+        loudly to a full replay (`self.last_fork["degrade"]`). The tail
+        reuses the base's pod specs/weights/seed by construction — the
+        checkpointed carry embeds the weight vector via its blocked
+        summaries, which is exactly why a weight-changing fork can never
+        match a base checkpoint (different run digest) and must be
+        rejected upstream, not silently degraded here."""
+        if self.typical is None:
+            self.set_typical_pods()
+        t0 = time.perf_counter()
+        base_kind, base_pod = build_events(pods, self.cfg.use_timestamps)
+        fev = int(fork_event)
+        if not 0 <= fev <= len(base_kind):
+            raise ValueError(
+                f"fork_event {fev} outside the base stream "
+                f"(0..{len(base_kind)})"
+            )
+        tail_kind = np.asarray(tail_kind, base_kind.dtype)
+        tail_pod = np.asarray(tail_pod, base_pod.dtype)
+        ev_kind = np.concatenate([base_kind[:fev], tail_kind])
+        ev_pod = np.concatenate([base_pod[:fev], tail_pod])
+        specs = pods_to_specs(pods, self.node_index)
+        out = self.run_events(
+            self.init_state, specs, jnp.asarray(ev_kind),
+            jnp.asarray(ev_pod), jax.random.PRNGKey(self.cfg.seed),
+            pod_rows=pods, fork=(base_kind, base_pod, fev),
+        )
+        with self.obs.span("fetch", events=len(ev_kind)):
+            out = device_fetch(out)
+        stats = dict(getattr(self, "_fork_stats", None) or {})
+        if stats:
+            # REAL events this process fed (pad skips excluded): the
+            # tail-only latency-win counter the svc result doc reports
+            stats["events_executed"] = max(
+                0, len(ev_kind) - int(stats.get("source_cursor", 0))
+            )
+            stats["events_total"] = int(len(ev_kind))
+        self.last_fork = stats
+        result, events, unscheduled, rank = self._finish_replay(
+            out, pods, ev_kind, ev_pod, self.init_state
+        )
+        return self._record_result(
+            result, pods, events, unscheduled, rank,
+            time.perf_counter() - t0,
+        )
+
     def _telemetry_meta(self) -> dict:
         """Deterministic run description for the telemetry record (must be
         identical across same-seed runs — no walls, no paths)."""
@@ -1579,6 +1737,14 @@ class Simulator:
         return out
 
     def _record_result(self, result, pods, events, unscheduled, rank, wall):
+        # exact in-scan counters + creation-failure mask of the newest
+        # run: the svc serving path summarizes results in the SweepLane
+        # vocabulary (counters included) without re-deriving them
+        self.last_counters = (
+            np.asarray(result.counters)
+            if getattr(result, "counters", None) is not None else None
+        )
+        self.last_ever_failed = np.asarray(result.ever_failed)
         self.last_result = SimulateResult(
             unscheduled_pods=unscheduled,
             placed_node=np.asarray(result.placed_node),
@@ -3424,6 +3590,276 @@ def _slice_sweep_lane(out, amounts, i, wrow, seed, p, e, pad_skips):
         frag_gpu_milli=float(frag_sum_except_q3(amounts[i])),
         unscheduled=int(((pn < 0) & failed_i).sum()),
     )
+
+
+def lane_from_arrays(state, placed_node, dev_mask, ever_failed, counters,
+                     typical, weights, seed, events,
+                     pad_skips: int = 0) -> SweepLane:
+    """SweepLane from raw final-run arrays — the shared summary math of
+    lane_from_run (standalone/forked chunked runs) and the ChunkWave
+    serving path (ISSUE 16). Mirrors _slice_sweep_lane exactly: same
+    counters pad-correction, same gpu_alloc slot mask, same frag
+    post-pass — so every result document of a family is field-for-field
+    comparable regardless of which execution path produced it."""
+    from tpusim.ops.frag import cluster_frag_amounts, frag_sum_except_q3
+
+    pn = np.asarray(placed_node, np.int32)
+    failed = np.asarray(ever_failed, bool)
+    ctr = None
+    if counters is not None:
+        ctr = np.asarray(counters).astype(np.int64).copy()
+        ctr[4] = max(int(ctr[4]) - int(pad_skips), 0)  # bucket padding
+    st = jax.tree.map(np.asarray, state)
+    slot = (
+        np.arange(st.gpu_left.shape[1])[None, :] < st.gpu_cnt[:, None]
+    )
+    denom = max(int(st.gpu_cnt.sum()) * MILLI, 1)
+    alloc = 100.0 * float(
+        np.where(slot, MILLI - st.gpu_left, 0).sum()
+    ) / denom
+    amounts = np.asarray(
+        cluster_frag_amounts(
+            jax.tree.map(jnp.asarray, st), typical
+        ).sum(0)
+    )
+    return SweepLane(
+        weights=np.asarray(weights, np.int32).copy(),
+        seed=int(seed),
+        placed_node=pn,
+        dev_mask=np.asarray(dev_mask),
+        ever_failed=failed,
+        counters=ctr,
+        metrics=None,
+        state=st,
+        events=int(events),
+        placed=int((pn >= 0).sum()),
+        failed=int(failed.sum()),
+        gpu_alloc_pct=alloc,
+        frag_gpu_milli=float(frag_sum_except_q3(amounts)),
+        unscheduled=int(((pn < 0) & failed).sum()),
+    )
+
+
+def lane_from_run(sim: "Simulator", weights, seed,
+                  pad_skips: int = 0) -> SweepLane:
+    """SweepLane view of the Simulator's newest STANDALONE run
+    (schedule_pods / schedule_pods_fork) — the svc serving path's result
+    vocabulary (learn.objective.lane_terms) applied to base runs and
+    warm-state forks, which execute through the chunked replay rather
+    than a vmapped sweep."""
+    res = sim.last_result
+    return lane_from_arrays(
+        res.state, res.placed_node, res.dev_mask, sim.last_ever_failed,
+        sim.last_counters, sim.typical, weights, seed, int(res.events),
+        pad_skips,
+    )
+
+
+class ChunkWave:
+    """The continuous-batching chunk surface of the what-if serving
+    plane (ISSUE 16): B lanes of one job family stepping through the
+    donated `run_chunk` twin TOGETHER, one vmapped dispatch per chunk,
+    with per-lane event streams as operands. Because every lane shares
+    the family's state/specs/types/typical/weights/rank (forks of one
+    base run agree on all of them — the fork index enforces it), the
+    vmap axis carries only (carry, ev_kind chunk, ev_pod chunk): a lane
+    can be restored from a mid-trace base checkpoint, joined at ANY
+    chunk boundary via the scatter entry (replacing a padding lane),
+    and finished independently — all through exactly three jitted
+    callables whose executable count is the zero-recompile metric.
+
+    Padding discipline mirrors run_events byte-for-byte (_bucket_sizes
+    pow2 adaptation included), so `base_digest` here equals the digest
+    the standalone base run persisted its checkpoints under — the fork
+    index's content contract. Idle/free lanes are fed EV_SKIP chunks:
+    the scan body splits the PRNG key BEFORE branching on kind, so a
+    skip advances only the key and the skip counter — trailing skip
+    count differences between lanes are inert for every extracted
+    result (pinned by tests/test_fork.py), and the host-tracked pad
+    count corrects the skip counter per lane."""
+
+    def __init__(self, sim: "Simulator", pods, lanes: int, chunk: int,
+                 bucket: int = 512):
+        from tpusim.io.trace import build_events
+        from tpusim.sim.table_engine import build_pod_types, pad_pod_types
+
+        if sim.cfg.mesh or sim.cfg.engine not in ("table", "auto"):
+            raise ValueError(
+                "chunk waves run on the table engine (engine table/auto, "
+                "no mesh)"
+            )
+        if (sim.cfg.extenders or sim.cfg.record_decisions
+                or sim.cfg.series_every):
+            raise ValueError(
+                "chunk waves have no extender/decision/series surface"
+            )
+        if sim.typical is None:
+            sim.set_typical_pods()
+        self.sim = sim
+        self.lanes = int(lanes)
+        self.chunk = max(1, int(chunk))
+        fn = sim._table_fn
+        self._fn = fn
+        state = sim.init_state
+        specs = pods_to_specs(pods, sim.node_index)
+        bk, bp = build_events(pods, sim.cfg.use_timestamps)
+        bk, bp = jnp.asarray(bk), jnp.asarray(bp)
+        validate_events(bk, bp, int(specs.cpu.shape[0]))
+        p, e = int(specs.cpu.shape[0]), int(bk.shape[0])
+        p2, e2 = _bucket_sizes(p, e, bucket)
+        types = build_pod_types(specs)
+        k = int(types.share.cpu.shape[0]) + int(types.whole.cpu.shape[0])
+        if k == 0:
+            raise ValueError(
+                "no distinct pod types — the table carry surface needs "
+                "at least one"
+            )
+        specs, tid = _pad_specs(specs, p2, types.type_id, xp=jnp)
+        types = types._replace(type_id=tid)
+        if p2 != p or e2 != e:
+            types = pad_pod_types(types)
+        self.base_kind, self.base_pod = _pad_events(bk, bp, e2, xp=jnp)
+        self.p, self.e, self.p2, self.e2 = p, e, p2, e2
+        self.specs, self.types = specs, types
+        self.state = state
+        self.key = jax.random.PRNGKey(sim.cfg.seed)
+        self.rank = sim.rank
+        self.base_digest = sim._run_digest(
+            state, specs, self.base_kind, self.base_pod, self.key,
+            sim.rank
+        )
+        self.checkpoint_dir = sim._checkpoint_dir()
+        template = jax.eval_shape(
+            fn.init_carry, state, specs, types, sim.typical, self.key,
+            sim.rank
+        )
+        self._tleaves, self._tdef = jax.tree.flatten(template)
+        typical, rank = sim.typical, sim.rank
+
+        def _chunk1(carry, evk, evp):
+            carry, _ys = fn.run_chunk(
+                carry, specs, types, evk, evp, typical, rank
+            )
+            # strip weak_type from every carry leaf: the scan body
+            # leaves one weakly-typed counter, and a weak-vs-strong
+            # signature flip between host-built carries (stack/restore,
+            # strong) and jit outputs (weak) would re-trace step AND
+            # scatter once mid-wave — churn the zero-recompile census
+            # must not carry
+            return ChunkWave._strong(carry)
+
+        # the three compiled entries of the wave: a lane join, a lane
+        # finish, and the B-wide chunk advance — each traces exactly
+        # once per family (the donated carry keeps buffers in place)
+        self._step = jax.jit(
+            jax.vmap(_chunk1, in_axes=(0, 0, 0)), donate_argnums=(0,)
+        )
+        self._scatter = jax.jit(
+            lambda batch, lane, i: jax.tree.map(
+                lambda b, l: b.at[i].set(l), batch, lane
+            ),
+            donate_argnums=(0,),
+        )
+
+        def _finish1(batch, i):
+            lane = jax.tree.map(lambda x: x[i], batch)
+            st, placed, masks, failed = fn.finish(lane)
+            return st, placed, masks, failed, lane.ctr
+
+        self._finish = jax.jit(_finish1)
+
+    # ---- lane carries ----
+
+    @staticmethod
+    def _strong(tree):
+        """Strip weak_type from every leaf (values/dtypes unchanged, so
+        checkpoints and digests are unaffected) — the wave's signature
+        stability contract: every carry that circulates, whether
+        host-built or a jit output, presents the same strong-typed
+        avals to step/scatter/finish."""
+        return jax.tree.map(lambda x: x.astype(x.dtype), tree)
+
+    def init_lane(self):
+        """Fresh event-0 carry — full-replay twins and degraded forks."""
+        tables = self.sim._cached_tables(self.state, self.types, self.key)
+        return self._strong(self._fn.init_carry(
+            self.state, self.specs, self.types, self.sim.typical,
+            self.key, self.rank, tables=tables,
+        ))
+
+    def restore_lane(self, fork_event: int):
+        """(cursor, carry) restored from the base run's nearest persisted
+        checkpoint at-or-before the divergence event, or None (the
+        degrade path — the caller falls back to init_lane). Never
+        deletes a base checkpoint it merely fails to interpret."""
+        from tpusim.io import storage as ckpt
+
+        def _validate(arrays):
+            leaves = [
+                arrays[f"c{i:03d}"] for i in range(len(self._tleaves))
+            ]
+            if any(
+                a.shape != t.shape or a.dtype != t.dtype
+                for a, t in zip(leaves, self._tleaves)
+            ):
+                raise ValueError("carry layout mismatch")
+
+        found = ckpt.load_valid_checkpoint(
+            self.checkpoint_dir, self.base_digest, validate=_validate,
+            max_cursor=int(fork_event), delete_invalid=False,
+        )
+        if found is None:
+            return None
+        cursor, arrays, _path = found
+        leaves = [
+            jnp.asarray(arrays[f"c{i:03d}"])
+            for i in range(len(self._tleaves))
+        ]
+        return cursor, jax.tree.unflatten(self._tdef, leaves)
+
+    def fork_stream(self, fork_event: int, tail):
+        """(ev_kind, ev_pod, real) of the forked run: the shared base
+        prefix up to fork_event + the divergent ((kind, pod), ...) tail,
+        as host arrays. `real` is the true event count; the wave pads
+        each lane's final partial chunk with inert EV_SKIPs."""
+        bk = np.asarray(self.base_kind)
+        bp = np.asarray(self.base_pod)
+        tk = np.asarray([k for k, _ in tail], bk.dtype)
+        tpd = np.asarray([pd for _, pd in tail], bp.dtype)
+        evk = np.concatenate([bk[: int(fork_event)], tk])
+        evp = np.concatenate([bp[: int(fork_event)], tpd])
+        return evk, evp, int(evk.shape[0])
+
+    # ---- the wave surface ----
+
+    def stack(self, carries):
+        """Lane carries -> the batched wave carry (leading lane axis)."""
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *carries)
+
+    def step(self, batch_carry, evk, evp):
+        """Advance every lane one chunk: evk/evp are [lanes, chunk].
+        DONATES batch_carry — the caller rebinds."""
+        return self._step(batch_carry, jnp.asarray(evk), jnp.asarray(evp))
+
+    def scatter(self, batch_carry, lane_carry, i: int):
+        """Install a joining lane's carry into slot i at a chunk
+        boundary (donates batch_carry; i is traced — one executable
+        serves every slot)."""
+        return self._scatter(batch_carry, lane_carry, jnp.int32(i))
+
+    def finish_lane(self, batch_carry, i: int):
+        """(state, placed, masks, failed, counters) of lane i — the
+        batch carry survives (not donated) and keeps stepping."""
+        return self._finish(batch_carry, jnp.int32(i))
+
+    def executables(self) -> int:
+        """Compiled-executable census across the wave's three entries —
+        the zero-recompile acceptance metric: stable across join waves,
+        lane scatters, and finishes of one family."""
+        return (
+            self._step._cache_size() + self._scatter._cache_size()
+            + self._finish._cache_size()
+        )
 
 
 def schedule_pods_sweep(

@@ -85,6 +85,8 @@ class JobService:
         payload = svc_jobs.expand_policy_preset(
             payload, self.policy_presets
         )
+        if isinstance(payload, dict) and payload.get("fork"):
+            payload = self._resolve_fork(payload)
         spec = svc_jobs.validate_job(payload)
         trace = self.traces.get(spec.trace)
         if trace is None:
@@ -107,6 +109,64 @@ class JobService:
                 job.id, {"status": job.status, "phase": "submitted"}
             )
         return job.describe()
+
+    def _resolve_fork(self, payload: dict) -> dict:
+        """Expand a fork submission against the fork index (ISSUE 16):
+        the client sends only the handle — base job digest, divergence
+        event, tail (and mode) — and the base's full spec payload is
+        merged in, so a fork is BY CONSTRUCTION the same replay as its
+        base up to the divergence event. Any explicitly-supplied field
+        must EQUAL the base's: the checkpointed carry embeds the base's
+        weights in its blocked summaries, so a weight-changing fork can
+        never restore from a base checkpoint — reject it loudly here
+        instead of silently replaying cold."""
+        from tpusim.svc import forks as svc_forks
+
+        fork = payload.get("fork")
+        if not isinstance(fork, dict):
+            raise ValueError(
+                'fork must be an object: {"base": <base job digest>, '
+                '"event": E, "tail": [[kind, pod], ...]}'
+            )
+        base_digest = str(fork.get("base", ""))
+        entry = svc_forks.load_base_entry(self.artifact_dir, base_digest)
+        if entry is None:
+            raise ValueError(
+                f"fork base {base_digest[:12] or '?'}… has no finished "
+                'base run on this service — submit {"base": true, ...} '
+                "for the trace first and wait for it to finish"
+            )
+        base_payload = {
+            k: v for k, v in entry["spec"].items() if k != "base"
+        }
+        base_spec = svc_jobs.validate_job(base_payload)
+        merged = dict(base_payload)
+        merged.update(
+            {k: v for k, v in payload.items() if k != "fork"}
+        )
+        merged["fork"] = fork
+        spec = svc_jobs.validate_job(merged)
+        for field in ("trace", "policies", "weights", "seed", "gpu_sel",
+                      "norm", "dim_ext", "tune", "tune_seed", "engine"):
+            if getattr(spec, field) == getattr(base_spec, field):
+                continue
+            hint = ""
+            if field in ("weights", "policies"):
+                hint = (
+                    " — the base checkpoints' carry embeds the base's "
+                    "weight vector (blocked score summaries), so a "
+                    "weight-changing what-if can never restore warm; "
+                    "run it as its own base job"
+                )
+            raise ValueError(
+                f"fork field {field!r} differs from base "
+                f"{base_digest[:12]}… "
+                f"({getattr(spec, field)!r} != "
+                f"{getattr(base_spec, field)!r}): a warm-state fork "
+                f"replays the base bit-identically up to the divergence "
+                f"event{hint}"
+            )
+        return merged
 
     # ---- the MonitorServer app hook ----
 
@@ -206,6 +266,7 @@ class JobService:
         if self.worker is not None:
             stats["sweep_executables"] = self.worker.sweep_executables()
             stats["batches_run"] = self.worker.batches_run
+            stats["waves"] = self.worker.wave_stats()
         if self.fleet is not None:
             stats.update(self.fleet.queue_fields())
         stats["traces"] = sorted(self.traces)

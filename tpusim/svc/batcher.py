@@ -103,6 +103,25 @@ class Job:
     worker: str = ""  # owning worker id while claimed (ISSUE 12)
     lease_deadline_unix: float = 0.0  # in-memory lease mirror
     stolen: int = 0  # times this job was reclaimed from a dead worker
+    # the serving-latency instrumentation (ISSUE 16): admission ->
+    # claim -> dispatch -> result wall-clock stamps. `claimed` is set by
+    # claim_batch/claim_family, `dispatched` by the worker the moment
+    # the job's lane actually begins executing (for a continuous-
+    # batching joiner that is its wave-join boundary, not the batch
+    # claim), `finished` by mark_done/mark_failed. A steal clears the
+    # claim/dispatch stamps — the retry's latency is measured fresh.
+    claimed_unix: float = 0.0
+    dispatched_unix: float = 0.0
+
+    def kind(self) -> str:
+        """Latency-bucket vocabulary: base | fork | full | plain —
+        fork/full split by mode so the SLO gate can compare the warm
+        path against its from-event-0 twin."""
+        if self.spec.base:
+            return "base"
+        if self.spec.fork:
+            return "full" if self.spec.fork[2] == "full" else "fork"
+        return "plain"
 
     def describe(self) -> dict:
         """The GET /jobs/<id> document."""
@@ -125,6 +144,19 @@ class Job:
             out["stolen"] = self.stolen
         if self.error:
             out["error"] = self.error
+        # per-job latency ladder (ISSUE 16): every stamp that exists,
+        # plus the end-to-end admission->result latency once terminal
+        out["submitted_unix"] = self.submitted_unix
+        if self.claimed_unix:
+            out["claimed_unix"] = self.claimed_unix
+            out["claim_latency_s"] = (
+                self.claimed_unix - self.submitted_unix
+            )
+        if self.dispatched_unix:
+            out["dispatched_unix"] = self.dispatched_unix
+        if self.finished_unix:
+            out["finished_unix"] = self.finished_unix
+            out["latency_s"] = self.finished_unix - self.submitted_unix
         return out
 
 
@@ -166,6 +198,11 @@ class JobQueue:
             "quota_rejected": 0, "steals": 0, "lease_expired": 0,
             "dup_completions": 0,
         }
+        # admission->result latency samples per job kind (ISSUE 16):
+        # bounded ring per bucket, fed by mark_done (cached dedup hits
+        # never ran, so they never sample); /queue serves p50/p99
+        self._latency: Dict[str, List[float]] = {}
+        self._latency_cap = 1024
 
     # ---- submission / lookup ----
 
@@ -268,14 +305,45 @@ class JobQueue:
             taken = set(id(j) for j in batch)
             self._queue = [j for j in self._queue if id(j) not in taken]
             self._batches += 1
-            lease_deadline = (now if now is not None else time.time()) \
-                + self.lease_s
+            claim_t = now if now is not None else time.time()
+            lease_deadline = claim_t + self.lease_s
             for lane, job in enumerate(batch):
                 job.status = "batched"
                 job.batch = self._batches
                 job.lane = lane
                 job.worker = str(worker)
                 job.lease_deadline_unix = lease_deadline
+                job.claimed_unix = claim_t
+            self._cond.notify_all()
+            return batch
+
+    def claim_family(self, worker: str, family_key,
+                     max_n: int = 0,
+                     now: Optional[float] = None) -> List[Job]:
+        """Non-blocking targeted claim: up to max_n QUEUED jobs of ONE
+        family, FIFO order — the continuous-batching join path
+        (ISSUE 16): a worker whose wave for this family is running
+        polls at every chunk boundary, and late arrivals replace
+        padding lanes instead of waiting for the wave to drain. Same
+        ownership/lease stamping as claim_batch."""
+        if max_n <= 0:
+            return []
+        with self._cond:
+            batch = [
+                j for j in self._queue
+                if j.spec.family_key() == family_key
+            ][: int(max_n)]
+            if not batch:
+                return []
+            taken = set(id(j) for j in batch)
+            self._queue = [j for j in self._queue if id(j) not in taken]
+            claim_t = now if now is not None else time.time()
+            lease_deadline = claim_t + self.lease_s
+            for job in batch:
+                job.status = "batched"
+                job.worker = str(worker)
+                job.lease_deadline_unix = lease_deadline
+                job.claimed_unix = claim_t
             self._cond.notify_all()
             return batch
 
@@ -315,6 +383,8 @@ class JobQueue:
                 job.batch = -1
                 job.lane = -1
                 job.stolen += 1
+                job.claimed_unix = 0.0
+                job.dispatched_unix = 0.0
             self.stats_counters["lease_expired"] += len(stolen)
             self.stats_counters["steals"] += len(stolen)
             self._queue = stolen + self._queue
@@ -364,6 +434,8 @@ class JobQueue:
                 job.batch = -1
                 job.lane = -1
                 job.stolen += 1
+                job.claimed_unix = 0.0
+                job.dispatched_unix = 0.0
             self.stats_counters["steals"] += len(held)
             self._queue = held + self._queue
             self._cond.notify_all()
@@ -386,6 +458,7 @@ class JobQueue:
                 job.status = "batched"
                 job.worker = str(worker)
                 job.lease_deadline_unix = float(deadline_unix)
+                job.claimed_unix = time.time()
                 claimed.append(job)
             return claimed
 
@@ -422,6 +495,10 @@ class JobQueue:
             job.lease_deadline_unix = 0.0
             job.finished_unix = time.time()
             self.stats_counters["done"] += 1
+            samples = self._latency.setdefault(job.kind(), [])
+            samples.append(job.finished_unix - job.submitted_unix)
+            if len(samples) > self._latency_cap:
+                del samples[: len(samples) - self._latency_cap]
 
     def mark_failed(self, job: Job, error: str) -> None:
         with self._cond:
@@ -453,8 +530,32 @@ class JobQueue:
                 out[label] = out.get(label, 0) + 1
             return out
 
+    def latency_percentiles(self) -> Dict[str, dict]:
+        """{kind: {count, p50_s, p99_s}} over the bounded admission->
+        result sample rings — the /queue latency view and the
+        serve-latency gate's SLO input (nearest-rank percentiles, so
+        small smoke samples are exact, not interpolated)."""
+        with self._cond:
+            out: Dict[str, dict] = {}
+            for kind, samples in self._latency.items():
+                if not samples:
+                    continue
+                s = sorted(samples)
+                n = len(s)
+
+                def _pct(q):
+                    return s[min(n - 1, max(0, int(q * n + 0.999999) - 1))]
+
+                out[kind] = {
+                    "count": n,
+                    "p50_s": _pct(0.50),
+                    "p99_s": _pct(0.99),
+                }
+            return out
+
     def stats(self) -> dict:
         fams = self.family_depths()
+        lat = self.latency_percentiles()
         with self._cond:
             return {
                 "depth": len(self._queue),
@@ -464,6 +565,7 @@ class JobQueue:
                 "family_quota": self.family_quota,
                 "families": fams,
                 "lease_s": self.lease_s,
+                "latency": lat,
                 **self.stats_counters,
             }
 

@@ -247,15 +247,18 @@ def format_results_table(results: Sequence[dict]) -> str:
 
 
 def submit_and_wait(url: str, docs: Sequence[dict], timeout: float = 300.0,
-                    out=None) -> List[dict]:
+                    out=None, poll_s: float = 0.0) -> List[dict]:
     """The whole `tpusim submit` flow: POST (with backpressure retries),
     poll to terminal, fetch results. When any job failed server-side,
     raises JobsFailed carrying BOTH the failure descriptions and the
     done jobs' fetched results — the caller can report partial success
-    and must exit nonzero."""
+    and must exit nonzero. `poll_s > 0` caps the inter-poll delay — the
+    knob latency-sensitive interactive what-if clients (and the serve-
+    latency gate) use so a millisecond-scale warm fork is not measured
+    through a second-scale poll schedule."""
     accepted = submit_jobs(url, docs, out=out)
     ids = [a["id"] for a in accepted]
-    final = wait_jobs(url, ids, timeout=timeout)
+    final = wait_jobs(url, ids, timeout=timeout, poll_s=poll_s)
     failed = [d for d in final if d["status"] == "failed"]
     if failed:
         done_ids = [d["id"] for d in final if d["status"] == "done"]

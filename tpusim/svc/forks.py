@@ -1,0 +1,106 @@
+"""Checkpoint-keyed fork index of the what-if serving plane (ISSUE 16).
+
+A BASE job ({"base": true, ...}) advances its trace once through the
+chunked table path with `checkpoint_keep=-1`, leaving every mid-trace
+carry on disk as a content-addressed checkpoint (the PR 2 discipline:
+`<run digest>.e<cursor>.ckpt.npz`). This module persists the small
+durable record that makes those checkpoints *discoverable* by later
+fork jobs — the fork index entry:
+
+  <base job digest>.base.json     (digest-signed JSON, atomic write)
+
+mapping the base JOB digest (the handle clients hold) to the base RUN
+digest (the content key the checkpoint files are named under), plus the
+replay geometry a fork needs to reproduce the base's padded shapes
+(events, pods, checkpoint_every) and the base's full spec payload — the
+vocabulary the serving endpoint merges into fork submissions so a fork
+is BY CONSTRUCTION the same replay as its base up to the divergence
+event (same trace, policies, weights, seed, knobs). A fork that tries
+to change weights changes operand bytes, changes the run digest, and
+finds no checkpoint — the index makes that rejection loud at submit
+time instead of a silent cold replay.
+
+Entries are tiny, content-addressed, and idempotent to rewrite; a torn
+or foreign entry is deleted and treated as missing (the base run can
+always be re-submitted — content addressing makes recomputation safe).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+BASE_SCHEMA = "tpusim-svc-base/1"
+BASE_SUFFIX = ".base.json"
+
+# svc checkpoint landing zone, shared by base writers and fork readers
+CHECKPOINT_SUBDIR = "checkpoints"
+
+
+def checkpoint_dir(artifact_dir: str) -> str:
+    return os.path.join(artifact_dir, CHECKPOINT_SUBDIR)
+
+
+def base_entry_path(artifact_dir: str, digest: str) -> str:
+    return os.path.join(artifact_dir, f"{digest}{BASE_SUFFIX}")
+
+
+def write_base_entry(artifact_dir: str, digest: str, run_digest: str,
+                     every: int, events: int, pods: int,
+                     spec_payload: dict) -> str:
+    """Persist one finished base run's fork-index entry (atomic,
+    signed). `digest` is the base JOB digest; `run_digest` is the
+    driver's content key its checkpoint files are named under."""
+    from tpusim.io.storage import write_signed_json
+
+    os.makedirs(artifact_dir, exist_ok=True)
+    return write_signed_json(
+        base_entry_path(artifact_dir, digest),
+        {"schema": BASE_SCHEMA, "job": digest},
+        {
+            "run_digest": str(run_digest),
+            "checkpoint_every": int(every),
+            "events": int(events),
+            "pods": int(pods),
+            "spec": spec_payload,
+        },
+    )
+
+
+def load_base_entry(artifact_dir: str, digest: str) -> Optional[dict]:
+    """The fork-index entry for a base JOB digest, or None. Torn /
+    foreign / digest-mismatched files are deleted and treated as
+    missing — the serving endpoint then answers 400 ("base not
+    finished") and the client re-runs the base."""
+    from tpusim.io.storage import read_signed_json
+
+    path = base_entry_path(artifact_dir, digest)
+    if not os.path.isfile(path):
+        return None
+    try:
+        header, doc = read_signed_json(path, BASE_SCHEMA)
+        if (header.get("job") != digest or not isinstance(doc, dict)
+                or not isinstance(doc.get("spec"), dict)
+                or not doc.get("run_digest")):
+            raise ValueError("foreign or malformed base entry")
+        return doc
+    except (OSError, ValueError):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+
+
+def nearest_checkpoint(ck_dir: str, run_digest: str,
+                       fork_event: int) -> Optional[int]:
+    """Cursor of the newest persisted base checkpoint at-or-before the
+    divergence event, or None — the fork index's core lookup. Purely a
+    directory listing: no file is opened, nothing is deleted (torn
+    files are the LOADER's problem, and the loader walks back)."""
+    from tpusim.io.storage import iter_checkpoints
+
+    for cursor, _ in iter_checkpoints(ck_dir, run_digest):
+        if cursor <= int(fork_event):
+            return cursor
+    return None

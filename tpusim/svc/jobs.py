@@ -43,8 +43,22 @@ ENGINES = ("auto", "table", "sequential")
 # (a typo'd "wieghts" must not silently become a default-weight replay)
 JOB_KEYS = frozenset((
     "trace", "policies", "weights", "seed", "gpu_sel", "norm", "dim_ext",
-    "tune", "tune_seed", "engine", "fault",
+    "tune", "tune_seed", "engine", "fault", "base", "fork",
 ))
+
+# the per-job fork document's vocabulary (ISSUE 16): a what-if job that
+# shares a BASE run's history up to a divergence event and replays only
+# its own tail from the nearest persisted carry. `mode="full"` forces
+# the from-event-0 replay of the SAME divergent stream — the A/B twin
+# the latency SLO and bit-identity checks compare against (a distinct
+# digest, so the comparison is never answered from the fork's cache).
+FORK_FIELDS = ("base", "event", "tail", "mode")
+FORK_MODES = ("fork", "full")
+
+# tail event kinds a fork may inject — EV_CREATE/EV_DELETE from
+# tpusim.sim.engine, spelled as ints so validation stays jax-free
+# (pinned against the engine constants by tests/test_fork.py)
+FORK_EV_KINDS = (0, 1)
 
 # the per-job fault document's vocabulary == FaultConfig's fields
 # (tpusim.sim.faults); canonical order for the spec tuple
@@ -75,6 +89,15 @@ class JobSpec:
     # order, or () for a fault-free replay. A sweep OPERAND like
     # weights/seed/tune — fault jobs batch onto one compiled chaos scan.
     fault: Tuple = ()
+    # base-run flag (ISSUE 16): advance this trace ONCE through the
+    # chunked table path, persisting every mid-trace carry as a fork
+    # source — the warm state that what-if forks restore from.
+    base: bool = False
+    # fork what-if (ISSUE 16): (base job digest, divergence event,
+    # mode, ((kind, pod), ...) tail), or () for a plain replay. The
+    # base digest keys the family so fork waves share one compiled
+    # chunk; mode "full" pins the from-event-0 A/B twin.
+    fork: Tuple = ()
 
     def family_key(self) -> tuple:
         """Batching compatibility key — everything that shapes the
@@ -85,31 +108,52 @@ class JobSpec:
         ones (the fault build is a different jaxpr). The tune pinning
         fault batches used to carry is gone (ISSUE 12): the merged
         fault stream is a per-lane operand of the multi-trace sweep, so
-        mixed fault/tune/weight jobs ride one compiled scan."""
+        mixed fault/tune/weight jobs ride one compiled scan.
+
+        Fork jobs (ISSUE 16) batch per BASE run — their lanes share the
+        base's restored carry and padded geometry, so the base digest
+        joins the key (mode does not: the "full" A/B twin rides the
+        same wave). Base jobs run standalone chunked replays, never a
+        sweep, so each is its own family. Plain jobs keep the exact
+        historical 7-tuple (`+ ()` is identity)."""
+        marker: tuple = ()
+        if self.fork:
+            marker = (("fork", self.fork[0]),)
+        elif self.base:
+            marker = (("base",),)
         return (
             self.trace, tuple(n for n, _ in self.policies),
             self.gpu_sel, self.norm, self.dim_ext, self.engine,
             bool(self.fault),
-        )
+        ) + marker
 
     def family_label(self) -> str:
         """Human/JSON-friendly rendering of family_key — the per-family
         admission-quota surface in /queue and the QuotaFull 429 body
         (ISSUE 12)."""
-        return "|".join((
+        parts = [
             self.trace, "+".join(n for n, _ in self.policies),
             self.gpu_sel, self.norm, self.dim_ext, self.engine,
             "fault" if self.fault else "nofault",
-        ))
+        ]
+        if self.fork:
+            parts.append(f"fork:{str(self.fork[0])[:12]}")
+        elif self.base:
+            parts.append("base")
+        return "|".join(parts)
 
     def canonical(self) -> tuple:
         """The digest's canonical form: every field, deterministic order,
-        tune as a repr-stable float."""
+        tune as a repr-stable float. base/fork markers append only when
+        set — every pre-ISSUE-16 job digest (and its cached result) is
+        unchanged."""
         return (
             self.trace, self.policies, self.weights, self.seed,
             self.gpu_sel, self.norm, self.dim_ext, float(self.tune),
             self.tune_seed, self.engine,
-        ) + ((self.fault,) if self.fault else ())
+        ) + ((self.fault,) if self.fault else ()) \
+          + (("base",) if self.base else ()) \
+          + ((("fork",) + self.fork,) if self.fork else ())
 
     def fault_config(self):
         """The job's FaultConfig, or None for a fault-free replay."""
@@ -238,8 +282,34 @@ def validate_job(payload: dict) -> JobSpec:
             for f in FAULT_FIELDS
         )
 
+    base = payload.get("base", False)
+    if not isinstance(base, bool):
+        raise ValueError(f"base must be a boolean, got {base!r}")
+    fork = payload.get("fork")
+    fork_t: Tuple = ()
+    if fork is not None:
+        fork_t = _validate_fork(fork)
+    if base and fork_t:
+        raise ValueError(
+            "base excludes fork: a base run IS the shared history forks "
+            "restore from — fork it in a second job"
+        )
+    if (base or fork_t) and fault_t:
+        raise ValueError(
+            "base/fork exclude fault: the fault lane's retry carry has "
+            "no checkpoint surface yet — run fault what-ifs as plain "
+            "jobs"
+        )
+    if (base or fork_t) and engine == "sequential":
+        raise ValueError(
+            "base/fork need the chunked carry surface — engine must be "
+            "auto or table, not sequential"
+        )
+
     return JobSpec(
         fault=fault_t,
+        base=base,
+        fork=fork_t,
         trace=str(payload.get("trace", "default")),
         policies=tuple(policies),
         weights=weights,
@@ -293,6 +363,64 @@ def _as_int(v, what: str) -> int:
     return int(v)
 
 
+def _validate_fork(fork) -> Tuple:
+    """Fork document -> the canonical fork tuple
+    (base_digest, event, mode, ((kind, pod), ...)), failing loudly."""
+    if not isinstance(fork, dict):
+        raise ValueError(
+            f"fork must be an object of {{{', '.join(FORK_FIELDS)}}}, "
+            f"got {fork!r}"
+        )
+    unknown = set(fork) - set(FORK_FIELDS)
+    if unknown:
+        raise ValueError(
+            f"unknown fork key(s) {sorted(unknown)} (known: "
+            f"{sorted(FORK_FIELDS)})"
+        )
+    base = fork.get("base")
+    if (not isinstance(base, str) or len(base) != 64
+            or any(c not in "0123456789abcdef" for c in base)):
+        raise ValueError(
+            "fork.base must be the 64-hex job digest of a FINISHED base "
+            f"run (POST {{'base': true, ...}} first), got {base!r}"
+        )
+    event = _as_int(fork.get("event"), "fork.event")
+    if event < 0:
+        raise ValueError(f"fork.event must be >= 0, got {event}")
+    mode = fork.get("mode", "fork")
+    if mode not in FORK_MODES:
+        raise ValueError(
+            f"fork.mode must be one of {FORK_MODES} (fork = warm tail "
+            f"replay, full = forced from-event-0 twin), got {mode!r}"
+        )
+    tail = fork.get("tail")
+    if not isinstance(tail, (list, tuple)) or not tail:
+        raise ValueError(
+            "fork.tail must be a non-empty list of [kind, pod] pairs "
+            f"(kind {FORK_EV_KINDS[0]} = create, {FORK_EV_KINDS[1]} = "
+            f"delete), got {tail!r}"
+        )
+    tail_t = []
+    for i, pair in enumerate(tail):
+        if not isinstance(pair, (list, tuple)) or len(pair) != 2:
+            raise ValueError(
+                f"fork.tail[{i}] must be a [kind, pod] pair, got {pair!r}"
+            )
+        kind = _as_int(pair[0], f"fork.tail[{i}] kind")
+        pod = _as_int(pair[1], f"fork.tail[{i}] pod")
+        if kind not in FORK_EV_KINDS:
+            raise ValueError(
+                f"fork.tail[{i}] kind must be one of {FORK_EV_KINDS} "
+                f"(create/delete), got {kind}"
+            )
+        if pod < 0:
+            raise ValueError(
+                f"fork.tail[{i}] pod must be >= 0, got {pod}"
+            )
+        tail_t.append((kind, pod))
+    return (base, event, mode, tuple(tail_t))
+
+
 def spec_to_payload(spec: JobSpec) -> dict:
     """JobSpec -> the job document that validates back to the IDENTICAL
     spec (and therefore digest) — the fleet claim handshake's wire form
@@ -317,6 +445,15 @@ def spec_to_payload(spec: JobSpec) -> dict:
         doc["fault"] = {
             f: (float(v) if f.endswith("_events") else int(v))
             for f, v in zip(FAULT_FIELDS, spec.fault)
+        }
+    if spec.base:
+        doc["base"] = True
+    if spec.fork:
+        doc["fork"] = {
+            "base": spec.fork[0],
+            "event": int(spec.fork[1]),
+            "mode": spec.fork[2],
+            "tail": [[int(k), int(p)] for k, p in spec.fork[3]],
         }
     return doc
 

@@ -1,0 +1,233 @@
+"""Continuous batching for warm-state what-if jobs (ISSUE 16).
+
+A ForkWave serves every fork job of one (family, base run) pair through
+the driver's ChunkWave: B lanes step through the donated `run_chunk`
+twin together, one vmapped dispatch per chunk, and — the continuous
+part — a job that arrives while the wave is running JOINS at the next
+chunk boundary by replacing a free (padding) lane via the scatter
+entry, instead of waiting for the wave to drain. Lanes finish
+independently (a fork that diverges late replays a longer tail than one
+that diverges early), so results stream out per lane the moment that
+lane's events are consumed — the admission→result latency of a short
+fork is its own tail-replay time, not the wave's.
+
+Per-lane bookkeeping lives here, host-side: the event cursor, the inert
+EV_SKIP pad count (corrects the skip counter exactly like the sweep
+path's bucket-padding correction), join timestamps for the latency
+instrumentation, and tail-relative progress ticks (a forked job's
+/progress reports ITS tail's events/s and ETA, never the base run's
+clock). The numeric work — restore, step, scatter, finish, and the
+bit-identity discipline that makes a warm fork byte-equal to its
+from-event-0 replay — is the driver's (sim.driver.ChunkWave).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class ForkWave:
+    """One family's continuous-batching wave (see module docstring)."""
+
+    def __init__(self, wave, monitor=None, out=None):
+        self.wave = wave  # sim.driver.ChunkWave
+        self.monitor = monitor
+        self.out = out
+        self.waves_run = 0  # completed serve() calls (join waves ride one)
+        self.joins = 0  # jobs that joined a RUNNING wave at a boundary
+        self.degrades = 0  # forks that fell back to full replay
+
+    # ---- lane construction ----
+
+    def _lane_for(self, job) -> dict:
+        """Per-lane host state for one fork job: its divergent stream,
+        its starting carry (restored warm for mode 'fork', event-0 cold
+        for mode 'full' or on degrade), and the counters the result
+        document needs."""
+        base_digest, fork_event, mode, tail = job.spec.fork
+        evk, evp, real = self.wave.fork_stream(fork_event, tail)
+        cursor, carry, degrade = 0, None, False
+        if mode == "fork":
+            found = self.wave.restore_lane(fork_event)
+            if found is not None:
+                cursor, carry = found
+            else:
+                degrade = True
+                self.degrades += 1
+                if self.out is not None:
+                    print(
+                        f"[Degrade] fork {job.digest[:12]}…: no usable "
+                        f"base checkpoint at-or-before event "
+                        f"{fork_event} — full replay from event 0",
+                        file=self.out,
+                    )
+        if carry is None:
+            carry = self.wave.init_lane()
+        return {
+            "job": job, "evk": evk, "evp": evp, "real": real,
+            "cursor": cursor, "c0": cursor, "pads": 0,
+            "degrade": degrade, "mode": mode, "base": base_digest,
+            "fork_event": int(fork_event), "carry": carry,
+            "joined": time.time(),
+        }
+
+    def _skip_chunk(self):
+        from tpusim.sim.engine import EV_SKIP
+
+        C = self.wave.chunk
+        bk = np.asarray(self.wave.base_kind)
+        bp = np.asarray(self.wave.base_pod)
+        return (np.full(C, EV_SKIP, bk.dtype), np.zeros(C, bp.dtype))
+
+    def _chunk_rows(self, lane) -> tuple:
+        """Slice lane's next chunk from its stream, padding a final
+        partial chunk with inert EV_SKIPs (tracked for the counter
+        correction)."""
+        from tpusim.sim.engine import EV_SKIP
+
+        C = self.wave.chunk
+        seg_k = lane["evk"][lane["cursor"]: lane["cursor"] + C]
+        seg_p = lane["evp"][lane["cursor"]: lane["cursor"] + C]
+        pad = C - len(seg_k)
+        if pad:
+            seg_k = np.concatenate(
+                [seg_k, np.full(pad, EV_SKIP, seg_k.dtype)]
+            )
+            seg_p = np.concatenate([seg_p, np.zeros(pad, seg_p.dtype)])
+            lane["pads"] += pad
+        return seg_k, seg_p
+
+    def _publish(self, lane, **fields) -> None:
+        if self.monitor is None:
+            return
+        # tail-relative honesty (ISSUE 16 satellite): done/total/rate
+        # count THIS fork's replayed events — the restored base prefix
+        # never inflates the rate, and the ETA is the tail's
+        executed = max(0, min(lane["cursor"], lane["real"]) - lane["c0"])
+        total = max(1, lane["real"] - lane["c0"])
+        dt = max(time.time() - lane["joined"], 1e-9)
+        rate = executed / dt
+        self.monitor.publish_job_progress(
+            lane["job"].id,
+            dict(
+                fields, phase="forking", done=executed, total=total,
+                ev_per_s=rate,
+                eta_s=(total - executed) / rate if rate > 0 else 0.0,
+                source_cursor=lane["c0"], degrade=lane["degrade"],
+                mode=lane["mode"],
+            ),
+        )
+
+    # ---- the serve loop ----
+
+    def serve(self, jobs: List, claim_more: Optional[Callable] = None,
+              on_join: Optional[Callable] = None,
+              on_done: Optional[Callable] = None) -> None:
+        """Run one continuous wave: start with `jobs` (<= lane width),
+        admit late arrivals from `claim_more(n_free)` at every chunk
+        boundary, finish lanes independently. Callbacks:
+
+          on_join(job)                    a job's lane begins stepping
+                                          (initial members AND joiners)
+          on_done(job, lane: SweepLane, meta: dict)
+                                          that job's result is final
+
+        meta carries the serving telemetry the result document and the
+        latency gate read: events_executed (<= tail + one chunk, the
+        warm-state win), events_total, source_cursor, degrade, mode.
+        """
+        from tpusim.sim.driver import lane_from_arrays
+
+        B = self.wave.lanes
+        slots: List[Optional[dict]] = [None] * B
+        pending = list(jobs)
+        for i in range(min(len(pending), B)):
+            slots[i] = self._lane_for(pending.pop(0))
+            if on_join is not None:
+                on_join(slots[i]["job"])
+            self._publish(slots[i])
+        active = [s for s in slots if s is not None]
+        if not active:
+            return
+        # free slots replicate the first lane's carry: they are stepped
+        # with EV_SKIP chunks (inert) until a joiner's scatter replaces
+        # them. Every occupied lane ENTERS via the scatter entry —
+        # initial members and boundary joiners share one code path, so
+        # the first wave primes the same executable a later join
+        # dispatches (the zero-recompile census counts joins for free).
+        filler = active[0]["carry"]
+        batch = self.wave.stack([filler] * B)
+        for i, s in enumerate(slots):
+            if s is not None:
+                batch = self.wave.scatter(batch, s["carry"], i)
+                s["carry"] = None  # the batch owns it now
+
+        while any(s is not None for s in slots):
+            ck_rows, cp_rows = [], []
+            for s in slots:
+                if s is None:
+                    k, p = self._skip_chunk()
+                else:
+                    k, p = self._chunk_rows(s)
+                ck_rows.append(k)
+                cp_rows.append(p)
+            batch = self.wave.step(
+                batch, np.stack(ck_rows), np.stack(cp_rows)
+            )
+            for i, s in enumerate(slots):
+                if s is None:
+                    continue
+                s["cursor"] = min(s["cursor"] + self.wave.chunk, s["real"])
+                if s["cursor"] >= s["real"]:
+                    st, placed, masks, failed, ctr = (
+                        self.wave.finish_lane(batch, i)
+                    )
+                    p = self.wave.p
+                    lane = lane_from_arrays(
+                        st, np.asarray(placed)[:p],
+                        np.asarray(masks)[:p], np.asarray(failed)[:p],
+                        np.asarray(ctr), self.wave.sim.typical,
+                        s["job"].spec.weights, s["job"].spec.seed,
+                        s["real"], pad_skips=s["pads"],
+                    )
+                    meta = {
+                        "events_executed": s["real"] - s["c0"],
+                        "events_total": s["real"],
+                        "source_cursor": s["c0"],
+                        "degrade": s["degrade"],
+                        "mode": s["mode"],
+                        "base": s["base"],
+                        "fork_event": s["fork_event"],
+                    }
+                    self._publish(s, phase="done")
+                    if on_done is not None:
+                        on_done(s["job"], lane, meta)
+                    slots[i] = None
+                else:
+                    self._publish(s)
+            # the chunk boundary: admit pending + late-arriving jobs
+            # into free lanes (continuous batching — a joiner replaces
+            # a padding lane via ONE scatter dispatch)
+            free = [i for i, s in enumerate(slots) if s is None]
+            if free and claim_more is not None:
+                got = claim_more(len(free) - len(pending))
+                if got:
+                    pending.extend(got)
+            while free and pending:
+                i = free.pop(0)
+                s = self._lane_for(pending.pop(0))
+                batch = self.wave.scatter(batch, s["carry"], i)
+                s["carry"] = None
+                slots[i] = s
+                if any(x is not None and x is not s for x in slots):
+                    self.joins += 1
+                if on_join is not None:
+                    on_join(s["job"])
+                self._publish(s)
+        self.waves_run += 1
+
+    def executables(self) -> int:
+        return self.wave.executables()

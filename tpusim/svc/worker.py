@@ -34,6 +34,7 @@ A batch that raises marks its jobs failed and the worker keeps serving
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from dataclasses import dataclass
@@ -277,6 +278,7 @@ class Worker:
         self._sims: dict = {}  # family_key -> Simulator
         self._shape_hw: dict = {}  # family_key -> (max pods, max events)
         self._sweep_fns: set = set()  # jitted sweep wrappers dispatched
+        self._waves: dict = {}  # family_key -> svc.waves.ForkWave
         self.batches_run = 0
         self.last_dispatch_s = 0.0  # wall of the newest run_batch
         self.first_dispatch_s = 0.0  # wall of the FIRST (compile) batch
@@ -356,12 +358,18 @@ class Worker:
     # ---- the batch dispatch ----
 
     def run_batch(self, batch: List[Job]) -> None:
-        """Serve one compatible batch through a single vmapped sweep,
-        under the lease protocol (ISSUE 12): signed lease files are
-        staked before dispatch, renewed while the scan runs (heartbeat
-        ticks + the fallback timer), and released on completion — a
-        `kill -9` mid-batch leaves expired leases any live worker can
-        steal. Public so smoke/tests can drive it synchronously."""
+        """Serve one compatible batch, under the lease protocol
+        (ISSUE 12): signed lease files are staked before dispatch,
+        renewed while the scan runs (heartbeat ticks + the fallback
+        timer), and released on completion — a `kill -9` mid-batch
+        leaves expired leases any live worker can steal. Three routes
+        (family keys keep them unmixed): base jobs advance their trace
+        once through the chunked path and persist the checkpoint ladder
+        + fork-index entry; fork/full jobs ride the family's continuous
+        ForkWave (late arrivals JOIN it at chunk boundaries, so
+        all_jobs can outgrow the claimed batch); everything else is the
+        vmapped sweep. Public so smoke/tests can drive it
+        synchronously."""
         self.queue.mark_running(batch)
         self._publish(batch, phase="running")
         members = [j.digest for j in batch]
@@ -373,33 +381,57 @@ class Worker:
                 stake_cb=self.lease_stake_cb,
                 release_cb=self.lease_release_cb,
             ).start()
+        all_jobs = list(batch)  # grows when joiners enter a fork wave
         t0 = time.perf_counter()
         try:
-            lanes = self._dispatch(batch)
+            if batch[0].spec.base:
+                for job in batch:
+                    job.dispatched_unix = time.time()
+                    self._run_base(job)
+            elif batch[0].spec.fork:
+                self._run_fork_wave(batch, keeper, all_jobs)
+            else:
+                now = time.time()
+                for job in batch:
+                    job.dispatched_unix = now
+                lanes = self._dispatch(batch)
+                for job, lane in zip(batch, lanes):
+                    self._complete(job, lane)
         except Exception as err:  # poisoned family: fail the jobs, live on
             msg = f"{type(err).__name__}: {err}"
-            for job in batch:
+            undone = [j for j in all_jobs if j.status != "done"]
+            for job in undone:
                 self.queue.mark_failed(job, msg)
                 # terminal: drop the persisted spec so restart recovery
                 # does not re-run the poisoned batch forever
                 svc_jobs.delete_job_spec(self.artifact_dir, job.digest)
             if keeper is not None:
                 keeper.stop(release=True)
-            self._publish(batch, phase="failed", error=msg)
+            self._publish(undone, phase="failed", error=msg)
             return
         self.last_dispatch_s = time.perf_counter() - t0
         if self.batches_run == 0:
             self.first_dispatch_s = self.last_dispatch_s
-        for job, lane in zip(batch, lanes):
-            result = summarize_lane(lane, job)
-            svc_jobs.write_result(self.artifact_dir, job.digest, result)
-            self.queue.mark_done(job, result)
-            # terminal: the signed result is the durable record now
-            svc_jobs.delete_job_spec(self.artifact_dir, job.digest)
         if keeper is not None:
             keeper.stop(release=True)
         self.batches_run += 1
-        self._publish(batch, phase="done")
+        self._publish(all_jobs, phase="done")
+
+    def _complete(self, job: Job, lane, fork_meta: Optional[dict] = None,
+                  base_meta: Optional[dict] = None) -> None:
+        """One job's terminal bookkeeping: summarize, persist the signed
+        result, mark done, drop the spec. Fork/base serving telemetry
+        rides the result document (`result["fork"]` / `result["base_run"]`
+        — what the latency gate and what-if clients read)."""
+        result = summarize_lane(lane, job)
+        if fork_meta is not None:
+            result["fork"] = dict(fork_meta)
+        if base_meta is not None:
+            result["base_run"] = dict(base_meta)
+        svc_jobs.write_result(self.artifact_dir, job.digest, result)
+        self.queue.mark_done(job, result)
+        # terminal: the signed result is the durable record now
+        svc_jobs.delete_job_spec(self.artifact_dir, job.digest)
 
     def _dispatch(self, batch: List[Job]):
         """ONE dispatch path for fault-free AND fault batches (the
@@ -483,7 +515,190 @@ class Worker:
         self._sweep_fns.add(sim._last_sweep_fn)
         return lanes
 
+    # ---- the warm-state serving plane (ISSUE 16) ----
+
+    def _chunked_sim(self, job: Job):
+        """The exact-replay Simulator a base run or fork wave executes
+        on. Unlike the sweep cache (weights/seeds are vmap operands
+        there), the chunked path bakes THIS job's weights into
+        cfg.policies and THIS job's seed into cfg.seed — both feed the
+        run digest its checkpoints are content-addressed under, which
+        is precisely how a weight-changing fork can never match a base
+        checkpoint. Cached per (family, weights, seed); forks of one
+        base all share one entry because the fork index pins their
+        weights/seed to the base's."""
+        from tpusim.sim.driver import Simulator, SimulatorConfig
+        from tpusim.svc import forks as svc_forks
+
+        spec = job.spec
+        key = (spec.family_key(), tuple(spec.weights), int(spec.seed))
+        sim = self._sims.get(key)
+        if sim is None:
+            trace = self.traces[spec.trace]
+            cfg = SimulatorConfig(
+                policies=tuple(
+                    (name, int(w))
+                    for (name, _), w in zip(spec.policies, spec.weights)
+                ),
+                gpu_sel_method=spec.gpu_sel,
+                norm_method=spec.norm,
+                dim_ext_method=spec.dim_ext,
+                # forced off "auto": only the table engine has the
+                # chunked carry surface the checkpoint ladder rides
+                engine="table",
+                report_per_event=False,
+                shuffle_pod=False,
+                seed=int(spec.seed),
+                table_cache_dir=self.table_cache_dir,
+                checkpoint_dir=svc_forks.checkpoint_dir(self.artifact_dir),
+                checkpoint_keep=-1,  # base ladders must survive the run
+            )
+            sim = Simulator(trace.nodes, cfg)
+            sim.set_workload_pods(trace.pods)
+            sim.set_typical_pods()
+            self._sims[key] = sim
+        sim._hb_worker = self.worker_id
+        sim._hb_job = job.id
+        return sim
+
+    def _checkpoint_every(self, events: int) -> int:
+        """Base-run chunk length: ~32 rungs across the trace, capped at
+        the serving bucket. The fork latency bound is `tail + one
+        chunk`, so shorter chunks mean warmer forks AND more wave steps
+        for a full replay — the p99 separation the latency gate
+        enforces; 32 keeps the per-base checkpoint count (and the base
+        run's write overhead) modest."""
+        return max(1, min(self.bucket, -(-int(events) // 32)))
+
+    def _run_base(self, job: Job) -> None:
+        """Advance one base trace through the chunked table path,
+        persisting every mid-trace carry (checkpoint_keep=-1) and the
+        fork-index entry that makes the ladder discoverable."""
+        from tpusim.io.trace import build_events
+        from tpusim.sim.driver import _bucket_sizes, lane_from_run
+        from tpusim.svc import forks as svc_forks
+
+        spec = job.spec
+        sim = self._chunked_sim(job)
+        prep = sim.prepare_pods(
+            tuning_ratio=spec.tune, tuning_seed=spec.tune_seed
+        )
+        e = len(build_events(prep, sim.cfg.use_timestamps)[0])
+        sim.cfg.checkpoint_every = self._checkpoint_every(e)
+        sim._reset_run_state()
+        sim.schedule_pods(prep)
+        p = len(prep)
+        # the replay padded events up to the bucket geometry: correct
+        # the skip counter exactly like the sweep path does
+        _, e2 = _bucket_sizes(p, e, 512)
+        lane = lane_from_run(
+            sim, spec.weights, spec.seed, pad_skips=e2 - e
+        )
+        svc_forks.write_base_entry(
+            self.artifact_dir, job.digest, sim.last_run_digest,
+            sim.cfg.checkpoint_every, e, p,
+            svc_jobs.spec_to_payload(spec),
+        )
+        meta = {
+            "run_digest": str(sim.last_run_digest),
+            "checkpoint_every": int(sim.cfg.checkpoint_every),
+            "events": int(e),
+            "pods": int(p),
+        }
+        self._complete(job, lane, base_meta=meta)
+
+    def _fork_wave_for(self, job: Job):
+        """The family's ForkWave (one ChunkWave = three jitted entries,
+        shared by every fork of the base — the zero-recompile census).
+        The chunk length comes from the base's fork-index entry so lane
+        restore cursors land exactly on the base ladder's rungs; a
+        missing entry (fleet worker without the coordinator's artifact
+        dir) falls back to the same derivation the base used — forks
+        then degrade per-lane to full replay, loudly."""
+        from tpusim.sim.driver import ChunkWave
+        from tpusim.svc import forks as svc_forks
+        from tpusim.svc.waves import ForkWave
+
+        key = job.spec.family_key()
+        fw = self._waves.get(key)
+        if fw is None:
+            spec = job.spec
+            sim = self._chunked_sim(job)
+            prep = sim.prepare_pods(
+                tuning_ratio=spec.tune, tuning_seed=spec.tune_seed
+            )
+            entry = svc_forks.load_base_entry(
+                self.artifact_dir, spec.fork[0]
+            )
+            if entry is not None:
+                chunk = int(entry["checkpoint_every"])
+            else:
+                from tpusim.io.trace import build_events
+
+                e = len(build_events(prep, sim.cfg.use_timestamps)[0])
+                chunk = self._checkpoint_every(e)
+            wave = ChunkWave(
+                sim, prep, lanes=self.queue.lane_width, chunk=chunk
+            )
+            fw = ForkWave(wave, monitor=self.monitor, out=sys.stderr)
+            self._waves[key] = fw
+        return fw
+
+    def _run_fork_wave(self, batch: List[Job], keeper,
+                       all_jobs: List[Job]) -> None:
+        """Serve one fork family's batch through its continuous
+        ForkWave: claimed jobs fill lanes, and at every chunk boundary
+        the wave pulls MORE queued jobs of the family off the queue
+        (claim_family) — the late arrival joins the running wave instead
+        of waiting behind it. Joiners enter the lease set (and all_jobs,
+        so the poisoned-batch path fails them too)."""
+        fw = self._fork_wave_for(batch[0])
+        fw.wave.sim._hb_job = batch[0].id
+        key = batch[0].spec.family_key()
+
+        def claim_more(n: int) -> List[Job]:
+            if n <= 0:
+                return []
+            got = self.queue.claim_family(self.worker_id, key, n)
+            if got:
+                self.queue.mark_running(got)
+                all_jobs.extend(got)
+                if keeper is not None:
+                    keeper.members.extend(j.digest for j in got)
+                    keeper.renew_now()
+            return got
+
+        def on_join(job: Job) -> None:
+            if not job.dispatched_unix:
+                job.dispatched_unix = time.time()
+            self._publish([job], phase="running")
+
+        def on_done(job: Job, lane, meta: dict) -> None:
+            self._complete(job, lane, fork_meta=meta)
+
+        fw.serve(
+            batch, claim_more=claim_more, on_join=on_join,
+            on_done=on_done,
+        )
+
     # ---- introspection ----
+
+    def wave_executables(self) -> int:
+        """Compiled executables across every ForkWave served (step +
+        scatter + finish per family) — stable across fork waves AND
+        boundary joins, the serve-latency gate's zero-recompile
+        check."""
+        return sum(fw.executables() for fw in self._waves.values())
+
+    def wave_stats(self) -> dict:
+        """Continuous-batching counters for /queue."""
+        return {
+            "families": len(self._waves),
+            "waves_run": sum(f.waves_run for f in self._waves.values()),
+            "joins": sum(f.joins for f in self._waves.values()),
+            "degrades": sum(f.degrades for f in self._waves.values()),
+            "executables": self.wave_executables(),
+        }
 
     def sweep_executables(self) -> int:
         """Compiled sweep executables across every family served — the
