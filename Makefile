@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke serve-latency-smoke tune-smoke policy-smoke pallas-hbm-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke serve-latency-smoke tune-smoke policy-smoke pallas-hbm-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke fleet-ha-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_fork.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_svc_fork.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_transfer.py tests/test_supervisor.py tests/test_policy_learned.py tests/test_blocked_engine.py tests/test_pallas_hbm.py tests/test_table_engine.py tests/test_parallel.py tests/test_pallas_engine.py tests/test_batch.py tests/test_kube_client.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_deschedule.py tests/test_fork.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_svc_fork.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_ha.py tests/test_transfer.py tests/test_supervisor.py tests/test_policy_learned.py tests/test_blocked_engine.py tests/test_pallas_hbm.py tests/test_table_engine.py tests/test_parallel.py tests/test_pallas_engine.py tests/test_batch.py tests/test_kube_client.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -179,6 +179,19 @@ fleet-chaos-smoke:
 fleet-wan-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --fleet-wan-only
 
+# fleet-ha smoke (ENGINES.md "Round 21"): coordinator failover end to
+# end — a token-armed leader + standby CLI pair sharing one artifact
+# dir, two workers joined against BOTH urls, jobs submitted through
+# the failover client, then `kill -9` of the LEADER while leases are
+# held mid-batch. Hard checks: the standby promotes at a bumped epoch
+# (role/epoch live on /healthz), workers re-register and finish 100%
+# of jobs with per-file byte identity vs a single-coordinator
+# reference, a stale-epoch op answers 409, every mutating endpoint
+# rejects missing/forged tokens with 401, the resurrected old leader
+# fences itself to standby, and token material never reaches /queue.
+fleet-ha-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --fleet-ha-only
+
 # bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
 # measurement under profiling and diff it against the newest committed
 # BENCH_r*.json baseline — exact on events/placements/gpu_alloc
@@ -200,7 +213,10 @@ fleet-wan-smoke:
 # byte-identical results, warm-joiner compile skip), and the wide-area
 # fleet (ISSUE 13, the fleet-wan-smoke check: no-shared-fs workers
 # under injected transfer faults, supervisor respawn, circuit
-# breaker). Exit 1 on regression; artifacts land in .tpusim_obs/.
+# breaker), and coordinator HA (ISSUE 17, the fleet-ha-smoke check:
+# kill -9 the leader mid-batch, epoch-fenced standby takeover, auth
+# probes, byte-identity vs a single-coordinator reference). Exit 1 on
+# regression; artifacts land in .tpusim_obs/.
 bench-gate:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate
 

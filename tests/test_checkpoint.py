@@ -133,10 +133,16 @@ def _run_driver(nodes, pods, every, ckdir, mesh=0, seed=42):
     return sim, out
 
 
+@pytest.mark.slow
 def test_driver_chunked_matches_plain(tmp_path):
     """checkpoint_every routes run_events through the chunked dispatch with
     results — including the reconstructed metric series — byte-identical
-    to the unsegmented scan, and completed runs leave no files behind."""
+    to the unsegmented scan, and completed runs leave no files behind.
+
+    resume-smoke only (ISSUE 17 tier-1 buyback): every assertion here is
+    a strict subset of test_kill_and_resume_bit_identity's (same inputs,
+    same chunked-vs-plain compare, same metric series, same empty-dir
+    prune check) — tier-1 keeps that one as the representative pin."""
     nodes, pods = _driver_inputs()
     _, r0 = _run_driver(nodes, pods, 0, "")
     _, r1 = _run_driver(nodes, pods, 10, str(tmp_path))
@@ -208,10 +214,13 @@ def test_resume_is_content_addressed(tmp_path):
     assert not any("[Checkpoint] resumed" in l for l in sim.log.lines)
 
 
+@pytest.mark.slow
 def test_mesh_chunked_matches_plain(tmp_path):
     """The shard engine's gather-to-host snapshot: a mesh replay with
     checkpointing on matches both its own unsegmented run and the
-    single-device engine bit-for-bit."""
+    single-device engine bit-for-bit. resume-smoke only (ISSUE 17
+    tier-1 buyback): tier-1 keeps the single-device kill/resume pin;
+    the mesh==flat equivalence itself is pinned by the engine suites."""
     nodes, pods = _driver_inputs()
     _, r0 = _run_driver(nodes, pods, 0, "")
     _, r1 = _run_driver(nodes, pods, 0, "", mesh=4)

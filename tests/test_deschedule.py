@@ -137,7 +137,12 @@ def test_frag_multi_pod_budget_and_revisit():
     assert len(set(victims)) == len(victims)
 
 
+@pytest.mark.slow
 def test_driver_deschedule_end_to_end():
+    """resume-smoke only (ISSUE 17 tier-1 buyback): tier-1's driver-
+    deschedule representative is test_deschedule_reschedule_emits_per_
+    event_reports (same driver + deschedule_cluster path, same shapes);
+    the conservation assertions here ride resume-smoke."""
     nodes = [
         NodeRow("n0", 32000, 262144, 4, "A100"),
         NodeRow("n1", 32000, 262144, 4, "A100"),

@@ -306,7 +306,7 @@ def test_quota_rejection_is_not_prefix(trace, tmp_path):
 
     calls = []
 
-    def fake_request(url, data=None, timeout=30.0):
+    def fake_request(url, data=None, timeout=30.0, headers=None):
         calls.append(json.loads(data.decode()))
         if len(calls) == 1:
             return 429, {"Retry-After": "0"}, body

@@ -1,0 +1,563 @@
+"""Coordinator HA, epoch fencing, fleet auth, capability routing
+(ISSUE 17).
+
+The tier-1 slice is pure host-side protocol — no device dispatch, no
+HTTP servers, no child processes (~2 s):
+
+  1. the leadership lease file: signed round-trip, torn/edited files
+     skipped AND DELETED with a [Degrade] callback, reserved basename
+     invisible to the job-lease reaper (scan_leases);
+  2. CoordinatorState transitions: stale-lease takeover bumps the
+     epoch, a live foreign lease is respected (epoch remembered for
+     fencing), renew() detects a successor's newer epoch and demotes;
+  3. epoch fencing through FleetService.handle: an op stamped with an
+     OLDER epoch gets 409 {"stale_epoch": true, "register": true} and
+     re-registration adopts the new epoch; an op stamped with a NEWER
+     epoch deposes the handling coordinator on the spot (409
+     {"deposed": true} + self-demotion to standby);
+  4. a standby answers 503 + Retry-After on EVERY mutating endpoint,
+     /jobs included, and health() reports role + epoch;
+  5. duplicate completion of the same digest across an epoch bump is
+     a silent dedup (the exactly-once-across-failover contract);
+  6. bearer auth: all seven mutating endpoints 401 on a missing or
+     forged token with one uniform body (no digest/worker existence
+     leak), and token material never reaches /queue or logs;
+  7. capability routing: fault-family work only goes to workers that
+     declare fault-lane support, starved families are visible in
+     /queue, FIFO holds within eligible work;
+  8. the TPUSIM_COORD_LEASE_S / TPUSIM_COORD_SKEW_S knobs fail loudly
+     naming the variable, and parse_url_list validates --join lists.
+
+Slow (resume-smoke): the CoordKeeper thread drill — a leader whose
+renewal timer dies is superseded by a watching standby in real time.
+The full 3-process kill -9 failover acceptance lives in
+gate.fleet_ha_smoke (`make fleet-ha-smoke`).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpusim.io.kube_client import parse_url_list
+from tpusim.io.trace import NodeRow, PodRow
+from tpusim.svc import coord as svc_coord
+from tpusim.svc import jobs as svc_jobs
+from tpusim.svc import leases as svc_leases
+from tpusim.svc.api import JobService
+from tpusim.svc.auth import bearer_headers, check as auth_check, describe
+from tpusim.svc.batcher import JobQueue
+from tpusim.svc.coord import (
+    COORD_LEASE_BASENAME,
+    CoordinatorState,
+    CoordKeeper,
+    read_coord_lease,
+    write_coord_lease,
+)
+from tpusim.svc.fleet import FleetService
+from tpusim.svc.worker import TraceRef
+
+FAM = [["FGDScore", 1000], ["BestFitScore", 500]]
+
+
+def _mk_cluster(rng, n=16):
+    return [
+        NodeRow(f"n{i:03d}", 32000, 131072, int(g), "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], n))
+    ]
+
+
+def _mk_pods(rng, n=40):
+    out = []
+    for i in range(n):
+        gpu = int(rng.choice([0, 1, 2]))
+        milli = 1000 if gpu > 1 else int(rng.choice([0, 300, 500, 1000]))
+        if gpu == 0:
+            milli = 0
+        out.append(
+            PodRow(f"p{i:04d}", int(rng.choice([1000, 2000, 4000])), 2048,
+                   gpu, milli)
+        )
+    return out
+
+
+@pytest.fixture(scope="module")
+def trace():
+    rng = np.random.default_rng(3)
+    nodes, pods = _mk_cluster(rng), _mk_pods(rng)
+    return TraceRef(
+        "default", nodes, pods, svc_jobs.trace_digest(nodes, pods)
+    )
+
+
+def _ha_stack(trace, tmp_path, token="", lease_s=30.0):
+    """A coordinator stack with the HA plane armed: JobQueue +
+    JobService + FleetService + a CoordinatorState that has taken
+    leadership at epoch 1. lease_s is generous — the fast slice never
+    waits out a deadline; staleness is driven with explicit `now`s."""
+    queue = JobQueue(maxsize=32, lane_width=2, lease_s=5.0)
+    service = JobService(queue, None, {"default": trace}, str(tmp_path))
+    service.bucket = 512
+    service.token = token
+    fleet = FleetService(service)
+    service.fleet = fleet
+    coord = CoordinatorState(str(tmp_path), "c1", url="http://c1",
+                             lease_s=lease_s, skew_s=0.0)
+    assert coord.try_acquire()
+    fleet.coord = coord
+    return queue, service, fleet, coord
+
+
+def _call(app, path, doc, headers=None, method="POST"):
+    body = json.dumps(doc).encode() if doc is not None else b""
+    resp = app.handle(method, path, body, headers)
+    return resp[0], json.loads(resp[2].decode())
+
+
+def _spec_doc(i=0, fault=False):
+    doc = {"policies": FAM, "weights": [1000 + i, 500], "seed": 42}
+    if fault:
+        doc["fault"] = {"mtbf_events": 5.0, "seed": 7 + i}
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# 1. the leadership lease file
+# ---------------------------------------------------------------------------
+
+
+def test_coord_lease_roundtrip_and_torn_degrade(tmp_path):
+    art = str(tmp_path)
+    write_coord_lease(art, 3, "cA", 123, "http://x", time.time() + 5)
+    doc = read_coord_lease(art)
+    assert doc["epoch"] == 3 and doc["leader"] == "cA"
+    assert doc["pid"] == 123 and doc["url"] == "http://x"
+    assert not svc_coord.coord_lease_stale(doc, skew_s=0.0)
+    assert svc_coord.coord_lease_stale(doc, now=time.time() + 10,
+                                       skew_s=0.0)
+    # skew margin: a just-expired lease is NOT stale under skew
+    assert not svc_coord.coord_lease_stale(
+        doc, now=doc["deadline_unix"] + 1.0, skew_s=2.0
+    )
+
+    # tear the file: skipped, reported, DELETED — never trusted
+    path = svc_coord.coord_lease_path(art)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 2])
+    skipped = []
+    assert read_coord_lease(art, on_skip=lambda p, e: skipped.append(p)) \
+        is None
+    assert skipped == [path]
+    import os
+    assert not os.path.exists(path)
+
+
+def test_coord_lease_invisible_to_job_lease_reaper(tmp_path):
+    """coordinator.lease.json shares the *.lease.json suffix with the
+    per-job files; scan_leases must neither judge nor delete it."""
+    art = str(tmp_path)
+    write_coord_lease(art, 1, "cA", 123, "", time.time() - 100)  # stale!
+    digest = "d" * 64
+    svc_leases.write_lease(art, digest, "w1", 11, time.time() + 60,
+                           [digest])
+    leases = svc_leases.scan_leases(art)
+    assert [d for d, _ in leases] == [digest]
+    assert read_coord_lease(art) is not None  # survived the scan
+    assert COORD_LEASE_BASENAME == "coordinator.lease.json"
+
+
+# ---------------------------------------------------------------------------
+# 2. CoordinatorState transitions
+# ---------------------------------------------------------------------------
+
+
+def test_stale_lease_takeover_bumps_epoch(tmp_path):
+    art = str(tmp_path)
+    c1 = CoordinatorState(art, "c1", lease_s=10.0, skew_s=0.0)
+    c2 = CoordinatorState(art, "c2", lease_s=10.0, skew_s=0.0)
+    now = time.time()
+    assert c1.try_acquire(now) and c1.epoch == 1 and c1.role == "leader"
+
+    # live foreign lease: c2 stays standby but REMEMBERS the epoch
+    assert not c2.try_acquire(now + 1)
+    assert c2.role == "standby" and c2.epoch == 1
+
+    # the leader stops renewing; past deadline + skew, c2 takes over
+    assert c2.try_acquire(now + 10.0 + 0.1)
+    assert c2.role == "leader" and c2.epoch == 2 and c2.takeovers == 1
+    assert read_coord_lease(art)["leader"] == "c2"
+
+    # the resurrected c1 sees the newer on-disk epoch and demotes
+    assert not c1.renew(now + 11)
+    assert c1.role == "standby" and c1.demotions == 1
+    assert c1.epoch == 1  # it learns epoch 2 from the next fenced op
+
+    # re-acquiring while c2's lease is live fails; after release, wins
+    assert not c1.try_acquire(now + 12)
+    c2.release()
+    assert read_coord_lease(art) is None
+    assert c1.try_acquire(now + 13)
+    assert c1.epoch == 3  # max(seen 2, ours 1) + 1
+
+
+def test_leader_renew_in_place_and_release_respects_successor(tmp_path):
+    art = str(tmp_path)
+    c1 = CoordinatorState(art, "c1", lease_s=10.0, skew_s=0.0)
+    now = time.time()
+    assert c1.try_acquire(now)
+    d0 = read_coord_lease(art)["deadline_unix"]
+    assert c1.renew(now + 3)
+    assert read_coord_lease(art)["deadline_unix"] > d0
+    # try_acquire while already leading is a renew, not an epoch bump
+    assert c1.try_acquire(now + 4) and c1.epoch == 1
+
+    # a successor stakes epoch 2; c1.release() must NOT delete it
+    write_coord_lease(art, 2, "c2", 99, "", now + 100)
+    c1.release()
+    assert read_coord_lease(art)["leader"] == "c2"
+
+
+# ---------------------------------------------------------------------------
+# 3. epoch fencing through the fleet protocol
+# ---------------------------------------------------------------------------
+
+
+def test_stale_epoch_op_409_and_reregister_adopts_epoch(trace, tmp_path):
+    queue, service, fleet, coord = _ha_stack(trace, tmp_path)
+    code, reg = _call(fleet, "/workers/register",
+                      {"worker": "", "pid": 11, "host": "h",
+                       "caps": {"backend": "cpu", "devices": 1}})
+    assert code == 200 and reg["epoch"] == 1
+    w1 = reg["worker"]
+
+    # a failover happened elsewhere: our coordinator is now at epoch 3
+    coord.note_epoch(2)  # deposes c1 …
+    assert coord.role == "standby"
+    assert coord.try_acquire()  # … and c1 wins leadership back
+    assert coord.epoch == 3
+
+    # the worker still stamps its registration-time epoch → fenced,
+    # told to re-register. Fencing runs BEFORE worker lookup: even an
+    # unknown sender learns only the epoch, nothing about the registry.
+    code, doc = _call(fleet, "/workers/claim", {"worker": w1, "epoch": 1})
+    assert code == 409 and doc["stale_epoch"] and doc["register"]
+    assert doc["epoch"] == 3
+    code, doc = _call(fleet, "/workers/claim",
+                      {"worker": "ghost", "epoch": 1})
+    assert code == 409 and doc["stale_epoch"]
+
+    # re-registration hands back the current epoch; ops flow again
+    code, reg2 = _call(fleet, "/workers/register",
+                       {"worker": w1, "pid": 11})
+    assert code == 200 and reg2["epoch"] == 3
+    code, claim = _call(fleet, "/workers/claim",
+                        {"worker": w1, "epoch": 3})
+    assert code == 200 and claim["epoch"] == 3
+
+    # a malformed stamp is a 400, not a crash or a silent pass
+    code, doc = _call(fleet, "/workers/claim",
+                      {"worker": w1, "epoch": "banana"})
+    assert code == 400
+    # an UNSTAMPED op (pre-HA worker) passes the fence untouched
+    code, _ = _call(fleet, "/workers/claim", {"worker": w1})
+    assert code == 200
+
+
+def test_newer_epoch_op_deposes_handling_coordinator(trace, tmp_path,
+                                                     capsys):
+    queue, service, fleet, coord = _ha_stack(trace, tmp_path)
+    _call(fleet, "/workers/register", {"worker": "w1", "pid": 11})
+
+    # a worker registered with a NEWER leader talks to the deposed one:
+    # the op itself is the proof — demote on the spot, answer 409
+    code, doc = _call(fleet, "/workers/claim", {"worker": "w1", "epoch": 5})
+    assert code == 409 and doc["deposed"] and doc["epoch"] == 5
+    assert coord.role == "standby" and coord.epoch == 5
+    assert "DEPOSED" in capsys.readouterr().err
+
+    # from now on EVERY mutating endpoint is a 503 with Retry-After —
+    # the demoted leader cannot corrupt shared state
+    for path, body in [
+        ("/workers/claim", {"worker": "w1", "epoch": 5}),
+        ("/workers/register", {"worker": "", "pid": 1}),
+        ("/workers/renew", {"worker": "w1", "digests": []}),
+        ("/workers/complete", {"worker": "w1", "done": []}),
+        ("/leases", {"op": "write", "digest": "d" * 64}),
+        ("/results/" + "d" * 64, {}),
+    ]:
+        resp = fleet.handle("POST", path, json.dumps(body).encode(), None)
+        assert resp[0] == 503, path
+        assert len(resp) == 4 and resp[3] == {"Retry-After": "2"}, path
+        assert json.loads(resp[2].decode())["role"] == "standby"
+    code, doc = _call(service, "/jobs", _spec_doc())
+    assert code == 503 and doc["role"] == "standby"
+
+    # reads still answer (the operator needs /queue to see WHY)
+    code, q = _call(service, "/queue", None, method="GET")
+    assert code == 200 and q["role"] == "standby" and q["epoch"] == 5
+
+    # health: a standby is healthy by existing, and says so
+    ok, extra = fleet.health()
+    assert ok and extra["role"] == "standby" and extra["epoch"] == 5
+
+
+def test_duplicate_completion_across_epochs_dedups(trace, tmp_path):
+    """Exactly-once across failover: the same digest completed under
+    epoch 1 and again (by the re-registered worker, after adoption)
+    under epoch 2 is acked once and deduped once — digests pin
+    trajectories, result writes are atomic replaces."""
+    queue, service, fleet, coord = _ha_stack(trace, tmp_path)
+    art = str(tmp_path)
+    _call(fleet, "/workers/register", {"worker": "w1", "pid": 11})
+    service.submit_payload(_spec_doc(0))
+    code, claim = _call(fleet, "/workers/claim",
+                        {"worker": "w1", "epoch": 1})
+    [jd] = claim["jobs"]
+    svc_jobs.write_result(art, jd["digest"], {"placed": 1})
+    code, comp = _call(fleet, "/workers/complete",
+                       {"worker": "w1", "epoch": 1,
+                        "done": [jd["digest"]]})
+    assert code == 200 and comp["acked"] == 1 and comp["dup"] == 0
+
+    # failover: a successor leads at epoch 2, adopts the artifact dir
+    c2 = CoordinatorState(art, "c2", lease_s=30.0, skew_s=0.0)
+    assert c2.try_acquire(time.time() + 100)  # c1's lease judged stale
+    assert c2.epoch == 2
+    fleet.coord = c2  # the same queue state, now fenced at epoch 2
+
+    # the worker re-registers and re-sends the completion it never got
+    # an ack for (its POST raced the old leader's death)
+    code, reg = _call(fleet, "/workers/register", {"worker": "w1",
+                                                   "pid": 11})
+    assert reg["epoch"] == 2
+    code, comp2 = _call(fleet, "/workers/complete",
+                        {"worker": "w1", "epoch": 2,
+                         "done": [jd["digest"]]})
+    assert code == 200 and comp2["acked"] == 0 and comp2["dup"] == 1
+    st = queue.stats()
+    assert st["done"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 4. bearer auth on the mutating plane
+# ---------------------------------------------------------------------------
+
+
+def test_auth_401_on_every_mutating_endpoint(trace, tmp_path):
+    token = "s3cret-token-0123456789"
+    queue, service, fleet, coord = _ha_stack(trace, tmp_path, token=token)
+    digest = "d" * 64
+    mutating = [
+        (fleet, "/workers/register", {"worker": "", "pid": 1}),
+        (fleet, "/workers/claim", {"worker": "w1", "epoch": 1}),
+        (fleet, "/workers/renew", {"worker": "w1", "digests": []}),
+        (fleet, "/workers/complete", {"worker": "w1", "done": []}),
+        (fleet, "/leases", {"op": "write", "digest": digest}),
+        (fleet, "/results/" + digest, {}),
+        (service, "/jobs", _spec_doc()),
+    ]
+    for headers in (None, {}, {"Authorization": "Bearer wrong"},
+                    {"Authorization": token}):  # missing Bearer prefix
+        for app, path, body in mutating:
+            code, doc = _call(app, path, body, headers=headers)
+            assert code == 401, (path, headers)
+            # ONE uniform body: a 401 never reveals whether the digest
+            # or worker exists, and never echoes the expected token
+            assert doc == {"error": "missing or invalid bearer token"}
+
+    # the real token passes; /queue shows armed-or-not, NEVER material
+    ok = bearer_headers(token)
+    code, reg = _call(fleet, "/workers/register",
+                      {"worker": "", "pid": 1}, headers=ok)
+    assert code == 200
+    code, q = _call(service, "/queue", None, method="GET")
+    assert code == 200 and q["auth"] == describe(token)
+    assert token not in json.dumps(q)
+    assert q["auth"].startswith("enabled")
+
+    # reads stay open (health probes and dashboards don't carry tokens)
+    code, _ = _call(fleet, "/workers", None, method="GET")
+    assert code == 200
+
+    # check() semantics: empty token disables, compare is exact
+    assert auth_check({}, "")
+    assert not auth_check({}, token)
+    assert auth_check({"Authorization": "Bearer " + token}, token)
+    assert not auth_check({"Authorization": "Bearer " + token + "x"},
+                          token)
+    assert bearer_headers("") == {}
+    assert describe("") == "disabled"
+
+
+def test_load_token_fail_loud(tmp_path, monkeypatch):
+    from tpusim.svc.auth import ENV_TOKEN, load_token
+
+    monkeypatch.delenv(ENV_TOKEN, raising=False)
+    assert load_token("") == ""
+    monkeypatch.setenv(ENV_TOKEN, "  env-tok  ")
+    assert load_token("") == "env-tok"
+
+    f = tmp_path / "tok.txt"
+    f.write_text("file-tok\n")
+    assert load_token(str(f)) == "file-tok"  # file beats env
+    (tmp_path / "empty.txt").write_text("  \n")
+    with pytest.raises(ValueError, match="empty"):
+        load_token(str(tmp_path / "empty.txt"))
+    with pytest.raises(ValueError, match="unreadable"):
+        load_token(str(tmp_path / "missing.txt"))
+
+
+# ---------------------------------------------------------------------------
+# 5. capability routing + starvation visibility
+# ---------------------------------------------------------------------------
+
+
+def test_capability_routing_and_starved_family_in_queue(trace, tmp_path,
+                                                        capsys):
+    queue, service, fleet, coord = _ha_stack(trace, tmp_path)
+
+    # the serve wiring installs this; replicate it here (api.start_job_
+    # server owns the real install)
+    def _needs(spec):
+        return {"fault": bool(spec.fault), "nodes": len(trace.nodes),
+                "mem_bytes": 0}
+    queue.family_needs_fn = _needs
+
+    _call(fleet, "/workers/register",
+          {"worker": "wplain", "pid": 1,
+           "caps": {"backend": "cpu", "devices": 1,
+                    "fault_lanes": False}})
+    service.submit_payload(_spec_doc(0, fault=True))
+    service.submit_payload(_spec_doc(1))
+
+    # the incapable worker claims PAST the fault job (FIFO within
+    # eligible work) and the starved family turns loud + visible
+    code, claim = _call(fleet, "/workers/claim",
+                        {"worker": "wplain", "epoch": 1})
+    got = [bool(svc_jobs.validate_job(j["spec"]).fault)
+           for j in claim["jobs"]]
+    assert got == [False]
+    code, q = _call(service, "/queue", None, method="GET")
+    assert len(q["starved_families"]) == 1
+    assert "STARVED" in capsys.readouterr().err
+    # a second claim finds ONLY work it cannot serve: empty + a tick
+    code, claim = _call(fleet, "/workers/claim",
+                        {"worker": "wplain", "epoch": 1})
+    assert claim["jobs"] == []
+    assert queue.stats()["starved_claims"] >= 1
+
+    # a capable worker joins: the fault job flows, starvation clears
+    _call(fleet, "/workers/register",
+          {"worker": "wfault", "pid": 2,
+           "caps": {"backend": "cpu", "devices": 1,
+                    "fault_lanes": True}})
+    code, claim2 = _call(fleet, "/workers/claim",
+                         {"worker": "wfault", "epoch": 1})
+    assert [bool(svc_jobs.validate_job(j["spec"]).fault)
+            for j in claim2["jobs"]] == [True]
+    code, q = _call(service, "/queue", None, method="GET")
+    assert q["starved_families"] == []
+
+
+def test_eligible_caps_matrix(trace, tmp_path):
+    queue = JobQueue(maxsize=8, lane_width=1)
+    spec_plain = svc_jobs.validate_job(_spec_doc(0))
+    spec_fault = svc_jobs.validate_job(_spec_doc(1, fault=True))
+    queue.family_needs_fn = lambda s: {
+        "fault": bool(s.fault), "nodes": 500, "mem_bytes": 1 << 30
+    }
+    # no caps (pre-ISSUE-17 worker / in-process) = unrestricted
+    assert queue.eligible(spec_fault, None)
+    assert queue.eligible(spec_fault, {})
+    assert not queue.eligible(spec_fault, {"fault_lanes": False})
+    assert queue.eligible(spec_plain, {"fault_lanes": False})
+    # max_nodes / memory thresholds; 0 = undeclared = unlimited
+    assert not queue.eligible(spec_plain, {"max_nodes": 100})
+    assert queue.eligible(spec_plain, {"max_nodes": 500})
+    assert queue.eligible(spec_plain, {"max_nodes": 0})
+    assert not queue.eligible(spec_plain, {"memory_bytes": 1 << 20})
+    assert queue.eligible(spec_plain, {"memory_bytes": 1 << 31})
+    # a broken needs fn must not wedge claims: falls back to spec.fault
+    queue.family_needs_fn = lambda s: 1 / 0
+    assert queue.eligible(spec_plain, {"max_nodes": 1})
+    assert not queue.eligible(spec_fault, {"fault_lanes": False})
+
+
+# ---------------------------------------------------------------------------
+# 6. knobs + URL lists
+# ---------------------------------------------------------------------------
+
+
+def test_coord_env_knobs_fail_loud(monkeypatch):
+    monkeypatch.delenv("TPUSIM_COORD_LEASE_S", raising=False)
+    monkeypatch.delenv("TPUSIM_COORD_SKEW_S", raising=False)
+    assert svc_coord.coord_lease_s() == svc_coord.DEFAULT_COORD_LEASE_S
+    assert svc_coord.coord_skew_s() == 2.0
+
+    monkeypatch.setenv("TPUSIM_COORD_LEASE_S", "fast")
+    with pytest.raises(ValueError, match="TPUSIM_COORD_LEASE_S"):
+        svc_coord.coord_lease_s()
+    monkeypatch.setenv("TPUSIM_COORD_LEASE_S", "0")
+    with pytest.raises(ValueError, match="TPUSIM_COORD_LEASE_S"):
+        svc_coord.coord_lease_s()
+    monkeypatch.setenv("TPUSIM_COORD_LEASE_S", "1.5")
+    assert svc_coord.coord_lease_s() == 1.5
+
+    monkeypatch.setenv("TPUSIM_COORD_SKEW_S", "-1")
+    with pytest.raises(ValueError, match="TPUSIM_COORD_SKEW_S"):
+        svc_coord.coord_skew_s()
+    monkeypatch.setenv("TPUSIM_COORD_SKEW_S", "0.5")
+    assert svc_coord.coord_skew_s() == 0.5
+
+
+def test_parse_url_list():
+    assert parse_url_list("http://a:1") == ["http://a:1"]
+    assert parse_url_list("http://a:1/, http://b:2 ,http://a:1") == \
+        ["http://a:1", "http://b:2"]
+    assert parse_url_list(["http://a:1", "http://b:2/"]) == \
+        ["http://a:1", "http://b:2"]
+    with pytest.raises(ValueError, match="no coordinator URLs"):
+        parse_url_list(" , ,")
+    with pytest.raises(ValueError, match="no coordinator URLs"):
+        parse_url_list("")
+
+
+# ---------------------------------------------------------------------------
+# 7. the renewal timer drill (threads + real sleeps -> slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_keeper_crash_takeover_in_real_time(tmp_path):
+    """c1 leads with a live CoordKeeper; the keeper dies (a wedged
+    leader); the watching c2 takes over one lease + skew later; c1's
+    next renew self-demotes and fires on_deposed."""
+    art = str(tmp_path)
+    c1 = CoordinatorState(art, "c1", lease_s=0.3, skew_s=0.0)
+    c2 = CoordinatorState(art, "c2", lease_s=0.3, skew_s=0.0)
+    assert c1.try_acquire()
+    keeper = CoordKeeper(c1).start()
+    time.sleep(0.5)  # several renewals pass; c2 cannot take over
+    assert not c2.try_acquire()
+    keeper.stop()  # the "crash": renewals stop, the lease goes stale
+
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if c2.try_acquire():
+            break
+        time.sleep(0.05)
+    assert c2.role == "leader" and c2.epoch == 2
+
+    deposed = []
+    k1 = CoordKeeper(c1, on_deposed=lambda: deposed.append(1))
+    c1.role = "leader"  # simulate the zombie believing it still leads
+    k1.start()
+    k2 = CoordKeeper(c2).start()
+    deadline = time.time() + 10.0
+    while time.time() < deadline and not deposed:
+        time.sleep(0.05)
+    k1.stop()
+    k2.stop(release=True)
+    assert deposed and c1.role == "standby"
+    assert read_coord_lease(art) is None  # graceful stop released it

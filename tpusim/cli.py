@@ -346,6 +346,33 @@ def _build_parser() -> argparse.ArgumentParser:
         '{"policy_preset": "NAME"} and replay byte-identically to the '
         "artifact run locally",
     )
+    # coordinator HA (ISSUE 17): leadership is one more signed file in
+    # the artifact dir — a standby watches it and takes over, epoch-
+    # fenced against the deposed leader
+    p_serve.add_argument(
+        "--standby", action="store_true",
+        help="start as a STANDBY coordinator: watch the artifact dir's "
+        "coordinator.lease.json and take over (bump the epoch, adopt "
+        "pending jobs and live worker leases) when the leader's lease "
+        "goes stale; mutating endpoints answer 503 + Retry-After until "
+        "promotion. Implies --fleet",
+    )
+    p_serve.add_argument(
+        "--fleet", action="store_true",
+        help="arm the fleet coordinator plane (register/claim/renew/"
+        "complete + the HA leadership lease) WITHOUT spawning local "
+        "workers — remote hosts join with `tpusim worker --join`; "
+        "--workers N implies it",
+    )
+    p_serve.add_argument(
+        "--token-file", default="", metavar="FILE",
+        help="bearer token (the file's stripped contents; or env "
+        "TPUSIM_FLEET_TOKEN) required on every mutating endpoint — "
+        "POST /jobs, claim/renew/complete/leases, result uploads, "
+        "register. Constant-time compare; 401 without leaking whether "
+        "a digest exists; token material never appears in logs or "
+        "/queue",
+    )
     p_serve.add_argument(
         "--table-cache-dir", default="", metavar="DIR",
         help="content-keyed init-table cache shared by the fleet "
@@ -369,8 +396,11 @@ def _build_parser() -> argparse.ArgumentParser:
         "leases while scanning; SIGTERM drains the in-flight batch",
     )
     p_worker.add_argument(
-        "--join", required=True, metavar="URL",
-        help="coordinator base URL (the address `serve --jobs` printed)",
+        "--join", required=True, metavar="URL[,URL...]",
+        help="coordinator base URL (the address `serve --jobs` "
+        "printed); a comma-separated list names an HA pair/set — the "
+        "worker rotates to the next coordinator on connection failure "
+        "or standby 503, on the shared backoff schedule (ISSUE 17)",
     )
     p_worker.add_argument(
         "--id", default="", metavar="NAME",
@@ -407,6 +437,11 @@ def _build_parser() -> argparse.ArgumentParser:
         help="remote-mode local cache root (downloaded traces keyed "
         "by content digest + this worker's artifact scratch); default "
         "a per-host tmp dir",
+    )
+    p_worker.add_argument(
+        "--token-file", default="", metavar="FILE",
+        help="bearer token for an auth-armed fleet (the file's "
+        "stripped contents; or env TPUSIM_FLEET_TOKEN)",
     )
 
     # the learned-scoring lane (ISSUE 9; README "Tune policy weights"):
@@ -627,13 +662,20 @@ def _build_parser() -> argparse.ArgumentParser:
         "[[name, w], ...]})",
     )
     p_submit.add_argument(
-        "--url", required=True, metavar="URL",
+        "--url", required=True, metavar="URL[,URL...]",
         help="service base URL (the address `serve --jobs` printed, "
-        "e.g. http://127.0.0.1:8642)",
+        "e.g. http://127.0.0.1:8642); a comma-separated list names an "
+        "HA pair/set — the client fails over to the next coordinator "
+        "when one dies mid-wait (re-submission dedups by job digest)",
     )
     p_submit.add_argument(
         "--timeout", type=float, default=300.0, metavar="SECONDS",
         help="overall wait budget for results",
+    )
+    p_submit.add_argument(
+        "--token-file", default="", metavar="FILE",
+        help="bearer token for an auth-armed service (the file's "
+        "stripped contents; or env TPUSIM_FLEET_TOKEN)",
     )
 
     sub.add_parser("version", help="print version")
@@ -824,6 +866,10 @@ def _serve_jobs(args) -> int:
 
     from tpusim.obs.server import watch_dir
     from tpusim.svc import load_trace, start_job_server
+    from tpusim.svc.api import recover_pending_jobs
+    from tpusim.svc.auth import describe as auth_describe
+    from tpusim.svc.auth import load_token
+    from tpusim.svc.coord import CoordinatorState, CoordKeeper
 
     traces = {}
     if args.nodes or args.pods:
@@ -850,6 +896,30 @@ def _serve_jobs(args) -> int:
     max_n = int(getattr(args, "max_workers", 0) or 0)
     if max_n and not fleet_n:
         raise ValueError("--max-workers needs --workers N")
+    standby = bool(getattr(args, "standby", False))
+    fleet_mode = fleet_n > 0 or standby or bool(getattr(args, "fleet", False))
+    token = load_token(getattr(args, "token_file", ""))
+    # the HA leadership lease (ISSUE 17): armed in fleet mode only —
+    # the single in-process-worker service of PR 7 has no standby to
+    # fence against and stays exactly as it was
+    coord = None
+    if fleet_mode:
+        try:
+            host = os.uname().nodename
+        except (AttributeError, OSError):
+            host = "localhost"
+        coord = CoordinatorState(
+            args.dir, name=f"{host}-{os.getpid()}", out=sys.stderr
+        )
+        if not standby:
+            if not coord.try_acquire():
+                print(
+                    "[serve] another coordinator holds a LIVE "
+                    "leadership lease (epoch "
+                    f"{coord.epoch}) — running as standby; pass "
+                    "--standby to silence this",
+                    file=sys.stderr,
+                )
     # named learned-policy presets (ISSUE 14): NAME=artifact.json ->
     # the [(name, weight)] pairs submit jobs reference by preset name
     presets = {}
@@ -874,11 +944,15 @@ def _serve_jobs(args) -> int:
         lane_width=args.lane_width, queue_size=args.queue_size,
         table_cache_dir=args.table_cache_dir,
         compile_cache_dir=args.compile_cache_dir,
-        fleet=fleet_n > 0, lease_s=args.lease_s,
+        fleet=fleet_mode, lease_s=args.lease_s,
         family_quota=args.family_quota,
         policy_presets=presets,
+        token=token, coord=coord,
         out=sys.stderr,
     )
+    if coord is not None:
+        # the lease is re-staked with the bound URL at the next renewal
+        coord.url = srv.url
     sup = None
     if fleet_n > 0:
         import subprocess
@@ -889,6 +963,7 @@ def _serve_jobs(args) -> int:
         cmd = worker_command(
             srv.url, table_cache_dir=args.table_cache_dir,
             compile_cache_dir=args.compile_cache_dir,
+            token_file=getattr(args, "token_file", ""),
         )
         sup = Supervisor(
             lambda _n: subprocess.Popen(cmd), fleet_n,
@@ -899,7 +974,40 @@ def _serve_jobs(args) -> int:
             out=sys.stderr,
         )
         service.fleet.supervisor = sup
+        if coord is not None and coord.role != "leader":
+            # a standby's local workers would only spin on its own
+            # 503s — spawn them at promotion (resume fills the floor)
+            sup.pause()
         sup.start()
+    # HA plumbing (ISSUE 17): the leader renews its leadership lease on
+    # a CoordKeeper timer; a standby (or a deposed ex-leader) polls
+    # try_acquire on the watch cadence and promotes by adopting the
+    # artifact dir's pending state — which the epoch fence guarantees
+    # the old leader can no longer mutate
+    ha = {"keeper": None}
+
+    def _on_deposed():
+        if sup is not None:
+            sup.pause()
+
+    def _promote():
+        old = ha["keeper"]
+        if old is not None:
+            old.stop()
+        recover_pending_jobs(service, out=sys.stderr)
+        if service.fleet is not None:
+            service.fleet.adopt_leases(out=sys.stderr)
+        if sup is not None:
+            sup.resume()
+        ha["keeper"] = CoordKeeper(coord, on_deposed=_on_deposed).start()
+        print(
+            f"[serve] PROMOTED to leader at epoch {coord.epoch} — "
+            "pending jobs requeued, live worker leases adopted",
+            file=sys.stderr,
+        )
+
+    if coord is not None and coord.role == "leader":
+        ha["keeper"] = CoordKeeper(coord, on_deposed=_on_deposed).start()
     # graceful shutdown (ISSUE 10): SIGTERM/SIGINT begin the drain —
     # /healthz flips to 503, POSTs answer 503 + Retry-After, the
     # in-flight batch finishes (worker.stop joins after it), and every
@@ -920,7 +1028,12 @@ def _serve_jobs(args) -> int:
         pass  # non-main thread (tests drive _serve_jobs directly)
     mode = (f"supervised fleet of {fleet_n} worker processes"
             + (f" (autoscale to {max_n})" if max_n else "")
-            if fleet_n else "single in-process worker")
+            if fleet_n else
+            ("fleet coordinator (external workers)" if fleet_mode
+             else "single in-process worker"))
+    if coord is not None:
+        mode += (f"; role {coord.role} epoch {coord.epoch}; "
+                 f"auth {auth_describe(token)}")
     hosted = "; ".join(
         f"trace {name!r} = {len(t.nodes)} nodes x {len(t.pods)} pods"
         for name, t in traces.items()
@@ -948,6 +1061,9 @@ def _serve_jobs(args) -> int:
             )
             return 0
         while not stop_flag["stop"]:
+            if (coord is not None and coord.role != "leader"
+                    and coord.try_acquire()):
+                _promote()
             record, progress = watch_dir(args.dir)
             if record is not None:
                 srv.publish_record(record)
@@ -963,6 +1079,12 @@ def _serve_jobs(args) -> int:
     except KeyboardInterrupt:
         srv.begin_drain()
     finally:
+        if ha["keeper"] is not None:
+            # graceful exit releases the leadership lease so a standby
+            # takes over immediately, not one lease + skew later
+            ha["keeper"].stop(release=True)
+        elif coord is not None:
+            coord.release()
         if sup is not None:
             sup.stop()
         if worker is not None:
@@ -978,6 +1100,7 @@ def cmd_worker(args) -> int:
     import signal
     import threading
 
+    from tpusim.svc.auth import load_token
     from tpusim.svc.client import ServiceError
     from tpusim.svc.fleet import run_worker
 
@@ -999,6 +1122,7 @@ def cmd_worker(args) -> int:
             compile_cache_dir=args.compile_cache_dir,
             out=sys.stderr, stop_event=stop_event,
             mode=args.mode, cache_dir=args.cache_dir,
+            token=load_token(getattr(args, "token_file", "")),
         )
     except ServiceError as err:
         print(f"tpusim worker: {err}", file=sys.stderr)
@@ -1325,8 +1449,11 @@ def cmd_submit(args) -> int:
         # shape-routed: grid files expand per row, single job documents
         # (incl. ones carrying a flat `weights` vector) pass through
         docs = docs_from_payload(payload)
+        from tpusim.svc.auth import load_token
+
         results = submit_and_wait(
-            args.url, docs, timeout=args.timeout, out=sys.stderr
+            args.url, docs, timeout=args.timeout, out=sys.stderr,
+            token=load_token(getattr(args, "token_file", "")),
         )
     except JobsFailed as err:
         if err.results:

@@ -147,6 +147,26 @@ def with_backoff(call, max_attempts: int = 8, stop_event=None):
         return code, headers, body
 
 
+def parse_url_list(urls) -> List[str]:
+    """A comma-separated coordinator list (`--join u1,u2` /
+    `submit --url u1,u2`, ISSUE 17) → ordered, deduped URL list with
+    trailing slashes trimmed. Accepts a single URL, a comma string, or
+    an iterable; raises ValueError on an empty result so a typo'd flag
+    fails loudly at startup, not as a mid-sweep stall."""
+    if isinstance(urls, str):
+        parts = urls.split(",")
+    else:
+        parts = list(urls or [])
+    out: List[str] = []
+    for p in parts:
+        p = str(p).strip().rstrip("/")
+        if p and p not in out:
+            out.append(p)
+    if not out:
+        raise ValueError(f"no coordinator URLs in {urls!r}")
+    return out
+
+
 class KubeClientError(RuntimeError):
     pass
 

@@ -948,6 +948,328 @@ def fleet_chaos_smoke(out_dir: str, n_workers: int = 3
     return True, msgs
 
 
+def _ha_jobs() -> list:
+    """The HA smoke's job mix: weight/seed/tune variants plus one fault
+    job (capability-routed — every spawned worker declares fault-lane
+    support). The policy family deliberately differs from _fleet_jobs()
+    and _wan_jobs(): those smokes measure cold-compile walls on THEIR
+    families, and sharing a process (bench-gate) must not pre-warm
+    them."""
+    fam = [["GpuClusteringScore", 900], ["BestFitScore", 450]]
+    docs = [
+        {"policies": fam, "weights": [900 + 31 * i, 450 + 11 * i],
+         "seed": 50 + i % 2, "tune": [0.0, 0.0, 0.25][i % 3],
+         "engine": "sequential"}
+        for i in range(6)
+    ]
+    docs.append(
+        {"policies": fam, "weights": [1000, 500], "seed": 52, "tune": 0.0,
+         "engine": "sequential",
+         "fault": {"mtbf_events": 12.0, "mttr_events": 15.0, "seed": 9,
+                   "backoff_base": 2, "backoff_cap": 16, "max_retries": 2,
+                   "queue_capacity": 16}}
+    )
+    return docs
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def fleet_ha_smoke(out_dir: str) -> Tuple[bool, List[str]]:
+    """ISSUE 17 (`make fleet-ha-smoke`): coordinator failover end to
+    end, over real processes and real HTTP. Phase 1 runs the job mix on
+    a single in-process coordinator — the byte-identity reference.
+    Phase 2 boots a token-armed leader + standby CLI pair sharing one
+    artifact dir, joins two workers against BOTH urls, submits the same
+    jobs through the failover client, `kill -9`s the LEADER while
+    leases are held mid-batch, and hard-checks the HA contracts:
+    (a) the standby promotes (role/epoch on /healthz) and 100%% of jobs
+    complete with per-file byte-identity vs the reference, (b) a
+    stale-epoch op and missing/forged tokens are rejected (409 / 401 on
+    every mutating endpoint), (c) the resurrected old leader fences
+    itself to standby against the live lease, and (d) token material
+    never appears in /queue. Any exception is a FAIL verdict."""
+    msgs: List[str] = []
+    procs: list = []
+    coords: list = []
+    srv = worker = None
+    try:
+        import shutil
+        import signal as _signal
+        import subprocess
+        import threading
+        import time as _time
+
+        from tpusim.svc import load_trace, start_job_server
+        from tpusim.svc.auth import bearer_headers
+        from tpusim.svc.client import _request, submit_and_wait
+        from tpusim.svc.fleet import stop_workers
+        from tpusim.svc.jobs import result_path
+
+        base = os.path.join(out_dir, "fleet_ha_smoke")
+        if os.path.isdir(base):
+            shutil.rmtree(base)
+        os.makedirs(base)
+        nodes_csv, pods_csv = _write_fleet_trace(base)
+        ccache = os.path.join(base, "compile_cache")
+        tcache = os.path.join(base, "table_cache")
+        docs = _ha_jobs()
+
+        # ---- phase 1: the single-coordinator reference
+        art1 = os.path.join(base, "ref")
+        os.makedirs(art1)
+        trace = load_trace("default", nodes_csv, pods_csv)
+        srv, service, worker = start_job_server(
+            art1, {"default": trace}, listen=":0", lane_width=2,
+            queue_size=64, compile_cache_dir=ccache,
+            table_cache_dir=tcache,
+        )
+        accepted = [service.submit_payload(d) for d in docs]
+        digests = [a["digest"] for a in accepted]
+        if not service.queue.wait_idle(timeout=300):
+            return False, ["[gate] fleet-ha: phase-1 reference run did "
+                           "not drain (FAIL)"]
+        ref_bytes = {}
+        for d in digests:
+            with open(result_path(art1, d), "rb") as f:
+                ref_bytes[d] = f.read()
+        worker.stop()
+        srv.stop()
+        worker = srv = None
+
+        # ---- phase 2: leader + standby CLI pair, token-armed
+        token = "ha-smoke-" + os.urandom(12).hex()
+        token_file = os.path.join(base, "token.txt")
+        with open(token_file, "w") as f:
+            f.write(token + "\n")
+        art2 = os.path.join(base, "fleet")
+        os.makedirs(art2)
+        p1, p2 = _free_port(), _free_port()
+        u1, u2 = f"http://127.0.0.1:{p1}", f"http://127.0.0.1:{p2}"
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            TPUSIM_COORD_LEASE_S="1.5", TPUSIM_COORD_SKEW_S="0.5",
+        )
+
+        def _coord_cmd(port: int, standby: bool = False) -> list:
+            cmd = [
+                sys.executable, "-m", "tpusim", "serve", art2, "--jobs",
+                "--nodes", nodes_csv, "--pods", pods_csv, "--fleet",
+                "--listen", f"127.0.0.1:{port}", "--poll", "0.3",
+                "--lane-width", "2", "--lease-s", "2.0",
+                "--token-file", token_file,
+                "--table-cache-dir", tcache,
+                "--compile-cache-dir", ccache,
+            ]
+            if standby:
+                cmd.append("--standby")
+            return cmd
+
+        def _spawn_coord(port: int, tag: str, standby: bool = False):
+            log = open(os.path.join(base, f"coord_{tag}.log"), "ab")
+            proc = subprocess.Popen(
+                _coord_cmd(port, standby), env=env,
+                stdout=log, stderr=log,
+            )
+            coords.append(proc)
+            return proc
+
+        def _wait_role(url: str, want: str, timeout_s: float) -> dict:
+            end = _time.time() + timeout_s
+            last = "?"
+            while _time.time() < end:
+                try:
+                    _, _, h = _request(url + "/healthz", timeout=5)
+                    last = h.get("role", "?")
+                    if last == want:
+                        return h
+                except OSError:
+                    pass
+                _time.sleep(0.1)
+            raise RuntimeError(
+                f"{url} never reached role {want!r} (last: {last!r})"
+            )
+
+        leader = _spawn_coord(p1, "leader")
+        _wait_role(u1, "leader", 60)
+        _spawn_coord(p2, "standby", standby=True)
+        _wait_role(u2, "standby", 60)
+
+        wcmd = [
+            sys.executable, "-m", "tpusim", "worker",
+            "--join", f"{u1},{u2}", "--token-file", token_file,
+            "--table-cache-dir", tcache, "--compile-cache-dir", ccache,
+        ]
+        for i in range(2):
+            log = open(os.path.join(base, f"worker_{i}.log"), "ab")
+            procs.append(
+                subprocess.Popen(wcmd, env=env, stdout=log, stderr=log)
+            )
+
+        # submit through the failover client against BOTH urls; it must
+        # ride out the leader's death mid-wait
+        box: dict = {}
+
+        def _submit():
+            try:
+                box["results"] = submit_and_wait(
+                    f"{u1},{u2}", docs, timeout=300, token=token
+                )
+            except Exception as err:  # surfaced below as a FAIL
+                box["err"] = err
+
+        th = threading.Thread(target=_submit, daemon=True)
+        th.start()
+
+        # kill -9 the LEADER once a worker provably holds leases
+        deadline = _time.time() + 120
+        held = False
+        while _time.time() < deadline and not held:
+            try:
+                _, _, q = _request(u1 + "/queue", timeout=5)
+            except OSError:
+                break  # leader already gone?
+            for row in (q.get("workers") or {}).values():
+                if row.get("leases_held", 0) > 0:
+                    held = True
+                    break
+            _time.sleep(0.05)
+        if not held:
+            return False, ["[gate] fleet-ha: never observed a worker "
+                           "holding leases before the kill (FAIL)"]
+        os.kill(leader.pid, _signal.SIGKILL)
+        msgs.append(
+            f"[gate] fleet-ha: kill -9'd the LEADER (pid {leader.pid}) "
+            "with leases held mid-batch"
+        )
+
+        h = _wait_role(u2, "leader", 30)
+        epoch = int(h.get("epoch", 0))
+        if epoch < 2:
+            return False, [
+                f"[gate] fleet-ha: standby promoted WITHOUT bumping the "
+                f"epoch (epoch={epoch}) (FAIL)"
+            ]
+        msgs.append(
+            f"[gate] fleet-ha: standby took over as leader at epoch "
+            f"{epoch}"
+        )
+
+        # fencing probe: an op stamped with the dead leader's epoch
+        auth = bearer_headers(token)
+        code, _, doc = _request(
+            u2 + "/workers/claim",
+            json.dumps({"worker": "ghost", "epoch": 1}).encode(),
+            headers=auth,
+        )
+        if code != 409 or not doc.get("stale_epoch"):
+            return False, [
+                f"[gate] fleet-ha: stale-epoch claim answered {code} "
+                f"{doc} instead of 409 stale_epoch (FAIL)"
+            ]
+        # auth probes: every mutating endpoint, tokenless AND forged
+        mutating = [
+            ("/jobs", b"{}"), ("/workers/register", b"{}"),
+            ("/workers/claim", b"{}"), ("/workers/renew", b"{}"),
+            ("/workers/complete", b"{}"), ("/leases", b"{}"),
+            ("/results/deadbeef", b"x"),
+        ]
+        for path, body in mutating:
+            for hdrs in (None, {"Authorization": "Bearer forged"}):
+                code, _, _doc = _request(u2 + path, body, headers=hdrs)
+                if code != 401:
+                    return False, [
+                        f"[gate] fleet-ha: POST {path} with "
+                        f"{'no' if hdrs is None else 'a forged'} token "
+                        f"answered {code}, want 401 (FAIL)"
+                    ]
+        msgs.append(
+            "[gate] fleet-ha: stale-epoch op fenced (409) and all "
+            f"{len(mutating)} mutating endpoints reject missing/forged "
+            "tokens (401)"
+        )
+
+        th.join(300)
+        if "err" in box:
+            return False, [
+                f"[gate] fleet-ha: submit flow failed across the "
+                f"failover ({type(box['err']).__name__}: {box['err']}) "
+                "(FAIL)"
+            ]
+        results = box.get("results") or []
+        if len(results) != len(docs):
+            return False, [
+                f"[gate] fleet-ha: {len(results)}/{len(docs)} jobs "
+                "completed after the failover (FAIL)"
+            ]
+
+        # the resurrected old leader must fence itself to standby
+        res = _spawn_coord(p1, "resurrected")
+        _wait_role(u1, "standby", 30)
+        msgs.append(
+            f"[gate] fleet-ha: resurrected old leader (pid {res.pid}) "
+            "fenced itself to standby against the live epoch-"
+            f"{epoch} lease"
+        )
+
+        # byte-identity vs the single-coordinator reference
+        for d in digests:
+            with open(result_path(art2, d), "rb") as f:
+                got = f.read()
+            if got != ref_bytes[d]:
+                return False, [
+                    f"[gate] fleet-ha: result {d[:12]}… diverges from "
+                    "the single-coordinator reference bytes (FAIL)"
+                ]
+        # token redaction: /queue must describe auth without material
+        _, _, q = _request(u2 + "/queue", timeout=5)
+        blob = json.dumps(q)
+        if token in blob:
+            return False, ["[gate] fleet-ha: token material LEAKED "
+                           "into /queue (FAIL)"]
+        if not str(q.get("auth", "")).startswith("enabled"):
+            return False, [
+                f"[gate] fleet-ha: /queue auth field says "
+                f"{q.get('auth')!r}, want 'enabled (...)' (FAIL)"
+            ]
+        msgs.append(
+            f"[gate] fleet-ha: {len(docs)} jobs (incl. a fault lane) "
+            "survived a leader kill -9 — every result byte-identical "
+            "to the single-coordinator reference; auth described, "
+            "never leaked"
+        )
+    except Exception as err:
+        return False, [
+            f"[gate] fleet-ha: FAIL ({type(err).__name__}: {err})"
+        ]
+    finally:
+        try:
+            if procs:
+                from tpusim.svc.fleet import stop_workers
+
+                stop_workers(procs)
+            for c in coords:
+                if c.poll() is None:
+                    try:
+                        c.kill()
+                    except OSError:
+                        pass
+            if worker is not None:
+                worker.stop()
+            if srv is not None:
+                srv.stop()
+        except Exception:
+            pass
+    return True, msgs
+
+
 class FlakyShim:
     """The WAN fault injector of `make fleet-wan-smoke` (ISSUE 13): a
     MonitorServer extension app inserted BEFORE the real fleet app that
@@ -2043,6 +2365,15 @@ def main(argv=None) -> int:
         "skip) — the `make fleet-chaos-smoke` mode",
     )
     ap.add_argument(
+        "--fleet-ha-only", action="store_true",
+        help="run only the coordinator-HA smoke (ISSUE 17: token-armed "
+        "leader + standby pair over real HTTP, kill -9 the leader "
+        "mid-batch, standby adopts at a bumped epoch, workers re-join, "
+        "100%% completion byte-identical to a single-coordinator "
+        "reference, stale-epoch 409, forged-token 401s, resurrected "
+        "leader fenced) — the `make fleet-ha-smoke` mode",
+    )
+    ap.add_argument(
         "--fleet-wan-only", action="store_true",
         help="run only the fleet-wan smoke (ISSUE 13: remote-mode "
         "workers with NO shared filesystem behind a flaky HTTP shim, "
@@ -2084,6 +2415,12 @@ def main(argv=None) -> int:
         force_virtual_cpu_devices(2, force=True)
         os.makedirs(args.out, exist_ok=True)
         ok, msgs = policy_smoke(args.out)
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
+
+    if args.fleet_ha_only:
+        ok, msgs = fleet_ha_smoke(args.out)
         print("\n".join(msgs))
         print(f"[gate] {'PASS' if ok else 'FAIL'}")
         return 0 if ok else 1
@@ -2229,13 +2566,18 @@ def main(argv=None) -> int:
     # flaky transfer plane + supervisor respawn + the circuit breaker
     wan_ok, wan_msgs = fleet_wan_smoke(args.out)
     print("\n".join(wan_msgs))
+    # fleet-ha smoke (ISSUE 17): leader + standby pair, kill -9 the
+    # leader mid-batch — epoch-fenced takeover, auth probes,
+    # byte-identity vs a single-coordinator reference
+    ha_ok, ha_msgs = fleet_ha_smoke(args.out)
+    print("\n".join(ha_msgs))
     # scale-lane advisory (ISSUE 11 satellite): newest committed
     # MULTICHIP_r*.json, like the BENCH_r*.json baselines
     mc_ok, mc_msgs = multichip_advisory(latest_multichip())
     print("\n".join(mc_msgs))
     smoke_ok = (dec_ok and scrape_ok and swp_ok and svc_ok and serve_ok
                 and tune_ok and chaos_ok and pol_ok and hbm_ok
-                and mesh_ok and fleet_ok and wan_ok and mc_ok)
+                and mesh_ok and fleet_ok and wan_ok and ha_ok and mc_ok)
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

@@ -262,6 +262,11 @@ class MonitorServer:
                     with srv._lock:
                         draining = srv._draining
                         ok = not draining and hook_ok
+                        if draining and "role" in extra:
+                            # a draining coordinator is leaving the
+                            # role — standbys/clients must not treat
+                            # it as a live leader (ISSUE 17)
+                            extra = dict(extra, role="draining")
                         body = json.dumps({
                             "ok": ok,
                             "phase": srv._progress.get("phase"),

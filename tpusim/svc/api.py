@@ -30,6 +30,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from tpusim.svc import jobs as svc_jobs
+from tpusim.svc.auth import check as _auth_check
 from tpusim.svc.batcher import JobQueue, QueueFull, QuotaFull
 from tpusim.svc.worker import TraceRef, Worker
 
@@ -63,6 +64,10 @@ class JobService:
         # `serve --jobs --workers N` runs; None for the single
         # in-process worker of PR 7
         self.fleet = None
+        # bearer token guarding every mutating endpoint (ISSUE 17);
+        # empty = auth disabled. FleetService reads it via its `token`
+        # property so both planes enforce ONE secret.
+        self.token = ""
         # submit path serializes digest lookup + enqueue so concurrent
         # duplicate POSTs dedup instead of double-running
         self._submit_lock = threading.Lock()
@@ -172,6 +177,14 @@ class JobService:
 
     def handle(self, method: str, path: str, body: bytes, headers=None):
         if path == "/jobs" and method == "POST":
+            # auth BEFORE any parsing: a 401 must not leak whether the
+            # body would have been a valid spec or a known digest
+            if not _auth_check(headers, self.token):
+                return _json_body(
+                    401, {"error": "missing or invalid bearer token"}
+                )
+            if self.fleet is not None and self.fleet.role != "leader":
+                return self.fleet.standby_503()
             return self._post_jobs(body)
         if path == "/queue" and method == "GET":
             return self._get_queue()
@@ -312,7 +325,8 @@ def start_job_server(
     table_cache_dir: str = "", compile_cache_dir: str = "",
     start_worker: bool = True, recover: bool = True, out=None,
     fleet: bool = False, lease_s: float = 0.0, family_quota: int = 0,
-    policy_presets: Optional[dict] = None,
+    policy_presets: Optional[dict] = None, token: str = "",
+    coord=None,
 ) -> Tuple[object, JobService, Optional[Worker]]:
     """Wire the full service: MonitorServer (+ heartbeat-fed /progress)
     with the JobService app, a bounded JobQueue, and either the single
@@ -327,7 +341,11 @@ def start_job_server(
     ADOPTS still-live lease files (a coordinator restart under live
     workers must not double-hand-out their batches). `family_quota`
     arms the per-family admission cap; `lease_s` overrides the lease
-    duration (svc.leases.DEFAULT_LEASE_S)."""
+    duration (svc.leases.DEFAULT_LEASE_S). `token` arms bearer auth on
+    every mutating endpoint (ISSUE 17); `coord` (a
+    svc.coord.CoordinatorState, fleet mode only) arms HA — epoch-fenced
+    mutations, standby 503s, and recovery deferred until this process
+    actually holds the leadership lease."""
     from tpusim.obs.server import MonitorServer
 
     srv = MonitorServer(listen)
@@ -343,17 +361,32 @@ def start_job_server(
     service = JobService(queue, worker, traces, artifact_dir, monitor=srv,
                          policy_presets=policy_presets)
     service.bucket = bucket  # the register handshake hands it to workers
+    service.token = str(token or "")
+
+    # capability routing (ISSUE 17): tell the queue what each family
+    # actually NEEDS, judged against the hosted trace — claim_batch only
+    # hands fault-family or large-N work to workers declaring support.
+    def _family_needs(spec):
+        ref = service.traces.get(spec.trace)
+        n_nodes = len(ref.nodes) if ref is not None else 0
+        return {"fault": bool(spec.fault), "nodes": int(n_nodes),
+                "mem_bytes": 0}
+
+    queue.family_needs_fn = _family_needs
     srv.add_app(service)
     if fleet:
         from tpusim.svc.fleet import FleetService
 
         service.fleet = FleetService(service, lease_s=lease_s, out=out)
+        service.fleet.coord = coord
         srv.add_app(service.fleet)
         # fleet /healthz: 503 only when NO worker is live
         srv.health_hook = service.fleet.health
-    if recover:
+    if recover and (coord is None or coord.role == "leader"):
         # before start(): recovered jobs must be queued before the first
-        # client request can observe the service
+        # client request can observe the service. A standby defers —
+        # adoption happens at promotion (the CLI's takeover path), when
+        # the epoch fence guarantees the old leader can no longer act.
         recover_pending_jobs(service, out=out)
         if service.fleet is not None:
             service.fleet.adopt_leases(out=out)

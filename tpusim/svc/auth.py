@@ -1,0 +1,85 @@
+"""Bearer-token admission for the fleet planes (ISSUE 17).
+
+Digest verification (PR 13) guards *integrity* — a tampered upload or
+trace chunk is rejected by content hash. It never guarded *admission*:
+anyone who could reach the coordinator could submit jobs, claim work,
+or complete someone else's digest. This module adds the missing gate:
+a shared bearer token, loaded once at serve/worker/submit startup from
+`--token-file` or `TPUSIM_FLEET_TOKEN` (the envutil fail-loud
+pattern — a configured-but-unreadable token file is a startup error
+naming the path, never a silently open fleet), checked on every
+mutating endpoint with a constant-time compare.
+
+Rules the call sites follow:
+
+  * the check runs BEFORE any path/digest parsing, so a 401 never
+    leaks whether a digest exists;
+  * 401 bodies are uniform (`{"error": "missing or invalid bearer
+    token"}`) for missing, malformed, and forged tokens alike;
+  * token material never reaches a log line or the `/queue` document —
+    `describe()` is the only sanctioned rendering.
+
+An empty token disables the gate (the single-host default; every
+pre-ISSUE-17 flow is unchanged).
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+from typing import Optional
+
+ENV_TOKEN = "TPUSIM_FLEET_TOKEN"
+_HEADER = "Authorization"
+_PREFIX = "Bearer "
+
+
+def load_token(token_file: str = "") -> str:
+    """The fleet token: the file's stripped contents when
+    `--token-file` is given (fail-loud on an unreadable path), else
+    `TPUSIM_FLEET_TOKEN`, else "" (auth disabled)."""
+    if token_file:
+        try:
+            with open(token_file, "r", encoding="utf-8") as f:
+                tok = f.read().strip()
+        except OSError as err:
+            raise ValueError(
+                f"--token-file {token_file} is unreadable "
+                f"({type(err).__name__}: {err}) — refusing to start "
+                "with auth half-configured"
+            )
+        if not tok:
+            raise ValueError(
+                f"--token-file {token_file} is empty — refusing to "
+                "start with auth half-configured"
+            )
+        return tok
+    return os.environ.get(ENV_TOKEN, "").strip()
+
+
+def check(headers, token: str) -> bool:
+    """True when the request may mutate state: auth disabled, or the
+    `Authorization: Bearer <token>` header matches under
+    `hmac.compare_digest`. `headers` is any case-insensitive-get
+    mapping (http.client Message) or a plain dict."""
+    if not token:
+        return True
+    raw = (headers or {}).get(_HEADER) or ""
+    if not raw.startswith(_PREFIX):
+        return False
+    return hmac.compare_digest(
+        raw[len(_PREFIX):].encode("utf-8"), token.encode("utf-8")
+    )
+
+
+def bearer_headers(token: Optional[str]) -> dict:
+    """The request-side half: headers to attach to a mutating call."""
+    if not token:
+        return {}
+    return {_HEADER: _PREFIX + token}
+
+
+def describe(token: str) -> str:
+    """The ONLY way token state reaches a log line or `/queue`: armed
+    or off, length only — never material, never a digest of it."""
+    return f"enabled ({len(token)} chars)" if token else "disabled"

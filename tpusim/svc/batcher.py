@@ -197,7 +197,16 @@ class JobQueue:
             # completions of stolen jobs
             "quota_rejected": 0, "steals": 0, "lease_expired": 0,
             "dup_completions": 0,
+            # capability routing (ISSUE 17): claims that found queued
+            # work but nothing THIS worker declared support for
+            "starved_claims": 0,
         }
+        # capability routing (ISSUE 17): spec -> needs dict
+        # ({"fault": bool, "nodes": int, "mem_bytes": int}); the
+        # coordinator installs a trace-aware version (api.start_job_
+        # server) so above-threshold-N families route only to workers
+        # declaring the capacity. None -> spec-only needs (fault flag).
+        self.family_needs_fn = None
         # admission->result latency samples per job kind (ISSUE 16):
         # bounded ring per bucket, fed by mark_done (cached dedup hits
         # never ran, so they never sample); /queue serves p50/p99
@@ -271,11 +280,63 @@ class JobQueue:
         with self._cond:
             return len(self._queue)
 
+    # ---- capability routing (ISSUE 17) ----
+
+    def _needs(self, spec: JobSpec) -> dict:
+        """What serving this spec's family requires of a worker."""
+        if self.family_needs_fn is not None:
+            try:
+                return dict(self.family_needs_fn(spec))
+            except Exception:
+                pass  # a broken needs fn must not wedge claims
+        return {"fault": bool(spec.fault), "nodes": 0, "mem_bytes": 0}
+
+    def eligible(self, spec: JobSpec, caps: Optional[dict]) -> bool:
+        """May a worker with these capability tags serve this spec's
+        family? No caps (a pre-ISSUE-17 worker, or the local in-process
+        one) means unrestricted — every pre-existing flow is unchanged.
+        A worker declares: fault_lanes (fault-schedule sweep support,
+        default True), max_nodes (biggest trace it will take, 0 =
+        unlimited), memory_bytes (approximate host/device memory, 0 =
+        undeclared)."""
+        if not caps:
+            return True
+        needs = self._needs(spec)
+        if needs.get("fault") and not caps.get("fault_lanes", True):
+            return False
+        max_nodes = int(caps.get("max_nodes") or 0)
+        if max_nodes and int(needs.get("nodes") or 0) > max_nodes:
+            return False
+        mem = int(caps.get("memory_bytes") or 0)
+        if mem and int(needs.get("mem_bytes") or 0) > mem:
+            return False
+        return True
+
+    def starved_families(self, caps_list) -> List[str]:
+        """Family labels with queued work that NO live worker's
+        capability tags can serve — the `/queue` starvation surface.
+        Only meaningful when there ARE live workers (an empty fleet is
+        'no workers', not 'no capable workers'): callers pass the live
+        registry's caps and skip the call when it is empty."""
+        caps_list = [c or {} for c in caps_list]
+        out: List[str] = []
+        with self._cond:
+            seen = set()
+            for j in self._queue:
+                fam = j.spec.family_key()
+                if fam in seen:
+                    continue
+                seen.add(fam)
+                if not any(self.eligible(j.spec, c) for c in caps_list):
+                    out.append(j.spec.family_label())
+        return out
+
     # ---- batch formation: the claim side of the lease protocol ----
 
     def claim_batch(self, worker: str, timeout: Optional[float] = None,
                     linger_s: float = 0.0,
-                    now: Optional[float] = None) -> List[Job]:
+                    now: Optional[float] = None,
+                    caps: Optional[dict] = None) -> List[Job]:
         """Pop the next batch FOR `worker`: the oldest queued job + every
         queued job sharing its family key (the family shard), FIFO
         order, up to lane_width — each claimed job stamped with the
@@ -285,7 +346,14 @@ class JobQueue:
         wait up to that long for the rest of a concurrent submission
         wave to land (a wave split across two batches costs two scans —
         and, when the stragglers carry bigger tuned traces, a recompile
-        the one-batch form would have amortized)."""
+        the one-batch form would have amortized).
+
+        `caps` (ISSUE 17) makes the claim capability-aware: the batch
+        family is the OLDEST queued family this worker's tags can
+        serve — FIFO preserved within eligible work, ineligible
+        families left in place for a capable claimer (never reordered,
+        never dropped). Queued work with nothing eligible counts a
+        `starved_claims` tick and returns empty immediately."""
         with self._cond:
             if not self._queue:
                 self._cond.wait(timeout)
@@ -298,7 +366,17 @@ class JobQueue:
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
-            fam = self._queue[0].spec.family_key()
+            fam = None
+            if caps:
+                for j in self._queue:
+                    if self.eligible(j.spec, caps):
+                        fam = j.spec.family_key()
+                        break
+                if fam is None:
+                    self.stats_counters["starved_claims"] += 1
+                    return []
+            else:
+                fam = self._queue[0].spec.family_key()
             batch = [
                 j for j in self._queue if j.spec.family_key() == fam
             ][: self.lane_width]

@@ -7,11 +7,21 @@ exponential-with-jitter schedule — the SAME delay discipline its List
 retries use, so a fleet of submitters never dogpiles the service) and
 re-submits the remainder; dedup on the service side makes re-submitting
 an already-accepted document harmless.
+
+Coordinator HA (ISSUE 17): `submit_and_wait` accepts a comma-separated
+coordinator LIST and rotates through it on the shared backoff schedule
+when the current coordinator is lost — connection refused/lost past the
+retry budget, a standby's 503, or a post-failover 404 for a job id the
+dead leader assigned (`CoordinatorLost`). Re-submission after a rotate
+is idempotent: job digests dedup server-side, completed work answers
+from the result cache. Mutating POSTs carry the fleet bearer token
+(`token=` or TPUSIM_FLEET_TOKEN); the token never reaches a log line.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 import urllib.error
 import urllib.request
@@ -24,6 +34,19 @@ TERMINAL = ("done", "failed")
 
 class ServiceError(RuntimeError):
     pass
+
+
+class CoordinatorLost(ServiceError):
+    """One coordinator URL can no longer serve this flow (dead,
+    standby, or restarted with fresh job ids) — the caller's cue to
+    rotate to the next URL in its list and re-submit."""
+
+
+def _token(token: Optional[str]) -> str:
+    """Explicit token, else TPUSIM_FLEET_TOKEN, else "" (auth off)."""
+    if token is not None:
+        return str(token)
+    return os.environ.get("TPUSIM_FLEET_TOKEN", "").strip()
 
 
 class JobsFailed(ServiceError):
@@ -40,16 +63,18 @@ class JobsFailed(ServiceError):
 
 def _request(url: str, data: Optional[bytes] = None,
              timeout: float = 30.0,
-             content_type: str = "application/json"
+             content_type: str = "application/json",
+             headers: Optional[dict] = None
              ) -> Tuple[int, dict, dict]:
     """(status, headers, parsed JSON body); HTTP errors with a JSON body
     (the service's 4xx/5xx answers) are returned, transport errors
     raise. `content_type` marks non-JSON request bodies (the fleet's
-    raw signed-result uploads, ISSUE 13); answers are always JSON."""
-    req = urllib.request.Request(
-        url, data=data,
-        headers={"Content-Type": content_type} if data else {},
-    )
+    raw signed-result uploads, ISSUE 13); answers are always JSON.
+    `headers` adds extra request headers (the bearer token, ISSUE 17)."""
+    hdrs = dict(headers or {})
+    if data:
+        hdrs["Content-Type"] = content_type
+    req = urllib.request.Request(url, data=data, headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, dict(resp.headers), json.loads(
@@ -64,21 +89,27 @@ def _request(url: str, data: Optional[bytes] = None,
 
 
 def submit_jobs(url: str, docs: Sequence[dict], max_retries: int = 8,
-                timeout: float = 30.0, out=None) -> List[dict]:
+                timeout: float = 30.0, out=None,
+                token: Optional[str] = None) -> List[dict]:
     """POST every job document, honoring 429/Retry-After backpressure:
     rejected remainders are re-submitted after the advertised delay
     (dedup makes overlap safe). Returns the accepted job descriptions in
     submission order; raises ServiceError on a 400 or when the queue
-    never drains within max_retries rounds."""
+    never drains within max_retries rounds, CoordinatorLost when this
+    coordinator is unreachable or a standby (the rotate cue)."""
     import http.client
 
+    from tpusim.svc.auth import bearer_headers
+
     url = url.rstrip("/")
+    auth = bearer_headers(_token(token))
     pending = list(docs)
     accepted: List[dict] = []
     for attempt in range(1, max_retries + 1):
         body = json.dumps({"jobs": pending}).encode()
         try:
-            code, headers, doc = _request(url + "/jobs", body, timeout)
+            code, headers, doc = _request(url + "/jobs", body, timeout,
+                                          headers=auth)
         except (ConnectionResetError,
                 http.client.RemoteDisconnected, urllib.error.URLError) as e:
             # a draining/restarting service (ISSUE 10 graceful shutdown)
@@ -92,12 +123,12 @@ def submit_jobs(url: str, docs: Sequence[dict], max_retries: int = 8,
             if isinstance(e, ConnectionRefusedError) or isinstance(
                 reason, ConnectionRefusedError
             ):
-                raise ServiceError(
+                raise CoordinatorLost(
                     f"POST /jobs: connection refused at {url} — is the "
                     "service running?"
                 )
             if attempt >= max_retries:
-                raise ServiceError(
+                raise CoordinatorLost(
                     f"POST /jobs kept failing ({type(e).__name__}: {e}) "
                     f"after {max_retries} attempts"
                 )
@@ -115,12 +146,25 @@ def submit_jobs(url: str, docs: Sequence[dict], max_retries: int = 8,
             return accepted
         if code == 400:
             raise ServiceError(f"rejected: {doc.get('error', doc)}")
+        if code == 401:
+            raise ServiceError(
+                "POST /jobs -> HTTP 401: bearer token missing or "
+                "rejected (--token-file / TPUSIM_FLEET_TOKEN)"
+            )
         if code == 503:
             # drain answer: the service is finishing its in-flight batch
             # before exiting; the restarted process recovers persisted
-            # specs, so waiting + resubmitting is the right move
+            # specs, so waiting + resubmitting is the right move. A
+            # STANDBY's 503 (ISSUE 17) is different after a couple of
+            # polls: this coordinator is deliberately not leading —
+            # rotate instead of waiting out the schedule.
+            if doc.get("role") == "standby" and attempt >= 2:
+                raise CoordinatorLost(
+                    f"{url} is a standby coordinator (epoch "
+                    f"{doc.get('epoch', '?')})"
+                )
             if attempt >= max_retries:
-                raise ServiceError(
+                raise CoordinatorLost(
                     f"service stayed draining after {max_retries} attempts"
                 )
             delay = _retry_delay_s(attempt, headers.get("Retry-After"))
@@ -190,7 +234,25 @@ def wait_jobs(url: str, job_ids: Sequence[str], timeout: float = 300.0,
         for jid in job_ids:
             if last[jid] and last[jid]["status"] in TERMINAL:
                 continue
-            code, _, doc = _request(f"{url}/jobs/{jid}")
+            try:
+                code, _, doc = _request(f"{url}/jobs/{jid}")
+            except OSError as e:
+                # the coordinator died mid-poll (ISSUE 17): the caller
+                # rotates + re-submits — digests make that idempotent
+                raise CoordinatorLost(
+                    f"GET /jobs/{jid}: {type(e).__name__}: {e}"
+                )
+            if code == 404:
+                # a failed-over coordinator assigned NEW ids to the
+                # recovered specs; ours died with the old leader
+                raise CoordinatorLost(
+                    f"job {jid} unknown at {url} (coordinator "
+                    "restarted or failed over)"
+                )
+            if code == 503:
+                raise CoordinatorLost(
+                    f"{url} answered 503 for {jid} (standby/draining)"
+                )
             if code != 200:
                 raise ServiceError(f"GET /jobs/{jid} -> HTTP {code}: {doc}")
             last[jid] = doc
@@ -216,7 +278,17 @@ def fetch_results(url: str, job_ids: Sequence[str],
     url = url.rstrip("/")
     out = []
     for jid in job_ids:
-        code, _, doc = _request(f"{url}/jobs/{jid}/result", timeout=timeout)
+        try:
+            code, _, doc = _request(f"{url}/jobs/{jid}/result",
+                                    timeout=timeout)
+        except OSError as e:
+            raise CoordinatorLost(
+                f"GET /jobs/{jid}/result: {type(e).__name__}: {e}"
+            )
+        if code in (404, 503):
+            raise CoordinatorLost(
+                f"GET /jobs/{jid}/result -> HTTP {code} at {url}"
+            )
         if code != 200:
             raise ServiceError(
                 f"GET /jobs/{jid}/result -> HTTP {code}: {doc}"
@@ -247,7 +319,8 @@ def format_results_table(results: Sequence[dict]) -> str:
 
 
 def submit_and_wait(url: str, docs: Sequence[dict], timeout: float = 300.0,
-                    out=None, poll_s: float = 0.0) -> List[dict]:
+                    out=None, poll_s: float = 0.0,
+                    token: Optional[str] = None) -> List[dict]:
     """The whole `tpusim submit` flow: POST (with backpressure retries),
     poll to terminal, fetch results. When any job failed server-side,
     raises JobsFailed carrying BOTH the failure descriptions and the
@@ -255,17 +328,53 @@ def submit_and_wait(url: str, docs: Sequence[dict], timeout: float = 300.0,
     and must exit nonzero. `poll_s > 0` caps the inter-poll delay — the
     knob latency-sensitive interactive what-if clients (and the serve-
     latency gate) use so a millisecond-scale warm fork is not measured
-    through a second-scale poll schedule."""
-    accepted = submit_jobs(url, docs, out=out)
-    ids = [a["id"] for a in accepted]
-    final = wait_jobs(url, ids, timeout=timeout, poll_s=poll_s)
-    failed = [d for d in final if d["status"] == "failed"]
-    if failed:
-        done_ids = [d["id"] for d in final if d["status"] == "done"]
-        raise JobsFailed(
-            "job(s) failed: "
-            + "; ".join(f"{d['id']}: {d.get('error', '?')}" for d in failed),
-            failed,
-            fetch_results(url, done_ids) if done_ids else [],
-        )
-    return fetch_results(url, ids)
+    through a second-scale poll schedule.
+
+    `url` may be a comma-separated coordinator LIST (ISSUE 17): a
+    CoordinatorLost at any stage rotates to the next URL on the shared
+    backoff schedule and re-submits the SAME docs there — job digests
+    dedup server-side and finished work answers from the result cache,
+    so a coordinator failover costs a stall, never duplicate runs."""
+    from tpusim.io.kube_client import parse_url_list
+
+    urls = parse_url_list(url)
+    deadline = time.time() + timeout
+    rounds = 2 * len(urls)
+    last_lost: Optional[CoordinatorLost] = None
+    for round_ in range(1, rounds + 1):
+        cur = urls[0]
+        try:
+            accepted = submit_jobs(cur, docs, out=out, token=token)
+            ids = [a["id"] for a in accepted]
+            final = wait_jobs(
+                cur, ids, timeout=max(deadline - time.time(), 1.0),
+                poll_s=poll_s,
+            )
+            failed = [d for d in final if d["status"] == "failed"]
+            if failed:
+                done_ids = [
+                    d["id"] for d in final if d["status"] == "done"
+                ]
+                raise JobsFailed(
+                    "job(s) failed: "
+                    + "; ".join(f"{d['id']}: {d.get('error', '?')}"
+                                for d in failed),
+                    failed,
+                    fetch_results(cur, done_ids) if done_ids else [],
+                )
+            return fetch_results(cur, ids)
+        except CoordinatorLost as err:
+            last_lost = err
+            if round_ >= rounds or time.time() >= deadline:
+                break
+            urls = urls[1:] + urls[:1]
+            delay = _retry_delay_s(min(round_, 4))
+            if out is not None:
+                print(
+                    f"[submit] coordinator lost ({err}); rotating to "
+                    f"{urls[0]} in {delay:.1f}s", file=out,
+                )
+            time.sleep(min(delay, max(deadline - time.time(), 0.0)))
+    raise last_lost if last_lost is not None else ServiceError(
+        "submit_and_wait made no attempt"
+    )

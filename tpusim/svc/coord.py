@@ -1,0 +1,342 @@
+"""Coordinator HA — the epoch-fenced leadership lease (ISSUE 17).
+
+PR 12/13 made the *workers* stateless and kill-tolerant; the
+coordinator stayed the single point of failure. This module removes it
+with the same design argument: the artifact dir is the one source of
+truth, so leadership is just one more signed file in it.
+
+The active coordinator stakes `coordinator.lease.json` (written through
+`io.storage.write_signed_json` — atomic tmp+rename, payload-digest
+header) carrying an **epoch counter** and a deadline, and renews it on
+a LeaseKeeper-style timer at lease_s/3. A standby (`tpusim serve
+--jobs --standby`) watches the file and takes over when it goes stale:
+it bumps the epoch, stakes the lease, and re-adopts pending job specs
+(`recover_pending_jobs`), live worker leases (`claim_specific` via
+`FleetService.adopt_leases`), the fork index, and the policy presets —
+all of which live in the artifact dir already.
+
+**Epoch fencing** guards the split-brain window. Every fleet op
+(claim/renew/complete/leases) is stamped with the coordinator epoch
+the worker learned at registration:
+
+  op epoch < ours   the sender registered with a deposed leader →
+                    409 `{"stale_epoch": true, "register": true}`;
+                    the worker re-registers and adopts the new epoch.
+  op epoch > ours   a worker holds proof that a NEWER leader exists →
+                    WE are the deposed one: answer 409 `{"deposed":
+                    true}` and demote to standby on the spot. A
+                    resurrected old leader therefore fences itself on
+                    the first op it sees, before it can corrupt state.
+
+Exactly-once still holds across a failover for the PR 12 reasons: job
+digests pin trajectories, result writes are atomic whole-file replaces
+of identical bytes, and duplicate completions dedup silently.
+
+Torn/edited `coordinator.lease.json` files are skipped AND deleted
+with a `[Degrade]` warning (the load_valid_checkpoint pattern): a lost
+leadership lease only makes the cluster leaderless for one takeover
+interval, which is always safe.
+
+Knobs (fail-loud through tpusim.envutil, naming the variable):
+`TPUSIM_COORD_LEASE_S` (leadership lease duration, default 6 s; the
+standby takes over roughly one lease + skew after a leader dies) and
+`TPUSIM_COORD_SKEW_S` (cross-host clock margin on staleness
+judgements, default 2 s — the TPUSIM_LEASE_SKEW_S pattern).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from tpusim.envutil import float_env as _float_env
+
+# Lives beside the per-job `<digest>.lease.json` files; scan_leases
+# skips this reserved name so the job-lease reaper never judges (or
+# deletes) the leadership lease.
+COORD_LEASE_BASENAME = "coordinator.lease.json"
+COORD_LEASE_SCHEMA = "tpusim-svc-coord/1"
+
+DEFAULT_COORD_LEASE_S = 6.0
+
+
+def coord_lease_s() -> float:
+    """Leadership lease duration (env TPUSIM_COORD_LEASE_S, default
+    6 s). Renewal runs at a third of it; a standby takes over about one
+    lease + skew after the leader stops renewing. Must be > 0 — fails
+    loudly naming the variable (the PR 15 envutil pattern)."""
+    val = _float_env("TPUSIM_COORD_LEASE_S", DEFAULT_COORD_LEASE_S)
+    if val <= 0.0:
+        raise ValueError(
+            f"TPUSIM_COORD_LEASE_S must be > 0 seconds, got {val}"
+        )
+    return val
+
+
+def coord_skew_s() -> float:
+    """Clock-skew margin on every leadership-staleness judgement (env
+    TPUSIM_COORD_SKEW_S, default 2 s): the lease may be judged by a
+    different host than the one that wrote it, and leadership must
+    never change hands merely because two clocks disagree."""
+    return _float_env("TPUSIM_COORD_SKEW_S", 2.0)
+
+
+def coord_lease_path(artifact_dir: str) -> str:
+    return os.path.join(artifact_dir, COORD_LEASE_BASENAME)
+
+
+def write_coord_lease(artifact_dir: str, epoch: int, leader: str,
+                      pid: int, url: str, deadline_unix: float) -> str:
+    from tpusim.io.storage import write_signed_json
+
+    header = {"schema": COORD_LEASE_SCHEMA, "role": "coordinator"}
+    doc = {
+        "epoch": int(epoch),
+        "leader": str(leader),
+        "pid": int(pid),
+        "url": str(url),
+        "deadline_unix": float(deadline_unix),
+    }
+    return write_signed_json(coord_lease_path(artifact_dir), header, doc)
+
+
+def _degrade(path: str, err) -> None:
+    print(
+        f"[Degrade] skipping torn/foreign coordinator lease {path} "
+        f"({type(err).__name__}: {err}); deleted — the cluster is "
+        "leaderless until the next stake",
+        file=sys.stderr,
+    )
+
+
+def read_coord_lease(artifact_dir: str, on_skip=None) -> Optional[dict]:
+    """The leadership lease document, or None. Torn/edited/foreign
+    files are DELETED and reported through `on_skip(path, err)`
+    (default: a `[Degrade]` stderr line) — never trusted, never fatal,
+    never allowed to wedge a takeover."""
+    from tpusim.io.storage import read_signed_json
+
+    path = coord_lease_path(artifact_dir)
+    if not os.path.isfile(path):
+        return None
+    try:
+        header, doc = read_signed_json(path, COORD_LEASE_SCHEMA)
+        if header.get("role") != "coordinator":
+            raise ValueError("foreign lease file (not a coordinator lease)")
+        if not isinstance(doc.get("epoch"), int) or "deadline_unix" not in doc:
+            raise ValueError("malformed coordinator lease document")
+        return doc
+    except (OSError, ValueError, json.JSONDecodeError) as err:
+        (on_skip or _degrade)(path, err)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+
+
+def coord_lease_stale(doc: dict, now: Optional[float] = None,
+                      skew_s: Optional[float] = None) -> bool:
+    """True when the leadership deadline has passed by MORE than the
+    clock-skew margin — the only condition under which a standby may
+    take over."""
+    if now is None:
+        now = time.time()
+    if skew_s is None:
+        skew_s = coord_skew_s()
+    return float(now) > float(doc.get("deadline_unix", 0.0)) + skew_s
+
+
+def delete_coord_lease(artifact_dir: str) -> None:
+    try:
+        os.unlink(coord_lease_path(artifact_dir))
+    except OSError:
+        pass
+
+
+class CoordinatorState:
+    """One coordinator's view of the leadership protocol: its role
+    (`leader` | `standby`), its epoch, and the stake/renew/acquire
+    transitions over the shared lease file. Pure protocol — no threads,
+    no HTTP — so the tier-1 fencing matrix drives it synchronously; the
+    renewal timer lives in CoordKeeper and the serve loop.
+
+    Thread-safety: `epoch`/`role` are read by HTTP handler threads and
+    written under `_lock` by the serve loop / keeper; both are simple
+    attribute reads (atomic in CPython), and fencing tolerates a
+    one-op-stale view by construction.
+    """
+
+    def __init__(self, artifact_dir: str, name: str, url: str = "",
+                 lease_s: Optional[float] = None,
+                 skew_s: Optional[float] = None, out=None):
+        self.artifact_dir = str(artifact_dir)
+        self.name = str(name)
+        self.url = str(url)
+        self.lease_s = float(lease_s) if lease_s else coord_lease_s()
+        self.skew_s = float(skew_s) if skew_s is not None else coord_skew_s()
+        self.out = out
+        self.epoch = 0  # highest epoch this process has observed
+        self.role = "standby"
+        self.takeovers = 0
+        self.demotions = 0
+        self._lock = threading.Lock()
+
+    def _say(self, msg: str) -> None:
+        if self.out is not None:
+            print(msg, file=self.out)
+
+    # ---- transitions ----
+
+    def try_acquire(self, now: Optional[float] = None) -> bool:
+        """Attempt to become (or stay) the leader. Succeeds when the
+        on-disk lease is absent, stale past the skew margin, or our
+        own; a live foreign lease means someone else leads — remember
+        their epoch (for fencing) and stay standby."""
+        now = time.time() if now is None else now
+        with self._lock:
+            doc = read_coord_lease(self.artifact_dir)
+            if doc is not None:
+                seen = int(doc.get("epoch", 0))
+                if (doc.get("leader") != self.name
+                        and not coord_lease_stale(doc, now, self.skew_s)):
+                    self.epoch = max(self.epoch, seen)
+                    if self.role != "standby":
+                        self.role = "standby"
+                    return False
+                if doc.get("leader") == self.name and self.role == "leader":
+                    # already leading — just renew in place
+                    self._stake(now)
+                    return True
+            seen = int(doc.get("epoch", 0)) if doc else 0
+            self.epoch = max(self.epoch, seen) + 1
+            self.role = "leader"
+            self.takeovers += 1
+            self._stake(now)
+            self._say(
+                f"[coord] {self.name} took leadership at epoch "
+                f"{self.epoch} (previous lease: "
+                f"{'stale' if doc else 'absent'})"
+            )
+            return True
+
+    def _stake(self, now: float) -> None:
+        write_coord_lease(
+            self.artifact_dir, self.epoch, self.name, os.getpid(),
+            self.url, now + self.lease_s,
+        )
+
+    def renew(self, now: Optional[float] = None) -> bool:
+        """Push the leadership deadline out. Returns False — after
+        demoting — when the on-disk lease names a newer epoch: a
+        standby took over while we were wedged, and overwriting its
+        lease would be the split-brain this module exists to prevent."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if self.role != "leader":
+                return False
+            doc = read_coord_lease(self.artifact_dir)
+            if doc is not None and int(doc.get("epoch", 0)) > self.epoch:
+                self._demote_locked(
+                    f"coordinator lease shows epoch "
+                    f"{int(doc['epoch'])} > ours ({self.epoch})"
+                )
+                return False
+            self._stake(now)
+            return True
+
+    def note_epoch(self, epoch: int) -> bool:
+        """Record an epoch observed in a fleet op. Returns True when it
+        deposes us (op epoch newer than ours while we believed we were
+        the leader) — the caller answers 409 `{"deposed": true}`."""
+        epoch = int(epoch)
+        with self._lock:
+            if epoch <= self.epoch:
+                return False
+            deposed = self.role == "leader"
+            self.epoch = epoch
+            if deposed:
+                self._demote_locked(
+                    f"a fleet op carried epoch {epoch} > ours"
+                )
+            return deposed
+
+    def demote(self, reason: str = "") -> None:
+        with self._lock:
+            if self.role == "leader":
+                self._demote_locked(reason)
+
+    def _demote_locked(self, reason: str) -> None:
+        self.role = "standby"
+        self.demotions += 1
+        print(
+            f"[Degrade] coordinator {self.name} DEPOSED at epoch "
+            f"{self.epoch}{': ' + reason if reason else ''} — demoting "
+            "to standby (mutating endpoints now answer 503)",
+            file=sys.stderr,
+        )
+        self._say(f"[coord] {self.name} demoted to standby ({reason})")
+
+    def release(self) -> None:
+        """Graceful shutdown: delete our own lease so a standby takes
+        over immediately instead of waiting out the deadline. Never
+        deletes a successor's lease."""
+        with self._lock:
+            if self.role != "leader":
+                return
+            doc = read_coord_lease(self.artifact_dir)
+            if doc is not None and doc.get("leader") == self.name:
+                delete_coord_lease(self.artifact_dir)
+            self.role = "standby"
+
+
+class CoordKeeper:
+    """The leadership renewal timer — LeaseKeeper's little sibling.
+    Renews at lease_s/3 so one missed tick never deposes a healthy
+    leader; a renew() that discovers deposition stops the timer and
+    fires `on_deposed` (the serve loop drops back to standby watch)."""
+
+    def __init__(self, state: CoordinatorState, on_deposed=None):
+        self.state = state
+        self.on_deposed = on_deposed
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "CoordKeeper":
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="coord-keeper", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        period = max(self.state.lease_s / 3.0, 0.05)
+        while not self._stop.wait(period):
+            try:
+                ok = self.state.renew()
+            except Exception as err:  # keep renewing through fs hiccups
+                print(
+                    f"[coord] renew failed ({type(err).__name__}: "
+                    f"{err}); retrying", file=sys.stderr,
+                )
+                continue
+            if not ok:
+                if self.on_deposed is not None:
+                    try:
+                        self.on_deposed()
+                    except Exception:
+                        pass
+                return
+
+    def stop(self, release: bool = False) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if release:
+            self.state.release()

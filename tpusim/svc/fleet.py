@@ -73,6 +73,8 @@ from typing import Dict, List, Optional
 from tpusim.svc import jobs as svc_jobs
 from tpusim.svc import leases as svc_leases
 from tpusim.svc.api import _json_body
+from tpusim.svc.auth import bearer_headers
+from tpusim.svc.auth import check as auth_check
 from tpusim.svc.batcher import Job, JobQueue
 
 
@@ -105,6 +107,11 @@ class WorkerInfo:
     # counters (downloads/uploads/bytes/resumes/sha retries)
     mode: str = "shared-fs"
     transfers: dict = field(default_factory=dict)
+    # capability tags (ISSUE 17): what this worker declared at
+    # registration — backend name, device count, approximate memory
+    # bytes, fault-lane support, and the biggest trace it will take
+    # (max_nodes, 0 = unlimited). claim_batch routes families by these.
+    caps: dict = field(default_factory=dict)
 
     def live(self, now: float, window_s: float) -> bool:
         return (now - self.last_seen_unix) <= window_s
@@ -132,7 +139,7 @@ class WorkerRegistry:
         return max(3.0 * self.lease_s, 3.0)
 
     def register(self, worker_id: str, pid: int, host: str,
-                 mode: str = "") -> WorkerInfo:
+                 mode: str = "", caps: Optional[dict] = None) -> WorkerInfo:
         with self._lock:
             if not worker_id:
                 self._auto += 1
@@ -148,7 +155,22 @@ class WorkerRegistry:
                 info.last_seen_unix = time.time()
             if mode:
                 info.mode = str(mode)
+            if isinstance(caps, dict):
+                info.caps = dict(caps)
             return info
+
+    def live_caps(self, now: Optional[float] = None) -> List[dict]:
+        """The capability tags of every LIVE worker — the starvation
+        judge's input (a family no live worker can serve is starved;
+        an empty fleet is a different problem)."""
+        if now is None:
+            now = time.time()
+        with self._lock:
+            snapshot = list(self.workers.values())
+        return [
+            w.caps or {} for w in snapshot
+            if w.live(now, self.live_window_s)
+        ]
 
     def touch(self, worker_id: str) -> Optional[WorkerInfo]:
         with self._lock:
@@ -176,6 +198,7 @@ class WorkerRegistry:
                 "pid": w.pid,
                 "host": w.host,
                 "mode": w.mode,
+                "caps": dict(w.caps),
                 "transfers": dict(w.transfers),
                 "live": w.live(now, self.live_window_s),
                 "last_seen_s": round(now - w.last_seen_unix, 2),
@@ -216,15 +239,96 @@ class FleetService:
         # ISSUE 13), or None when workers join only from outside; /queue
         # and /healthz surface its respawn/breaker state when set
         self.supervisor = None
+        # the HA plane (ISSUE 17): a CoordinatorState when leadership
+        # leases are armed (the serve CLI / the fencing tests); None
+        # keeps every single-coordinator flow unfenced and unchanged
+        self.coord = None
+        # families already warned about in a [Degrade] line — once per
+        # family per process, not once per /queue poll
+        self._starve_warned = set()
         # coordinator-side transfer-plane counters (ISSUE 13)
         self.transfers = {
             "trace_requests": 0, "trace_bytes": 0,
             "uploads_ok": 0, "uploads_rejected": 0, "lease_posts": 0,
         }
 
+    # ---- the HA + auth gates (ISSUE 17) ----
+
+    @property
+    def epoch(self) -> int:
+        return self.coord.epoch if self.coord is not None else 0
+
+    @property
+    def role(self) -> str:
+        return self.coord.role if self.coord is not None else "leader"
+
+    @property
+    def token(self) -> str:
+        return getattr(self.service, "token", "") or ""
+
+    def _unauthorized(self):
+        # one uniform body for missing/malformed/forged tokens, issued
+        # BEFORE any digest parsing — a 401 never reveals whether a
+        # digest (or worker, or trace) exists
+        return _json_body(
+            401, {"error": "missing or invalid bearer token"}
+        )
+
+    def standby_503(self):
+        return _json_body(
+            503,
+            {"error": "standby coordinator — not the leader",
+             "role": self.role, "epoch": self.epoch},
+            headers={"Retry-After": "2"},
+        )
+
+    def _fence(self, doc: dict):
+        """Epoch fencing (ISSUE 17): judge the op's coordinator-epoch
+        stamp against ours. Older → 409 `{"stale_epoch": true,
+        "register": true}` (the worker re-registers and adopts the new
+        epoch). NEWER → the sender holds proof a newer leader exists,
+        so WE are the deposed one: demote on the spot and answer 409
+        `{"deposed": true}`. Unstamped ops (pre-HA workers, HA off)
+        pass untouched."""
+        if self.coord is None:
+            return None
+        op_epoch = doc.get("epoch")
+        if op_epoch is None:
+            return None
+        try:
+            op_epoch = int(op_epoch)
+        except (TypeError, ValueError):
+            return _json_body(400, {"error": "epoch must be an integer"})
+        mine = self.epoch
+        if op_epoch < mine:
+            return _json_body(409, {
+                "error": f"stale coordinator epoch {op_epoch} "
+                         f"(current {mine})",
+                "stale_epoch": True, "epoch": mine, "register": True,
+            })
+        if op_epoch > mine:
+            self.coord.note_epoch(op_epoch)
+            return _json_body(409, {
+                "error": f"op carries epoch {op_epoch} > ours ({mine}) "
+                         "— this coordinator was deposed and has "
+                         "demoted itself",
+                "deposed": True, "epoch": op_epoch,
+            })
+        return None
+
     # ---- request routing ----
 
     def handle(self, method: str, path: str, body: bytes, headers=None):
+        mine = (path in ("/traces", "/leases", "/workers")
+                or path.startswith(("/traces/", "/results/", "/workers/")))
+        if mine and method == "POST":
+            # admission first (auth runs before ANY path/digest
+            # parsing), then leadership: a standby must not mutate
+            # shared state even for a validly-authed worker
+            if not auth_check(headers, self.token):
+                return self._unauthorized()
+            if self.role != "leader":
+                return self.standby_503()
         # the transfer plane (ISSUE 13): trace download, result upload,
         # and the remote workers' lease mirror — all digest-guarded
         if path == "/traces" and method == "GET":
@@ -256,7 +360,12 @@ class FleetService:
         if not isinstance(doc, dict):
             return _json_body(400, {"error": "want a JSON object"})
         if path == "/workers/register":
+            # never fenced: register is HOW a worker adopts the new
+            # epoch after a takeover
             return self._register(doc)
+        fenced = self._fence(doc)
+        if fenced is not None:
+            return fenced
         if path == "/workers/claim":
             return self._claim(doc)
         if path == "/workers/renew":
@@ -383,6 +492,9 @@ class FleetService:
             return _json_body(400, {"error": f"bad JSON body: {err}"})
         if not isinstance(doc, dict):
             return _json_body(400, {"error": "want a JSON object"})
+        fenced = self._fence(doc)
+        if fenced is not None:
+            return fenced
         members = [str(m) for m in doc.get("members") or []]
         if not members:
             return _json_body(400, {"error": "want a members list"})
@@ -432,6 +544,7 @@ class FleetService:
         info = self.registry.register(
             str(doc.get("worker") or ""), doc.get("pid") or 0,
             str(doc.get("host") or ""), mode=str(doc.get("mode") or ""),
+            caps=doc.get("caps"),
         )
         if self.out is not None:
             print(f"[fleet] worker {info.id} joined (pid {info.pid}"
@@ -448,6 +561,9 @@ class FleetService:
             "artifact_dir": os.path.abspath(self.service.artifact_dir),
             "bucket": getattr(self.service, "bucket", 512),
             "traces": traces,
+            # the handshake is how a worker learns the coordinator
+            # epoch it must stamp every subsequent op with (ISSUE 17)
+            "epoch": self.epoch,
         })
 
     def release_dead(self, pid: int) -> int:
@@ -489,6 +605,27 @@ class FleetService:
         self.total_steals_cleaned += len(stolen)
         return stolen
 
+    def starved_families(self) -> List[str]:
+        """Queued families NO live worker's declared capabilities can
+        serve (ISSUE 17) — the `/queue` visibility + one loud
+        `[Degrade]` per family. Empty when the fleet is empty: that is
+        'no workers', a different (already-visible) problem."""
+        caps_list = self.registry.live_caps()
+        if not caps_list:
+            return []
+        starved = self.queue.starved_families(caps_list)
+        for fam in starved:
+            if fam not in self._starve_warned:
+                self._starve_warned.add(fam)
+                print(
+                    f"[Degrade] queued family {fam} is STARVED: no "
+                    "live worker declares the capabilities it needs "
+                    "(fault-lane support / max_nodes / memory) — it "
+                    "waits until a capable worker joins",
+                    file=self.out if self.out is not None else sys.stderr,
+                )
+        return starved
+
     def _claim(self, doc):
         info, err = self._known(doc)
         if err is not None:
@@ -496,7 +633,13 @@ class FleetService:
         self.steal_sweep()
         info.claims += 1
         batch = self.queue.claim_batch(info.id, timeout=0.0,
-                                       linger_s=0.05)
+                                       linger_s=0.05,
+                                       caps=info.caps or None)
+        if not batch and self.queue.depth() > 0:
+            # this worker found only work it cannot serve — judge the
+            # whole fleet so a truly starved family is loud, not a
+            # silent forever-queued row
+            self.starved_families()
         # stolen-but-already-finished shortcut: a thief's claim of a job
         # whose (presumed dead, actually slow) owner DID write the
         # signed result answers from disk — never re-runs the device
@@ -526,6 +669,7 @@ class FleetService:
             ],
             "deadline_unix": deadline,
             "lease_s": self.queue.lease_s,
+            "epoch": self.epoch,
         })
 
     def _renew(self, doc):
@@ -646,6 +790,8 @@ class FleetService:
     # ---- the /queue aggregation fields ----
 
     def queue_fields(self) -> dict:
+        from tpusim.svc.auth import describe as auth_describe
+
         rows = self.registry.describe(self.queue)
         out = {
             "workers": rows,
@@ -655,6 +801,13 @@ class FleetService:
                 r["sweep_executables"] for r in rows.values()
             ),
             "transfer": dict(self.transfers),
+            # the HA + auth surfaces (ISSUE 17): role/epoch for the
+            # operator, auth armed-or-not (NEVER token material), and
+            # the families currently starved for a capable worker
+            "role": self.role,
+            "epoch": self.epoch,
+            "auth": auth_describe(self.token),
+            "starved_families": self.starved_families(),
         }
         if self.supervisor is not None:
             # respawns, backoff, breaker state + reason, autoscale
@@ -673,7 +826,16 @@ class FleetService:
         extra = {
             "workers_live": live,
             "workers_known": len(self.registry.workers),
+            # role + epoch (ISSUE 17): `leader|standby` here; the
+            # /healthz handler overrides role to `draining` during a
+            # graceful shutdown (MonitorServer owns that flag)
+            "role": self.role,
+            "epoch": self.epoch,
         }
+        if self.role == "standby":
+            # a standby with no workers is doing its one job: watching
+            # the leadership lease. It is healthy by existing.
+            return True, extra
         if self.supervisor is not None:
             sup_ok, sup_fields = self.supervisor.healthy()
             extra.update(sup_fields)
@@ -699,19 +861,20 @@ def _with_backoff(call, max_attempts: int = 8, stop_event=None):
 
 
 def _post(url: str, path: str, doc: dict, timeout: float = 30.0,
-          max_attempts: int = 8, stop_event=None):
+          max_attempts: int = 8, stop_event=None, token: str = ""):
     from tpusim.svc.client import _request
 
     full = url.rstrip("/") + path
     data = json.dumps(doc).encode()
     return _with_backoff(
-        lambda: _request(full, data, timeout=timeout),
+        lambda: _request(full, data, timeout=timeout,
+                         headers=bearer_headers(token)),
         max_attempts=max_attempts, stop_event=stop_event,
     )
 
 
 def _post_bytes(url: str, path: str, data: bytes, timeout: float = 60.0,
-                max_attempts: int = 8):
+                max_attempts: int = 8, token: str = ""):
     """POST raw bytes (the signed-result upload) on the same backoff
     schedule as _post."""
     from tpusim.svc.client import _request
@@ -719,9 +882,95 @@ def _post_bytes(url: str, path: str, data: bytes, timeout: float = 60.0,
     full = url.rstrip("/") + path
     return _with_backoff(
         lambda: _request(full, data, timeout=timeout,
-                         content_type="application/octet-stream"),
+                         content_type="application/octet-stream",
+                         headers=bearer_headers(token)),
         max_attempts=max_attempts,
     )
+
+
+class CoordinatorRing:
+    """Multi-coordinator failover client (ISSUE 17): an ordered URL
+    list (`--join u1,u2`), one live cursor. Every post rides the
+    shared `with_backoff` schedule against the CURRENT coordinator;
+    when that coordinator stays unreachable past the whole schedule —
+    or keeps answering 503 (a standby, or a draining leader) — the
+    cursor rotates to the next URL and the call is retried there. With
+    a single URL this degrades to exactly the pre-HA behavior (the
+    final answer or exception surfaces).
+
+    Carries the bearer token so every mutating call through the ring
+    is authenticated; the token itself never appears in any log line.
+    """
+
+    def __init__(self, urls, token: str = "", stop_event=None):
+        from tpusim.io.kube_client import parse_url_list
+
+        self.urls = parse_url_list(urls)
+        self.token = str(token or "")
+        self.stop_event = stop_event
+        self._idx = 0
+
+    @property
+    def url(self) -> str:
+        return self.urls[self._idx]
+
+    def rotate(self) -> str:
+        self._idx = (self._idx + 1) % len(self.urls)
+        return self.url
+
+    def _attempts_per_url(self, max_attempts: int) -> int:
+        # with alternatives available, give up on one coordinator
+        # sooner — the schedule is shared, the budget is split
+        return max_attempts if len(self.urls) == 1 else min(max_attempts, 3)
+
+    def _drive(self, fn, max_attempts: int):
+        from tpusim.io.kube_client import retryable_conn_excs
+        from tpusim.svc.client import ServiceError
+
+        last_exc = None
+        answer = None
+        per_url = self._attempts_per_url(max_attempts)
+        for i in range(len(self.urls)):
+            try:
+                answer = fn(self.url, per_url)
+            except retryable_conn_excs() as err:
+                last_exc = err
+                if len(self.urls) > 1:
+                    self.rotate()
+                continue
+            code = answer[0]
+            if code == 503 and i < len(self.urls) - 1:
+                # a standby (or a draining leader) said "not me" —
+                # the next coordinator in the ring may be leading
+                self.rotate()
+                continue
+            return answer
+        if answer is not None:
+            return answer
+        if last_exc is not None:
+            raise last_exc
+        raise ServiceError(f"no coordinator reachable in {self.urls}")
+
+    def post(self, path: str, doc: dict, timeout: float = 30.0,
+             max_attempts: int = 8, stop_event=None):
+        return self._drive(
+            lambda u, ma: _post(
+                u, path, doc, timeout=timeout, max_attempts=ma,
+                stop_event=stop_event or self.stop_event,
+                token=self.token,
+            ),
+            max_attempts,
+        )
+
+    def post_bytes(self, path: str, data: bytes, timeout: float = 60.0,
+                   max_attempts: int = 8):
+        return self._drive(
+            lambda u, ma: _post_bytes(
+                u, path, data, timeout=timeout, max_attempts=ma,
+                token=self.token,
+            ),
+            max_attempts,
+        )
 
 
 def _get_bytes(url: str, path: str, offset: int = 0,
@@ -956,7 +1205,8 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
                max_batches: int = 0, table_cache_dir: str = "",
                compile_cache_dir: str = "", out=None,
                stop_event=None, mode: str = "auto",
-               cache_dir: str = "") -> int:
+               cache_dir: str = "", token: str = "",
+               caps: Optional[dict] = None) -> int:
     """The fleet worker's main loop: register, then claim/run/complete
     until stopped (or `max_batches` served — the test/smoke bound).
     Returns the number of batches served. SIGTERM handling is the
@@ -972,20 +1222,37 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
     /leases; "auto" (default) probes the handshake's paths and picks.
     Every POST rides the shared capped-backoff-with-jitter schedule
     honoring Retry-After, so a coordinator restart mid-claim is a
-    stall, not a dead worker."""
+    stall, not a dead worker.
+
+    `url` may be a comma-separated coordinator LIST (ISSUE 17): the
+    worker rotates through it via CoordinatorRing when the current
+    coordinator dies or demotes to standby, re-registering after an
+    epoch bump — a coordinator failover is a stall, not lost work.
+    `token` authenticates every mutating POST; `caps` are the
+    capability tags declared at registration (default:
+    svc.worker.local_caps())."""
     from tpusim.io.kube_client import retryable_conn_excs
     from tpusim.svc.client import ServiceError
-    from tpusim.svc.worker import Worker, load_trace
+    from tpusim.svc.worker import Worker, load_trace, local_caps
 
     host = os.uname().nodename if hasattr(os, "uname") else ""
+    if caps is None:
+        caps = local_caps()
+    ring = CoordinatorRing(url, token=token, stop_event=stop_event)
     try:
-        code, _, reg = _post(url, "/workers/register", {
+        code, _, reg = ring.post("/workers/register", {
             "worker": worker_id, "pid": os.getpid(), "host": host,
+            "caps": caps,
         }, stop_event=stop_event)
     except retryable_conn_excs() as err:
         raise ServiceError(
-            f"could not reach the coordinator at {url} "
+            f"could not reach any coordinator in {ring.urls} "
             f"({type(err).__name__}: {err})"
+        )
+    if code == 401:
+        raise ServiceError(
+            "POST /workers/register -> HTTP 401: bearer token missing "
+            "or rejected (--token-file / TPUSIM_FLEET_TOKEN)"
         )
     if code != 200:
         raise ServiceError(
@@ -993,14 +1260,39 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
         )
     wid = reg["worker"]
     lease_s = float(reg["lease_s"])
+    epoch = int(reg.get("epoch") or 0)
     counters = new_transfer_counters()
+
+    def stamp(doc: dict) -> dict:
+        # every mirrored lease/complete/claim op carries the
+        # coordinator epoch (ISSUE 17) — the fencing stamp
+        if epoch:
+            doc["epoch"] = epoch
+        return doc
+
+    def re_register() -> int:
+        # after a takeover the ring may already point at the new
+        # leader; registering there adopts ITS epoch for all
+        # subsequent stamps
+        nonlocal epoch
+        code, _, r = ring.post("/workers/register", {
+            "worker": wid, "pid": os.getpid(), "host": host,
+            "mode": mode, "caps": caps,
+        })
+        if code == 200:
+            new_epoch = int(r.get("epoch") or 0)
+            if out is not None and new_epoch != epoch:
+                print(
+                    f"[worker {wid}] re-registered at {ring.url} "
+                    f"(epoch {epoch} -> {new_epoch})", file=out,
+                )
+            epoch = new_epoch
+        return code
 
     mode = resolve_worker_mode(mode, reg)
     # record the resolved topology in the roster (register is an
     # idempotent update — /workers shows mode per worker)
-    _post(url, "/workers/register", {
-        "worker": wid, "pid": os.getpid(), "host": host, "mode": mode,
-    })
+    re_register()
 
     traces = {}
     if mode == "remote":
@@ -1014,7 +1306,8 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
         os.makedirs(artifact_dir, exist_ok=True)
         for name, meta in (reg.get("traces") or {}).items():
             traces[name] = ensure_local_trace(
-                url, name, meta, cache_dir, counters=counters, out=out,
+                ring.url, name, meta, cache_dir, counters=counters,
+                out=out,
             )
     else:
         artifact_dir = reg["artifact_dir"]
@@ -1045,11 +1338,21 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
     )
 
     def renew_remote(digests):
-        code, _, doc = _post(url, "/workers/renew",
-                             {"worker": wid, "digests": list(digests)})
-        if code != 200:
-            return []
-        return doc.get("lost") or []
+        # one 409 (epoch bump / wiped roster) earns an immediate
+        # re-register + retry so in-flight work keeps its lease across
+        # a coordinator failover instead of riding out a steal
+        for attempt in (1, 2):
+            code, _, doc = ring.post(
+                "/workers/renew",
+                stamp({"worker": wid, "digests": list(digests)}),
+            )
+            if code == 409 and attempt == 1:
+                re_register()
+                continue
+            if code != 200:
+                return []
+            return doc.get("lost") or []
+        return []
 
     worker.renew_cb = renew_remote
     if mode == "remote":
@@ -1057,15 +1360,16 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
         # reaping are unchanged) — a no-shared-fs worker mirrors them
         # over POST /leases; short retry budgets keep the keeper thread
         # from stalling a whole renewal period on a flaky link
-        worker.lease_stake_cb = lambda members: _post(
-            url, "/leases",
-            {"op": "stake", "worker": wid, "pid": os.getpid(),
-             "members": list(members)},
+        worker.lease_stake_cb = lambda members: ring.post(
+            "/leases",
+            stamp({"op": "stake", "worker": wid, "pid": os.getpid(),
+                   "members": list(members)}),
             max_attempts=3,
         )
-        worker.lease_release_cb = lambda members: _post(
-            url, "/leases",
-            {"op": "release", "worker": wid, "members": list(members)},
+        worker.lease_release_cb = lambda members: ring.post(
+            "/leases",
+            stamp({"op": "release", "worker": wid,
+                   "members": list(members)}),
             max_attempts=3,
         )
 
@@ -1074,8 +1378,9 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
     enable_compile_cache(compile_cache_dir)
     if out is not None:
         print(
-            f"[worker {wid}] joined {url} ({mode}, pid {os.getpid()}, "
-            f"{len(traces)} trace(s), lease {lease_s:.1f}s)", file=out,
+            f"[worker {wid}] joined {ring.url} ({mode}, pid "
+            f"{os.getpid()}, {len(traces)} trace(s), lease "
+            f"{lease_s:.1f}s)", file=out,
         )
 
     served = 0
@@ -1085,21 +1390,35 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
             # wait out the whole backoff schedule against a draining
             # coordinator's 503s (uploads/completions below finish
             # regardless — that is the graceful half)
-            code, _, doc = _post(url, "/workers/claim", {"worker": wid},
-                                 stop_event=stop_event)
+            code, _, doc = ring.post("/workers/claim",
+                                     stamp({"worker": wid}),
+                                     stop_event=stop_event)
         except retryable_conn_excs():
-            # coordinator down longer than the whole backoff schedule:
-            # its recovery requeues everything; keep polling
+            # every coordinator down longer than the whole backoff
+            # schedule: recovery requeues everything; keep polling
             time.sleep(max(poll_s, 0.5))
             continue
         if code == 409:
-            # roster wiped by a coordinator restart — re-register
-            _post(url, "/workers/register", {
-                "worker": wid, "pid": os.getpid(), "host": host,
-                "mode": mode,
-            })
+            # roster wiped by a coordinator restart, or our epoch
+            # stamp is stale after a takeover — re-register (the ring
+            # already points at whichever coordinator answered)
+            re_register()
             continue
         if code != 200:
+            time.sleep(max(poll_s, 0.5))
+            continue
+        resp_epoch = int((doc or {}).get("epoch") or 0)
+        if resp_epoch and epoch and resp_epoch < epoch:
+            # the worker-side fence (ISSUE 17): a resurrected
+            # old-epoch leader handed us work — refuse it and move to
+            # the coordinator whose epoch matches what we adopted
+            if out is not None:
+                print(
+                    f"[worker {wid}] rejecting claim from {ring.url} "
+                    f"(epoch {resp_epoch} < {epoch} — deposed "
+                    "leader); rotating", file=out,
+                )
+            ring.rotate()
             time.sleep(max(poll_s, 0.5))
             continue
         jobs_docs = doc.get("jobs") or []
@@ -1150,7 +1469,7 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
                     failed[d] = "local signed result vanished/torn"
                     continue
                 try:
-                    code, _, up = _post_bytes(url, f"/results/{d}", data)
+                    code, _, up = ring.post_bytes(f"/results/{d}", data)
                 except retryable_conn_excs():
                     code, up = 0, {"error": "coordinator unreachable"}
                 if code == 200:
@@ -1182,17 +1501,26 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
                             "expiry", file=out,
                         )
             done = still_done
-        try:
-            _post(url, "/workers/complete", {
-                "worker": wid, "done": done, "failed": failed,
-                "dispatch_s": worker.last_dispatch_s,
-                "sweep_executables": worker.sweep_executables(),
-                "transfers": counters,
-            })
-        except retryable_conn_excs():
-            # results + spec deletions are already on disk — a restarted
-            # coordinator reconciles from there (its claim shortcut)
-            pass
+        for attempt in (1, 2):
+            try:
+                code, _, _ack = ring.post("/workers/complete", stamp({
+                    "worker": wid, "done": done, "failed": failed,
+                    "dispatch_s": worker.last_dispatch_s,
+                    "sweep_executables": worker.sweep_executables(),
+                    "transfers": counters,
+                }))
+            except retryable_conn_excs():
+                # results + spec deletions are already on disk — a
+                # restarted coordinator reconciles from there (its
+                # claim shortcut)
+                break
+            if code == 409 and attempt == 1:
+                # epoch bump mid-batch: adopt the new epoch and report
+                # the SAME completion once more — mark_done dedups, so
+                # across-epoch duplicates are silent, never conflicts
+                re_register()
+                continue
+            break
         if out is not None and batch:
             print(
                 f"[worker {wid}] batch {served}: {len(done)} done, "
@@ -1212,7 +1540,7 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
 
 def worker_command(url: str, table_cache_dir: str = "",
                    compile_cache_dir: str = "", mode: str = "",
-                   cache_dir: str = "") -> List[str]:
+                   cache_dir: str = "", token_file: str = "") -> List[str]:
     """The `tpusim worker --join` argv for one spawned child — shared
     by spawn_local_workers and the supervisor's spawn_fn (ISSUE 13).
     No --id: the coordinator assigns pid-scoped ids, so a respawned or
@@ -1227,12 +1555,16 @@ def worker_command(url: str, table_cache_dir: str = "",
         cmd += ["--mode", mode]
     if cache_dir:
         cmd += ["--cache-dir", cache_dir]
+    if token_file:
+        # the token travels as a file PATH, never argv material — a
+        # `ps` on the worker host shows the path, not the secret
+        cmd += ["--token-file", token_file]
     return cmd
 
 
 def spawn_local_workers(url: str, n: int, table_cache_dir: str = "",
                         compile_cache_dir: str = "",
-                        out=None) -> List[subprocess.Popen]:
+                        out=None, token_file: str = "") -> List[subprocess.Popen]:
     """Spawn N `tpusim worker --join` processes against this
     coordinator. They inherit the environment (JAX_PLATFORMS etc.) and
     share the persistent compile cache + table cache dirs — the warm
@@ -1241,7 +1573,7 @@ def spawn_local_workers(url: str, n: int, table_cache_dir: str = "",
     for _ in range(int(n)):
         cmd = worker_command(
             url, table_cache_dir=table_cache_dir,
-            compile_cache_dir=compile_cache_dir,
+            compile_cache_dir=compile_cache_dir, token_file=token_file,
         )
         procs.append(subprocess.Popen(cmd))
         if out is not None:

@@ -169,9 +169,16 @@ def scan_leases(artifact_dir: str,
     recovery's work list."""
     if not os.path.isdir(artifact_dir):
         return []
+    from tpusim.svc.coord import COORD_LEASE_BASENAME
+
     out = []
     for fname in sorted(os.listdir(artifact_dir)):
         if not fname.endswith(LEASE_SUFFIX):
+            continue
+        if fname == COORD_LEASE_BASENAME:
+            # the leadership lease (ISSUE 17) shares the suffix but has
+            # its own schema + reaper — never judge it as a job lease
+            # (read_lease would "helpfully" delete it as foreign).
             continue
         digest = fname[: -len(LEASE_SUFFIX)]
         doc = read_lease(artifact_dir, digest, on_skip=on_skip)

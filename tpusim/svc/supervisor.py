@@ -104,6 +104,10 @@ class Supervisor:
         self.on_exit = on_exit  # pid -> ignored (fleet.release_dead)
         self.out = out
         self.children: List[Child] = []
+        # standby/demoted mode (ISSUE 17): a paused supervisor reaps
+        # but neither respawns nor autoscales — the HA serve loop
+        # pauses at demotion and resumes at promotion
+        self.paused = False
         self.breaker = BreakerState()
         self._failures = 0  # consecutive fast exits (backoff key)
         self._next_spawn_unix = 0.0
@@ -132,9 +136,28 @@ class Supervisor:
     def start(self, now: Optional[float] = None) -> "Supervisor":
         now = time.time() if now is None else now
         with self._lock:
-            while len(self.children) < self.base:
+            while not self.paused and len(self.children) < self.base:
                 self._spawn(now)
         return self
+
+    def pause(self) -> None:
+        """Stop respawning and autoscaling (standby / demoted
+        coordinator, ISSUE 17). Live children keep running: a demoted
+        leader's local workers are harmlessly fenced by its 503s and
+        pick work back up the moment it re-acquires leadership."""
+        with self._lock:
+            self.paused = True
+
+    def resume(self, now: Optional[float] = None) -> None:
+        """Promotion: re-arm spawning (the next poll() fills the floor
+        immediately — no leftover backoff from the paused era)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self.paused = False
+            self._next_spawn_unix = 0.0
+            while len([c for c in self.children if not c.draining]) \
+                    < self.base:
+                self._spawn(now)
 
     def stop(self, timeout: float = 10.0) -> None:
         """Drain every child: SIGTERM (graceful — the worker CLI's stop
@@ -238,8 +261,10 @@ class Supervisor:
 
             alive = [c for c in self.children if not c.draining]
 
-            # 2. respawn toward the floor (breaker + backoff gated)
+            # 2. respawn toward the floor (breaker + backoff + pause
+            # gated)
             while (len(alive) < self.base and not self.breaker.open
+                   and not self.paused
                    and now >= self._next_spawn_unix):
                 window = [
                     t for t in self.breaker.respawn_times
@@ -260,7 +285,7 @@ class Supervisor:
             # 3. autoscale (only armed when max > base and a load
             # signal exists)
             if (self.load_fn is not None and self.max > self.base
-                    and not self.breaker.open):
+                    and not self.breaker.open and not self.paused):
                 try:
                     depth = int(self.load_fn())
                 except Exception:
@@ -319,6 +344,7 @@ class Supervisor:
             return {
                 "workers": self.base,
                 "max_workers": self.max,
+                "paused": self.paused,
                 "alive": len(alive),
                 "draining": len(self.children) - len(alive),
                 "pids": [c.pid for c in self.children],

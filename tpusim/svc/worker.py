@@ -219,6 +219,37 @@ def load_trace(name: str, nodes_csv: str, pods_csv: str,
     )
 
 
+def local_caps() -> dict:
+    """The capability tags this process declares in the fleet register
+    handshake (ISSUE 17): accelerator backend + local device count
+    (from jax when importable; cpu/1 otherwise — a handshake must never
+    crash on a worker without the toolchain warm), approximate host
+    memory, fault-lane support (every engine in this tree carries the
+    chaos dispatch, so True unless an operator override says otherwise),
+    and max_nodes (0 = no cluster-size ceiling). The coordinator routes
+    claims against these tags (JobQueue.eligible)."""
+    backend, devices = "cpu", 1
+    try:
+        import jax
+
+        backend = str(jax.default_backend())
+        devices = int(jax.local_device_count())
+    except Exception:
+        pass  # capability probing is best-effort, never fatal
+    mem = 0
+    try:
+        mem = int(os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES"))
+    except (ValueError, OSError, AttributeError):
+        pass
+    return {
+        "backend": backend,
+        "devices": devices,
+        "memory_bytes": mem,
+        "fault_lanes": True,
+        "max_nodes": 0,
+    }
+
+
 def summarize_lane(lane, job: Job) -> dict:
     """SweepLane -> the persisted/HTTP result document: the shared
     per-lane term vocabulary (learn.objective.lane_terms — ONE code
