@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke serve-latency-smoke tune-smoke policy-smoke pallas-hbm-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke fleet-ha-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke serve-latency-smoke tune-smoke policy-smoke pallas-hbm-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke fleet-ha-smoke fleet-trace-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -43,7 +43,7 @@ bench-scale-smoke:
 # files including slow-marked cases (the synthetic kill/resume +
 # telemetry subsets are already wired into tier-1).
 resume-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_deschedule.py tests/test_fork.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_svc_fork.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_ha.py tests/test_transfer.py tests/test_supervisor.py tests/test_policy_learned.py tests/test_blocked_engine.py tests/test_pallas_hbm.py tests/test_table_engine.py tests/test_parallel.py tests/test_pallas_engine.py tests/test_batch.py tests/test_kube_client.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_checkpoint.py tests/test_deschedule.py tests/test_fork.py tests/test_faults.py tests/test_fault_lane.py tests/test_obs.py tests/test_decisions.py tests/test_series.py tests/test_sweep.py tests/test_svc.py tests/test_svc_fork.py tests/test_learn.py tests/test_pipeline.py tests/test_fleet.py tests/test_ha.py tests/test_transfer.py tests/test_trace_audit.py tests/test_supervisor.py tests/test_policy_learned.py tests/test_blocked_engine.py tests/test_pallas_hbm.py tests/test_table_engine.py tests/test_parallel.py tests/test_pallas_engine.py tests/test_batch.py tests/test_kube_client.py -q
 
 # config-axis sweep smoke (ENGINES.md "Round 11"): the weight-operand /
 # vmapped-sweep suite (cross-engine bit-identity under traced weights,
@@ -192,6 +192,22 @@ fleet-wan-smoke:
 fleet-ha-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --fleet-ha-only
 
+# fleet-trace smoke (ENGINES.md "Round 22"): the fleet flight recorder
+# end-to-end — a coordinator + supervised worker pair over real HTTP,
+# jobs submitted BEFORE the workers join, then `kill -9` of the first
+# lease-holder mid-batch. Hard checks: every job completes with a
+# gap-free stitched cross-process timeline (admission/queue-wait/claim/
+# dispatch/upload/verify spans all carrying the ONE trace id minted at
+# submit; zero orphan spans; the killed worker's half-open attempt
+# stitched as ABANDONED), the `tpusim trace` / `tpusim audit` verbs
+# exit 0 against the artifact dir (Chrome-trace export written), the
+# hash-chained audit log verifies end-to-end recording BOTH the steal
+# and the supervisor's respawn, and the aggregated coordinator
+# /metrics parses as exposition text with a worker=-labeled series set
+# for every live worker that served a batch.
+fleet-trace-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --fleet-trace-only
+
 # bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
 # measurement under profiling and diff it against the newest committed
 # BENCH_r*.json baseline — exact on events/placements/gpu_alloc
@@ -215,7 +231,10 @@ fleet-ha-smoke:
 # under injected transfer faults, supervisor respawn, circuit
 # breaker), and coordinator HA (ISSUE 17, the fleet-ha-smoke check:
 # kill -9 the leader mid-batch, epoch-fenced standby takeover, auth
-# probes, byte-identity vs a single-coordinator reference). Exit 1 on
+# probes, byte-identity vs a single-coordinator reference), and the
+# fleet flight recorder (ISSUE 19, the fleet-trace-smoke check:
+# stitched cross-process timelines across a kill -9 + steal, the
+# hash-chained audit log, aggregated per-worker /metrics). Exit 1 on
 # regression; artifacts land in .tpusim_obs/.
 bench-gate:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate

@@ -116,6 +116,8 @@ def _invariance_runs(overrides):
         _assert_same_run(runs[0][0], runs[0][1], res, dm)
 
 
+@pytest.mark.slow  # two fault-engine compiles (~11 s) — the ISSUE 19
+# tier-1 buy-back trims it into resume-smoke beside the blocked case
 def test_fault_lane_engine_invariant():
     """sequential vs flat-table fault lanes replay one schedule
     bit-identically (the shard engine is pinned separately; the
@@ -271,6 +273,8 @@ def test_retry_queue_overflow_goes_terminal():
     assert reasons.count("max-retries-exceeded") >= 2
 
 
+@pytest.mark.slow  # the auto-fallback leg compiles a segmented replay
+# (~3 s) — ISSUE 19 tier-1 buy-back, resume-smoke runs it
 def test_fault_mode_validation():
     nodes, pods = _nodes(), _pods(2)
     sim = _sim(nodes, pods, fault_mode="nope")
@@ -429,6 +433,8 @@ def test_torn_checkpoint_walkback(tmp_path):
     assert storage.load_valid_checkpoint(d, digest) is None
 
 
+@pytest.mark.slow  # boots two job servers and drains real batches
+# (~6 s) — ISSUE 19 tier-1 buy-back, resume-smoke runs it
 def test_svc_job_spec_persistence_and_recovery(tmp_path):
     """Accepted jobs persist as .job.json; a restarted service requeues
     every spec without a signed result (crash mid-batch no longer

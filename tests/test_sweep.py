@@ -263,6 +263,8 @@ def _assert_lane_matches(lane, res, telemetry=None):
         ), (got, telemetry.counters)
 
 
+@pytest.mark.slow  # three standalone oracles + the vmapped sweep
+# (~10 s of compiles) — ISSUE 19 tier-1 buy-back, resume-smoke runs it
 def test_sweep_matches_standalone_table():
     """Each lane of a table-engine config-axis sweep must equal the
     standalone run with that weight row baked into the config — same
@@ -311,6 +313,8 @@ def test_sweep_matches_standalone_table():
     assert len(engines) == 1
 
 
+@pytest.mark.slow  # a full CLI sweep replay (~4 s) — ISSUE 19 tier-1
+# buy-back, resume-smoke runs it
 def test_apply_sweep_weights_cli(tmp_path):
     """`tpusim apply --sweep-weights weights.json` — the CLI face: loads
     a {"weights": ..., "seeds": ...} grid, replays it as one sweep, and

@@ -1,0 +1,512 @@
+"""Fleet flight recorder (ISSUE 19): the hash-chained audit log, the
+per-process span recorder + cross-process stitcher, trace-id
+propagation through the fleet protocol (including an epoch-bump
+re-register and an orphan steal), steal-visibility accounting, and the
+aggregated /metrics label hygiene.
+
+Tier-1 slice: pure protocol, no device, no spawned worker fleet — the
+stitch/chain/fence cases run on handcrafted files and the in-process
+FleetService stack (the test_fleet idiom). The process-spawning case
+(a real kill -9'd recorder) is slow-marked and runs under
+`make resume-smoke`; the full real-HTTP fleet end-to-end lives in
+`make fleet-trace-smoke`.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tpusim.io import storage
+from tpusim.io.trace import NodeRow, PodRow
+from tpusim.obs import audit as obs_audit
+from tpusim.obs import trace as obs_trace
+from tpusim.obs.emitters import parse_prometheus_text
+from tpusim.svc import jobs as svc_jobs
+from tpusim.svc.api import JobService
+from tpusim.svc.batcher import JobQueue
+from tpusim.svc.fleet import FleetService, worker_metrics_text
+from tpusim.svc.worker import TraceRef
+
+FAM = [["FGDScore", 1000], ["BestFitScore", 500]]
+
+
+@pytest.fixture(scope="module")
+def trace():
+    rng = np.random.default_rng(3)
+    nodes = [
+        NodeRow(f"n{i:03d}", 32000, 131072, int(g),
+                "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([0, 2, 4, 8], 16))
+    ]
+    pods = []
+    for i in range(24):
+        gpu = int(rng.choice([0, 1, 2]))
+        milli = 1000 if gpu > 1 else int(rng.choice([0, 300, 500, 1000]))
+        if gpu == 0:
+            milli = 0
+        pods.append(
+            PodRow(f"p{i:04d}", int(rng.choice([1000, 2000, 4000])),
+                   2048, gpu, milli)
+        )
+    return TraceRef(
+        "default", nodes, pods, svc_jobs.trace_digest(nodes, pods)
+    )
+
+
+def _fleet_stack(trace, tmp_path, lease_s=0.25):
+    queue = JobQueue(maxsize=32, lane_width=2, lease_s=lease_s)
+    service = JobService(queue, None, {"default": trace}, str(tmp_path))
+    service.bucket = 512
+    service.spans = obs_trace.SpanRecorder(str(tmp_path), "coord-test")
+    service.audit = obs_audit.AuditLog(str(tmp_path), "coord-test")
+    fleet = FleetService(service)
+    service.fleet = fleet
+    return queue, service, fleet
+
+
+def _call(fleet, path, doc):
+    resp = fleet.handle("POST", path, json.dumps(doc).encode())
+    return resp[0], json.loads(resp[2].decode())
+
+
+# ---------------------------------------------------------------------------
+# 1. the hash chain (io.storage) — append, verify, tamper
+# ---------------------------------------------------------------------------
+
+
+def test_chain_append_and_verify(tmp_path):
+    path = str(tmp_path / "chain.jsonl")
+    for i in range(5):
+        storage.chain_append(path, {"kind": "k", "i": i})
+    assert storage.chain_verify(path) == 5
+    records = storage.chain_records(path)
+    assert [r["i"] for r, _ in records] == list(range(5))
+    # every record names its predecessor; genesis opens the chain
+    assert records[0][0]["prev"] == storage.CHAIN_GENESIS
+    for (_, h), (r2, _) in zip(records, records[1:]):
+        assert r2["prev"] == h
+
+
+def test_chain_rejects_truncation(tmp_path):
+    path = str(tmp_path / "chain.jsonl")
+    for i in range(4):
+        storage.chain_append(path, {"i": i})
+    with open(path) as f:
+        lines = f.read().splitlines()
+    with open(path, "w") as f:
+        f.write("\n".join(lines[:-1]) + "\n")
+    # links still verify line-to-line, but the head sidecar knows the
+    # chain is SHORTER than it was — truncation fails loudly
+    with pytest.raises(ValueError):
+        storage.chain_verify(path)
+
+
+def test_chain_rejects_edit(tmp_path):
+    path = str(tmp_path / "chain.jsonl")
+    for i in range(4):
+        storage.chain_append(path, {"i": i, "who": "w1"})
+    with open(path) as f:
+        lines = f.read().splitlines()
+    doc = json.loads(lines[1])
+    doc["who"] = "w2"  # rewrite history
+    lines[1] = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(ValueError):
+        storage.chain_records(path)
+    with pytest.raises(ValueError):
+        storage.chain_verify(path)
+
+
+# ---------------------------------------------------------------------------
+# 2. the audit log + `tpusim audit`
+# ---------------------------------------------------------------------------
+
+
+def test_audit_log_tail_filters_and_cli(tmp_path):
+    art = str(tmp_path)
+    log = obs_audit.AuditLog(art, "coord-1")
+    log.emit("takeover", coordinator="c1", epoch=3)
+    log.emit("steal", job="a" * 64, worker="w1", reason="lease_expired")
+    log.emit("requeue", job="b" * 64, worker="w1", reason="worker-dead")
+    log.emit("steal", job="c" * 64, worker="w2", reason="lease_expired")
+    assert obs_audit.verify(art) == 4
+
+    assert [r["kind"] for r in obs_audit.tail(art, n=0)] == [
+        "takeover", "steal", "requeue", "steal"]
+    assert len(obs_audit.tail(art, n=0, kind="steal")) == 2
+    assert [r["job"] for r in obs_audit.tail(art, n=0, worker="w1")] == [
+        "a" * 64, "b" * 64]
+    # job filters match by prefix (digests are long)
+    assert len(obs_audit.tail(art, n=0, job="a" * 8)) == 1
+    assert len(obs_audit.tail(art, n=1)) == 1
+
+    from tpusim.cli import main
+    assert main(["audit", "-d", art]) == 0
+    assert main(["audit", "-d", art, "--verify"]) == 0
+    assert main(["audit", "-d", str(tmp_path / "nope")]) == 2
+    # truncate: the verify verb exits 1, loudly
+    path = obs_audit.audit_path(art)
+    with open(path) as f:
+        lines = f.read().splitlines()
+    with open(path, "w") as f:
+        f.write("\n".join(lines[:-1]) + "\n")
+    assert main(["audit", "-d", art, "--verify"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# 3. the span recorder + stitcher + `tpusim trace`
+# ---------------------------------------------------------------------------
+
+
+def test_span_recorder_and_stitch(tmp_path):
+    art = str(tmp_path)
+    job = "d" * 64
+    rec = obs_trace.SpanRecorder(art, "coord-9")
+    rec.emit(obs_trace.SPAN_ADMIT, 10.0, 10.5, job=job, trace="t1")
+    sid = rec.begin(obs_trace.SPAN_DISPATCH, job=job, trace="t1",
+                    lane=0)
+    rec.end(sid, dispatch_s=1.25)
+    with rec.span(obs_trace.SPAN_UPLOAD, job=job, trace="t1") as sp:
+        sp.meta["bytes"] = 123
+    with pytest.raises(RuntimeError):
+        with rec.span(obs_trace.SPAN_VERIFY, job=job, trace="t1"):
+            raise RuntimeError("boom")
+    rec.emit(obs_trace.SPAN_ADMIT, 11.0, 11.1, job="e" * 64, trace="t2")
+
+    spans, problems = obs_trace.stitch(art, job=job)
+    assert problems == []
+    assert [s["status"] for s in spans] == ["ok"] * 4
+    names = {s["name"] for s in spans}
+    assert names == {obs_trace.SPAN_ADMIT, obs_trace.SPAN_DISPATCH,
+                     obs_trace.SPAN_UPLOAD, obs_trace.SPAN_VERIFY}
+    # begin + end meta fold into one span; the ctx meta and the error
+    by_name = {s["name"]: s for s in spans}
+    assert by_name[obs_trace.SPAN_DISPATCH]["meta"] == {
+        "lane": 0, "dispatch_s": 1.25}
+    assert by_name[obs_trace.SPAN_UPLOAD]["meta"] == {"bytes": 123}
+    assert by_name[obs_trace.SPAN_VERIFY]["meta"]["error"] == (
+        "RuntimeError")
+    # trace filter; job prefix filter (the CLI convenience)
+    assert len(obs_trace.stitch(art, trace="t2")[0]) == 1
+    assert len(obs_trace.stitch(art, job="d" * 12)[0]) == 4
+
+    doc = obs_trace.chrome_trace(spans)
+    assert [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    text = "\n".join(obs_trace.format_timeline(spans))
+    assert obs_trace.SPAN_DISPATCH in text and "coord-9" in text
+
+
+def test_stitch_abandoned_orphan_and_tamper(tmp_path):
+    art = str(tmp_path)
+    # a once-real, now-dead pid: a reaped child's
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait()
+    dead_pid = child.pid
+    # the killed worker's file: a begin with no end, written by the
+    # recorder's own signing path but carrying the dead writer's pid
+    doc = {
+        "schema": obs_trace.SCHEMA, "ev": "begin", "span": "x-1",
+        "name": obs_trace.SPAN_DISPATCH, "job": "f" * 64,
+        "trace": "t9", "proc": "worker-dead", "pid": dead_pid,
+        "t": 100.0,
+    }
+    os.makedirs(os.path.join(art, obs_trace.SPANS_DIRNAME))
+    dead_file = os.path.join(
+        art, obs_trace.SPANS_DIRNAME,
+        "worker-dead" + obs_trace.SPANS_SUFFIX,
+    )
+    with open(dead_file, "w") as f:
+        f.write(json.dumps(obs_trace._sign(doc), sort_keys=True,
+                           separators=(",", ":")) + "\n")
+    # a live recorder ending a span it never began -> orphan
+    rec = obs_trace.SpanRecorder(art, "worker-live")
+    rec.end("never-began")
+
+    spans, problems = obs_trace.stitch(art)
+    assert problems == []
+    by_status = {s["status"]: s for s in spans}
+    assert by_status["abandoned"]["job"] == "f" * 64
+    assert by_status["abandoned"]["proc"] == "worker-dead"
+    assert "orphan" in by_status
+    text = "\n".join(obs_trace.format_timeline(spans))
+    assert "ABANDONED" in text and "ORPHAN" in text
+
+    # an EDITED span line is skipped and reported, never misread
+    with open(dead_file) as f:
+        line = f.read().splitlines()[0]
+    edited = json.loads(line)
+    edited["job"] = "0" * 64
+    with open(dead_file, "a") as f:
+        f.write(json.dumps(edited, sort_keys=True,
+                           separators=(",", ":")) + "\n")
+    spans2, problems2 = obs_trace.stitch(art)
+    assert any("signature mismatch" in p for p in problems2)
+    assert not any(s["job"] == "0" * 64 for s in spans2)
+
+
+def test_trace_cli(tmp_path):
+    art = str(tmp_path)
+    job = "a" * 64
+    rec = obs_trace.SpanRecorder(art, "coord-cli")
+    rec.emit(obs_trace.SPAN_ADMIT, 1.0, 1.5, job=job, trace="t1")
+
+    from tpusim.cli import main
+    out = str(tmp_path / "trace.json")
+    assert main(["trace", job, "-d", art, "--out", out]) == 0
+    with open(out) as f:
+        assert json.load(f)["traceEvents"]
+    assert main(["trace", "ffff", "-d", art]) == 2  # no matching spans
+    assert main(["trace", job, "-d", str(tmp_path / "nope")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# 4. trace-id propagation through the fleet protocol (no HTTP, no device)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_header_propagates_to_claim(trace, tmp_path):
+    """The id minted at submit rides the X-Tpusim-Trace header into
+    admission, tags the coordinator's admit + queue_wait spans, and is
+    handed to the claiming worker in the job document."""
+    queue, service, fleet = _fleet_stack(trace, tmp_path)
+    resp = service.handle(
+        "POST", "/jobs",
+        json.dumps({"policies": FAM, "weights": [1000, 500],
+                    "seed": 42}).encode(),
+        {obs_trace.TRACE_HEADER: "cafef00dcafef00d"},
+    )
+    body = json.loads(resp[2].decode())
+    assert resp[0] == 202
+    digest = body["digest"]
+    assert service.trace_of(digest) == "cafef00dcafef00d"
+
+    _call(fleet, "/workers/register", {"worker": "w1", "pid": 11})
+    code, claim = _call(fleet, "/workers/claim", {"worker": "w1"})
+    assert code == 200 and claim["jobs"]
+    jd = next(j for j in claim["jobs"] if j["digest"] == digest)
+    assert jd["trace"] == "cafef00dcafef00d"
+
+    spans, _ = obs_trace.stitch(str(tmp_path), job=digest)
+    names = {s["name"] for s in spans}
+    assert obs_trace.SPAN_ADMIT in names
+    assert obs_trace.SPAN_QUEUE_WAIT in names
+    assert {s["trace"] for s in spans} == {"cafef00dcafef00d"}
+
+
+class _FakeCoord:
+    """Just enough of CoordinatorState for the fencing path."""
+
+    def __init__(self, epoch):
+        self.epoch = epoch
+        self.role = "leader"
+        self.noted = []
+
+    def note_epoch(self, e):
+        self.noted.append(e)
+
+
+def test_trace_survives_epoch_bump_and_steal(trace, tmp_path):
+    """The failover journey, pure-protocol: a job claimed at epoch N,
+    the coordinator bumps to N+1 (a takeover elsewhere), the worker's
+    stale-epoch op answers 409 + register, the worker re-registers at
+    the new epoch, the abandoned lease expires, and the RE-CLAIMED job
+    still carries the trace id minted at submit — with the fence hit,
+    the lease expiry and the steal all in the audit chain, and the
+    steals-adjusted latency accounting on the job."""
+    queue, service, fleet = _fleet_stack(trace, tmp_path, lease_s=0.2)
+    coord = _FakeCoord(epoch=5)
+    fleet.coord = coord
+    art = str(tmp_path)
+
+    resp = service.handle(
+        "POST", "/jobs",
+        json.dumps({"policies": FAM, "weights": [1234, 500],
+                    "seed": 42}).encode(),
+        {obs_trace.TRACE_HEADER: "feedbeeffeedbeef"},
+    )
+    digest = json.loads(resp[2].decode())["digest"]
+
+    _call(fleet, "/workers/register",
+          {"worker": "w1", "pid": 11, "epoch": 5})
+    code, claim = _call(fleet, "/workers/claim",
+                        {"worker": "w1", "epoch": 5})
+    assert code == 200
+    assert claim["jobs"][0]["trace"] == "feedbeeffeedbeef"
+    job = queue.get_by_digest(digest)
+    assert job.attempts == 1
+
+    # the takeover happened elsewhere: our epoch is now 6, the
+    # worker's next op at 5 is fenced and told to re-register
+    coord.epoch = 6
+    code, doc = _call(fleet, "/workers/claim",
+                      {"worker": "w1", "epoch": 5})
+    assert code == 409 and doc["stale_epoch"] and doc["register"]
+
+    # w1's attempt is abandoned (it never completes); a second worker
+    # joins at the new epoch and steals the expired lease
+    time.sleep(queue.lease_s + 0.1)
+    _call(fleet, "/workers/register",
+          {"worker": "w2", "pid": 22, "epoch": 6})
+    code, claim2 = _call(fleet, "/workers/claim",
+                         {"worker": "w2", "epoch": 6})
+    assert code == 200
+    jd = next(j for j in claim2["jobs"] if j["digest"] == digest)
+    assert jd["stolen"] == 1
+    assert jd["trace"] == "feedbeeffeedbeef"  # preserved end to end
+    assert job.attempts == 2
+
+    svc_jobs.write_result(art, digest, {"placed": 1, "job": digest})
+    code, comp = _call(fleet, "/workers/complete",
+                       {"worker": "w2", "done": [digest],
+                        "dispatch_s": 0.5, "epoch": 6})
+    assert code == 200 and comp["acked"] == 1
+
+    # steal-visibility accounting (ISSUE 19): the abandoned attempt's
+    # wall is measured, and the adjusted latency subtracts it
+    desc = job.describe()
+    assert desc["attempts"] == 2
+    assert desc["steal_lost_s"] > 0
+    # describe() rounds steal_lost_s for display; compare against the
+    # job's exact accumulator
+    assert desc["adjusted_latency_s"] == pytest.approx(
+        max(desc["latency_s"] - job.steal_lost_s, 0.0), abs=1e-6
+    )
+    lat = queue.latency_percentiles()
+    row = next(iter(lat.values()))
+    assert row["adjusted_p50_s"] <= row["p50_s"]
+
+    # the whole incident is in the hash chain, in order, intact
+    assert obs_audit.verify(art) >= 2
+    kinds = [r["kind"] for r in obs_audit.tail(art, n=0)]
+    assert "fence_409" in kinds
+    assert "steal" in kinds
+    steal = obs_audit.tail(art, n=0, kind="steal")[0]
+    assert steal["job"] == digest and steal["worker"] == "w1"
+
+
+# ---------------------------------------------------------------------------
+# 5. the aggregated /metrics — label hygiene round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_merged_metrics_escaping_roundtrip(trace, tmp_path):
+    """A hostile worker id (quotes, backslashes, a newline) must ride
+    escape_label_value into the merged /metrics and round-trip through
+    parse_prometheus_text unchanged — the exposition text stays one
+    sample per line no matter what the id contains."""
+    queue, service, fleet = _fleet_stack(trace, tmp_path)
+    evil = 'w"1\\x\ny'
+    _call(fleet, "/workers/register", {"worker": evil, "pid": 33})
+    service.handle(
+        "POST", "/jobs",
+        json.dumps({"policies": FAM, "weights": [1000, 500],
+                    "seed": 42}).encode(),
+        None,
+    )
+    code, claim = _call(fleet, "/workers/claim", {"worker": evil})
+    digest = claim["jobs"][0]["digest"]
+    svc_jobs.write_result(str(tmp_path), digest,
+                          {"placed": 1, "job": digest})
+    push = worker_metrics_text(
+        1, 1, 0, 1.5, 1, {"download_bytes": 10, "upload_bytes": 20}
+    )
+    code, comp = _call(fleet, "/workers/complete",
+                       {"worker": evil, "done": [digest],
+                        "dispatch_s": 1.5, "probable_hits": 1,
+                        "metrics_text": push})
+    assert code == 200 and comp["acked"] == 1
+
+    code, ctype, body = fleet.handle("GET", "/metrics", b"")[:3]
+    assert code == 200 and ctype.startswith("text/plain")
+    text = body.decode()
+    series = parse_prometheus_text(text)  # raises on any bad line
+    assert series[("tpusim_fleet_workers_live", ())] == 1.0
+    assert ("tpusim_fleet_queue_depth", ()) in series
+    # the pushed snapshot re-emitted under the worker label, the id
+    # restored EXACTLY by the parser's unescape
+    key = ("tpusim_worker_batches", (("worker", evil),))
+    assert series[key] == 1.0
+    assert series[("tpusim_worker_jobs_done", (("worker", evil),))] == 1.0
+    assert series[
+        ("tpusim_worker_probable_compile_hits", (("worker", evil),))
+    ] == 1.0
+    # one physical line per sample: the newline in the id was escaped
+    assert len([ln for ln in text.splitlines()
+                if ln.startswith("tpusim_worker_batches")]) == 1
+
+    # the measured capability profile rides /workers (ISSUE 19)
+    row = fleet.registry.describe()[evil]
+    prof = row["profile"]
+    assert prof["ewma_dispatch_s"] == pytest.approx(1.5)
+    assert prof["compile_hit_rate"] == pytest.approx(1.0)
+    # a second, faster batch moves the EWMA by 0.7/0.3 smoothing
+    svc_jobs.write_result(str(tmp_path), "9" * 64, {"placed": 1})
+    _call(fleet, "/workers/complete",
+          {"worker": evil, "done": [], "dispatch_s": 0.5})
+    prof2 = fleet.registry.describe()[evil]["profile"]
+    assert prof2["ewma_dispatch_s"] == pytest.approx(
+        0.7 * 1.5 + 0.3 * 0.5)
+
+
+def test_unparseable_worker_push_never_poisons_metrics(trace, tmp_path):
+    queue, service, fleet = _fleet_stack(trace, tmp_path)
+    _call(fleet, "/workers/register", {"worker": "w1", "pid": 44})
+    code, comp = _call(fleet, "/workers/complete",
+                       {"worker": "w1", "done": [],
+                        "metrics_text": "this is not exposition {{{"})
+    assert code == 200  # the push is dropped, the complete still lands
+    code, _, body = fleet.handle("GET", "/metrics", b"")[:3]
+    series = parse_prometheus_text(body.decode())
+    assert not any(
+        dict(labels).get("worker") == "w1" for _, labels in series
+    )
+
+
+# ---------------------------------------------------------------------------
+# 6. the real kill -9 (process-spawning: resume-smoke)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # spawns + kill -9s a real recorder process
+def test_killed_recorder_stitches_abandoned(tmp_path):
+    """A real process begins a dispatch span and is kill -9'd mid-span:
+    the stitcher must render the corpse as ABANDONED (end = the file's
+    last witnessed stamp), never drop it and never fabricate an end."""
+    art = str(tmp_path)
+    job = "b" * 64
+    code = (
+        "import sys, time\n"
+        "from tpusim.obs.trace import SpanRecorder, SPAN_DISPATCH\n"
+        "r = SpanRecorder(sys.argv[1], 'worker-victim')\n"
+        "r.begin(SPAN_DISPATCH, job=sys.argv[2], trace='tkill')\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(60)\n"
+    )
+    child = subprocess.Popen(
+        [sys.executable, "-c", code, art, job],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert child.stdout.readline().strip() == "ready"
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+
+    spans, problems = obs_trace.stitch(art, job=job)
+    assert problems == []
+    assert len(spans) == 1
+    s = spans[0]
+    assert s["status"] == "abandoned"
+    assert s["name"] == obs_trace.SPAN_DISPATCH
+    assert s["trace"] == "tkill" and s["pid"] == child.pid
+    assert s["end"] >= s["start"]

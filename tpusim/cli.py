@@ -678,6 +678,56 @@ def _build_parser() -> argparse.ArgumentParser:
         "stripped contents; or env TPUSIM_FLEET_TOKEN)",
     )
 
+    p_trace = sub.add_parser(
+        "trace",
+        help="stitch a job's cross-process fleet timeline from the "
+        "artifact dir's span files (admission, queue wait, claim, "
+        "dispatch, upload, verify — abandoned attempts included)",
+    )
+    p_trace.add_argument(
+        "job", nargs="?", default="",
+        help="job digest (or unique prefix); omit for every span",
+    )
+    p_trace.add_argument(
+        "-d", "--dir", default="runs", metavar="DIR",
+        help="artifact dir the coordinator served from",
+    )
+    p_trace.add_argument(
+        "--trace-id", default="", metavar="ID",
+        help="filter by trace id instead of (or as well as) job digest",
+    )
+    p_trace.add_argument(
+        "--out", default="", metavar="FILE",
+        help="also write a Chrome-trace JSON (one track per process; "
+        "open in chrome://tracing or Perfetto)",
+    )
+
+    p_audit = sub.add_parser(
+        "audit",
+        help="query or verify the hash-chained control-plane audit "
+        "log (takeovers, depositions, steals, lease expiries, "
+        "requeues, breaker trips, fence hits, degrades)",
+    )
+    p_audit.add_argument(
+        "-d", "--dir", default="runs", metavar="DIR",
+        help="artifact dir holding audit.jsonl",
+    )
+    p_audit.add_argument(
+        "--verify", action="store_true",
+        help="walk the whole chain + head sidecar; exit 1 loudly on "
+        "any edit, truncation, or torn tail",
+    )
+    p_audit.add_argument(
+        "--tail", type=int, default=20, metavar="N",
+        help="show the last N matching records (0 = all)",
+    )
+    p_audit.add_argument("--kind", default="",
+                         help="filter by record kind")
+    p_audit.add_argument("--job", default="",
+                         help="filter by job digest (prefix ok)")
+    p_audit.add_argument("--worker", default="",
+                         help="filter by worker id")
+
     sub.add_parser("version", help="print version")
 
     p_doc = sub.add_parser("gen-doc", help="generate markdown CLI docs")
@@ -974,6 +1024,9 @@ def _serve_jobs(args) -> int:
             out=sys.stderr,
         )
         service.fleet.supervisor = sup
+        # respawns/breaker trips append to the coordinator's audit
+        # chain (ISSUE 19)
+        sup.audit = service.audit
         if coord is not None and coord.role != "leader":
             # a standby's local workers would only spin on its own
             # 503s — spawn them at promotion (resume fills the floor)
@@ -1475,6 +1528,71 @@ def cmd_submit(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """`tpusim trace <job-digest>` — stitch the per-process span files
+    under an artifact dir into one cross-process timeline (ISSUE 19).
+    Exit 2 when the dir holds no matching spans (unusable input, the
+    CLI discipline), 0 otherwise — file-level problems (torn lines,
+    bad signatures) print loudly but don't fail the stitch."""
+    from tpusim.obs import trace as obs_trace
+
+    if not os.path.isdir(args.dir):
+        print(f"tpusim trace: no such artifact dir {args.dir!r}",
+              file=sys.stderr)
+        return 2
+    spans, problems = obs_trace.stitch(
+        args.dir, job=args.job, trace=args.trace_id
+    )
+    for p in problems:
+        print(f"[trace] WARNING: {p}", file=sys.stderr)
+    if not spans:
+        what = f" for job {args.job!r}" if args.job else ""
+        print(f"tpusim trace: no spans{what} under {args.dir}",
+              file=sys.stderr)
+        return 2
+    for line in obs_trace.format_timeline(spans):
+        print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(obs_trace.chrome_trace(spans), f)
+        print(f"[trace] wrote Chrome trace {args.out} "
+              f"({len(spans)} spans)", file=sys.stderr)
+    return 0
+
+
+def cmd_audit(args) -> int:
+    """`tpusim audit [--verify]` — query or verify the hash-chained
+    control-plane audit log (ISSUE 19). --verify exits 1 LOUDLY on a
+    broken chain (edit, truncation, torn tail, missing head)."""
+    from tpusim.obs import audit as obs_audit
+
+    path = obs_audit.audit_path(args.dir)
+    if not os.path.isfile(path):
+        print(f"tpusim audit: no audit log at {path}", file=sys.stderr)
+        return 2
+    if args.verify:
+        try:
+            n = obs_audit.verify(path)
+        except ValueError as err:
+            print(f"tpusim audit: CHAIN BROKEN: {err}", file=sys.stderr)
+            return 1
+        print(f"[audit] chain intact: {n} record(s), head verified")
+        return 0
+    try:
+        records = obs_audit.tail(
+            path, n=args.tail, kind=args.kind, job=args.job,
+            worker=args.worker,
+        )
+    except ValueError as err:
+        print(f"tpusim audit: chain unreadable: {err}", file=sys.stderr)
+        return 1
+    for line in obs_audit.format_records(records):
+        print(line)
+    if not records:
+        print("[audit] no matching records", file=sys.stderr)
+    return 0
+
+
 def cmd_gen_doc(parser: argparse.ArgumentParser, args) -> int:
     os.makedirs(args.dir, exist_ok=True)
     path = os.path.join(args.dir, "tpusim.md")
@@ -1505,6 +1623,10 @@ def main(argv=None) -> int:
         return cmd_imitate(args)
     if args.command == "submit":
         return cmd_submit(args)
+    if args.command == "trace":
+        return cmd_trace(args)
+    if args.command == "audit":
+        return cmd_audit(args)
     if args.command == "version":
         print(f"tpusim version {VERSION} (commit {COMMIT})")
         return 0

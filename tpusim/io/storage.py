@@ -501,6 +501,156 @@ def read_signed_json(path: str, schema: str = ""):
     return header, json.loads(payload[0])
 
 
+# ---------------------------------------------------------------------------
+# Hash-chained append-only JSONL — the control-plane audit log (ISSUE 19)
+# ---------------------------------------------------------------------------
+#
+# The signed-JSONL format above is write-once: the digest covers the whole
+# payload, so appending means rewriting the file. The audit log needs the
+# opposite discipline — an append-only file that accretes one record per
+# control-plane decision for the life of a deployment — so integrity moves
+# from a whole-file digest to a per-record hash chain: every record carries
+# `prev` = sha256 of its predecessor's exact line bytes (genesis: 64 zeros).
+# An edited record breaks every successor's `prev`; a truncated file is
+# caught by the `<path>.head` sidecar (atomically rewritten on each append
+# with the record count + tip hash). The sidecar may lag the chain by
+# appends that crashed between the line write and the head rewrite — verify
+# therefore accepts a chain LONGER than the head says, as long as the
+# head's recorded tip is exactly where the head says it is; a chain
+# SHORTER than the head, or with a different record at the head's cursor,
+# fails loudly. Appends serialize across processes via flock on the chain
+# file itself (the HA smoke runs two coordinators over one artifact dir).
+
+CHAIN_GENESIS = "0" * 64
+CHAIN_HEAD_SUFFIX = ".head"
+CHAIN_HEAD_SCHEMA = "tpusim-chain-head/1"
+
+
+def chain_digest(line: str) -> str:
+    """sha256 hex of one chain line's exact bytes (no newline)."""
+    import hashlib
+
+    return hashlib.sha256(line.encode()).hexdigest()
+
+
+def _chain_tip(path: str):
+    """(record count, tip hash) of an existing chain file. Reads the
+    whole file — audit logs are control-plane-decision sized, not
+    event-stream sized. A torn final line (a writer killed mid-append on
+    a filesystem without atomic small appends) is NOT silently dropped:
+    appending under a torn tail would orphan the chain, so raise."""
+    n, tip = 0, CHAIN_GENESIS
+    with open(path) as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                raise ValueError(
+                    f"{path}: torn record after {n} chained entries"
+                )
+            if not isinstance(doc, dict):
+                raise ValueError(f"{path}: record {n} is not an object")
+            n += 1
+            tip = chain_digest(line)
+    return n, tip
+
+
+def chain_append(path: str, doc: dict) -> str:
+    """Append one record to a hash-chained JSONL; returns the written
+    line. The record gains `prev` (the predecessor's line hash) and the
+    head sidecar is atomically rewritten. Safe across processes (flock)
+    and threads (the flock covers the read-tip/append/rewrite-head
+    critical section; Python-level callers add their own mutex only to
+    keep intra-process contention off the syscall path)."""
+    import fcntl
+
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a+") as f:
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        try:
+            n, prev = (
+                _chain_tip(path) if os.path.getsize(path)
+                else (0, CHAIN_GENESIS)
+            )
+            body = dict(doc)
+            body["prev"] = prev
+            line = json.dumps(body, sort_keys=True, separators=(",", ":"))
+            f.write(line + "\n")
+            f.flush()
+            write_signed_json(
+                path + CHAIN_HEAD_SUFFIX,
+                {"schema": CHAIN_HEAD_SCHEMA},
+                {"n": n + 1, "tip": chain_digest(line)},
+            )
+        finally:
+            fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+    return line
+
+
+def chain_records(path: str):
+    """Every (record, line hash) of a chain file, verifying each link.
+    Raises ValueError on a broken chain (edited record), a torn tail,
+    or a non-object record — the loud half of `tpusim audit`."""
+    out = []
+    prev = CHAIN_GENESIS
+    with open(path) as f:
+        for i, raw in enumerate(f):
+            line = raw.rstrip("\n")
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                raise ValueError(
+                    f"{path}: torn record at line {i + 1} "
+                    f"(writer killed mid-append, or hand-edited)"
+                )
+            if not isinstance(doc, dict):
+                raise ValueError(f"{path}: line {i + 1} is not an object")
+            if doc.get("prev") != prev:
+                raise ValueError(
+                    f"{path}: chain broken at record {len(out)} — "
+                    f"prev {doc.get('prev')!r} != expected {prev!r} "
+                    f"(an earlier record was edited or removed)"
+                )
+            h = chain_digest(line)
+            out.append((doc, h))
+            prev = h
+    return out
+
+
+def chain_verify(path: str) -> int:
+    """Verify a hash-chained JSONL end-to-end against its head sidecar;
+    returns the record count. Raises ValueError on ANY tamper signal:
+    a broken link (edit), a missing head sidecar, a chain shorter than
+    the head claims, or a different record at the head's cursor
+    (truncate-and-regrow)."""
+    records = chain_records(path)
+    head_path = path + CHAIN_HEAD_SUFFIX
+    if not os.path.isfile(head_path):
+        raise ValueError(
+            f"{path}: head sidecar {head_path} missing — cannot rule "
+            f"out truncation"
+        )
+    _, head = read_signed_json(head_path, CHAIN_HEAD_SCHEMA)
+    n, tip = int(head.get("n", -1)), head.get("tip", "")
+    if n < 0 or n > len(records):
+        raise ValueError(
+            f"{path}: truncated — head records {n} entries, file has "
+            f"{len(records)}"
+        )
+    if n > 0 and records[n - 1][1] != tip:
+        raise ValueError(
+            f"{path}: record {n - 1} does not match the head tip "
+            f"(file truncated and regrown, or edited)"
+        )
+    return len(records)
+
+
 def prune_checkpoints(cache_dir: str, digest: str, keep_cursor: int,
                       keep: int = 0) -> None:
     """Drop a run's checkpoints below `keep_cursor` (each save supersedes

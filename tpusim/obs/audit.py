@@ -1,0 +1,151 @@
+"""Hash-chained control-plane audit log (ISSUE 19).
+
+Every decision the control plane makes on its own authority — an epoch
+bump, a takeover, a deposition, a lease expiry + steal, a requeue of a
+dead worker's jobs, a circuit-breaker trip, a 401/409 fence hit, a
+[Degrade] — appends one record to `<artifact_dir>/audit.jsonl`. Each
+record carries `prev` = the sha256 of its predecessor's exact line
+bytes (io.storage.chain_append — the signed-JSONL discipline extended
+to an append-only chain), and an atomically-rewritten `.head` sidecar
+pins the tip, so:
+
+  * editing ANY record breaks every successor's `prev` link
+  * truncating the file contradicts the head sidecar
+  * a writer killed mid-append leaves a torn tail that verify names
+
+`tpusim audit --verify` / `chain_verify` fail loudly on all three.
+Records are operator-facing facts, never secrets: token material MUST
+NOT enter a record (svc.auth.describe is the only sanctioned
+rendering — emitters pass worker/job/epoch facts only).
+
+The log is multi-process safe (flock in chain_append): the HA pair
+shares one artifact dir, and both the leader and the deposed standby
+legitimately append (takeover on one side, deposition on the other).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from tpusim.io.storage import chain_append, chain_records, chain_verify
+
+AUDIT_BASENAME = "audit.jsonl"
+SCHEMA = "tpusim-audit-v1"
+
+# kind vocabulary (ENGINES.md Round 22) — emitters stick to these so
+# `tpusim audit --kind` filters stay predictable
+KIND_TAKEOVER = "takeover"
+KIND_DEPOSED = "deposed"
+KIND_EPOCH_BUMP = "epoch_bump"
+KIND_STEAL = "steal"
+KIND_LEASE_EXPIRED = "lease_expired"
+KIND_REQUEUE = "requeue"
+KIND_BREAKER_TRIP = "breaker_trip"
+KIND_RESPAWN = "respawn"
+KIND_FENCE_409 = "fence_409"
+KIND_AUTH_401 = "auth_401"
+KIND_DEGRADE = "degrade"
+
+
+def audit_path(artifact_dir: str) -> str:
+    return os.path.join(artifact_dir, AUDIT_BASENAME)
+
+
+class AuditLog:
+    """Append-only chained audit writer for one artifact dir. emit() is
+    one flocked append — cheap enough for every control-plane decision,
+    and a failure to write NEVER takes the control plane down with it
+    (the decision already happened; the log is the witness, not the
+    actor): write errors count and print once, they don't raise."""
+
+    def __init__(self, artifact_dir: str, process: str = ""):
+        self.path = audit_path(artifact_dir)
+        self.process = str(process or f"pid-{os.getpid()}")
+        self._lock = threading.Lock()
+        self.write_errors = 0
+        self._warned = False
+
+    def emit(self, kind: str, job: str = "", worker: str = "",
+             **fields) -> Optional[dict]:
+        doc = {
+            "schema": SCHEMA,
+            "kind": str(kind),
+            "t": round(time.time(), 6),
+            "proc": self.process,
+            "pid": os.getpid(),
+        }
+        if job:
+            doc["job"] = str(job)
+        if worker:
+            doc["worker"] = str(worker)
+        for k, v in sorted(fields.items()):
+            if k not in doc:
+                doc[k] = v
+        try:
+            with self._lock:
+                chain_append(self.path, doc)
+        except (OSError, ValueError) as err:
+            self.write_errors += 1
+            if not self._warned:
+                self._warned = True
+                print(f"[audit] WARNING: append failed ({err}) — "
+                      f"decisions continue unrecorded")
+            return None
+        return doc
+
+
+def verify(artifact_dir_or_path: str) -> int:
+    """Record count of an intact chain; raises ValueError on tamper
+    (broken link / truncation / torn tail / missing head)."""
+    path = (audit_path(artifact_dir_or_path)
+            if os.path.isdir(artifact_dir_or_path)
+            else artifact_dir_or_path)
+    return chain_verify(path)
+
+
+def tail(artifact_dir_or_path: str, n: int = 20, kind: str = "",
+         job: str = "", worker: str = "") -> List[dict]:
+    """Last `n` records matching the filters, oldest first. Walks (and
+    therefore link-checks) the whole chain — an edited log can't serve
+    queries. Job filters match by prefix (digests are long)."""
+    path = (audit_path(artifact_dir_or_path)
+            if os.path.isdir(artifact_dir_or_path)
+            else artifact_dir_or_path)
+    if not os.path.isfile(path):
+        return []
+    records = [doc for doc, _ in chain_records(path)]
+    if kind:
+        records = [r for r in records if r.get("kind") == kind]
+    if job:
+        records = [r for r in records
+                   if str(r.get("job", "")).startswith(job)]
+    if worker:
+        records = [r for r in records if r.get("worker") == worker]
+    n = max(int(n), 0)
+    return records[-n:] if n else records
+
+
+def format_records(records) -> List[str]:
+    """Terminal rendering of audit records, one line each."""
+    lines = []
+    for r in records:
+        t = r.get("t")
+        stamp = (time.strftime("%H:%M:%S", time.localtime(t))
+                 if isinstance(t, (int, float)) else "--:--:--")
+        extra = {k: v for k, v in r.items()
+                 if k not in ("schema", "kind", "t", "proc", "pid",
+                              "job", "worker", "prev")}
+        parts = [f"{stamp}  {r.get('kind', '?'):<14}"]
+        if r.get("job"):
+            parts.append(f"job={str(r['job'])[:12]}")
+        if r.get("worker"):
+            parts.append(f"worker={r['worker']}")
+        parts.append(f"by={r.get('proc', '?')}")
+        if extra:
+            parts.append(json.dumps(extra, sort_keys=True))
+        lines.append("  ".join(parts))
+    return lines

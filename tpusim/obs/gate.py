@@ -948,6 +948,281 @@ def fleet_chaos_smoke(out_dir: str, n_workers: int = 3
     return True, msgs
 
 
+def _trace_smoke_jobs() -> list:
+    """The flight-recorder smoke's job mix — its OWN policy family
+    (PWRScore + DotProductScore). The other fleet smokes measure
+    cold-compile walls on THEIR families (fleet: FGD+BestFit, wan:
+    FGD+GpuPacking, HA: GpuClustering+BestFit), and sharing a
+    bench-gate process must not pre-warm them."""
+    fam = [["PWRScore", 800], ["DotProductScore", 300]]
+    return [
+        {"policies": fam, "weights": [800 + 29 * i, 300 + 17 * i],
+         "seed": 60 + i % 3, "tune": [0.0, 0.2, 0.0][i % 3],
+         "engine": "sequential"}
+        for i in range(6)
+    ]
+
+
+def fleet_trace_smoke(out_dir: str, n_workers: int = 2
+                      ) -> Tuple[bool, List[str]]:
+    """ISSUE 19 (`make fleet-trace-smoke`): the fleet flight recorder
+    end-to-end over real processes and real HTTP. Boots a coordinator +
+    supervised worker pair, submits a job wave BEFORE the workers join
+    (first claims land mid-compile — the widest kill window), `kill
+    -9`s the first worker observed holding leases mid-batch, and
+    hard-checks the observability contracts: (a) every job completes
+    and its stitched cross-process timeline is gap-free — admission,
+    claim, dispatch, upload and verify spans all sharing the ONE trace
+    id minted at submit, zero orphan spans anywhere, and the killed
+    worker's half-open attempt stitched as ABANDONED rather than lost;
+    (b) the `tpusim trace` / `tpusim audit` verbs work against the
+    artifact dir (exit 0, Chrome-trace export written, chain verified);
+    (c) the hash-chained audit log records the steal AND the
+    supervisor's respawn and verifies end-to-end; (d) the aggregated
+    coordinator /metrics parses via parse_prometheus_text and carries a
+    worker=-labeled series set for every live worker that served a
+    batch. Any exception is a FAIL verdict, not a traceback."""
+    msgs: List[str] = []
+    srv = sup = None
+    try:
+        import json as _json
+        import shutil
+        import signal as _signal
+        import subprocess
+        import time as _time
+        import urllib.request
+
+        from tpusim.obs import audit as obs_audit
+        from tpusim.obs import trace as obs_trace
+        from tpusim.obs.emitters import parse_prometheus_text
+        from tpusim.svc import load_trace, start_job_server
+        from tpusim.svc.client import _request, submit_jobs, wait_jobs
+        from tpusim.svc.fleet import worker_command
+        from tpusim.svc.supervisor import Supervisor
+
+        base = os.path.join(out_dir, "fleet_trace_smoke")
+        if os.path.isdir(base):
+            shutil.rmtree(base)
+        os.makedirs(base)
+        nodes_csv, pods_csv = _write_fleet_trace(base)
+        ccache = os.path.join(base, "compile_cache")
+        tcache = os.path.join(base, "table_cache")
+        docs = _trace_smoke_jobs()
+
+        art = os.path.join(base, "coord")
+        os.makedirs(art)
+        trace = load_trace("default", nodes_csv, pods_csv)
+        srv, service, _ = start_job_server(
+            art, {"default": trace}, listen=":0", lane_width=2,
+            queue_size=64, fleet=True, lease_s=2.0,
+            compile_cache_dir=ccache, table_cache_dir=tcache,
+        )
+
+        def spawn(n):
+            return subprocess.Popen(worker_command(
+                srv.url, table_cache_dir=tcache,
+                compile_cache_dir=ccache,
+            ))
+
+        # NO on_exit=release_dead here: instant reclaim would requeue
+        # the dead worker's jobs before the lease expires, and this
+        # smoke exists to witness the STEAL path in the audit chain
+        # (the wan smoke covers the release_dead fast path)
+        sup = Supervisor(spawn, n_workers, breaker_k=6,
+                         breaker_window_s=30.0)
+        # the respawn lands in the SAME hash chain as the steal it
+        # repairs — the audit log tells the whole story of the kill
+        sup.audit = service.audit
+        service.fleet.supervisor = sup
+
+        accepted = submit_jobs(srv.url, docs)
+        ids = [a["id"] for a in accepted]
+        digests = [a["digest"] for a in accepted]
+        sup.start()
+
+        killed_wid, killed_pid = "", 0
+        deadline = _time.time() + 240
+        while _time.time() < deadline:
+            sup.poll()
+            _, _, q = _request(srv.url + "/queue")
+            if not killed_wid:
+                for wid, row in (q.get("workers") or {}).items():
+                    if row.get("leases_held", 0) > 0 and row.get("pid"):
+                        os.kill(row["pid"], _signal.SIGKILL)
+                        killed_wid, killed_pid = wid, row["pid"]
+                        msgs.append(
+                            f"[gate] trace: kill -9'd {wid} (pid "
+                            f"{killed_pid}) holding "
+                            f"{row['leases_held']} lease(s) mid-batch"
+                        )
+                        break
+            if q.get("done", 0) >= len(docs) and killed_wid:
+                break
+            _time.sleep(0.05)
+        if not killed_wid:
+            return False, ["[gate] trace: never observed a worker "
+                           "holding leases to kill (FAIL)"]
+        final = None
+        deadline = _time.time() + 240
+        while _time.time() < deadline:
+            sup.poll()  # keep reaping/respawning while jobs finish
+            try:
+                final = wait_jobs(srv.url, ids, timeout=2.0)
+                break
+            except Exception:
+                continue
+        if final is None:
+            return False, ["[gate] trace: jobs did not finish after "
+                           "the kill (FAIL)"]
+        bad = [d["id"] for d in final if d["status"] != "done"]
+        if bad:
+            return False, [
+                f"[gate] trace: {len(bad)} job(s) never completed "
+                f"after the kill: {bad} (FAIL)"
+            ]
+        sup.poll()  # reap the killed child: its pid must read as DEAD
+        # (not zombie) for stitch() to classify its corpse as abandoned
+
+        # ---- (a) the stitched cross-process timelines
+        spans, problems = obs_trace.stitch(art)
+        if problems:
+            return False, [
+                f"[gate] trace: span files damaged: {problems} (FAIL)"
+            ]
+        orphans = [s for s in spans if s["status"] == "orphan"]
+        if orphans:
+            return False, [
+                f"[gate] trace: {len(orphans)} orphan span(s) — "
+                "end-without-begin should be impossible (FAIL)"
+            ]
+        abandoned = [s for s in spans if s["status"] == "abandoned"]
+        if not abandoned:
+            return False, [
+                "[gate] trace: the killed worker left NO abandoned "
+                "span — the stolen attempt vanished from the "
+                "timeline (FAIL)"
+            ]
+        want = {obs_trace.SPAN_ADMIT, obs_trace.SPAN_QUEUE_WAIT,
+                obs_trace.SPAN_CLAIM, obs_trace.SPAN_DISPATCH,
+                obs_trace.SPAN_UPLOAD, obs_trace.SPAN_VERIFY}
+        for d in digests:
+            mine = [s for s in spans if s["job"] == d]
+            names = {s["name"] for s in mine if s["status"] == "ok"}
+            missing = want - names
+            if missing:
+                return False, [
+                    f"[gate] trace: job {d[:12]}… timeline has gaps — "
+                    f"missing {sorted(missing)} (FAIL)"
+                ]
+            tids = {s["trace"] for s in mine} - {""}
+            if len(tids) != 1:
+                return False, [
+                    f"[gate] trace: job {d[:12]}… spans carry "
+                    f"{len(tids)} trace ids (want exactly the one "
+                    "minted at submit) (FAIL)"
+                ]
+        n_procs = len({s["proc"] for s in spans})
+        msgs.append(
+            f"[gate] trace: {len(spans)} spans across {n_procs} "
+            f"processes — every job's timeline complete, "
+            f"{len(abandoned)} abandoned attempt(s) from the kill, "
+            "zero orphans"
+        )
+
+        # ---- (b) the CLI verbs against the same artifact dir
+        stolen = next((d for d in final if d.get("stolen")), None)
+        probe = (stolen or final[0])["digest"]
+        chrome_out = os.path.join(base, "trace.json")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpusim", "trace", probe,
+             "-d", art, "--out", chrome_out],
+            capture_output=True, text=True, timeout=120,
+        )
+        if r.returncode != 0 or not os.path.isfile(chrome_out):
+            return False, [
+                f"[gate] trace: `tpusim trace` failed (rc={r.returncode}"
+                f", stderr={r.stderr.strip()[-200:]}) (FAIL)"
+            ]
+        with open(chrome_out) as f:
+            if not _json.load(f).get("traceEvents"):
+                return False, ["[gate] trace: Chrome-trace export is "
+                               "empty (FAIL)"]
+        r = subprocess.run(
+            [sys.executable, "-m", "tpusim", "audit", "-d", art,
+             "--verify"],
+            capture_output=True, text=True, timeout=120,
+        )
+        if r.returncode != 0:
+            return False, [
+                f"[gate] trace: `tpusim audit --verify` failed "
+                f"(rc={r.returncode}, stderr="
+                f"{r.stderr.strip()[-200:]}) (FAIL)"
+            ]
+        msgs.append(
+            f"[gate] trace: `tpusim trace {probe[:12]}…` stitched the "
+            f"{'stolen ' if stolen else ''}job and `tpusim audit "
+            "--verify` passed over the live chain"
+        )
+
+        # ---- (c) the audit chain records the whole incident
+        n_audit = obs_audit.verify(art)
+        kinds = {r["kind"] for r in obs_audit.tail(art, n=0)}
+        for needed in ("steal", "respawn"):
+            if needed not in kinds:
+                return False, [
+                    f"[gate] trace: audit chain ({n_audit} records, "
+                    f"kinds={sorted(kinds)}) never recorded the "
+                    f"{needed!r} (FAIL)"
+                ]
+        msgs.append(
+            f"[gate] trace: audit chain intact — {n_audit} records "
+            f"covering {sorted(kinds)}"
+        )
+
+        # ---- (d) the aggregated /metrics
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=30) as resp:
+            metrics_text = resp.read().decode()
+        series = parse_prometheus_text(metrics_text)
+        if ("tpusim_fleet_workers_live", ()) not in series:
+            return False, ["[gate] trace: merged /metrics lacks the "
+                           "fleet gauges (FAIL)"]
+        by_worker = {
+            dict(labels).get("worker")
+            for (_, labels) in series
+            if dict(labels).get("worker")
+        }
+        _, _, q = _request(srv.url + "/queue")
+        served = [
+            wid for wid, row in (q.get("workers") or {}).items()
+            if row.get("batches", 0) > 0 and row.get("pid") != killed_pid
+        ]
+        missing_w = [w for w in served if w not in by_worker]
+        if not by_worker or missing_w:
+            return False, [
+                f"[gate] trace: merged /metrics missing worker series "
+                f"for {missing_w or 'every worker'} "
+                f"(have {sorted(by_worker)}) (FAIL)"
+            ]
+        msgs.append(
+            f"[gate] trace: /metrics aggregates {len(by_worker)} live "
+            f"worker(s) under worker= labels "
+            f"({len(series)} series parse clean)"
+        )
+    except Exception as err:
+        return False, [f"[gate] trace: FAIL ({type(err).__name__}: "
+                       f"{err})"]
+    finally:
+        try:
+            if sup is not None:
+                sup.stop()
+            if srv is not None:
+                srv.stop()
+        except Exception:
+            pass
+    return True, msgs
+
+
 def _ha_jobs() -> list:
     """The HA smoke's job mix: weight/seed/tune variants plus one fault
     job (capability-routed — every spawned worker declares fault-lane
@@ -2374,6 +2649,17 @@ def main(argv=None) -> int:
         "leader fenced) — the `make fleet-ha-smoke` mode",
     )
     ap.add_argument(
+        "--fleet-trace-only", action="store_true",
+        help="run only the fleet flight-recorder smoke (ISSUE 19: "
+        "real-HTTP fleet + supervised workers, kill -9 of a "
+        "lease-holder mid-batch, gap-free stitched cross-process "
+        "timeline for every job with zero orphan spans, the stolen "
+        "attempt stitched as abandoned, hash-chained audit log "
+        "verifying end-to-end with the steal + respawn recorded, "
+        "aggregated /metrics with per-live-worker labeled series) — "
+        "the `make fleet-trace-smoke` mode",
+    )
+    ap.add_argument(
         "--fleet-wan-only", action="store_true",
         help="run only the fleet-wan smoke (ISSUE 13: remote-mode "
         "workers with NO shared filesystem behind a flaky HTTP shim, "
@@ -2421,6 +2707,12 @@ def main(argv=None) -> int:
 
     if args.fleet_ha_only:
         ok, msgs = fleet_ha_smoke(args.out)
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
+
+    if args.fleet_trace_only:
+        ok, msgs = fleet_trace_smoke(args.out)
         print("\n".join(msgs))
         print(f"[gate] {'PASS' if ok else 'FAIL'}")
         return 0 if ok else 1
@@ -2566,6 +2858,11 @@ def main(argv=None) -> int:
     # flaky transfer plane + supervisor respawn + the circuit breaker
     wan_ok, wan_msgs = fleet_wan_smoke(args.out)
     print("\n".join(wan_msgs))
+    # fleet-trace smoke (ISSUE 19): the flight recorder — stitched
+    # cross-process timelines across a kill -9 + steal, hash-chained
+    # audit log, aggregated per-worker /metrics
+    trace_ok, trace_msgs = fleet_trace_smoke(args.out)
+    print("\n".join(trace_msgs))
     # fleet-ha smoke (ISSUE 17): leader + standby pair, kill -9 the
     # leader mid-batch — epoch-fenced takeover, auth probes,
     # byte-identity vs a single-coordinator reference
@@ -2577,7 +2874,8 @@ def main(argv=None) -> int:
     print("\n".join(mc_msgs))
     smoke_ok = (dec_ok and scrape_ok and swp_ok and svc_ok and serve_ok
                 and tune_ok and chaos_ok and pol_ok and hbm_ok
-                and mesh_ok and fleet_ok and wan_ok and ha_ok and mc_ok)
+                and mesh_ok and fleet_ok and wan_ok and trace_ok
+                and ha_ok and mc_ok)
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

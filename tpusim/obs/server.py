@@ -117,6 +117,13 @@ class MonitorServer:
             self._metrics_text = text
             self._records += 1
 
+    def metrics_text(self) -> Optional[str]:
+        """The current /metrics exposition text (None before the first
+        publish) — the base the fleet coordinator's aggregated scrape
+        merges worker series into (ISSUE 19)."""
+        with self._lock:
+            return self._metrics_text
+
     def publish_progress(self, **fields):
         with self._lock:
             self._progress.update(fields)
@@ -144,9 +151,15 @@ class MonitorServer:
         return self
 
     def _dispatch_app(self, method: str, path: str, body: bytes,
-                      headers=None):
+                      headers=None, query: str = ""):
         for app in self._apps:
-            resp = app.handle(method, path, body, headers)
+            # apps opt into the raw query string (the /events filter
+            # plane, ISSUE 19) by declaring `accepts_query = True`;
+            # legacy apps keep the 4-arg handle() untouched
+            if getattr(app, "accepts_query", False):
+                resp = app.handle(method, path, body, headers, query)
+            else:
+                resp = app.handle(method, path, body, headers)
             if resp is not None:
                 return resp
         return None
@@ -202,7 +215,7 @@ class MonitorServer:
                 POST/job plane); True when one answered. An app exception
                 becomes a 500 — one bad request must not kill the
                 serving thread."""
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 body = b""
                 if method == "POST":
                     length = int(self.headers.get("Content-Length") or 0)
@@ -211,7 +224,7 @@ class MonitorServer:
                     # self.headers is an email.message.Message — apps
                     # get case-insensitive .get() (Range, Retry-After)
                     resp = srv._dispatch_app(method, path, body,
-                                             self.headers)
+                                             self.headers, query)
                 except Exception as err:
                     self._send(
                         500, "text/plain",

@@ -1,0 +1,378 @@
+"""Cross-process job tracing — the fleet flight recorder (ISSUE 19).
+
+The serving plane is a multi-host fleet (PR 12-17): a job's journey runs
+`tpusim submit` → coordinator admission → queue wait → worker claim →
+trace transfer → compile/dispatch/block → result upload → verify, and
+may cross a kill -9 failover or an orphan steal on the way. No single
+process sees the whole journey, so no single run record can tell it.
+This module makes the journey reconstructable from the artifact dir
+alone:
+
+  trace id      minted once per submit (client-side when possible,
+                coordinator-side otherwise) and propagated as the
+                `X-Tpusim-Trace` HTTP header on EVERY fleet hop —
+                /jobs, /workers/claim, /leases, /results upload,
+                /workers/complete, and the re-register after an epoch
+                bump — so every process tags its spans with the same id
+                without any shared state beyond the header.
+  SpanRecorder  one per process, appending spans to
+                `<artifact_dir>/spans/<process>.spans.jsonl`. Each span
+                is TWO records — `begin` at open, `end` at close — so a
+                kill -9 mid-span leaves a begin with no end, which the
+                stitcher renders as an ABANDONED span (the visible
+                corpse of a stolen attempt), never a silent gap. Every
+                record is digest-signed (`sig` = sha256 over the rest,
+                the io.storage discipline applied per-line because the
+                file is append-only), so an edited span fails loudly on
+                read while a torn tail line (the killed writer) is
+                skipped and reported, not fatal.
+  stitch()      `tpusim trace <job-digest>` merges every per-process
+                file into one timeline — terminal text plus a
+                Chrome-trace export with one track (pid) per process.
+
+Span names reuse the obs.spans phase vocabulary where the phases
+coincide (`scan`-like dispatch spans carry the dispatch_s/block_s wall
+split in their meta) and add the fleet hops: admit, queue_wait, claim,
+trace_transfer, dispatch, upload, verify.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, List, Optional
+
+TRACE_HEADER = "X-Tpusim-Trace"
+SPANS_DIRNAME = "spans"
+SPANS_SUFFIX = ".spans.jsonl"
+SCHEMA = "tpusim-trace-v1"
+
+# fleet-hop span vocabulary (ENGINES.md Round 22) — the stitcher accepts
+# any name, but emitters stick to these so timelines read uniformly
+SPAN_ADMIT = "admit"
+SPAN_QUEUE_WAIT = "queue_wait"
+SPAN_CLAIM = "claim"
+SPAN_TRANSFER = "trace_transfer"
+SPAN_DISPATCH = "dispatch"
+SPAN_UPLOAD = "upload"
+SPAN_VERIFY = "verify"
+
+
+def new_trace_id() -> str:
+    """16 hex chars of OS entropy — unique per submit, cheap to log."""
+    return os.urandom(8).hex()
+
+
+def header_trace(headers) -> str:
+    """The trace id off a request's header map ('' when absent). Accepts
+    email.message.Message (the stdlib server's header object) or any
+    mapping with case-sensitive keys."""
+    if headers is None:
+        return ""
+    get = getattr(headers, "get", None)
+    if get is None:
+        return ""
+    val = get(TRACE_HEADER) or get(TRACE_HEADER.lower()) or ""
+    return str(val).strip()
+
+
+def _sign(doc: dict) -> dict:
+    """Return doc + `sig` = sha256 over its canonical JSON — the
+    per-line integrity key of an append-only span file."""
+    body = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    out = dict(doc)
+    out["sig"] = hashlib.sha256(body.encode()).hexdigest()
+    return out
+
+
+def _check_sig(doc: dict) -> bool:
+    sig = doc.get("sig")
+    body = {k: v for k, v in doc.items() if k != "sig"}
+    raw = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return sig == hashlib.sha256(raw.encode()).hexdigest()
+
+
+_PROC_SAFE = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def _safe_process(process: str) -> str:
+    """Process name → filesystem-safe file stem (worker ids carry
+    host:pid colons)."""
+    return _PROC_SAFE.sub("_", str(process)) or "proc"
+
+
+class SpanRecorder:
+    """Per-process span appender. Thread-safe; every append is one
+    O_APPEND write of a signed JSON line, so concurrent emitters in one
+    process interleave whole lines and a kill -9 loses at most the line
+    in flight (reported as torn by the reader, never misread)."""
+
+    def __init__(self, artifact_dir: str, process: str):
+        self.process = str(process)
+        self.path = os.path.join(
+            artifact_dir, SPANS_DIRNAME,
+            _safe_process(process) + SPANS_SUFFIX,
+        )
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def _append(self, doc: dict):
+        line = json.dumps(
+            _sign(doc), sort_keys=True, separators=(",", ":")
+        )
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+    def _next_id(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return f"{os.getpid():x}-{self._seq:x}"
+
+    def begin(self, name: str, job: str = "", trace: str = "",
+              t: Optional[float] = None, **meta) -> str:
+        """Open a span; returns the span id `end()` closes. `t` lets a
+        reconstructing emitter backdate the start (the coordinator's
+        queue_wait span opens at the job's submit stamp)."""
+        span_id = self._next_id()
+        self._append({
+            "schema": SCHEMA, "ev": "begin", "span": span_id,
+            "name": str(name), "job": str(job), "trace": str(trace),
+            "proc": self.process, "pid": os.getpid(),
+            "t": round(float(time.time() if t is None else t), 6),
+            **({"meta": meta} if meta else {}),
+        })
+        return span_id
+
+    def end(self, span_id: str, t: Optional[float] = None, **meta):
+        self._append({
+            "schema": SCHEMA, "ev": "end", "span": str(span_id),
+            "proc": self.process, "pid": os.getpid(),
+            "t": round(float(time.time() if t is None else t), 6),
+            **({"meta": meta} if meta else {}),
+        })
+
+    def span(self, name: str, job: str = "", trace: str = "", **meta):
+        """Context-manager form; the yielded handle's .meta dict is
+        folded into the end record."""
+        return _SpanCtx(self, name, job, trace, meta)
+
+    def emit(self, name: str, start: float, end: float, job: str = "",
+             trace: str = "", **meta):
+        """One closed span with explicit absolute walls — the
+        reconstructed-phase form (queue_wait at claim time)."""
+        sid = self.begin(name, job=job, trace=trace, t=start, **meta)
+        self.end(sid, t=end)
+
+
+class _SpanCtx:
+    def __init__(self, rec: SpanRecorder, name, job, trace, meta):
+        self._rec = rec
+        self._args = (name, job, trace, meta)
+        self.meta: Dict[str, object] = {}
+        self._id = None
+
+    def __enter__(self):
+        name, job, trace, meta = self._args
+        self._id = self._rec.begin(name, job=job, trace=trace, **meta)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        end_meta = dict(self.meta)
+        if exc_type is not None:
+            end_meta["error"] = exc_type.__name__
+        self._rec.end(self._id, **end_meta)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Stitching — the read side of `tpusim trace`
+# ---------------------------------------------------------------------------
+
+
+def read_span_file(path: str):
+    """(records, problems) of one span file. A record with a bad
+    signature or a torn line is reported in `problems` and skipped —
+    the reader must survive the files a kill -9 leaves behind, but
+    never silently accept an edited one."""
+    records, problems = [], []
+    with open(path) as f:
+        for i, raw in enumerate(f):
+            line = raw.rstrip("\n")
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                problems.append(f"{path}:{i + 1}: torn record (skipped)")
+                continue
+            if not isinstance(doc, dict) or not _check_sig(doc):
+                problems.append(
+                    f"{path}:{i + 1}: signature mismatch (edited?)"
+                )
+                continue
+            records.append(doc)
+    return records, problems
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+def stitch(artifact_dir: str, job: str = "", trace: str = ""):
+    """Merge every per-process span file under `artifact_dir` into one
+    list of stitched spans, optionally filtered by job digest (prefix
+    match, the CLI convenience) and/or trace id. Returns (spans,
+    problems); each span is a dict:
+
+      name/job/trace/proc/pid/start/end/meta
+      status   ok         begin + end paired
+               abandoned  begin with no end — the process died (or is
+                          still mid-phase); the stolen attempt's corpse
+               orphan     end with no begin — file damage, never
+                          expected (the smoke gates on zero of these)
+
+    Abandoned spans report end = the file's last-seen timestamp for
+    that process (duration = what the recorder witnessed), never a
+    fabricated completion."""
+    spans_dir = os.path.join(artifact_dir, SPANS_DIRNAME)
+    out: List[dict] = []
+    problems: List[str] = []
+    if not os.path.isdir(spans_dir):
+        return out, problems
+    for fname in sorted(os.listdir(spans_dir)):
+        if not fname.endswith(SPANS_SUFFIX):
+            continue
+        records, probs = read_span_file(os.path.join(spans_dir, fname))
+        problems.extend(probs)
+        open_spans: Dict[str, dict] = {}
+        last_t = 0.0
+        for doc in records:
+            last_t = max(last_t, float(doc.get("t") or 0.0))
+            key = str(doc.get("span"))
+            if doc.get("ev") == "begin":
+                open_spans[key] = doc
+            elif doc.get("ev") == "end":
+                begin = open_spans.pop(key, None)
+                if begin is None:
+                    out.append({
+                        "name": "?", "job": "", "trace": "",
+                        "proc": doc.get("proc", fname),
+                        "pid": int(doc.get("pid") or 0),
+                        "start": float(doc.get("t") or 0.0),
+                        "end": float(doc.get("t") or 0.0),
+                        "meta": dict(doc.get("meta") or {}),
+                        "status": "orphan",
+                    })
+                    continue
+                meta = dict(begin.get("meta") or {})
+                meta.update(doc.get("meta") or {})
+                out.append({
+                    "name": begin.get("name", "?"),
+                    "job": begin.get("job", ""),
+                    "trace": begin.get("trace", ""),
+                    "proc": begin.get("proc", fname),
+                    "pid": int(begin.get("pid") or 0),
+                    "start": float(begin.get("t") or 0.0),
+                    "end": float(doc.get("t") or 0.0),
+                    "meta": meta,
+                    "status": "ok",
+                })
+        for begin in open_spans.values():
+            pid = int(begin.get("pid") or 0)
+            out.append({
+                "name": begin.get("name", "?"),
+                "job": begin.get("job", ""),
+                "trace": begin.get("trace", ""),
+                "proc": begin.get("proc", fname),
+                "pid": pid,
+                "start": float(begin.get("t") or 0.0),
+                "end": max(last_t, float(begin.get("t") or 0.0)),
+                "meta": dict(begin.get("meta") or {}),
+                "status": (
+                    "abandoned" if not _pid_alive(pid) else "open"
+                ),
+            })
+    if job:
+        out = [s for s in out
+               if s["job"] == job or s["job"].startswith(job)]
+    if trace:
+        out = [s for s in out if s["trace"] == trace]
+    out.sort(key=lambda s: (s["start"], s["proc"], s["name"]))
+    return out, problems
+
+
+def format_timeline(spans, out_lines: Optional[List[str]] = None):
+    """Terminal rendering: one line per span, grouped nothing — sorted
+    by start with a per-process column, offsets relative to the first
+    span. The abandoned attempt reads as `ABANDONED`, not a gap."""
+    lines = out_lines if out_lines is not None else []
+    if not spans:
+        lines.append("(no spans)")
+        return lines
+    t0 = min(s["start"] for s in spans)
+    procs = []
+    for s in spans:
+        if s["proc"] not in procs:
+            procs.append(s["proc"])
+    lines.append(
+        f"{len(spans)} spans across {len(procs)} processes "
+        f"({', '.join(procs)})"
+    )
+    for s in spans:
+        dur = max(s["end"] - s["start"], 0.0)
+        status = "" if s["status"] == "ok" else f"  [{s['status'].upper()}]"
+        extra = ""
+        meta = s.get("meta") or {}
+        if "dispatch_s" in meta:
+            extra = (f"  dispatch={meta['dispatch_s']:.3f}s"
+                     if isinstance(meta["dispatch_s"], (int, float))
+                     else "")
+        lines.append(
+            f"  +{s['start'] - t0:8.3f}s  {dur:8.3f}s  "
+            f"{s['proc']:<24} {s['name']:<14}"
+            f"{extra}{status}"
+        )
+    return lines
+
+
+def chrome_trace(spans) -> dict:
+    """Chrome-trace document: one pid (track) per process, `X` duration
+    events in microseconds, `M` process_name metadata rows — load in
+    chrome://tracing or Perfetto. Abandoned/orphan spans carry their
+    status in args so they render inspectable, not invisible."""
+    procs: Dict[str, int] = {}
+    events: List[dict] = []
+    t0 = min((s["start"] for s in spans), default=0.0)
+    for s in spans:
+        pid = procs.setdefault(s["proc"], len(procs) + 1)
+        args = {"job": s["job"], "trace": s["trace"],
+                "status": s["status"], **(s.get("meta") or {})}
+        events.append({
+            "name": s["name"] + (
+                "" if s["status"] == "ok" else f" [{s['status']}]"
+            ),
+            "ph": "X", "pid": pid, "tid": 1,
+            "ts": round((s["start"] - t0) * 1e6, 3),
+            "dur": round(max(s["end"] - s["start"], 0.0) * 1e6, 3),
+            "args": args,
+        })
+    for proc, pid in procs.items():
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 1,
+            "args": {"name": proc},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

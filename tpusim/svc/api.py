@@ -26,9 +26,13 @@ is what `tpusim serve DIR --jobs` and the smoke/test surfaces drive.
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
+import urllib.parse
 from typing import Dict, Optional, Tuple
 
+from tpusim.obs import trace as obs_trace
 from tpusim.svc import jobs as svc_jobs
 from tpusim.svc.auth import check as _auth_check
 from tpusim.svc.batcher import JobQueue, QueueFull, QuotaFull
@@ -46,6 +50,14 @@ def _json_body(code: int, doc, headers: Optional[dict] = None):
 
 class JobService:
     """The extension app MonitorServer routes /jobs and /queue to."""
+
+    # MonitorServer hands us the raw query string (the /events filters)
+    accepts_query = True
+
+    # bound on the digest -> trace-id map: FIFO like the monitor's
+    # per-job progress window — a long-lived service must not grow
+    # per-job state forever
+    MAX_TRACE_IDS = 1024
 
     def __init__(self, queue: JobQueue, worker: Optional[Worker],
                  traces: Dict[str, TraceRef], artifact_dir: str,
@@ -68,9 +80,21 @@ class JobService:
         # empty = auth disabled. FleetService reads it via its `token`
         # property so both planes enforce ONE secret.
         self.token = ""
+        # flight recorder (ISSUE 19): per-process span file + chained
+        # audit log, armed by start_job_server / the CLI. Both optional:
+        # a bare JobService in a unit test records nothing.
+        self.spans = None  # obs.trace.SpanRecorder
+        self.audit = None  # obs.audit.AuditLog
+        # job digest -> trace id, fed by the submit header (or minted
+        # here) and handed to workers at claim time so every process
+        # tags its spans with the id minted at submit
+        self.trace_ids: Dict[str, str] = {}
         # submit path serializes digest lookup + enqueue so concurrent
         # duplicate POSTs dedup instead of double-running
         self._submit_lock = threading.Lock()
+
+    def trace_of(self, digest: str) -> str:
+        return self.trace_ids.get(digest, "")
 
     def publish_job(self, job) -> None:
         """Push a job's lifecycle change into the monitor's per-job
@@ -83,10 +107,15 @@ class JobService:
 
     # ---- submission (shared by HTTP and in-process callers) ----
 
-    def submit_payload(self, payload: dict) -> dict:
+    def submit_payload(self, payload: dict, trace_id: str = "") -> dict:
         """Validate + dedup + enqueue one job document. Returns the job
         description (with `cached` marking digest-cache answers); raises
-        ValueError (→ 400) or QueueFull (→ 429)."""
+        ValueError (→ 400) or QueueFull (→ 429). `trace_id` is the
+        flight-recorder id off the submit header (minted here for
+        in-process callers); it tags the admission span and is handed
+        to whichever worker later claims the job — it NEVER enters the
+        spec or its digest (two submits of one spec must still dedup)."""
+        t_admit = time.time()
         payload = svc_jobs.expand_policy_preset(
             payload, self.policy_presets
         )
@@ -100,6 +129,10 @@ class JobService:
                 f"{', '.join(sorted(self.traces)) or 'none'})"
             )
         digest = svc_jobs.job_digest(spec, trace.digest)
+        tid = trace_id or obs_trace.new_trace_id()
+        self.trace_ids[digest] = tid
+        while len(self.trace_ids) > self.MAX_TRACE_IDS:
+            self.trace_ids.pop(next(iter(self.trace_ids)))
         with self._submit_lock:
             cached = svc_jobs.find_result(self.artifact_dir, digest)
             job = self.queue.submit(spec, digest, cached_result=cached)
@@ -109,6 +142,12 @@ class JobService:
                 # disk instead of a job stranded in `running` forever
                 # (recover_pending_jobs requeues it at the next startup)
                 svc_jobs.write_job_spec(self.artifact_dir, digest, payload)
+        if self.spans is not None:
+            self.spans.emit(
+                obs_trace.SPAN_ADMIT, t_admit, time.time(),
+                job=digest, trace=tid,
+                cached=bool(cached is not None),
+            )
         if self.monitor is not None:
             self.monitor.publish_job_progress(
                 job.id, {"status": job.status, "phase": "submitted"}
@@ -175,7 +214,8 @@ class JobService:
 
     # ---- the MonitorServer app hook ----
 
-    def handle(self, method: str, path: str, body: bytes, headers=None):
+    def handle(self, method: str, path: str, body: bytes, headers=None,
+               query: str = ""):
         if path == "/jobs" and method == "POST":
             # auth BEFORE any parsing: a 401 must not leak whether the
             # body would have been a valid spec or a known digest
@@ -185,9 +225,11 @@ class JobService:
                 )
             if self.fleet is not None and self.fleet.role != "leader":
                 return self.fleet.standby_503()
-            return self._post_jobs(body)
+            return self._post_jobs(body, obs_trace.header_trace(headers))
         if path == "/queue" and method == "GET":
             return self._get_queue()
+        if path == "/events" and method == "GET":
+            return self._get_events(query)
         if path.startswith("/jobs/"):
             if method != "GET":
                 return _json_body(405, {"error": "method not allowed"})
@@ -197,7 +239,7 @@ class JobService:
             return self._get_job(rest)
         return None  # not ours: fall through to the monitor built-ins
 
-    def _post_jobs(self, body: bytes):
+    def _post_jobs(self, body: bytes, trace_id: str = ""):
         try:
             payload = json.loads(body.decode() or "null")
         except (json.JSONDecodeError, UnicodeDecodeError) as err:
@@ -213,7 +255,7 @@ class JobService:
         rejected_indices = []
         for i, doc in enumerate(docs):
             try:
-                accepted.append(self.submit_payload(doc))
+                accepted.append(self.submit_payload(doc, trace_id))
             except ValueError as err:
                 # reject the lot on the first malformed doc: a half-
                 # accepted batch would make retries re-submit (harmless,
@@ -270,6 +312,34 @@ class JobService:
             )
         return _json_body(200, job.result)
 
+    def _get_events(self, query: str = ""):
+        """The audit-log query endpoint (ISSUE 19): bounded tail of the
+        chained control-plane log, filterable by kind/job/worker. The
+        read path link-checks the whole chain, so an edited log answers
+        500 with the verifier's complaint, never silently wrong data."""
+        from tpusim.obs import audit as obs_audit
+
+        q = urllib.parse.parse_qs(query or "")
+
+        def one(key, default=""):
+            vals = q.get(key) or [default]
+            return vals[0]
+
+        try:
+            n = min(max(int(one("n", "50")), 1), 500)
+        except ValueError:
+            return _json_body(400, {"error": "n must be an integer"})
+        try:
+            events = obs_audit.tail(
+                self.artifact_dir, n=n, kind=one("kind"),
+                job=one("job"), worker=one("worker"),
+            )
+        except ValueError as err:
+            return _json_body(
+                500, {"error": f"audit chain unreadable: {err}"}
+            )
+        return _json_body(200, {"events": events, "n": len(events)})
+
     def _get_queue(self):
         """The aggregated /queue document (ISSUE 12): queue + quota
         stats, plus — in fleet mode — the per-worker rows (depth served,
@@ -298,6 +368,10 @@ def recover_pending_jobs(service: JobService, out=None) -> int:
     for digest, payload in svc_jobs.pending_job_specs(service.artifact_dir):
         try:
             service.submit_payload(payload)
+            if service.audit is not None:
+                service.audit.emit(
+                    "requeue", job=digest, reason="recovered-spec",
+                )
             n += 1
         except QueueFull:
             if out is not None:
@@ -362,6 +436,18 @@ def start_job_server(
                          policy_presets=policy_presets)
     service.bucket = bucket  # the register handshake hands it to workers
     service.token = str(token or "")
+    # flight recorder (ISSUE 19): every coordinator process writes its
+    # own span file (HA pairs share the artifact dir, so the name is
+    # pid-scoped) and appends control-plane decisions to the chained
+    # audit log. Always armed — the log IS the operational record.
+    from tpusim.obs.audit import AuditLog
+    from tpusim.obs.trace import SpanRecorder
+
+    proc = f"coord-{os.getpid()}"
+    service.spans = SpanRecorder(artifact_dir, proc)
+    service.audit = AuditLog(artifact_dir, proc)
+    if coord is not None:
+        coord.audit = service.audit
 
     # capability routing (ISSUE 17): tell the queue what each family
     # actually NEEDS, judged against the hosted trace — claim_batch only

@@ -112,6 +112,14 @@ class Job:
     # claim/dispatch stamps — the retry's latency is measured fresh.
     claimed_unix: float = 0.0
     dispatched_unix: float = 0.0
+    # steal visibility (ISSUE 19): how many times this job was handed
+    # to a worker (every claim stamping increments), who owned it when
+    # a steal cleared the claim (the audit record's worker), and the
+    # wall-clock the abandoned attempts consumed — mark_done subtracts
+    # it for the steals-ADJUSTED admission->result latency view
+    attempts: int = 0
+    last_worker: str = ""
+    steal_lost_s: float = 0.0
 
     def kind(self) -> str:
         """Latency-bucket vocabulary: base | fork | full | plain —
@@ -154,9 +162,19 @@ class Job:
             )
         if self.dispatched_unix:
             out["dispatched_unix"] = self.dispatched_unix
+        if self.attempts:
+            out["attempts"] = self.attempts
+        if self.steal_lost_s:
+            out["steal_lost_s"] = round(self.steal_lost_s, 3)
         if self.finished_unix:
             out["finished_unix"] = self.finished_unix
             out["latency_s"] = self.finished_unix - self.submitted_unix
+            if self.steal_lost_s:
+                # what the latency WOULD have been had no attempt been
+                # abandoned — the steals-adjusted view (ISSUE 19)
+                out["adjusted_latency_s"] = max(
+                    out["latency_s"] - self.steal_lost_s, 0.0
+                )
         return out
 
 
@@ -211,6 +229,10 @@ class JobQueue:
         # bounded ring per bucket, fed by mark_done (cached dedup hits
         # never ran, so they never sample); /queue serves p50/p99
         self._latency: Dict[str, List[float]] = {}
+        # the steals-ADJUSTED twin (ISSUE 19): same samples minus each
+        # job's steal_lost_s — raw p99 answers "what did users see",
+        # adjusted p99 answers "what would the fleet do without deaths"
+        self._latency_adj: Dict[str, List[float]] = {}
         self._latency_cap = 1024
 
     # ---- submission / lookup ----
@@ -392,6 +414,7 @@ class JobQueue:
                 job.worker = str(worker)
                 job.lease_deadline_unix = lease_deadline
                 job.claimed_unix = claim_t
+                job.attempts += 1
             self._cond.notify_all()
             return batch
 
@@ -422,6 +445,7 @@ class JobQueue:
                 job.worker = str(worker)
                 job.lease_deadline_unix = lease_deadline
                 job.claimed_unix = claim_t
+                job.attempts += 1
             self._cond.notify_all()
             return batch
 
@@ -455,6 +479,9 @@ class JobQueue:
                 return []
             stolen.sort(key=lambda j: j.seq)
             for job in stolen:
+                job.last_worker = job.worker
+                if job.claimed_unix:
+                    job.steal_lost_s += max(now - job.claimed_unix, 0.0)
                 job.status = "queued"
                 job.worker = ""
                 job.lease_deadline_unix = 0.0
@@ -505,7 +532,11 @@ class JobQueue:
             if not held:
                 return []
             held.sort(key=lambda j: j.seq)
+            now = time.time()
             for job in held:
+                job.last_worker = job.worker
+                if job.claimed_unix:
+                    job.steal_lost_s += max(now - job.claimed_unix, 0.0)
                 job.status = "queued"
                 job.worker = ""
                 job.lease_deadline_unix = 0.0
@@ -537,6 +568,7 @@ class JobQueue:
                 job.worker = str(worker)
                 job.lease_deadline_unix = float(deadline_unix)
                 job.claimed_unix = time.time()
+                job.attempts += 1
                 claimed.append(job)
             return claimed
 
@@ -573,10 +605,15 @@ class JobQueue:
             job.lease_deadline_unix = 0.0
             job.finished_unix = time.time()
             self.stats_counters["done"] += 1
+            lat = job.finished_unix - job.submitted_unix
             samples = self._latency.setdefault(job.kind(), [])
-            samples.append(job.finished_unix - job.submitted_unix)
+            samples.append(lat)
             if len(samples) > self._latency_cap:
                 del samples[: len(samples) - self._latency_cap]
+            adj = self._latency_adj.setdefault(job.kind(), [])
+            adj.append(max(lat - job.steal_lost_s, 0.0))
+            if len(adj) > self._latency_cap:
+                del adj[: len(adj) - self._latency_cap]
 
     def mark_failed(self, job: Job, error: str) -> None:
         with self._cond:
@@ -613,22 +650,30 @@ class JobQueue:
         result sample rings — the /queue latency view and the
         serve-latency gate's SLO input (nearest-rank percentiles, so
         small smoke samples are exact, not interpolated)."""
+        def _pct(s, q):
+            n = len(s)
+            return s[min(n - 1, max(0, int(q * n + 0.999999) - 1))]
+
         with self._cond:
             out: Dict[str, dict] = {}
             for kind, samples in self._latency.items():
                 if not samples:
                     continue
                 s = sorted(samples)
-                n = len(s)
-
-                def _pct(q):
-                    return s[min(n - 1, max(0, int(q * n + 0.999999) - 1))]
-
-                out[kind] = {
-                    "count": n,
-                    "p50_s": _pct(0.50),
-                    "p99_s": _pct(0.99),
+                row = {
+                    "count": len(s),
+                    "p50_s": _pct(s, 0.50),
+                    "p99_s": _pct(s, 0.99),
                 }
+                adj = sorted(self._latency_adj.get(kind) or [])
+                if adj:
+                    # the steals-adjusted twin (ISSUE 19): the same
+                    # samples with each job's abandoned-attempt wall
+                    # subtracted — the gap between the pairs IS the
+                    # latency cost of worker deaths
+                    row["adjusted_p50_s"] = _pct(adj, 0.50)
+                    row["adjusted_p99_s"] = _pct(adj, 0.99)
+                out[kind] = row
             return out
 
     def stats(self) -> dict:

@@ -90,19 +90,27 @@ def _request(url: str, data: Optional[bytes] = None,
 
 def submit_jobs(url: str, docs: Sequence[dict], max_retries: int = 8,
                 timeout: float = 30.0, out=None,
-                token: Optional[str] = None) -> List[dict]:
+                token: Optional[str] = None,
+                trace: Optional[str] = None) -> List[dict]:
     """POST every job document, honoring 429/Retry-After backpressure:
     rejected remainders are re-submitted after the advertised delay
     (dedup makes overlap safe). Returns the accepted job descriptions in
     submission order; raises ServiceError on a 400 or when the queue
     never drains within max_retries rounds, CoordinatorLost when this
-    coordinator is unreachable or a standby (the rotate cue)."""
+    coordinator is unreachable or a standby (the rotate cue).
+
+    `trace` is the flight-recorder trace id (ISSUE 19): minted here when
+    absent and sent as the X-Tpusim-Trace header, so the whole submit —
+    including backpressure retries — stitches as one journey. Callers
+    that rotate coordinators pass the SAME id to every attempt."""
     import http.client
 
+    from tpusim.obs.trace import TRACE_HEADER, new_trace_id
     from tpusim.svc.auth import bearer_headers
 
     url = url.rstrip("/")
-    auth = bearer_headers(_token(token))
+    auth = dict(bearer_headers(_token(token)))
+    auth[TRACE_HEADER] = trace or new_trace_id()
     pending = list(docs)
     accepted: List[dict] = []
     for attempt in range(1, max_retries + 1):
@@ -336,15 +344,21 @@ def submit_and_wait(url: str, docs: Sequence[dict], timeout: float = 300.0,
     dedup server-side and finished work answers from the result cache,
     so a coordinator failover costs a stall, never duplicate runs."""
     from tpusim.io.kube_client import parse_url_list
+    from tpusim.obs.trace import new_trace_id
 
     urls = parse_url_list(url)
     deadline = time.time() + timeout
     rounds = 2 * len(urls)
     last_lost: Optional[CoordinatorLost] = None
+    # one trace id for the whole flow: a failover rotation re-submits
+    # under the SAME id, so the stitched timeline shows one journey
+    # crossing coordinators rather than two disconnected ones
+    tid = new_trace_id()
     for round_ in range(1, rounds + 1):
         cur = urls[0]
         try:
-            accepted = submit_jobs(cur, docs, out=out, token=token)
+            accepted = submit_jobs(cur, docs, out=out, token=token,
+                                   trace=tid)
             ids = [a["id"] for a in accepted]
             final = wait_jobs(
                 cur, ids, timeout=max(deadline - time.time(), 1.0),

@@ -185,10 +185,18 @@ class CoordinatorState:
         self.takeovers = 0
         self.demotions = 0
         self._lock = threading.Lock()
+        # the audit chain (ISSUE 19): an obs.audit.AuditLog when the
+        # serve loop wired one — takeover/epoch-bump/deposition are
+        # control-plane decisions, so each appends a chained record
+        self.audit = None
 
     def _say(self, msg: str) -> None:
         if self.out is not None:
             print(msg, file=self.out)
+
+    def _audit(self, kind: str, **fields) -> None:
+        if self.audit is not None:
+            self.audit.emit(kind, **fields)
 
     # ---- transitions ----
 
@@ -217,6 +225,11 @@ class CoordinatorState:
             self.role = "leader"
             self.takeovers += 1
             self._stake(now)
+            self._audit("epoch_bump", coordinator=self.name,
+                        from_epoch=seen, epoch=self.epoch)
+            self._audit("takeover", coordinator=self.name,
+                        epoch=self.epoch,
+                        prior_lease="stale" if doc else "absent")
             self._say(
                 f"[coord] {self.name} took leadership at epoch "
                 f"{self.epoch} (previous lease: "
@@ -273,6 +286,8 @@ class CoordinatorState:
     def _demote_locked(self, reason: str) -> None:
         self.role = "standby"
         self.demotions += 1
+        self._audit("deposed", coordinator=self.name,
+                    epoch=self.epoch, reason=reason)
         print(
             f"[Degrade] coordinator {self.name} DEPOSED at epoch "
             f"{self.epoch}{': ' + reason if reason else ''} — demoting "

@@ -70,6 +70,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from tpusim.obs import trace as obs_trace
 from tpusim.svc import jobs as svc_jobs
 from tpusim.svc import leases as svc_leases
 from tpusim.svc.api import _json_body
@@ -107,6 +108,14 @@ class WorkerInfo:
     # counters (downloads/uploads/bytes/resumes/sha retries)
     mode: str = "shared-fs"
     transfers: dict = field(default_factory=dict)
+    # the MEASURED capability profile (ISSUE 19), beside the caps the
+    # worker merely declared: EWMA of reported batch dispatch walls,
+    # the compile-cache probable-hit count (obs.spans.note_compile_cache
+    # heuristic, counted worker-side), and the worker's own pushed
+    # exposition-format snapshot (merged worker-labeled into /metrics)
+    ewma_dispatch_s: float = 0.0
+    probable_hits: int = 0
+    metrics_text: str = ""
     # capability tags (ISSUE 17): what this worker declared at
     # registration — backend name, device count, approximate memory
     # bytes, fault-lane support, and the biggest trace it will take
@@ -115,6 +124,25 @@ class WorkerInfo:
 
     def live(self, now: float, window_s: float) -> bool:
         return (now - self.last_seen_unix) <= window_s
+
+    def profile(self, now: float) -> dict:
+        """The measured profile row for /workers: what this worker
+        actually does — smoothed dispatch wall, transfer throughput
+        since join, compile-cache hit rate — as opposed to what its
+        caps tags declared at registration."""
+        tr = self.transfers or {}
+        moved = (int(tr.get("download_bytes") or 0)
+                 + int(tr.get("upload_bytes") or 0))
+        return {
+            "ewma_dispatch_s": round(self.ewma_dispatch_s, 3),
+            "transfer_bps": round(
+                moved / max(now - self.joined_unix, 1e-6), 1
+            ),
+            "compile_hit_rate": (
+                round(self.probable_hits / self.batches, 3)
+                if self.batches else 0.0
+            ),
+        }
 
 
 class WorkerRegistry:
@@ -210,6 +238,7 @@ class WorkerRegistry:
                 "sweep_executables": w.sweep_executables,
                 "first_dispatch_s": round(w.first_dispatch_s, 3),
                 "last_dispatch_s": round(w.last_dispatch_s, 3),
+                "profile": w.profile(now),
                 "leases_held": (
                     len(queue.jobs_of_worker(w.id)) if queue else 0
                 ),
@@ -266,10 +295,30 @@ class FleetService:
     def token(self) -> str:
         return getattr(self.service, "token", "") or ""
 
-    def _unauthorized(self):
+    # ---- the flight recorder (ISSUE 19): the audit log + span
+    # recorder live on JobService (one pair per coordinator process);
+    # every control-plane decision below witnesses itself through them
+
+    @property
+    def audit(self):
+        return getattr(self.service, "audit", None)
+
+    @property
+    def spans(self):
+        return getattr(self.service, "spans", None)
+
+    def _audit(self, kind: str, job: str = "", worker: str = "",
+               **fields):
+        log = self.audit
+        if log is not None:
+            log.emit(kind, job=job, worker=worker, **fields)
+
+    def _unauthorized(self, path: str = ""):
         # one uniform body for missing/malformed/forged tokens, issued
         # BEFORE any digest parsing — a 401 never reveals whether a
-        # digest (or worker, or trace) exists
+        # digest (or worker, or trace) exists. The audit record carries
+        # the path only: token material never enters the chain.
+        self._audit("auth_401", path=path)
         return _json_body(
             401, {"error": "missing or invalid bearer token"}
         )
@@ -301,6 +350,9 @@ class FleetService:
             return _json_body(400, {"error": "epoch must be an integer"})
         mine = self.epoch
         if op_epoch < mine:
+            self._audit("fence_409", worker=str(doc.get("worker") or ""),
+                        detail="stale_epoch", op_epoch=op_epoch,
+                        epoch=mine)
             return _json_body(409, {
                 "error": f"stale coordinator epoch {op_epoch} "
                          f"(current {mine})",
@@ -308,6 +360,8 @@ class FleetService:
             })
         if op_epoch > mine:
             self.coord.note_epoch(op_epoch)
+            self._audit("fence_409", worker=str(doc.get("worker") or ""),
+                        detail="deposed", op_epoch=op_epoch, epoch=mine)
             return _json_body(409, {
                 "error": f"op carries epoch {op_epoch} > ours ({mine}) "
                          "— this coordinator was deposed and has "
@@ -326,9 +380,13 @@ class FleetService:
             # parsing), then leadership: a standby must not mutate
             # shared state even for a validly-authed worker
             if not auth_check(headers, self.token):
-                return self._unauthorized()
+                return self._unauthorized(path)
             if self.role != "leader":
                 return self.standby_503()
+        # the fleet-aggregated metrics view (ISSUE 19): read-only, so
+        # it answers in front of MonitorServer's single-run builtin
+        if path == "/metrics" and method == "GET":
+            return self._metrics()
         # the transfer plane (ISSUE 13): trace download, result upload,
         # and the remote workers' lease mirror — all digest-guarded
         if path == "/traces" and method == "GET":
@@ -341,7 +399,7 @@ class FleetService:
         if path.startswith("/traces/") and method == "GET":
             return self._get_trace(path, headers)
         if path.startswith("/results/") and method == "POST":
-            return self._accept_result(path, body)
+            return self._accept_result(path, body, headers)
         if path == "/leases" and method == "POST":
             return self._leases(body)
         if not path.startswith("/workers"):
@@ -454,7 +512,7 @@ class FleetService:
             return (206, "text/csv", data, hdrs)
         return (200, "text/csv", data, hdrs)
 
-    def _accept_result(self, path: str, body: bytes):
+    def _accept_result(self, path: str, body: bytes, headers=None):
         """POST /results/<digest> — the upload half: the bytes must
         verify as a signed result for EXACTLY this digest before the
         atomic rename lands them; a torn or forged upload is rejected
@@ -463,12 +521,15 @@ class FleetService:
         digest = path[len("/results/"):]
         if not self._safe_digest(digest):
             return _json_body(404, {"error": f"bad result path {path!r}"})
+        t_verify = time.time()
         try:
             svc_jobs.accept_result_upload(
                 self.service.artifact_dir, digest, body
             )
         except (ValueError, json.JSONDecodeError) as err:
             self.transfers["uploads_rejected"] += 1
+            self._audit("degrade", job=digest, reason="rejected-upload",
+                        detail=str(err))
             print(
                 f"[Degrade] rejected result upload for {digest[:12]}… "
                 f"({err}); nothing written — the worker retries or the "
@@ -477,6 +538,13 @@ class FleetService:
             )
             return _json_body(400, {"error": f"rejected upload: {err}"})
         self.transfers["uploads_ok"] += 1
+        if self.spans is not None:
+            tid = (obs_trace.header_trace(headers)
+                   or self.service.trace_of(digest))
+            self.spans.emit(
+                obs_trace.SPAN_VERIFY, t_verify, time.time(),
+                job=digest, trace=tid, bytes=len(body),
+            )
         return _json_body(200, {"stored": digest, "bytes": len(body)})
 
     def _leases(self, body: bytes):
@@ -581,6 +649,8 @@ class FleetService:
         held = self.queue.release_worker(wid)
         for job in held:
             svc_leases.delete_lease(self.service.artifact_dir, job.digest)
+            self._audit("requeue", job=job.digest, worker=wid,
+                        reason="worker-dead", dead_pid=int(pid))
         if held and self.out is not None:
             print(
                 f"[fleet] released {len(held)} job(s) of dead worker "
@@ -596,6 +666,10 @@ class FleetService:
         stolen = self.queue.steal_expired()
         for job in stolen:
             svc_leases.delete_lease(self.service.artifact_dir, job.digest)
+            self._audit("steal", job=job.digest,
+                        worker=getattr(job, "last_worker", ""),
+                        reason="lease_expired",
+                        attempts=getattr(job, "attempts", 0))
             if self.out is not None:
                 print(
                     f"[fleet] lease expired on {job.id} "
@@ -657,16 +731,32 @@ class FleetService:
             if job.stolen:
                 info.steals_benefited += 1
             ready.append(job)
-        deadline = time.time() + self.queue.lease_s
+        now = time.time()
+        deadline = now + self.queue.lease_s
+        handed = []
+        for j in ready:
+            # the trace id rides the claim answer (ISSUE 19): the
+            # worker tags its dispatch/upload spans with the SAME id
+            # the submit minted — no shared state beyond this field
+            tid = self.service.trace_of(j.digest)
+            if self.spans is not None:
+                # queue_wait closes at hand-out; a re-claim after a
+                # steal re-emits it with the attempt count, so the
+                # stitched timeline shows both waits
+                self.spans.emit(
+                    obs_trace.SPAN_QUEUE_WAIT, j.submitted_unix, now,
+                    job=j.digest, trace=tid, worker=info.id,
+                    stolen=int(j.stolen),
+                    attempts=getattr(j, "attempts", 0),
+                )
+            handed.append({
+                "id": j.id, "digest": j.digest,
+                "spec": svc_jobs.spec_to_payload(j.spec),
+                "stolen": j.stolen,
+                "trace": tid,
+            })
         return _json_body(200, {
-            "jobs": [
-                {
-                    "id": j.id, "digest": j.digest,
-                    "spec": svc_jobs.spec_to_payload(j.spec),
-                    "stolen": j.stolen,
-                }
-                for j in ready
-            ],
+            "jobs": handed,
             "deadline_unix": deadline,
             "lease_s": self.queue.lease_s,
             "epoch": self.epoch,
@@ -692,9 +782,19 @@ class FleetService:
         acked = dup = 0
         for digest in done:
             job = self.queue.get_by_digest(digest)
+            t_verify = time.time()
             result = svc_jobs.find_result(
                 self.service.artifact_dir, digest
             )
+            if result is not None and info.mode != "remote" \
+                    and self.spans is not None:
+                # shared-fs jobs never cross _accept_result, so the
+                # signature check above IS their verify hop — witness
+                # it (remote uploads were witnessed at upload time)
+                self.spans.emit(
+                    obs_trace.SPAN_VERIFY, t_verify, time.time(),
+                    job=digest, trace=self.service.trace_of(digest),
+                )
             if job is None:
                 dup += 1  # finished after a restart reset the registry
                 continue
@@ -737,9 +837,31 @@ class FleetService:
             self.service.publish_job(job)
         info.batches += 1
         if doc.get("dispatch_s"):
-            info.last_dispatch_s = float(doc["dispatch_s"])
+            d = float(doc["dispatch_s"])
+            info.last_dispatch_s = d
             if not info.first_dispatch_s:
-                info.first_dispatch_s = float(doc["dispatch_s"])
+                info.first_dispatch_s = d
+            # the measured profile (ISSUE 19): first sample seeds the
+            # EWMA, then 0.7/0.3 smoothing — slow enough to damp one
+            # cold compile, fast enough to notice a degraded host
+            info.ewma_dispatch_s = (
+                d if not info.ewma_dispatch_s
+                else 0.7 * info.ewma_dispatch_s + 0.3 * d
+            )
+        if doc.get("probable_hits") is not None:
+            try:
+                info.probable_hits = int(doc["probable_hits"])
+            except (TypeError, ValueError):
+                pass
+        pushed = doc.get("metrics_text")
+        if isinstance(pushed, str) and pushed:
+            from tpusim.obs.emitters import parse_prometheus_text
+            try:
+                parse_prometheus_text(pushed)
+            except ValueError:
+                pass  # an unparseable push never poisons the merge
+            else:
+                info.metrics_text = pushed
         if doc.get("sweep_executables") is not None:
             info.sweep_executables = int(doc["sweep_executables"])
         if isinstance(doc.get("transfers"), dict):
@@ -747,6 +869,77 @@ class FleetService:
                 k: int(v) for k, v in doc["transfers"].items()
             }
         return _json_body(200, {"acked": acked, "dup": dup})
+
+    # ---- the fleet-aggregated /metrics (ISSUE 19) ----
+
+    def _metrics(self):
+        """GET /metrics, fleet edition: the coordinator's own snapshot
+        (MonitorServer.metrics_text, present once a run record was
+        published) + fleet-level gauges + every LIVE worker's pushed
+        snapshot re-emitted under a `worker="<id>"` label. Every label
+        value rides escape_label_value, `# TYPE` declarations are
+        emitted once per name across the whole merge, and the result
+        must round-trip parse_prometheus_text — the bench gate scrapes
+        and re-parses it. Name spaces keep the merge collision-free:
+        the base snapshot owns `tpusim_*` run-record names, the fleet
+        gauges own `tpusim_fleet_*`, worker pushes own
+        `tpusim_worker_*` (worker_metrics_text)."""
+        from tpusim.obs.emitters import (escape_label_value,
+                                         parse_prometheus_text)
+
+        lines: List[str] = []
+        typed = set()
+
+        def declare(name: str):
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {name} gauge")
+
+        monitor = getattr(self.service, "monitor", None)
+        base = monitor.metrics_text() if monitor is not None else ""
+        if base:
+            for ln in base.rstrip("\n").splitlines():
+                if ln.startswith("# TYPE "):
+                    parts = ln.split()
+                    if len(parts) >= 3:
+                        typed.add(parts[2])
+                lines.append(ln)
+        now = time.time()
+        declare("tpusim_fleet_workers_live")
+        lines.append(
+            f"tpusim_fleet_workers_live {self.registry.live_count(now)}"
+        )
+        declare("tpusim_fleet_queue_depth")
+        lines.append(f"tpusim_fleet_queue_depth {self.queue.depth()}")
+        for fam, depth in sorted(self.queue.family_depths().items()):
+            declare("tpusim_fleet_family_depth")
+            lines.append(
+                'tpusim_fleet_family_depth{family="%s"} %d'
+                % (escape_label_value(fam), depth)
+            )
+        with self.registry._lock:
+            snapshot = list(self.registry.workers.values())
+        for w in sorted(snapshot, key=lambda w: w.id):
+            if not w.metrics_text:
+                continue
+            if not w.live(now, self.registry.live_window_s):
+                continue  # a dead worker's last push is history, not state
+            try:
+                series = parse_prometheus_text(w.metrics_text)
+            except ValueError:
+                continue  # _complete validates, but never trust stale state
+            wl = escape_label_value(w.id)
+            for (name, labels) in sorted(series):
+                declare(name)
+                pairs = [
+                    f'{k}="{escape_label_value(v)}"' for k, v in labels
+                ] + [f'worker="{wl}"']
+                lines.append(
+                    f"{name}{{{','.join(pairs)}}} {series[(name, labels)]}"
+                )
+        text = "\n".join(lines) + "\n"
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                text.encode())
 
     # ---- restart recovery (the lease-file half) ----
 
@@ -766,6 +959,9 @@ class FleetService:
             if svc_leases.lease_expired(lease):
                 svc_leases.delete_lease(self.service.artifact_dir, digest)
                 self.queue.stats_counters["lease_expired"] += 1
+                self._audit("lease_expired", job=digest,
+                            worker=str(lease.get("worker") or ""),
+                            reason="expired-at-adoption")
                 continue
             if job is None or job.status != "queued":
                 continue
@@ -860,21 +1056,32 @@ def _with_backoff(call, max_attempts: int = 8, stop_event=None):
                         stop_event=stop_event)
 
 
+def _trace_headers(token: str, trace: str) -> dict:
+    """Auth + trace-propagation headers for one fleet hop (ISSUE 19):
+    the trace id rides X-Tpusim-Trace on every worker→coordinator POST
+    so both sides tag the same journey without shared state."""
+    headers = bearer_headers(token)
+    if trace:
+        headers[obs_trace.TRACE_HEADER] = str(trace)
+    return headers
+
+
 def _post(url: str, path: str, doc: dict, timeout: float = 30.0,
-          max_attempts: int = 8, stop_event=None, token: str = ""):
+          max_attempts: int = 8, stop_event=None, token: str = "",
+          trace: str = ""):
     from tpusim.svc.client import _request
 
     full = url.rstrip("/") + path
     data = json.dumps(doc).encode()
     return _with_backoff(
         lambda: _request(full, data, timeout=timeout,
-                         headers=bearer_headers(token)),
+                         headers=_trace_headers(token, trace)),
         max_attempts=max_attempts, stop_event=stop_event,
     )
 
 
 def _post_bytes(url: str, path: str, data: bytes, timeout: float = 60.0,
-                max_attempts: int = 8, token: str = ""):
+                max_attempts: int = 8, token: str = "", trace: str = ""):
     """POST raw bytes (the signed-result upload) on the same backoff
     schedule as _post."""
     from tpusim.svc.client import _request
@@ -883,7 +1090,7 @@ def _post_bytes(url: str, path: str, data: bytes, timeout: float = 60.0,
     return _with_backoff(
         lambda: _request(full, data, timeout=timeout,
                          content_type="application/octet-stream",
-                         headers=bearer_headers(token)),
+                         headers=_trace_headers(token, trace)),
         max_attempts=max_attempts,
     )
 
@@ -952,22 +1159,22 @@ class CoordinatorRing:
         raise ServiceError(f"no coordinator reachable in {self.urls}")
 
     def post(self, path: str, doc: dict, timeout: float = 30.0,
-             max_attempts: int = 8, stop_event=None):
+             max_attempts: int = 8, stop_event=None, trace: str = ""):
         return self._drive(
             lambda u, ma: _post(
                 u, path, doc, timeout=timeout, max_attempts=ma,
                 stop_event=stop_event or self.stop_event,
-                token=self.token,
+                token=self.token, trace=trace,
             ),
             max_attempts,
         )
 
     def post_bytes(self, path: str, data: bytes, timeout: float = 60.0,
-                   max_attempts: int = 8):
+                   max_attempts: int = 8, trace: str = ""):
         return self._drive(
             lambda u, ma: _post_bytes(
                 u, path, data, timeout=timeout, max_attempts=ma,
-                token=self.token,
+                token=self.token, trace=trace,
             ),
             max_attempts,
         )
@@ -998,6 +1205,32 @@ def new_transfer_counters() -> dict:
         "sha_retries": 0, "uploads": 0, "upload_bytes": 0,
         "upload_failed": 0,
     }
+
+
+def worker_metrics_text(served: int, jobs_done: int, jobs_failed: int,
+                        dispatch_s: float, probable_hits: int,
+                        counters: dict) -> str:
+    """The worker's own exposition-format snapshot, pushed on every
+    complete POST and re-emitted under a `worker="<id>"` label by the
+    coordinator's merged /metrics (ISSUE 19). Unlabeled here on
+    purpose: the coordinator owns the worker label, so the
+    escape_label_value hygiene lives at exactly one merge point."""
+    pairs = [
+        ("tpusim_worker_batches", int(served)),
+        ("tpusim_worker_jobs_done", int(jobs_done)),
+        ("tpusim_worker_jobs_failed", int(jobs_failed)),
+        ("tpusim_worker_last_dispatch_seconds", round(dispatch_s, 6)),
+        ("tpusim_worker_probable_compile_hits", int(probable_hits)),
+        ("tpusim_worker_download_bytes",
+         int(counters.get("download_bytes") or 0)),
+        ("tpusim_worker_upload_bytes",
+         int(counters.get("upload_bytes") or 0)),
+    ]
+    lines = []
+    for name, val in pairs:
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {val}")
+    return "\n".join(lines) + "\n"
 
 
 def _part_path(dest: str) -> str:
@@ -1262,6 +1495,15 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
     lease_s = float(reg["lease_s"])
     epoch = int(reg.get("epoch") or 0)
     counters = new_transfer_counters()
+    # the flight-recorder state (ISSUE 19): trace ids arrive on the
+    # claim answer, keyed by digest; every subsequent hop for that job
+    # rides the id as an X-Tpusim-Trace header. current_trace is the
+    # last batch's lead id — the claim/re-register hops' best context.
+    trace_ids: Dict[str, str] = {}
+    current_trace = ""
+    probable_hits = 0
+    jobs_done_total = 0
+    jobs_failed_total = 0
 
     def stamp(doc: dict) -> dict:
         # every mirrored lease/complete/claim op carries the
@@ -1278,7 +1520,7 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
         code, _, r = ring.post("/workers/register", {
             "worker": wid, "pid": os.getpid(), "host": host,
             "mode": mode, "caps": caps,
-        })
+        }, trace=current_trace)
         if code == 200:
             new_epoch = int(r.get("epoch") or 0)
             if out is not None and new_epoch != epoch:
@@ -1304,13 +1546,21 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
             )
         artifact_dir = os.path.join(cache_dir, "artifacts")
         os.makedirs(artifact_dir, exist_ok=True)
+        # remote-mode spans land in the worker's LOCAL artifact cache —
+        # `tpusim trace` stitches them only where the dir is shared
+        # (the documented limitation; the local fleet shares it)
+        recorder = obs_trace.SpanRecorder(artifact_dir, f"worker-{wid}")
         for name, meta in (reg.get("traces") or {}).items():
-            traces[name] = ensure_local_trace(
-                ring.url, name, meta, cache_dir, counters=counters,
-                out=out,
-            )
+            with recorder.span(obs_trace.SPAN_TRANSFER,
+                               trace_name=name) as sp:
+                traces[name] = ensure_local_trace(
+                    ring.url, name, meta, cache_dir, counters=counters,
+                    out=out,
+                )
+                sp.meta["download_bytes"] = counters["download_bytes"]
     else:
         artifact_dir = reg["artifact_dir"]
+        recorder = obs_trace.SpanRecorder(artifact_dir, f"worker-{wid}")
         for name, meta in (reg.get("traces") or {}).items():
             t = load_trace(
                 name, meta["nodes_csv"], meta["pods_csv"],
@@ -1341,10 +1591,12 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
         # one 409 (epoch bump / wiped roster) earns an immediate
         # re-register + retry so in-flight work keeps its lease across
         # a coordinator failover instead of riding out a steal
+        digests = list(digests)
         for attempt in (1, 2):
             code, _, doc = ring.post(
                 "/workers/renew",
-                stamp({"worker": wid, "digests": list(digests)}),
+                stamp({"worker": wid, "digests": digests}),
+                trace=trace_ids.get(digests[0], "") if digests else "",
             )
             if code == 409 and attempt == 1:
                 re_register()
@@ -1360,18 +1612,30 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
         # reaping are unchanged) — a no-shared-fs worker mirrors them
         # over POST /leases; short retry budgets keep the keeper thread
         # from stalling a whole renewal period on a flaky link
-        worker.lease_stake_cb = lambda members: ring.post(
-            "/leases",
-            stamp({"op": "stake", "worker": wid, "pid": os.getpid(),
-                   "members": list(members)}),
-            max_attempts=3,
-        )
-        worker.lease_release_cb = lambda members: ring.post(
-            "/leases",
-            stamp({"op": "release", "worker": wid,
-                   "members": list(members)}),
-            max_attempts=3,
-        )
+        def _stake(members):
+            members = list(members)
+            return ring.post(
+                "/leases",
+                stamp({"op": "stake", "worker": wid,
+                       "pid": os.getpid(), "members": members}),
+                max_attempts=3,
+                trace=(trace_ids.get(members[0], "")
+                       if members else ""),
+            )
+
+        def _release(members):
+            members = list(members)
+            return ring.post(
+                "/leases",
+                stamp({"op": "release", "worker": wid,
+                       "members": members}),
+                max_attempts=3,
+                trace=(trace_ids.get(members[0], "")
+                       if members else ""),
+            )
+
+        worker.lease_stake_cb = _stake
+        worker.lease_release_cb = _release
 
     from tpusim.sim.driver import enable_compile_cache
 
@@ -1385,6 +1649,7 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
 
     served = 0
     while stop_event is None or not stop_event.is_set():
+        t_claim = time.time()
         try:
             # the IDLE path carries the stop_event: a drain must not
             # wait out the whole backoff schedule against a draining
@@ -1392,7 +1657,8 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
             # regardless — that is the graceful half)
             code, _, doc = ring.post("/workers/claim",
                                      stamp({"worker": wid}),
-                                     stop_event=stop_event)
+                                     stop_event=stop_event,
+                                     trace=current_trace)
         except retryable_conn_excs():
             # every coordinator down longer than the whole backoff
             # schedule: recovery requeues everything; keep polling
@@ -1425,6 +1691,19 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
         if not jobs_docs:
             time.sleep(poll_s)
             continue
+        # adopt the claim answer's trace ids (ISSUE 19): each job's
+        # remaining hops — dispatch, upload, complete, lease mirror —
+        # tag themselves with the id the submit minted
+        t_claimed = time.time()
+        for jd in jobs_docs:
+            d = str(jd.get("digest") or "")
+            tid = str(jd.get("trace") or "")
+            if d:
+                trace_ids[d] = tid
+            recorder.emit(obs_trace.SPAN_CLAIM, t_claim, t_claimed,
+                          job=d, trace=tid,
+                          stolen=int(jd.get("stolen") or 0))
+        current_trace = str(jobs_docs[0].get("trace") or "")
 
         batch, skew_failed = [], {}
         for lane, jd in enumerate(jobs_docs):
@@ -1446,9 +1725,28 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
                 status="batched", batch=served + 1, lane=lane,
                 worker=wid,
             ))
+        # one dispatch span per job, OPEN across run_batch: a kill -9
+        # mid-batch leaves begins with no ends — the stitcher renders
+        # them ABANDONED, the visible corpse the steal accounts for
+        dispatch_spans = {
+            j.digest: recorder.begin(
+                obs_trace.SPAN_DISPATCH, job=j.digest,
+                trace=trace_ids.get(j.digest, ""), lane=j.lane,
+                stolen=int(j.stolen),
+            )
+            for j in batch
+        }
         if batch:
             worker.run_batch(batch)
             served += 1
+            # the compile-cache heuristic (obs.spans.note_compile_cache):
+            # a batch dispatch wall under 2 s means the persistent
+            # cache almost certainly served the executable
+            if 0 < worker.last_dispatch_s < 2.0:
+                probable_hits += 1
+        for j in batch:
+            recorder.end(dispatch_spans[j.digest], status=j.status,
+                         dispatch_s=worker.last_dispatch_s)
         done = [j.digest for j in batch if j.status == "done"]
         failed = {
             j.digest: j.error for j in batch if j.status == "failed"
@@ -1468,10 +1766,18 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
                 if data is None:
                     failed[d] = "local signed result vanished/torn"
                     continue
+                t_upload = time.time()
                 try:
-                    code, _, up = ring.post_bytes(f"/results/{d}", data)
+                    code, _, up = ring.post_bytes(
+                        f"/results/{d}", data,
+                        trace=trace_ids.get(d, ""),
+                    )
                 except retryable_conn_excs():
                     code, up = 0, {"error": "coordinator unreachable"}
+                recorder.emit(obs_trace.SPAN_UPLOAD, t_upload,
+                              time.time(), job=d,
+                              trace=trace_ids.get(d, ""),
+                              code=code, bytes=len(data))
                 if code == 200:
                     counters["uploads"] += 1
                     counters["upload_bytes"] += len(data)
@@ -1501,6 +1807,21 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
                             "expiry", file=out,
                         )
             done = still_done
+        elif done:
+            # shared-fs publish half: run_batch already wrote the
+            # signed results into the shared artifact dir — witness
+            # each publish so the stitched timeline is mode-invariant
+            # (upload = the result reaching the shared store; the
+            # coordinator's verify span lands at complete time)
+            for d in done:
+                t_pub = time.time()
+                data = svc_jobs.result_bytes(artifact_dir, d)
+                recorder.emit(obs_trace.SPAN_UPLOAD, t_pub, time.time(),
+                              job=d, trace=trace_ids.get(d, ""),
+                              bytes=len(data) if data else 0,
+                              shared_fs=1)
+        jobs_done_total += len(done)
+        jobs_failed_total += len(failed)
         for attempt in (1, 2):
             try:
                 code, _, _ack = ring.post("/workers/complete", stamp({
@@ -1508,7 +1829,14 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
                     "dispatch_s": worker.last_dispatch_s,
                     "sweep_executables": worker.sweep_executables(),
                     "transfers": counters,
-                }))
+                    # the measured-profile push (ISSUE 19)
+                    "probable_hits": probable_hits,
+                    "metrics_text": worker_metrics_text(
+                        served, jobs_done_total, jobs_failed_total,
+                        worker.last_dispatch_s, probable_hits,
+                        counters,
+                    ),
+                }), trace=current_trace)
             except retryable_conn_excs():
                 # results + spec deletions are already on disk — a
                 # restarted coordinator reconciles from there (its
@@ -1527,6 +1855,10 @@ def run_worker(url: str, worker_id: str = "", poll_s: float = 0.2,
                 f"{len(failed)} failed "
                 f"({worker.last_dispatch_s:.2f}s dispatch)", file=out,
             )
+        # finished journeys no longer need their trace ids (the map
+        # would otherwise grow one entry per job served, forever)
+        for d in list(done) + list(failed):
+            trace_ids.pop(d, None)
         if max_batches and served >= max_batches:
             break
     worker.stop()

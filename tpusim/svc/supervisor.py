@@ -119,6 +119,14 @@ class Supervisor:
             "scale_ups": 0, "scale_downs": 0,
         }
         self._lock = threading.Lock()
+        # the audit chain (ISSUE 19): respawns and breaker trips are
+        # control-plane decisions — each appends a chained record when
+        # the serve loop wired an obs.audit.AuditLog here
+        self.audit = None
+
+    def _audit(self, kind: str, **fields) -> None:
+        if self.audit is not None:
+            self.audit.emit(kind, **fields)
 
     # ---- lifecycle ----
 
@@ -207,6 +215,9 @@ class Supervisor:
             "the worker (see its stderr) and restart the coordinator "
             "or call reset_breaker()"
         )
+        self._audit("breaker_trip", respawns=self.breaker_k,
+                    window_s=self.breaker_window_s,
+                    trips=self.breaker.trips)
         if self.out is not None:
             print(f"[supervisor] CIRCUIT BREAKER OPEN: "
                   f"{self.breaker.reason}", file=self.out)
@@ -278,6 +289,9 @@ class Supervisor:
                 child = self._spawn(now)
                 alive.append(child)
                 self.counters["respawns"] += 1
+                self._audit("respawn", new_pid=child.pid,
+                            failures=self._failures,
+                            backoff_s=round(self._backoff_s(), 3))
                 self.breaker.respawn_times.append(now)
                 self._next_spawn_unix = now + self._backoff_s()
                 events["spawned"].append(child.pid)
