@@ -1,7 +1,7 @@
 # Convenience targets (the package is pure Python + an optional on-demand
 # C++ component; there is no build step — ref parity: Makefile builds bin/simon).
 
-.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke serve-latency-smoke tune-smoke policy-smoke pallas-hbm-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke fleet-ha-smoke fleet-trace-smoke bench-gate sweep native clean
+.PHONY: test test-fast test-tpu bench bench-scale bench-scale-smoke resume-smoke profile-smoke serve-smoke sweep-smoke svc-smoke serve-latency-smoke tune-smoke policy-smoke pallas-hbm-smoke chaos-smoke mesh-chaos-smoke fleet-chaos-smoke fleet-wan-smoke fleet-ha-smoke fleet-trace-smoke slo-smoke bench-gate sweep native clean
 
 # full suite, INCLUDING @pytest.mark.slow tests (pallas interpreter
 # sweeps, openb kill/resume, the full Bellman replay)
@@ -208,6 +208,24 @@ fleet-ha-smoke:
 fleet-trace-smoke:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --fleet-trace-only
 
+# SLO-plane smoke (ENGINES.md "Round 23"): the metrics-history +
+# burn-rate alerting plane end-to-end over real HTTP. A coordinator
+# armed with a tight --slo-file fork-p99 burn rule serves a base run,
+# then a COLD fork wave (the induced latency regression) fires the
+# burn-rate page — visible on /alerts, flipping /healthz to 503 with
+# the alert named, shown by `tpusim top --once`, with the native
+# per-kind latency summary on /metrics, the event series on /query,
+# cursor pagination on /events, and the kind=alert record in a
+# VERIFYING hash-chained audit log — then warm forks (recovery)
+# displace the burn windows and the alert RESOLVES under live traffic.
+# A forced crash loop trips the supervisor breaker and fires the
+# built-in breaker-open page. Finally a leader + standby CLI pair:
+# kill -9 the leader, the standby promotes at a bumped epoch and
+# ADOPTS the signed tsdb snapshot — /query history splices with no
+# gap (pre-kill points within snapshot cadence of the kill).
+slo-smoke:
+	JAX_PLATFORMS=cpu python -m tpusim.obs.gate --slo-only
+
 # bench regression gate (tpusim.obs.gate): re-run the headline openb FGD
 # measurement under profiling and diff it against the newest committed
 # BENCH_r*.json baseline — exact on events/placements/gpu_alloc
@@ -234,7 +252,10 @@ fleet-trace-smoke:
 # probes, byte-identity vs a single-coordinator reference), and the
 # fleet flight recorder (ISSUE 19, the fleet-trace-smoke check:
 # stitched cross-process timelines across a kill -9 + steal, the
-# hash-chained audit log, aggregated per-worker /metrics). Exit 1 on
+# hash-chained audit log, aggregated per-worker /metrics), and the SLO
+# plane (ISSUE 20, the slo-smoke check: induced fork regression fires
+# a burn-rate page that resolves under recovery traffic, breaker trip
+# pages, /query history survives a kill -9 takeover). Exit 1 on
 # regression; artifacts land in .tpusim_obs/.
 bench-gate:
 	JAX_PLATFORMS=cpu python -m tpusim.obs.gate

@@ -356,6 +356,7 @@ def _call(fleet, path, doc):
     return resp[0], json.loads(resp[2].decode())
 
 
+@pytest.mark.slow
 def test_fleet_protocol_claim_steal_complete(trace, tmp_path):
     queue, service, fleet = _fleet_stack(trace, tmp_path)
     art = str(tmp_path)
@@ -420,6 +421,7 @@ def test_fleet_protocol_claim_steal_complete(trace, tmp_path):
     assert "no valid signed result" in job_obj.error
 
 
+@pytest.mark.slow
 def test_stale_failure_report_cannot_kill_stolen_job(trace, tmp_path):
     """A stalled worker whose batch was stolen must not fail a job the
     thief is validly running — only the CURRENT owner's failure report
@@ -461,6 +463,7 @@ def test_stale_failure_report_cannot_kill_stolen_job(trace, tmp_path):
     assert fleet.release_dead(9999) == 0  # unknown pid: no-op
 
 
+@pytest.mark.slow
 def test_fleet_claim_shortcut_already_finished(trace, tmp_path):
     """A stolen job whose presumed-dead owner DID write the signed
     result is answered from disk at claim time — never re-run."""
@@ -522,6 +525,7 @@ def test_coordinator_restart_adopts_live_leases(trace, tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_fleet_healthz_degrades_only_when_empty(trace, tmp_path):
     import urllib.error
     import urllib.request

@@ -1,0 +1,594 @@
+"""The SLO plane: metrics history, burn-rate alerting, /events cursor
+pagination, batched takeover recovery (ISSUE 20).
+
+All host-side and fake-clocked — no HTTP servers, no device dispatch,
+no sleeps. The live end-to-end acceptance (real fleet, induced fork
+regression firing a page that resolves under recovery traffic, breaker
+trip, kill -9 takeover splicing /query history) is gate.slo_smoke
+(`make slo-smoke`).
+
+Covered here:
+  1. TSDB ring mechanics: bucket means, non-finite rejection, tier
+     selection at the downsampling boundaries, retention pruning,
+     latest() freshness;
+  2. snapshot persistence: write -> adopt continuity, local-wins
+     collisions, torn-file rejection;
+  3. TsdbApp /query round-trips, hostile label values included
+     (quotes, backslashes, newlines survive verbatim — only the
+     Prometheus TEXT rendering escapes);
+  4. the alert rule engine: threshold fire/resolve hysteresis,
+     multi-window burn-rate AND semantics, staleness, transitions
+     landing as kind=alert records in a VERIFYING audit chain,
+     compose_health wrapping, rule loading/validation;
+  5. the per-completion latency event feed (latency_samples_since) and
+     the native /metrics latency summary rendering;
+  6. /events cursor pagination (audit.tail `after` + the service's
+     limit/after/next_after contract);
+  7. batched standby-promotion recovery: many persisted specs re-admit
+     through ONE submit_many pass, one batch audit record, full-queue
+     leftover accounting.
+"""
+
+import io
+import json
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from tpusim.io.trace import NodeRow, PodRow
+from tpusim.obs import alerts as obs_alerts
+from tpusim.obs import audit as obs_audit
+from tpusim.obs import tsdb as obs_tsdb
+from tpusim.obs.emitters import latency_summary_lines
+from tpusim.svc import jobs as svc_jobs
+from tpusim.svc.api import JobService, recover_pending_jobs
+from tpusim.svc.batcher import JobQueue
+from tpusim.svc.worker import TraceRef
+
+FAM = [["FGDScore", 1000], ["BestFitScore", 500]]
+T0 = 1_700_000_000.0  # fake-clock epoch; every test drives `now`
+
+
+def _mk_cluster(rng, n=12):
+    return [
+        NodeRow(f"n{i:03d}", 32000, 131072, int(g), "V100M16" if g else "")
+        for i, g in enumerate(rng.choice([0, 2, 4], n))
+    ]
+
+
+def _mk_pods(rng, n=20):
+    out = []
+    for i in range(n):
+        gpu = int(rng.choice([0, 1]))
+        out.append(
+            PodRow(f"p{i:04d}", 1000, 2048, gpu, 500 if gpu else 0)
+        )
+    return out
+
+
+@pytest.fixture(scope="module")
+def trace():
+    rng = np.random.default_rng(7)
+    nodes, pods = _mk_cluster(rng), _mk_pods(rng)
+    return TraceRef(
+        "default", nodes, pods, svc_jobs.trace_digest(nodes, pods)
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1. TSDB ring mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_tsdb_bucket_mean_and_nonfinite():
+    db = obs_tsdb.TSDB(tiers=((1.0, 10), (5.0, 10)))
+    # two samples in the SAME 1s bucket merge to their mean
+    assert db.ingest([("m", None, 2.0)], now=T0 + 0.1) == 1
+    assert db.ingest([("m", None, 4.0)], now=T0 + 0.6) == 1
+    # non-finite and non-numeric samples are rejected, not stored
+    assert db.ingest(
+        [("m", None, float("nan")), ("m", None, float("inf")),
+         ("m", None, "bogus")], now=T0 + 0.7,
+    ) == 0
+    (s,) = db.query("m", since=T0 - 5, now=T0 + 1)
+    assert s["points"] == [[float(int(T0)), 3.0]]
+
+
+def test_tsdb_tier_selection_at_retention_boundary():
+    # fine: 1s x 10 (reaches 10s back), coarse: 5s x 100
+    db = obs_tsdb.TSDB(tiers=((1.0, 10), (5.0, 100)))
+    base = float(int(T0 / 5) * 5)  # align to the coarse bucket grid
+    for i in range(10):
+        db.ingest([("m", None, float(i))], now=base + i + 0.5)
+    now = base + 9.5
+    # a window the fine tier covers -> 1s resolution
+    (fine,) = db.query("m", since=now - 8, now=now)
+    assert fine["step_s"] == 1.0 and len(fine["points"]) >= 8
+    # a window past the fine tier's retention -> the coarse tier, and
+    # each coarse point is the MEAN of its five 1s samples
+    (coarse,) = db.query("m", since=now - 60, now=now)
+    assert coarse["step_s"] == 5.0
+    assert coarse["points"][0] == [base, 2.0]  # mean(0..4)
+    # an explicit step >= 5 forces the coarse tier even in-window
+    (forced,) = db.query("m", since=now - 8, step=5.0, now=now)
+    assert forced["step_s"] == 5.0
+
+
+def test_tsdb_retention_prunes_fine_tier():
+    db = obs_tsdb.TSDB(tiers=((1.0, 5), (10.0, 5)))
+    for i in range(20):
+        db.ingest([("m", None, 1.0)], now=T0 + i)
+    (s,) = db.query("m", since=0, now=T0 + 19)
+    assert len(s["points"]) <= 5
+    assert s["points"][0][0] >= T0 + 15  # oldest buckets pruned
+
+
+def test_tsdb_latest_and_staleness():
+    db = obs_tsdb.TSDB(tiers=((1.0, 900),))
+    db.ingest([("m", {"k": "a"}, 7.0)], now=T0)
+    # since=0 means EVERYTHING — latest() depends on that
+    ((labels, t, v),) = db.latest("m", now=T0 + 5)
+    assert labels == {"k": "a"} and v == 7.0
+    # stale series drop out of latest() past within_s
+    assert db.latest("m", within_s=3.0, now=T0 + 5) == []
+    assert db.latest("m", within_s=30.0, now=T0 + 5)
+
+
+# ---------------------------------------------------------------------------
+# 2. snapshot persistence
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_adopt_splices_history(tmp_path):
+    art = str(tmp_path)
+    a = obs_tsdb.TSDB(tiers=((1.0, 100),))
+    for i in range(5):
+        a.ingest([("m", None, float(i))], now=T0 + i)
+    a.write_snapshot(art, now=T0 + 5)
+
+    b = obs_tsdb.TSDB(tiers=((1.0, 100),))
+    # the adopter has its own newer samples AND one colliding bucket
+    b.ingest([("m", None, 100.0)], now=T0 + 4)   # collision: local wins
+    b.ingest([("m", None, 200.0)], now=T0 + 10)
+    adopted = b.adopt(art)
+    assert adopted == 4  # buckets T0..T0+3; the T0+4 collision skipped
+    (s,) = b.query("m", since=T0 - 1, now=T0 + 11)
+    ts = [t for t, _ in s["points"]]
+    assert ts == sorted(ts) and len(ts) == len(set(ts))
+    vals = dict(s["points"])
+    assert vals[float(int(T0 + 4))] == 100.0   # the adopter's bucket won
+    assert vals[float(int(T0))] == 0.0         # history spliced in
+    assert vals[float(int(T0 + 10))] == 200.0  # fresh samples intact
+
+
+def test_snapshot_missing_and_torn(tmp_path):
+    art = str(tmp_path)
+    db = obs_tsdb.TSDB()
+    assert db.adopt(art) == 0  # no snapshot = start blind, not crash
+    db.ingest([("m", None, 1.0)], now=T0)
+    path = db.write_snapshot(art, now=T0)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw.replace(b'"m"', b'"x"', 1))  # edit -> digest breaks
+    with pytest.raises(ValueError):
+        obs_tsdb.TSDB().adopt(art)
+
+
+# ---------------------------------------------------------------------------
+# 3. the /query HTTP surface (TsdbApp.handle, no server)
+# ---------------------------------------------------------------------------
+
+
+def _get(app, path, **params):
+    pairs = []
+    for k, v in params.items():
+        for vv in (v if isinstance(v, list) else [v]):
+            pairs.append((k, vv))
+    code, _, body = app.handle("GET", path, b"",
+                               query=urllib.parse.urlencode(pairs))
+    return code, json.loads(body.decode())
+
+
+def test_query_endpoint_roundtrip_and_discovery():
+    db = obs_tsdb.TSDB()
+    db.ingest([("tpusim_queue_depth", None, 3.0)])
+    app = obs_tsdb.TsdbApp(db)
+    code, doc = _get(app, "/query", name="tpusim_queue_depth",
+                     since="-60")
+    assert code == 200 and doc["series"][0]["points"]
+    # no name -> the discovery document
+    code, doc = _get(app, "/query")
+    assert code == 200
+    assert doc["names"][0]["name"] == "tpusim_queue_depth"
+    # malformed label / numbers -> 400, never a stack trace
+    assert _get(app, "/query", name="m", label="nosep")[0] == 400
+    assert _get(app, "/query", name="m", since="soon")[0] == 400
+    # /alerts with no engine -> an empty document, not 404
+    code, doc = _get(app, "/alerts")
+    assert code == 200 and doc["firing"] == []
+
+
+def test_query_hostile_label_roundtrip():
+    hostile = 'we"ird\\na\nme'
+    db = obs_tsdb.TSDB()
+    # real-clock ingest: TsdbApp anchors relative `since` at time.time()
+    db.ingest([("m", {"worker": hostile}, 1.0)])
+    app = obs_tsdb.TsdbApp(db)
+    code, doc = _get(app, "/query", name="m",
+                     label=f"worker={hostile}", since="-60")
+    # ingest/query keep hostile values VERBATIM (only the Prometheus
+    # text rendering escapes) and the urlencoded filter still matches
+    assert code == 200 and len(doc["series"]) == 1
+    assert doc["series"][0]["labels"]["worker"] == hostile
+
+
+# ---------------------------------------------------------------------------
+# 4. the alert rule engine
+# ---------------------------------------------------------------------------
+
+
+def _threshold_rule(**over):
+    rule = {
+        "name": "sat", "type": "threshold", "severity": "ticket",
+        "metric": "m", "op": ">=", "value": 0.9,
+        "for_s": 5.0, "clear_for_s": 5.0,
+    }
+    rule.update(over)
+    return rule
+
+
+def _burn_rule(**over):
+    rule = {
+        "name": "burn", "type": "burn_rate", "severity": "page",
+        "metric": "lat", "label": {"kind": "fork"},
+        "objective": 2.0, "op": ">", "budget": 0.25,
+        "windows": [{"window_s": 10.0, "burn": 2.0},
+                    {"window_s": 40.0, "burn": 1.0}],
+        "clear_for_s": 5.0,
+    }
+    rule.update(over)
+    return rule
+
+
+def test_threshold_fire_and_resolve_hysteresis():
+    db = obs_tsdb.TSDB()
+    eng = obs_alerts.AlertEngine(db, rules=[_threshold_rule()])
+    # breach must SUSTAIN for_s before firing — a one-tick spike is ok
+    db.ingest([("m", None, 0.95)], now=T0)
+    assert eng.evaluate(now=T0) == []
+    db.ingest([("m", None, 0.95)], now=T0 + 4)
+    assert eng.evaluate(now=T0 + 4) == []        # 4s < for_s
+    db.ingest([("m", None, 0.95)], now=T0 + 6)
+    (t,) = eng.evaluate(now=T0 + 6)              # 6s >= for_s -> fires
+    assert t["state"] == "firing" and t["alert"] == "sat"
+    assert [f["alert"] for f in eng.firing()] == ["sat"]
+    # clearing must sustain clear_for_s too (hysteresis both ways)
+    db.ingest([("m", None, 0.1)], now=T0 + 8)
+    assert eng.evaluate(now=T0 + 8) == []
+    db.ingest([("m", None, 0.95)], now=T0 + 10)  # flap: breach again
+    assert eng.evaluate(now=T0 + 10) == []       # still firing, no dup
+    db.ingest([("m", None, 0.1)], now=T0 + 12)
+    eng.evaluate(now=T0 + 12)
+    db.ingest([("m", None, 0.1)], now=T0 + 18)
+    (t,) = eng.evaluate(now=T0 + 18)             # clear held 6s >= 5s
+    assert t["state"] == "resolved"
+    assert eng.firing() == []
+
+
+def test_threshold_stale_series_resolves():
+    db = obs_tsdb.TSDB()
+    eng = obs_alerts.AlertEngine(
+        db, rules=[_threshold_rule(for_s=0.0, clear_for_s=0.0,
+                                   staleness_s=10.0)]
+    )
+    db.ingest([("m", None, 1.0)], now=T0)
+    (t,) = eng.evaluate(now=T0)
+    assert t["state"] == "firing"
+    # the series goes silent: past staleness it stops asserting and
+    # the alert resolves rather than pinning the last value forever
+    (t,) = eng.evaluate(now=T0 + 60)
+    assert t["state"] == "resolved"
+
+
+def test_burn_rate_needs_all_windows():
+    db = obs_tsdb.TSDB()
+    eng = obs_alerts.AlertEngine(db, rules=[_burn_rule()])
+    lbl = {"kind": "fork"}
+    # 35 good samples, then a short 5-sample breach burst
+    for i in range(35):
+        db.ingest([("lat", lbl, 0.1)], now=T0 + i)
+    for i in range(5):
+        db.ingest([("lat", lbl, 9.0)], now=T0 + 35 + i)
+    # fast window [33,43]: 5 breach of 7 (0.71 >= need 0.5, burning);
+    # slow window [3,43]: 5 breach of 37 (0.14 < need 0.25) -> a short
+    # spike alone can NOT page
+    assert eng.evaluate(now=T0 + 43) == []
+    st = eng._state["burn"]["detail"]["windows"]
+    assert st[0]["burning"] and not st[1]["burning"]
+    # keep breaching until the SLOW window crosses its need too
+    trans = []
+    for i in range(25):
+        db.ingest([("lat", lbl, 9.0)], now=T0 + 44 + i)
+        trans += eng.evaluate(now=T0 + 44 + i)
+    assert any(t["state"] == "firing" for t in trans)
+    # recovery: good samples displace both windows -> resolves after
+    # clear_for_s, WITH traffic still flowing
+    trans = []
+    for i in range(60):
+        db.ingest([("lat", lbl, 0.1)], now=T0 + 70 + i)
+        trans += eng.evaluate(now=T0 + 70 + i)
+    assert any(t["state"] == "resolved" for t in trans)
+
+
+def test_burn_rate_empty_window_is_not_burning():
+    db = obs_tsdb.TSDB()
+    eng = obs_alerts.AlertEngine(db, rules=[_burn_rule()])
+    # no data at all: a burn rule needs EVENTS to burn budget
+    assert eng.evaluate(now=T0) == []
+    assert eng.firing() == []
+
+
+def test_alert_transitions_chain_in_audit(tmp_path):
+    art = str(tmp_path)
+    db = obs_tsdb.TSDB()
+    audit = obs_audit.AuditLog(art, process="test")
+    eng = obs_alerts.AlertEngine(
+        db, rules=[_threshold_rule(for_s=0.0, clear_for_s=0.0)],
+        audit=audit,
+    )
+    db.ingest([("m", None, 1.0)], now=T0)
+    eng.evaluate(now=T0)
+    db.ingest([("m", None, 0.0)], now=T0 + 1)
+    eng.evaluate(now=T0 + 1)
+    # both transitions are records in a chain that VERIFIES
+    assert obs_audit.verify(art) == 2
+    recs = obs_audit.tail(art, kind="alert")
+    assert [(r["alert"], r["state"]) for r in recs] == [
+        ("sat", "firing"), ("sat", "resolved")
+    ]
+    assert all(r["kind"] == obs_audit.KIND_ALERT for r in recs)
+    assert recs[0]["severity"] == "ticket"
+
+
+def test_compose_health_wraps_not_replaces():
+    db = obs_tsdb.TSDB()
+    eng = obs_alerts.AlertEngine(
+        db, rules=[_threshold_rule(severity="page", for_s=0.0)]
+    )
+    hook = eng.compose_health(lambda: (True, {"fleet": "fine"}))
+    ok, extra = hook()
+    assert ok and extra["fleet"] == "fine" and extra["alerts_page"] == []
+    db.ingest([("m", None, 1.0)], now=T0)
+    eng.evaluate(now=T0)
+    ok, extra = hook()
+    assert not ok and extra["alerts_page"] == ["sat"]
+    assert extra["fleet"] == "fine"  # the wrapped hook still speaks
+    # a page must not HIDE a dead fleet: prior hook's verdict is ANDed
+    hook2 = eng.compose_health(lambda: (False, {"fleet": "dead"}))
+    ok2, extra2 = hook2()
+    assert not ok2 and extra2["fleet"] == "dead"
+
+
+def test_load_rules_merge_override_and_validation(tmp_path):
+    # no file -> the built-ins
+    names = [r["name"] for r in obs_alerts.load_rules()]
+    assert "fork-p99-burn" in names and "breaker-open" in names
+    # file rules OVERRIDE same-named defaults, defaults fill the rest
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps([dict(
+        obs_alerts.DEFAULT_RULES[0], objective=9.0)]))
+    rules = obs_alerts.load_rules(str(p))
+    mine = next(r for r in rules if r["name"] == "fork-p99-burn")
+    assert mine["objective"] == 9.0
+    assert len(rules) == len(obs_alerts.DEFAULT_RULES)
+    # {"defaults": false} drops the built-ins
+    p.write_text(json.dumps(
+        {"defaults": False, "rules": [_threshold_rule()]}))
+    assert [r["name"] for r in obs_alerts.load_rules(str(p))] == ["sat"]
+    # duplicates and malformed rules fail AT LOAD, naming the problem
+    p.write_text(json.dumps([_threshold_rule(), _threshold_rule()]))
+    with pytest.raises(ValueError, match="duplicate"):
+        obs_alerts.load_rules(str(p))
+    for bad, msg in [
+        (_threshold_rule(severity="sev1"), "severity"),
+        (_threshold_rule(op="=~"), "op"),
+        ({"name": "x", "type": "bogus", "metric": "m"}, "type"),
+        (_burn_rule(budget=2.0), "budget"),
+        (_burn_rule(windows=[]), "windows"),
+        (dict(_threshold_rule(), value=None) and
+         {k: v for k, v in _threshold_rule().items() if k != "value"},
+         "value"),
+    ]:
+        with pytest.raises(ValueError, match=msg):
+            obs_alerts.validate_rule(bad)
+
+
+# ---------------------------------------------------------------------------
+# 5. the latency event feed + the /metrics summary rendering
+# ---------------------------------------------------------------------------
+
+
+def _spec(i=0):
+    return svc_jobs.validate_job(
+        {"policies": FAM, "weights": [1000 + i, 500], "seed": 42}
+    )
+
+
+def test_latency_samples_since_cursor(trace):
+    queue = JobQueue(maxsize=8, lane_width=2)
+    cursors = {}
+    assert queue.latency_samples_since(cursors) == {}
+    j1 = queue.submit(_spec(1), "d1")
+    j2 = queue.submit(_spec(2), "d2")
+    queue.mark_done(j1, {"ok": 1})
+    out = queue.latency_samples_since(cursors)
+    assert list(out) == ["plain"] and len(out["plain"]) == 1
+    # the cursor advanced: the same completion is never re-served
+    assert queue.latency_samples_since(cursors) == {}
+    queue.mark_done(j2, {"ok": 1})
+    out = queue.latency_samples_since(cursors)
+    assert len(out["plain"]) == 1
+    # a foreign cursor dict starts from zero and sees everything
+    assert len(queue.latency_samples_since({})["plain"]) == 2
+
+
+def test_latency_summary_exposition_lines():
+    lat = {
+        "fork": {"count": 5, "p50_s": 0.01, "p99_s": 0.5,
+                 "adjusted_p50_s": 0.01, "adjusted_p99_s": 0.4},
+        'we"ird': {"count": 1, "p50_s": 1.0, "p99_s": 1.0},
+    }
+    text = "\n".join(latency_summary_lines(lat))
+    assert "# TYPE tpusim_queue_latency_seconds summary" in text
+    assert ('tpusim_queue_latency_seconds{kind="fork",quantile="0.99"} '
+            "0.5") in text
+    assert 'tpusim_queue_latency_seconds_count{kind="fork"} 5' in text
+    assert ('tpusim_queue_latency_adjusted_seconds{kind="fork",'
+            'quantile="0.99"} 0.4') in text
+    # hostile kind values are ESCAPED in the text rendering
+    assert 'kind="we\\"ird"' in text
+
+
+# ---------------------------------------------------------------------------
+# 6. /events cursor pagination
+# ---------------------------------------------------------------------------
+
+
+def test_audit_tail_cursor_semantics(tmp_path):
+    art = str(tmp_path)
+    log = obs_audit.AuditLog(art, process="test")
+    for i in range(7):
+        log.emit("steal", job=f"j{i}")
+    # classic tail: newest n, oldest first
+    tail = obs_audit.tail(art, n=3)
+    assert [r["job"] for r in tail] == ["j4", "j5", "j6"]
+    assert [r["seq"] for r in tail] == [5, 6, 7]
+    # with a cursor the window flips to FORWARD pagination: the oldest
+    # n past the cursor, so a poller never skips records
+    page = obs_audit.tail(art, n=3, after=2)
+    assert [r["seq"] for r in page] == [3, 4, 5]
+    page = obs_audit.tail(art, n=3, after=5)
+    assert [r["seq"] for r in page] == [6, 7]
+    assert obs_audit.tail(art, n=3, after=7) == []
+
+
+def test_events_endpoint_cursor(tmp_path, trace):
+    art = str(tmp_path)
+    queue = JobQueue(maxsize=8, lane_width=2)
+    service = JobService(queue, None, {"default": trace}, art)
+    log = obs_audit.AuditLog(art, process="test")
+    for i in range(5):
+        log.emit("steal", job=f"j{i}")
+
+    def get(query):
+        code, _, body = service._get_events(query)
+        return code, json.loads(body.decode())
+
+    code, doc = get("limit=2")
+    assert code == 200 and doc["n"] == 2
+    assert doc["next_after"] == 5  # tail window: newest records
+    code, doc = get("after=2&limit=2")
+    assert [e["seq"] for e in doc["events"]] == [3, 4]
+    assert doc["next_after"] == 4
+    code, doc = get(f"after={doc['next_after']}&limit=500")
+    assert [e["seq"] for e in doc["events"]] == [5]
+    # drained: the cursor echoes back instead of regressing to 0
+    code, doc = get("after=5")
+    assert doc["events"] == [] and doc["next_after"] == 5
+    assert get("after=bogus")[0] == 400
+
+
+# ---------------------------------------------------------------------------
+# 7. batched takeover recovery
+# ---------------------------------------------------------------------------
+
+
+def _persist_specs(art, trace, n):
+    digests = []
+    for i in range(n):
+        doc = {"policies": FAM, "weights": [1000 + i, 500], "seed": 42}
+        spec = svc_jobs.validate_job(doc)
+        d = svc_jobs.job_digest(spec, trace.digest)
+        svc_jobs.write_job_spec(art, d, doc)
+        digests.append(d)
+    return digests
+
+
+def test_recovery_batches_many_queued_jobs(tmp_path, trace):
+    # the takeover-with-many-queued-jobs path: 60 persisted specs
+    # re-admit through ONE submit_many pass with ONE audit record
+    art = str(tmp_path)
+    digests = _persist_specs(art, trace, 60)
+    queue = JobQueue(maxsize=128, lane_width=2)
+    service = JobService(queue, None, {"default": trace}, art)
+    service.audit = obs_audit.AuditLog(art, process="test")
+    out = io.StringIO()
+    assert recover_pending_jobs(service, out=out) == 60
+    assert queue.stats()["depth"] == 60
+    with queue._cond:
+        queued = [j.digest for j in queue._queue]
+    assert queued == sorted(digests)  # pending_job_specs order (sorted)
+    # every job got a trace id minted for the flight recorder
+    assert all(service.trace_of(d) for d in digests)
+    recs = obs_audit.tail(art, kind="requeue")
+    assert len(recs) == 1 and recs[0]["n"] == 60
+    assert len(recs[0]["jobs"]) == 16  # bounded digest sample
+
+
+def test_recovery_full_queue_leaves_leftovers(tmp_path, trace):
+    art = str(tmp_path)
+    _persist_specs(art, trace, 12)
+    queue = JobQueue(maxsize=8, lane_width=2)
+    service = JobService(queue, None, {"default": trace}, art)
+    out = io.StringIO()
+    n = recover_pending_jobs(service, out=out)
+    assert n == 8 and queue.stats()["depth"] == 8
+    assert "4 spec(s) left" in out.getvalue()
+
+
+def test_recovery_skips_malformed_and_unknown_trace(tmp_path, trace):
+    art = str(tmp_path)
+    _persist_specs(art, trace, 2)
+    # a spec naming a trace this coordinator does not host: skipped
+    # with a note, the REST of the batch still recovers
+    doc = {"trace": "gone", "policies": FAM, "weights": [1, 2],
+           "seed": 1}
+    spec = svc_jobs.validate_job(doc)
+    svc_jobs.write_job_spec(
+        art, svc_jobs.job_digest(spec, "deadbeef"), doc)
+    queue = JobQueue(maxsize=16, lane_width=2)
+    service = JobService(queue, None, {"default": trace}, art)
+    out = io.StringIO()
+    assert recover_pending_jobs(service, out=out) == 2
+    assert "skipping unrecoverable job" in out.getvalue()
+
+
+def test_adopt_history_resumes_paused_sampler(tmp_path, trace):
+    # the promotion half: adopt_history() splices the predecessor's
+    # snapshot and UNPAUSES the sampler (never started = still paused)
+    art = str(tmp_path)
+    pred = obs_tsdb.TSDB()
+    pred.ingest([("tpusim_queue_depth", None, 3.0)], now=T0)
+    pred.write_snapshot(art, now=T0)
+    queue = JobQueue(maxsize=8, lane_width=2)
+    service = JobService(queue, None, {"default": trace}, art)
+    service.tsdb = obs_tsdb.TSDB()
+    service.sampler = obs_tsdb.MetricsSampler(
+        service.tsdb, lambda now=None: [], paused=True)
+    out = io.StringIO()
+    assert service.adopt_history(out=out) == 2  # one bucket per tier
+    assert not service.sampler.paused
+    assert service.tsdb.query("tpusim_queue_depth", now=T0 + 5)
+    # a TORN snapshot is refused loudly but sampling still resumes
+    service2 = JobService(queue, None, {"default": trace}, art)
+    service2.tsdb = obs_tsdb.TSDB()
+    path = obs_tsdb.tsdb_snapshot_path(art)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:-10])
+    service2.sampler = obs_tsdb.MetricsSampler(
+        service2.tsdb, lambda now=None: [], paused=True)
+    assert service2.adopt_history(out=out) == 0
+    assert not service2.sampler.paused
+    assert "refusing torn/edited tsdb snapshot" in out.getvalue()

@@ -37,7 +37,8 @@ FAM = [["FGDScore", 1000], ["BestFitScore", 500]]
 # the flight recorder (ISSUE 19) deliberately writes into the artifact
 # dir on REJECTED requests too — the audit chain records the 400 and
 # the span plane owns spans/ — so "untouched" means "no payload files"
-_OBS_FILES = {"spans", "audit.jsonl", "audit.jsonl.head"}
+_OBS_FILES = {"spans", "audit.jsonl", "audit.jsonl.head",
+              "tsdb.snapshot.json"}
 
 
 def _payload_files(art):
@@ -72,6 +73,7 @@ def _trace_meta(url, name):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_trace_listing_and_meta(stack):
     srv, service, trace, base = stack
     code, _, doc = _request(srv.url + "/traces")
@@ -109,6 +111,7 @@ def test_download_cache_and_digest_verify(stack):
     assert t2.digest == trace.digest and c2["downloads"] == 0
 
 
+@pytest.mark.slow
 def test_partial_download_resumes(stack):
     """A dead transfer's .part file is resumed with a Range request —
     the re-download starts where the last one died, and the finished
@@ -135,6 +138,7 @@ def test_partial_download_resumes(stack):
     assert not os.path.exists(_part_path(dest))
 
 
+@pytest.mark.slow
 def test_range_request_answers_206(stack):
     srv, service, trace, base = stack
     code, headers, data = _get_bytes(
@@ -151,6 +155,7 @@ def test_range_request_answers_206(stack):
     assert code == 416
 
 
+@pytest.mark.slow
 def test_corrupt_cache_forces_redownload(stack):
     srv, service, trace, base = stack
     meta = _trace_meta(srv.url, "default")
@@ -166,6 +171,7 @@ def test_corrupt_cache_forces_redownload(stack):
     assert counters["sha_retries"] == 1 and counters["downloads"] == 1
 
 
+@pytest.mark.slow
 def test_sha_skew_fails_loudly(stack):
     """The coordinator advertising a sha its bytes do not match (version
     skew, a lying proxy): one clean re-download, then a LOUD refusal —
@@ -198,6 +204,7 @@ def _result_fixture(tmp_path, digest):
     return data
 
 
+@pytest.mark.slow
 def test_torn_upload_rejected_keeps_no_partial(stack, tmp_path):
     srv, service, trace, base = stack
     digest = "a" * 64
@@ -294,6 +301,7 @@ class _DropFirst:
         return None
 
 
+@pytest.mark.slow
 def test_post_rides_backoff_past_503(stack):
     """Satellite 1: fleet POSTs retry 429/5xx on the shared backoff
     schedule honoring Retry-After — three injected 503s cost three
@@ -313,6 +321,7 @@ def test_post_rides_backoff_past_503(stack):
     assert code == 503 and shim2.dropped == 2
 
 
+@pytest.mark.slow
 def test_post_backoff_aborts_on_stop_event(stack):
     """A SIGTERM'd worker must not ride out the whole backoff schedule
     against a draining coordinator's 503 + Retry-After answers — the
@@ -333,6 +342,7 @@ def test_post_backoff_aborts_on_stop_event(stack):
         srv._draining = False
 
 
+@pytest.mark.slow
 def test_lease_mirror_stake_release(stack):
     srv, service, trace, base = stack
     art = service.artifact_dir
@@ -391,6 +401,7 @@ def test_wire_strings_cannot_traverse_paths(stack, tmp_path):
     assert _payload_files(art) == []
 
 
+@pytest.mark.slow
 def test_orphan_part_adopted_across_respawn(stack):
     """A kill -9'd predecessor's .part (different, DEAD pid) is adopted
     and resumed by the successor — crash-resume reaches across a
@@ -439,6 +450,7 @@ def test_orphan_part_adopted_across_respawn(stack):
         assert f.read() == full
 
 
+@pytest.mark.slow
 def test_resolve_worker_mode(stack):
     srv, service, trace, base = stack
     code, _, reg = _post(srv.url, "/workers/register",
@@ -461,6 +473,7 @@ def test_resolve_worker_mode(stack):
         resolve_worker_mode("wan", reg)
 
 
+@pytest.mark.slow
 def test_register_records_mode_and_transfers(stack):
     srv, service, trace, base = stack
     _post(srv.url, "/workers/register",

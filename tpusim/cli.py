@@ -374,6 +374,14 @@ def _build_parser() -> argparse.ArgumentParser:
         "/queue",
     )
     p_serve.add_argument(
+        "--slo-file", default="", metavar="FILE",
+        help="SLO/alert rules JSON (threshold + multi-window burn-rate "
+        "over the in-process metrics history; see obs.alerts) — "
+        "overrides/extends the built-in defaults; firing transitions "
+        "append kind=alert audit records, surface on GET /alerts, and "
+        "page-severity burn flips /healthz (default $TPUSIM_SLO_FILE)",
+    )
+    p_serve.add_argument(
         "--table-cache-dir", default="", metavar="DIR",
         help="content-keyed init-table cache shared by the fleet "
         "(default $TPUSIM_TABLE_CACHE_DIR)",
@@ -727,6 +735,39 @@ def _build_parser() -> argparse.ArgumentParser:
                          help="filter by job digest (prefix ok)")
     p_audit.add_argument("--worker", default="",
                          help="filter by worker id")
+    p_audit.add_argument(
+        "--url", default="", metavar="URL",
+        help="tail a LIVE coordinator over HTTP instead of reading "
+        "local files: polls GET /events with the seq cursor "
+        "(?after=&limit=) so each poll ships only the delta",
+    )
+    p_audit.add_argument(
+        "--follow", action="store_true",
+        help="with --url: keep polling the cursor (Ctrl-C to stop)",
+    )
+
+    # the live fleet dashboard (ISSUE 20)
+    p_top = sub.add_parser(
+        "top",
+        help="live terminal dashboard for a serve --jobs coordinator: "
+        "queue, workers, firing alerts, and sparkline history "
+        "stitched from /queue, /workers, /alerts, /query",
+    )
+    p_top.add_argument("url", help="coordinator base URL "
+                       "(e.g. http://127.0.0.1:8642)")
+    p_top.add_argument(
+        "--interval", type=float, default=2.0, metavar="S",
+        help="redraw interval seconds (default 2)",
+    )
+    p_top.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (no screen clearing) — the "
+        "scriptable/smoke form",
+    )
+    p_top.add_argument(
+        "--width", type=int, default=0, metavar="COLS",
+        help="frame width (default: terminal width, floor 60)",
+    )
 
     sub.add_parser("version", help="print version")
 
@@ -998,6 +1039,7 @@ def _serve_jobs(args) -> int:
         family_quota=args.family_quota,
         policy_presets=presets,
         token=token, coord=coord,
+        slo_file=args.slo_file or os.environ.get("TPUSIM_SLO_FILE", ""),
         out=sys.stderr,
     )
     if coord is not None:
@@ -1050,12 +1092,18 @@ def _serve_jobs(args) -> int:
         recover_pending_jobs(service, out=sys.stderr)
         if service.fleet is not None:
             service.fleet.adopt_leases(out=sys.stderr)
+        # the metrics half of the takeover (ISSUE 20): splice the
+        # deposed leader's persisted tsdb snapshot under our ring and
+        # resume the (standby-paused) sampler — /query history survives
+        # the failover instead of starting blind
+        service.adopt_history(out=sys.stderr)
         if sup is not None:
             sup.resume()
         ha["keeper"] = CoordKeeper(coord, on_deposed=_on_deposed).start()
         print(
             f"[serve] PROMOTED to leader at epoch {coord.epoch} — "
-            "pending jobs requeued, live worker leases adopted",
+            "pending jobs requeued, live worker leases adopted, "
+            "metrics history spliced",
             file=sys.stderr,
         )
 
@@ -1560,12 +1608,79 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def _audit_over_http(args) -> int:
+    """The --url form of `tpusim audit`: GET /events with cursor
+    pagination. One shot prints the newest --tail records; --follow
+    keeps walking `after = next_after` so every poll is a delta."""
+    import time
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from tpusim.obs import audit as obs_audit
+
+    base = args.url.rstrip("/")
+    filters = {"kind": args.kind, "job": args.job, "worker": args.worker}
+
+    def fetch(after: int, limit: int) -> dict:
+        q = {k: v for k, v in filters.items() if v}
+        q["limit"] = str(limit)
+        if after:
+            q["after"] = str(after)
+        url = f"{base}/events?{urllib.parse.urlencode(q)}"
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            return json.loads(resp.read().decode())
+
+    try:
+        doc = fetch(0, max(args.tail, 1) if args.tail else 500)
+    except (urllib.error.URLError, OSError, ValueError) as err:
+        print(f"tpusim audit: {base}/events unreachable: {err}",
+              file=sys.stderr)
+        return 2
+    for line in obs_audit.format_records(doc.get("events") or []):
+        print(line)
+    if not args.follow:
+        if not doc.get("events"):
+            print("[audit] no matching records", file=sys.stderr)
+        return 0
+    cursor = int(doc.get("next_after") or 0)
+    try:
+        while True:
+            time.sleep(2.0)
+            try:
+                doc = fetch(cursor, 500)
+            except (urllib.error.URLError, OSError, ValueError) as err:
+                print(f"[audit] poll failed ({err}); retrying",
+                      file=sys.stderr)
+                continue
+            for line in obs_audit.format_records(doc.get("events") or []):
+                print(line, flush=True)
+            cursor = max(cursor, int(doc.get("next_after") or 0))
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_top(args) -> int:
+    """`tpusim top URL` — the live fleet dashboard (ISSUE 20)."""
+    from tpusim.obs import top as obs_top
+
+    return obs_top.run(
+        args.url, interval=args.interval, once=args.once,
+        width=args.width,
+    )
+
+
 def cmd_audit(args) -> int:
     """`tpusim audit [--verify]` — query or verify the hash-chained
     control-plane audit log (ISSUE 19). --verify exits 1 LOUDLY on a
-    broken chain (edit, truncation, torn tail, missing head)."""
+    broken chain (edit, truncation, torn tail, missing head).
+    --url tails a LIVE coordinator via the /events seq cursor
+    (ISSUE 20): each poll asks only for records past the last seen
+    seq, so a long-lived fleet's tail ships deltas, not the chain."""
     from tpusim.obs import audit as obs_audit
 
+    if args.url:
+        return _audit_over_http(args)
     path = obs_audit.audit_path(args.dir)
     if not os.path.isfile(path):
         print(f"tpusim audit: no audit log at {path}", file=sys.stderr)
@@ -1627,6 +1742,8 @@ def main(argv=None) -> int:
         return cmd_trace(args)
     if args.command == "audit":
         return cmd_audit(args)
+    if args.command == "top":
+        return cmd_top(args)
     if args.command == "version":
         print(f"tpusim version {VERSION} (commit {COMMIT})")
         return 0

@@ -501,6 +501,16 @@ def read_signed_json(path: str, schema: str = ""):
     return header, json.loads(payload[0])
 
 
+# the SLO plane's history snapshot (ISSUE 20): the active coordinator
+# periodically persists its tsdb ring here (signed-JSON, atomic) so a
+# promoted standby adopts metrics history instead of starting blind
+TSDB_SNAPSHOT_BASENAME = "tsdb.snapshot.json"
+
+
+def tsdb_snapshot_path(artifact_dir: str) -> str:
+    return os.path.join(artifact_dir, TSDB_SNAPSHOT_BASENAME)
+
+
 # ---------------------------------------------------------------------------
 # Hash-chained append-only JSONL — the control-plane audit log (ISSUE 19)
 # ---------------------------------------------------------------------------

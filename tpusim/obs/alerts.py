@@ -1,0 +1,425 @@
+"""Declarative SLO / alert rules over the tsdb ring (ISSUE 20).
+
+Two rule types, both evaluated once per sampler tick against the
+in-process TSDB:
+
+  threshold   "metric OP value sustained for_s" — the pager-classic
+              form for states (breaker open, queue saturated, steal
+              rate hot). Fires after the condition holds `for_s`
+              seconds; resolves after it clears `clear_for_s` (the
+              hysteresis that keeps a flapping metric from paging once
+              per tick).
+  burn_rate   the SRE multi-window form for SLOs: each sample either
+              meets the objective or burns error budget; the rule fires
+              when the burn FRACTION over EVERY window exceeds
+              burn x budget (a fast window for detection speed, a slow
+              window so a single spike can't page), and resolves once
+              no window burns for `clear_for_s`.
+
+Rules load from `--slo-file` JSON (a list, or {"rules": [...],
+"defaults": false} to drop the built-ins); DEFAULT_RULES cover the
+SLOs the repo already measures ad hoc: fork p99, availability, queue
+depth vs capacity, steal / lease-expiry rate, breaker state.
+
+Every firing/resolution transition is a control-plane decision, so it
+appends a `kind=alert` record to the hash-chained audit.jsonl —
+`tpusim audit --verify` covers the alert history exactly like
+takeovers and steals — and page-severity burns flip the /healthz
+readiness detail via compose_health (wrapping, not replacing, the
+fleet's own liveness hook).
+"""
+
+from __future__ import annotations
+
+import json
+import operator
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpusim.obs.audit import KIND_ALERT
+
+SEVERITIES = ("page", "ticket")
+OPS = {">": operator.gt, ">=": operator.ge,
+       "<": operator.lt, "<=": operator.le}
+
+# how recent a threshold rule's newest sample must be to count: stale
+# series (a worker that left, a kind that stopped completing) silently
+# stop asserting rather than pinning the last value forever
+DEFAULT_STALENESS_S = 15.0
+
+DEFAULT_RULES: List[dict] = [
+    {
+        # the serving SLO the gate measures ad hoc since ISSUE 16:
+        # admission->result p99 of warm-state forks. "p99 <= 2s" as a
+        # burn rule: budget 0.01 over per-completion event samples IS
+        # the 99th percentile, measured continuously — fires when the
+        # fraction of slow completions in both windows exceeds
+        # burn x 1%, resolves when fast completions displace them
+        "name": "fork-p99-burn",
+        "type": "burn_rate",
+        "severity": "page",
+        "metric": "tpusim_queue_latency_event_seconds",
+        "label": {"kind": "fork"},
+        "objective": 2.0,
+        "op": ">",
+        "budget": 0.01,
+        "windows": [
+            {"window_s": 60.0, "burn": 14.0},
+            {"window_s": 300.0, "burn": 6.0},
+        ],
+        "clear_for_s": 30.0,
+    },
+    {
+        # availability: fraction of completed jobs that failed, per tick
+        "name": "availability-burn",
+        "type": "burn_rate",
+        "severity": "page",
+        "metric": "tpusim_queue_error_ratio",
+        "objective": 0.0,
+        "op": ">",
+        "budget": 0.05,
+        "windows": [
+            {"window_s": 60.0, "burn": 6.0},
+            {"window_s": 300.0, "burn": 3.0},
+        ],
+        "clear_for_s": 30.0,
+    },
+    {
+        "name": "queue-saturation",
+        "type": "threshold",
+        "severity": "ticket",
+        "metric": "tpusim_queue_saturation",
+        "op": ">=",
+        "value": 0.9,
+        "for_s": 10.0,
+        "clear_for_s": 10.0,
+    },
+    {
+        "name": "steal-rate",
+        "type": "threshold",
+        "severity": "ticket",
+        "metric": "tpusim_queue_steals_rate",
+        "op": ">",
+        "value": 0.5,
+        "for_s": 5.0,
+        "clear_for_s": 15.0,
+    },
+    {
+        "name": "lease-expiry-rate",
+        "type": "threshold",
+        "severity": "ticket",
+        "metric": "tpusim_queue_lease_expired_rate",
+        "op": ">",
+        "value": 0.5,
+        "for_s": 5.0,
+        "clear_for_s": 15.0,
+    },
+    {
+        # the supervisor's crash-loop circuit breaker: open = the fleet
+        # cannot keep workers alive — that IS a page
+        "name": "breaker-open",
+        "type": "threshold",
+        "severity": "page",
+        "metric": "tpusim_fleet_breaker_open",
+        "op": ">=",
+        "value": 1.0,
+        "for_s": 0.0,
+        "clear_for_s": 5.0,
+    },
+]
+
+
+def validate_rule(doc: dict) -> dict:
+    """Normalized copy of one rule doc; ValueError names the field on
+    anything malformed — a typo'd SLO file must fail at load, not
+    silently never fire."""
+    if not isinstance(doc, dict):
+        raise ValueError(f"rule must be an object, got {type(doc).__name__}")
+    name = str(doc.get("name") or "")
+    if not name:
+        raise ValueError("rule needs a non-empty name")
+    kind = str(doc.get("type") or "")
+    if kind not in ("threshold", "burn_rate"):
+        raise ValueError(
+            f"rule {name!r}: type must be threshold|burn_rate, got {kind!r}"
+        )
+    sev = str(doc.get("severity") or "ticket")
+    if sev not in SEVERITIES:
+        raise ValueError(
+            f"rule {name!r}: severity must be one of {SEVERITIES}, "
+            f"got {sev!r}"
+        )
+    metric = str(doc.get("metric") or "")
+    if not metric:
+        raise ValueError(f"rule {name!r}: metric is required")
+    op = str(doc.get("op") or ">")
+    if op not in OPS:
+        raise ValueError(
+            f"rule {name!r}: op must be one of {sorted(OPS)}, got {op!r}"
+        )
+    label = doc.get("label") or {}
+    if not isinstance(label, dict):
+        raise ValueError(f"rule {name!r}: label must be an object")
+    out = {
+        "name": name, "type": kind, "severity": sev, "metric": metric,
+        "op": op, "label": {str(k): str(v) for k, v in label.items()},
+        "for_s": float(doc.get("for_s", 0.0)),
+        "clear_for_s": float(doc.get("clear_for_s", 0.0)),
+        "staleness_s": float(doc.get("staleness_s", DEFAULT_STALENESS_S)),
+    }
+    if kind == "threshold":
+        if "value" not in doc:
+            raise ValueError(f"rule {name!r}: threshold needs value")
+        out["value"] = float(doc["value"])
+    else:
+        if "objective" not in doc:
+            raise ValueError(f"rule {name!r}: burn_rate needs objective")
+        out["objective"] = float(doc["objective"])
+        budget = float(doc.get("budget", 0.0))
+        if not 0.0 < budget <= 1.0:
+            raise ValueError(
+                f"rule {name!r}: budget must be in (0, 1], got {budget}"
+            )
+        out["budget"] = budget
+        windows = doc.get("windows") or []
+        if not windows:
+            raise ValueError(f"rule {name!r}: burn_rate needs windows")
+        norm = []
+        for w in windows:
+            ws = float(w.get("window_s", 0.0))
+            burn = float(w.get("burn", 0.0))
+            if ws <= 0 or burn <= 0:
+                raise ValueError(
+                    f"rule {name!r}: each window needs window_s > 0 and "
+                    f"burn > 0, got {w}"
+                )
+            norm.append({"window_s": ws, "burn": burn})
+        out["windows"] = sorted(norm, key=lambda w: w["window_s"])
+    return out
+
+
+def load_rules(path: str = "") -> List[dict]:
+    """The --slo-file loader: JSON list of rules, or {"rules": [...],
+    "defaults": false}. File rules override same-named defaults;
+    defaults fill the rest unless the doc opts out. No path -> the
+    built-ins alone."""
+    defaults = [validate_rule(r) for r in DEFAULT_RULES]
+    if not path:
+        return defaults
+    with open(path) as f:
+        doc = json.load(f)
+    keep_defaults = True
+    if isinstance(doc, dict):
+        keep_defaults = bool(doc.get("defaults", True))
+        doc = doc.get("rules")
+    if not isinstance(doc, list):
+        raise ValueError(
+            f"{path}: want a JSON list of rules or "
+            '{"rules": [...], "defaults": bool}'
+        )
+    rules = [validate_rule(r) for r in doc]
+    names = [r["name"] for r in rules]
+    if len(set(names)) != len(names):
+        raise ValueError(f"{path}: duplicate rule names {names}")
+    if keep_defaults:
+        have = set(names)
+        rules += [r for r in defaults if r["name"] not in have]
+    return rules
+
+
+class AlertEngine:
+    """Per-rule ok -> firing -> ok state machine over the tsdb. One
+    evaluate() per sampler tick; transitions land in the audit chain
+    and a bounded in-memory transition ring feeds GET /alerts."""
+
+    MAX_TRANSITIONS = 256
+
+    def __init__(self, tsdb, rules: Optional[List[dict]] = None,
+                 audit=None):
+        self.tsdb = tsdb
+        self.rules = [validate_rule(r) for r in (
+            rules if rules is not None else DEFAULT_RULES
+        )]
+        self.audit = audit
+        self._lock = threading.Lock()
+        self._state: Dict[str, dict] = {
+            r["name"]: {"state": "ok", "breach_since": None,
+                        "clear_since": None, "fired_unix": 0.0,
+                        "value": 0.0, "detail": {}}
+            for r in self.rules
+        }
+        self.transitions: List[dict] = []
+        self.evaluations = 0
+
+    # ---- evaluation ----
+
+    def _eval_threshold(self, rule: dict, now: float):
+        """(breaching, value, detail) for a threshold rule: newest
+        fresh sample of any matching series; worst offender wins."""
+        op = OPS[rule["op"]]
+        rows = self.tsdb.latest(rule["metric"], label=rule["label"],
+                                within_s=rule["staleness_s"], now=now)
+        breaching, worst, labels = False, None, {}
+        for lbl, _, v in rows:
+            if worst is None or op(v, worst):
+                worst, labels = v, lbl
+            if op(v, rule["value"]):
+                breaching = True
+        value = worst if worst is not None else 0.0
+        return breaching, value, {"value": round(value, 6),
+                                  "threshold": rule["value"],
+                                  "labels": labels}
+
+    def _eval_burn(self, rule: dict, now: float):
+        """(breaching, value, detail): breach fraction per window over
+        every matching series' samples; fires only when ALL windows
+        burn past burn x budget."""
+        op = OPS[rule["op"]]
+        burning_all = True
+        detail_windows = []
+        fast_frac = 0.0
+        for i, w in enumerate(rule["windows"]):
+            series = self.tsdb.query(
+                rule["metric"], label=rule["label"],
+                since=now - w["window_s"], step=0.0, now=now,
+            )
+            pts = [v for s in series for _, v in s["points"]]
+            frac = (sum(1 for v in pts if op(v, rule["objective"]))
+                    / len(pts)) if pts else 0.0
+            need = min(w["burn"] * rule["budget"], 1.0)
+            burning = bool(pts) and frac >= need
+            burning_all = burning_all and burning
+            if i == 0:
+                fast_frac = frac
+            detail_windows.append({
+                "window_s": w["window_s"], "burn_fraction": round(frac, 4),
+                "need": round(need, 4), "samples": len(pts),
+                "burning": burning,
+            })
+        return burning_all, fast_frac, {
+            "objective": rule["objective"], "budget": rule["budget"],
+            "windows": detail_windows,
+        }
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """Advance every rule's state machine; returns the transitions
+        this pass produced (also retained in self.transitions)."""
+        if now is None:
+            now = time.time()
+        fired = []
+        with self._lock:
+            self.evaluations += 1
+            for rule in self.rules:
+                if rule["type"] == "threshold":
+                    breaching, value, detail = self._eval_threshold(
+                        rule, now)
+                else:
+                    breaching, value, detail = self._eval_burn(rule, now)
+                st = self._state[rule["name"]]
+                st["value"], st["detail"] = value, detail
+                if breaching:
+                    st["clear_since"] = None
+                    if st["breach_since"] is None:
+                        st["breach_since"] = now
+                    if (st["state"] == "ok"
+                            and now - st["breach_since"]
+                            >= rule["for_s"]):
+                        st["state"] = "firing"
+                        st["fired_unix"] = now
+                        fired.append(self._transition(
+                            rule, "firing", value, now))
+                else:
+                    st["breach_since"] = None
+                    if st["state"] == "firing":
+                        if st["clear_since"] is None:
+                            st["clear_since"] = now
+                        if (now - st["clear_since"]
+                                >= rule["clear_for_s"]):
+                            st["state"] = "ok"
+                            st["clear_since"] = None
+                            fired.append(self._transition(
+                                rule, "resolved", value, now))
+        return fired
+
+    def _transition(self, rule: dict, state: str, value: float,
+                    now: float) -> dict:
+        rec = {"t": round(now, 3), "alert": rule["name"], "state": state,
+               "severity": rule["severity"], "value": round(value, 6),
+               "rule": rule["type"], "metric": rule["metric"]}
+        self.transitions.append(rec)
+        del self.transitions[:-self.MAX_TRANSITIONS]
+        if self.audit is not None:
+            self.audit.emit(
+                KIND_ALERT, alert=rule["name"], state=state,
+                severity=rule["severity"], value=round(value, 6),
+                rule=rule["type"], metric=rule["metric"],
+            )
+        return rec
+
+    # ---- views ----
+
+    def firing(self) -> List[dict]:
+        with self._lock:
+            out = []
+            for rule in self.rules:
+                st = self._state[rule["name"]]
+                if st["state"] != "firing":
+                    continue
+                out.append({
+                    "alert": rule["name"],
+                    "severity": rule["severity"],
+                    "rule": rule["type"],
+                    "metric": rule["metric"],
+                    "since_unix": round(st["fired_unix"], 3),
+                    "value": round(st["value"], 6),
+                    "detail": st["detail"],
+                })
+            return out
+
+    def page_firing(self) -> List[str]:
+        """Names of firing page-severity alerts — the /healthz flip."""
+        return [f["alert"] for f in self.firing()
+                if f["severity"] == "page"]
+
+    def describe(self) -> dict:
+        """The GET /alerts document."""
+        firing = self.firing()
+        with self._lock:
+            return {
+                "firing": firing,
+                "rules": [
+                    {"name": r["name"], "type": r["type"],
+                     "severity": r["severity"], "metric": r["metric"],
+                     "label": r["label"],
+                     "state": self._state[r["name"]]["state"]}
+                    for r in self.rules
+                ],
+                "transitions": list(self.transitions[-50:]),
+                "evaluations": self.evaluations,
+            }
+
+    def compose_health(self, prev_hook=None):
+        """A MonitorServer health_hook that ANDs the previous hook (the
+        fleet's worker-liveness view) with "no page-severity alert is
+        firing" and merges alert detail into the /healthz document —
+        wrap, never replace: a page burn must not hide a dead fleet and
+        vice versa."""
+        def hook():
+            ok, extra = (prev_hook() if prev_hook is not None
+                         else (True, {}))
+            pages = self.page_firing()
+            extra = dict(extra, alerts_firing=len(self.firing()),
+                         alerts_page=pages)
+            if pages:
+                ok = False
+            return ok, extra
+
+        return hook
+
+
+def slo_file_from_env() -> str:
+    """TPUSIM_SLO_FILE fallback for surfaces that don't thread the
+    flag (the gate's subprocess coordinators set the env instead)."""
+    return os.environ.get("TPUSIM_SLO_FILE", "")

@@ -49,6 +49,9 @@ KIND_RESPAWN = "respawn"
 KIND_FENCE_409 = "fence_409"
 KIND_AUTH_401 = "auth_401"
 KIND_DEGRADE = "degrade"
+# the SLO plane (ISSUE 20): alert firing/resolution transitions are
+# control-plane decisions too — they chain like takeovers and steals
+KIND_ALERT = "alert"
 
 
 def audit_path(artifact_dir: str) -> str:
@@ -108,16 +111,30 @@ def verify(artifact_dir_or_path: str) -> int:
 
 
 def tail(artifact_dir_or_path: str, n: int = 20, kind: str = "",
-         job: str = "", worker: str = "") -> List[dict]:
+         job: str = "", worker: str = "", after: int = 0) -> List[dict]:
     """Last `n` records matching the filters, oldest first. Walks (and
     therefore link-checks) the whole chain — an edited log can't serve
-    queries. Job filters match by prefix (digests are long)."""
+    queries. Job filters match by prefix (digests are long).
+
+    Every record gains `seq` — its 1-based position in the chain — and
+    `after > 0` keeps only records past that cursor (ISSUE 20): a
+    long-lived fleet's audit poll ships the delta since its last seen
+    seq instead of re-reading the whole chain's worth of JSON. With a
+    cursor the WINDOW flips from tail to forward pagination — the
+    OLDEST n past the cursor — so a poller walking `after = last seq`
+    never skips records between polls."""
     path = (audit_path(artifact_dir_or_path)
             if os.path.isdir(artifact_dir_or_path)
             else artifact_dir_or_path)
     if not os.path.isfile(path):
         return []
-    records = [doc for doc, _ in chain_records(path)]
+    records = []
+    after = max(int(after), 0)
+    for seq, (doc, _) in enumerate(chain_records(path), start=1):
+        if seq <= after:
+            continue
+        doc["seq"] = seq
+        records.append(doc)
     if kind:
         records = [r for r in records if r.get("kind") == kind]
     if job:
@@ -126,7 +143,9 @@ def tail(artifact_dir_or_path: str, n: int = 20, kind: str = "",
     if worker:
         records = [r for r in records if r.get("worker") == worker]
     n = max(int(n), 0)
-    return records[-n:] if n else records
+    if not n:
+        return records
+    return records[:n] if after else records[-n:]
 
 
 def format_records(records) -> List[str]:
@@ -138,7 +157,7 @@ def format_records(records) -> List[str]:
                  if isinstance(t, (int, float)) else "--:--:--")
         extra = {k: v for k, v in r.items()
                  if k not in ("schema", "kind", "t", "proc", "pid",
-                              "job", "worker", "prev")}
+                              "job", "worker", "prev", "seq")}
         parts = [f"{stamp}  {r.get('kind', '?'):<14}"]
         if r.get("job"):
             parts.append(f"job={str(r['job'])[:12]}")

@@ -247,6 +247,48 @@ def write_prometheus(path: str, record: dict, prefix: str = "tpusim") -> str:
     return path
 
 
+def latency_summary_lines(latency: dict,
+                          prefix: str = "tpusim") -> List[str]:
+    """The /queue per-kind admission->result latency rings as NATIVE
+    Prometheus summary series (ISSUE 20): p50/p99 as `quantile`-labeled
+    samples plus the `_count` suffix, per job kind — so the tsdb, the
+    gate, and external scrapers consume one vocabulary instead of
+    parsing the /queue JSON side-channel. `latency` is
+    JobQueue.latency_percentiles()'s document. Kind names are escaped
+    like every label value here; one `# TYPE ... summary` per metric."""
+    lines: List[str] = []
+    name = _metric_name(prefix, "queue_latency_seconds")
+    adj_name = _metric_name(prefix, "queue_latency_adjusted_seconds")
+    typed: set = set()
+
+    def sample(metric: str, labels: str, value):
+        lines.append(f"{metric}{labels} {value}")
+
+    def declare(metric: str):
+        if metric not in typed:
+            typed.add(metric)
+            lines.append(f"# TYPE {metric} summary")
+
+    for kind in sorted(latency):
+        row = latency[kind]
+        k = escape_label_value(str(kind))
+        declare(name)
+        sample(name, f'{{kind="{k}",quantile="0.5"}}',
+               row.get("p50_s", 0.0))
+        sample(name, f'{{kind="{k}",quantile="0.99"}}',
+               row.get("p99_s", 0.0))
+        sample(f"{name}_count", f'{{kind="{k}"}}', row.get("count", 0))
+        if "adjusted_p99_s" in row:
+            declare(adj_name)
+            sample(adj_name, f'{{kind="{k}",quantile="0.5"}}',
+                   row.get("adjusted_p50_s", 0.0))
+            sample(adj_name, f'{{kind="{k}",quantile="0.99"}}',
+                   row.get("adjusted_p99_s", 0.0))
+            sample(f"{adj_name}_count", f'{{kind="{k}"}}',
+                   row.get("count", 0))
+    return lines
+
+
 def chrome_trace_events(spans: Iterable, pid: int = 1) -> List[dict]:
     """Span list -> Chrome trace "X" events (ts/dur in microseconds).
     Each span renders as two stacked slices — the dispatch (compile)

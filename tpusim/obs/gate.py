@@ -1545,6 +1545,460 @@ def fleet_ha_smoke(out_dir: str) -> Tuple[bool, List[str]]:
     return True, msgs
 
 
+def slo_smoke(out_dir: str) -> Tuple[bool, List[str]]:
+    """ISSUE 20 (`make slo-smoke`): the SLO plane end to end, over real
+    HTTP. Three phases:
+
+    (a) alert lifecycle — a coordinator armed with a tight --slo-file
+        fork-p99 burn rule serves a base run, then a COLD fork wave (the
+        deliberately induced latency regression: every completion eats
+        the compile wall) fires the burn-rate page. While firing:
+        /healthz degrades with the alert named, `tpusim top --once`
+        shows the PAGE, /metrics carries the native latency summary,
+        /query serves the event series, /events pages by cursor, and
+        the kind=alert record sits in a VERIFYING audit chain. Then
+        warm forks (recovery) displace the burn windows and the alert
+        RESOLVES — with traffic still flowing, not by going silent.
+    (b) breaker trip — a fleet-mode coordinator with the DEFAULT rules
+        and a supervisor forced into a crash loop: the circuit breaker
+        opens and the built-in breaker-open page fires off the sampled
+        gauge, recorded in the chain.
+    (c) takeover continuity — a leader + standby CLI pair sharing one
+        artifact dir; jobs run, the leader is kill -9'd, the standby
+        promotes at a bumped epoch and ADOPTS the signed tsdb snapshot:
+        /query on the new leader must serve pre-kill history with no
+        gap at the splice (newest adopted point within snapshot cadence
+        of the kill) plus fresh post-promotion points.
+    """
+    msgs: List[str] = []
+    procs: list = []
+    coords: list = []
+    srv = worker = srv_b = sup = None
+    saved_env = {k: os.environ.get(k)
+                 for k in ("TPUSIM_TSDB_STEP_S", "TPUSIM_TSDB_SNAPSHOT_S")}
+    try:
+        import shutil
+        import signal as _signal
+        import subprocess
+        import time as _time
+        import urllib.request
+
+        from tpusim.obs import audit as obs_audit
+        from tpusim.svc import load_trace, start_job_server
+        from tpusim.svc.client import _request, submit_and_wait
+        from tpusim.svc.supervisor import Supervisor
+
+        # tight sampling so the smoke's windows have real resolution
+        os.environ["TPUSIM_TSDB_STEP_S"] = "0.25"
+        os.environ["TPUSIM_TSDB_SNAPSHOT_S"] = "0.5"
+
+        base = os.path.join(out_dir, "slo_smoke")
+        if os.path.isdir(base):
+            shutil.rmtree(base)
+        os.makedirs(base)
+        nodes_csv, pods_csv = _write_fleet_trace(base)
+        ccache = os.path.join(base, "compile_cache")
+        tcache = os.path.join(base, "table_cache")
+        trace = load_trace("default", nodes_csv, pods_csv)
+        fam = [["FGDScore", 700]]
+
+        # the smoke's SLO file: the fork-p99 rule reshaped to smoke
+        # scale. objective 1.0s sits far above a warm fork (~ms) and
+        # far below a cold compile (seconds); the 30s fast window keeps
+        # the page up long enough to probe every surface, and budget
+        # 0.25 x burn 2 = a 0.5 breach fraction, so the alert resolves
+        # once warm completions OUTNUMBER the cold ones — recovery
+        # under live traffic, not silence
+        slo_file = os.path.join(base, "slo.json")
+        with open(slo_file, "w") as f:
+            json.dump({"defaults": False, "rules": [{
+                "name": "fork-p99-burn", "type": "burn_rate",
+                "severity": "page",
+                "metric": "tpusim_queue_latency_event_seconds",
+                "label": {"kind": "fork"},
+                "objective": 1.0, "op": ">", "budget": 0.25,
+                "windows": [{"window_s": 30.0, "burn": 2.0},
+                            {"window_s": 60.0, "burn": 1.0}],
+                "clear_for_s": 1.0,
+            }]}, f)
+
+        # ---- phase (a): fire -> probe every surface -> resolve
+        art1 = os.path.join(base, "local")
+        os.makedirs(art1)
+        srv, service, worker = start_job_server(
+            art1, {"default": trace}, listen=":0", lane_width=2,
+            queue_size=64, compile_cache_dir=ccache,
+            table_cache_dir=tcache, slo_file=slo_file,
+        )
+        (base_res,) = submit_and_wait(
+            srv.url,
+            [{"policies": fam, "weights": [700], "seed": 61,
+              "base": True}],
+            timeout=600, poll_s=0.05,
+        )
+        br = base_res.get("base_run") or {}
+        E = int(br.get("events", 0))
+        if not E:
+            return False, [f"[gate] slo: base result carries no "
+                           f"base_run meta ({sorted(base_res)}) (FAIL)"]
+        bd = base_res["job"]
+
+        def fork_doc(tail):
+            return {"fork": {"base": bd, "event": E - 1,
+                             "tail": [[int(a), int(p)]
+                                      for a, p in tail]}}
+
+        # the induced regression: the FIRST fork wave compiles the
+        # fork-path executables cold — every completion in it pays the
+        # compile wall, well past the 1s objective
+        t0 = _time.time()
+        submit_and_wait(
+            srv.url, [fork_doc([[1, 0], [0, 0]]),
+                      fork_doc([[1, 1], [0, 1]])],
+            timeout=600, poll_s=0.05,
+        )
+        cold_s = _time.time() - t0
+        if cold_s <= 1.0:
+            return False, [
+                f"[gate] slo: the cold fork wave finished in "
+                f"{cold_s:.2f}s — too fast to breach the 1s objective, "
+                "the regression never happened (FAIL)"
+            ]
+
+        deadline = _time.time() + 30
+        fire = None
+        while _time.time() < deadline and fire is None:
+            _, _, a = _request(srv.url + "/alerts", timeout=5)
+            for fd in a.get("firing") or []:
+                if fd.get("alert") == "fork-p99-burn":
+                    fire = fd
+            if fire is None:
+                _time.sleep(0.1)
+        if fire is None:
+            return False, [
+                f"[gate] slo: cold fork wave ({cold_s:.1f}s "
+                "completions) never fired fork-p99-burn (FAIL)"
+            ]
+        msgs.append(
+            f"[gate] slo: induced fork regression ({cold_s:.1f}s cold "
+            f"wave vs 1s objective) fired fork-p99-burn "
+            f"(burn fraction {fire.get('value')})"
+        )
+
+        # while firing: /healthz flips, top shows the PAGE, /metrics
+        # carries the native summary, /query serves the series
+        code, _, h = _request(srv.url + "/healthz", timeout=5)
+        if code != 503 or "fork-p99-burn" not in (
+                h.get("alerts_page") or []):
+            return False, [
+                f"[gate] slo: /healthz did not degrade on the page "
+                f"burn (HTTP {code}, body={h}) (FAIL)"
+            ]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        top = subprocess.run(
+            [sys.executable, "-m", "tpusim", "top", srv.url, "--once",
+             "--width", "100"],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        if (top.returncode != 0 or "fork-p99-burn" not in top.stdout
+                or "PAGE" not in top.stdout):
+            return False, [
+                f"[gate] slo: `tpusim top --once` does not show the "
+                f"firing page (rc={top.returncode}):\n{top.stdout}"
+                f"{top.stderr} (FAIL)"
+            ]
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=5) as resp:
+            mtext = resp.read().decode()
+        if ("# TYPE tpusim_queue_latency_seconds summary" not in mtext
+                or 'tpusim_queue_latency_seconds{kind="fork",'
+                   'quantile="0.99"}' not in mtext):
+            return False, [
+                "[gate] slo: /metrics lacks the native per-kind "
+                "latency summary series (FAIL)"
+            ]
+        _, _, qd = _request(
+            srv.url + "/query?name=tpusim_queue_latency_event_seconds"
+            "&label=kind%3Dfork&since=-120", timeout=5,
+        )
+        ev_pts = [p for s in qd.get("series") or []
+                  for p in s["points"]]
+        if not ev_pts:
+            return False, ["[gate] slo: /query serves no fork event-"
+                           "latency history (FAIL)"]
+
+        # /events cursor pagination (live): page 1 record, then resume
+        # from the cursor — no overlap, no skips
+        _, _, ev1 = _request(srv.url + "/events?limit=1", timeout=5)
+        cur = int(ev1.get("next_after", 0))
+        if len(ev1.get("events") or []) != 1 or cur < 1:
+            return False, [f"[gate] slo: /events?limit=1 answered "
+                           f"{ev1} (FAIL)"]
+        _, _, ev2 = _request(
+            srv.url + f"/events?after={cur}&limit=500", timeout=5)
+        seqs = [e.get("seq", 0) for e in ev2.get("events") or []]
+        if any(s <= cur for s in seqs):
+            return False, [
+                f"[gate] slo: cursor page re-served seqs <= {cur}: "
+                f"{seqs} (FAIL)"
+            ]
+
+        # recovery: warm forks (compile cached now, ~ms each) displace
+        # the burn windows until the fraction drops and the page clears
+        deadline = _time.time() + 90
+        resolved = False
+        j = 0
+        while _time.time() < deadline and not resolved:
+            submit_and_wait(
+                srv.url,
+                [fork_doc([[1, j % 40], [0, (j * 7 + 1) % 40]])],
+                timeout=600, poll_s=0.05,
+            )
+            j += 1
+            _, _, a = _request(srv.url + "/alerts", timeout=5)
+            resolved = not (a.get("firing") or [])
+            if not resolved:
+                _time.sleep(0.3)
+        if not resolved:
+            return False, [
+                f"[gate] slo: fork-p99-burn never resolved after "
+                f"{j} warm recovery forks (FAIL)"
+            ]
+        code, _, h = _request(srv.url + "/healthz", timeout=5)
+        if code != 200:
+            return False, [f"[gate] slo: /healthz still {code} after "
+                           "the alert resolved (FAIL)"]
+
+        # the firing AND the resolution are records in a chain that
+        # still verifies
+        n_chain = obs_audit.verify(art1)
+        alert_recs = obs_audit.tail(art1, n=0, kind="alert")
+        states = [(r.get("alert"), r.get("state")) for r in alert_recs]
+        if (("fork-p99-burn", "firing") not in states
+                or ("fork-p99-burn", "resolved") not in states):
+            return False, [
+                f"[gate] slo: audit chain lacks the firing/resolved "
+                f"alert records (got {states}) (FAIL)"
+            ]
+        msgs.append(
+            f"[gate] slo: page visible on /healthz(503) + `tpusim top` "
+            f"+ /metrics summary + /query; resolved after {j} warm "
+            f"fork(s) under live traffic; firing+resolved records in a "
+            f"verifying {n_chain}-record audit chain"
+        )
+        worker.stop()
+        srv.stop()
+        worker = srv = None
+
+        # ---- phase (b): forced crash loop -> breaker-open page
+        art_b = os.path.join(base, "breaker")
+        os.makedirs(art_b)
+        srv_b, service_b, _ = start_job_server(
+            art_b, {"default": trace}, listen=":0", lane_width=2,
+            queue_size=16, fleet=True, lease_s=2.0,
+        )
+        sup = Supervisor(
+            lambda n: subprocess.Popen(
+                [sys.executable, "-c", "raise SystemExit(3)"]),
+            1, breaker_k=3, breaker_window_s=20.0,
+            on_exit=service_b.fleet.release_dead,
+        )
+        sup.healthy_after_s = 3600.0  # every exit counts as a crash
+        service_b.fleet.supervisor = sup
+        sup.start()
+        deadline = _time.time() + 60
+        while _time.time() < deadline and not sup.breaker.open:
+            sup.poll()
+            _time.sleep(0.05)
+        if not sup.breaker.open:
+            return False, ["[gate] slo: forced crash loop never "
+                           "tripped the breaker (FAIL)"]
+        deadline = _time.time() + 20
+        fired_b = False
+        while _time.time() < deadline and not fired_b:
+            _, _, a = _request(srv_b.url + "/alerts", timeout=5)
+            fired_b = any(fd.get("alert") == "breaker-open"
+                          for fd in a.get("firing") or [])
+            if not fired_b:
+                _time.sleep(0.1)
+        if not fired_b:
+            return False, [
+                "[gate] slo: the open breaker never fired the default "
+                "breaker-open page off the sampled gauge (FAIL)"
+            ]
+        obs_audit.verify(art_b)
+        brecs = obs_audit.tail(art_b, n=0, kind="alert")
+        if not any(r.get("alert") == "breaker-open"
+                   and r.get("state") == "firing" for r in brecs):
+            return False, ["[gate] slo: breaker-open firing record "
+                           "missing from the audit chain (FAIL)"]
+        msgs.append(
+            "[gate] slo: crash-loop breaker trip fired the built-in "
+            "breaker-open page, chained in audit"
+        )
+        sup.stop()
+        sup = None
+        srv_b.stop()
+        srv_b = None
+
+        # ---- phase (c): history survives an epoch-fenced takeover
+        token = "slo-smoke-" + os.urandom(8).hex()
+        token_file = os.path.join(base, "token.txt")
+        with open(token_file, "w") as f:
+            f.write(token + "\n")
+        art2 = os.path.join(base, "fleet")
+        os.makedirs(art2)
+        p1, p2 = _free_port(), _free_port()
+        u1, u2 = f"http://127.0.0.1:{p1}", f"http://127.0.0.1:{p2}"
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            TPUSIM_COORD_LEASE_S="1.5", TPUSIM_COORD_SKEW_S="0.5",
+            TPUSIM_TSDB_STEP_S="0.25", TPUSIM_TSDB_SNAPSHOT_S="0.5",
+        )
+
+        def _coord_cmd(port: int, standby: bool = False) -> list:
+            cmd = [
+                sys.executable, "-m", "tpusim", "serve", art2, "--jobs",
+                "--nodes", nodes_csv, "--pods", pods_csv, "--fleet",
+                "--listen", f"127.0.0.1:{port}", "--poll", "0.3",
+                "--lane-width", "2", "--lease-s", "2.0",
+                "--token-file", token_file,
+                "--table-cache-dir", tcache,
+                "--compile-cache-dir", ccache,
+            ]
+            if standby:
+                cmd.append("--standby")
+            return cmd
+
+        def _spawn_coord(port: int, tag: str, standby: bool = False):
+            log = open(os.path.join(base, f"coord_{tag}.log"), "ab")
+            proc = subprocess.Popen(
+                _coord_cmd(port, standby), env=env,
+                stdout=log, stderr=log,
+            )
+            coords.append(proc)
+            return proc
+
+        def _wait_role(url: str, want: str, timeout_s: float) -> dict:
+            end = _time.time() + timeout_s
+            last = "?"
+            while _time.time() < end:
+                try:
+                    _, _, hh = _request(url + "/healthz", timeout=5)
+                    last = hh.get("role", "?")
+                    if last == want:
+                        return hh
+                except OSError:
+                    pass
+                _time.sleep(0.1)
+            raise RuntimeError(
+                f"{url} never reached role {want!r} (last: {last!r})"
+            )
+
+        leader = _spawn_coord(p1, "leader")
+        _wait_role(u1, "leader", 60)
+        _spawn_coord(p2, "standby", standby=True)
+        _wait_role(u2, "standby", 60)
+        wcmd = [
+            sys.executable, "-m", "tpusim", "worker",
+            "--join", f"{u1},{u2}", "--token-file", token_file,
+            "--table-cache-dir", tcache, "--compile-cache-dir", ccache,
+        ]
+        wlog = open(os.path.join(base, "worker_0.log"), "ab")
+        procs.append(
+            subprocess.Popen(wcmd, env=env, stdout=wlog, stderr=wlog))
+
+        docs = [{"policies": fam, "weights": [700 + 13 * i], "seed": 61,
+                 "engine": "sequential"} for i in range(4)]
+        results = submit_and_wait(f"{u1},{u2}", docs, timeout=300,
+                                  token=token)
+        if len(results) != len(docs):
+            return False, [f"[gate] slo: {len(results)}/{len(docs)} "
+                           "jobs completed on the HA pair (FAIL)"]
+        _time.sleep(1.5)  # >= two snapshot cadences: history on disk
+
+        _, _, pre = _request(
+            u1 + "/query?name=tpusim_queue_done_total&since=-120",
+            timeout=5)
+        if not any(s["points"] for s in pre.get("series") or []):
+            return False, ["[gate] slo: leader served no done_total "
+                           "history before the kill (FAIL)"]
+        t_kill = _time.time()
+        os.kill(leader.pid, _signal.SIGKILL)
+        h = _wait_role(u2, "leader", 30)
+        epoch = int(h.get("epoch", 0))
+        if epoch < 2:
+            return False, [f"[gate] slo: standby promoted without "
+                           f"bumping the epoch ({epoch}) (FAIL)"]
+        _time.sleep(2.0)  # let the adopted history gain fresh points
+
+        _, _, post = _request(
+            u2 + "/query?name=tpusim_queue_done_total&since=-180",
+            timeout=5)
+        pts = sorted((t, v) for s in post.get("series") or []
+                     for t, v in s["points"])
+        pre_side = [t for t, _ in pts if t <= t_kill]
+        post_side = [t for t, _ in pts if t > t_kill]
+        if not pre_side or not post_side:
+            return False, [
+                f"[gate] slo: promoted standby's /query did not splice "
+                f"history ({len(pre_side)} pre-kill / {len(post_side)} "
+                "post-promotion points) (FAIL)"
+            ]
+        gap = t_kill - max(pre_side)
+        if gap > 3.0:
+            return False, [
+                f"[gate] slo: {gap:.1f}s of history lost at the splice "
+                "(snapshot cadence is 0.5s) (FAIL)"
+            ]
+        ts = [t for t, _ in pts]
+        if ts != sorted(ts) or len(set(ts)) != len(ts):
+            return False, ["[gate] slo: spliced series timestamps are "
+                           "not strictly increasing (FAIL)"]
+        _, _, a2 = _request(u2 + "/alerts", timeout=5)
+        if not a2.get("rules"):
+            return False, ["[gate] slo: promoted standby serves no "
+                           "alert rules (FAIL)"]
+        n2 = obs_audit.verify(art2)
+        msgs.append(
+            f"[gate] slo: kill -9 takeover at epoch {epoch} adopted "
+            f"{len(pre_side)} pre-kill points with {gap:.2f}s gap at "
+            f"the splice (cadence 0.5s) + {len(post_side)} fresh "
+            f"points; alert engine live on the new leader; shared "
+            f"audit chain verifies ({n2} records)"
+        )
+    except Exception as err:
+        return False, [f"[gate] slo: FAIL ({type(err).__name__}: {err})"]
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            if procs:
+                from tpusim.svc.fleet import stop_workers
+
+                stop_workers(procs)
+            for c in coords:
+                if c.poll() is None:
+                    try:
+                        c.kill()
+                    except OSError:
+                        pass
+            if sup is not None:
+                sup.stop()
+            if worker is not None:
+                worker.stop()
+            if srv is not None:
+                srv.stop()
+            if srv_b is not None:
+                srv_b.stop()
+        except Exception:
+            pass
+    return True, msgs
+
+
 class FlakyShim:
     """The WAN fault injector of `make fleet-wan-smoke` (ISSUE 13): a
     MonitorServer extension app inserted BEFORE the real fleet app that
@@ -2668,6 +3122,16 @@ def main(argv=None) -> int:
         "breaker) — the `make fleet-wan-smoke` mode",
     )
     ap.add_argument(
+        "--slo-only", action="store_true",
+        help="run only the SLO-plane smoke (ISSUE 20: real-HTTP fleet, "
+        "induced fork-latency regression fires a burn-rate page "
+        "visible on /alerts + /healthz + `tpusim top`, chained in a "
+        "verifying audit log, resolving under live recovery traffic; "
+        "crash-loop breaker trip fires the built-in page; /query "
+        "history survives a kill -9 takeover with no gap at the "
+        "splice) — the `make slo-smoke` mode",
+    )
+    ap.add_argument(
         "--pallas-hbm-only", action="store_true",
         help="run only the HBM-residency pallas smoke (ISSUE 15: "
         "N=8192/K=151 interpreter replay above the old VMEM ceiling "
@@ -2701,6 +3165,12 @@ def main(argv=None) -> int:
         force_virtual_cpu_devices(2, force=True)
         os.makedirs(args.out, exist_ok=True)
         ok, msgs = policy_smoke(args.out)
+        print("\n".join(msgs))
+        print(f"[gate] {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
+
+    if args.slo_only:
+        ok, msgs = slo_smoke(args.out)
         print("\n".join(msgs))
         print(f"[gate] {'PASS' if ok else 'FAIL'}")
         return 0 if ok else 1
@@ -2868,6 +3338,11 @@ def main(argv=None) -> int:
     # byte-identity vs a single-coordinator reference
     ha_ok, ha_msgs = fleet_ha_smoke(args.out)
     print("\n".join(ha_msgs))
+    # SLO-plane smoke (ISSUE 20): burn-rate page fires on an induced
+    # fork regression, resolves under recovery traffic, breaker trip
+    # pages, /query history survives a kill -9 takeover
+    slo_ok, slo_msgs = slo_smoke(args.out)
+    print("\n".join(slo_msgs))
     # scale-lane advisory (ISSUE 11 satellite): newest committed
     # MULTICHIP_r*.json, like the BENCH_r*.json baselines
     mc_ok, mc_msgs = multichip_advisory(latest_multichip())
@@ -2875,7 +3350,7 @@ def main(argv=None) -> int:
     smoke_ok = (dec_ok and scrape_ok and swp_ok and svc_ok and serve_ok
                 and tune_ok and chaos_ok and pol_ok and hbm_ok
                 and mesh_ok and fleet_ok and wan_ok and trace_ok
-                and ha_ok and mc_ok)
+                and ha_ok and slo_ok and mc_ok)
 
     if base is None:
         print("[gate] no committed BENCH_r*.json baseline found — smoke "

@@ -96,6 +96,15 @@ class MonitorServer:
         # live (one dead worker of three is the fleet working as
         # designed, not an outage). Draining still wins.
         self.health_hook = None
+        # live exposition extras (ISSUE 20): a callable returning extra
+        # Prometheus lines appended to every /metrics response — the
+        # job plane exports its per-kind latency summaries here so the
+        # scrape carries live queue telemetry, not just the newest
+        # published run record. Served even before the first publish.
+        self.metrics_extra_fn = None
+        # shutdown hooks: stop() runs these (the SLO sampler thread
+        # rides the server lifecycle)
+        self._cleanups: list = []
 
     def begin_drain(self):
         with self._lock:
@@ -117,12 +126,32 @@ class MonitorServer:
             self._metrics_text = text
             self._records += 1
 
-    def metrics_text(self) -> Optional[str]:
+    def metrics_text(self, include_extra: bool = False) -> Optional[str]:
         """The current /metrics exposition text (None before the first
         publish) — the base the fleet coordinator's aggregated scrape
-        merges worker series into (ISSUE 19)."""
+        merges worker series into (ISSUE 19). `include_extra` appends
+        the live extras (metrics_extra_fn) so the merged fleet scrape
+        and the plain GET serve one vocabulary; with no published
+        record yet the extras alone still serve (a fleet coordinator
+        never publishes a run record of its own)."""
         with self._lock:
-            return self._metrics_text
+            text = self._metrics_text
+        if not include_extra or self.metrics_extra_fn is None:
+            return text
+        try:
+            extra = self.metrics_extra_fn()
+        except Exception:
+            return text  # a broken extras hook must not break scrapes
+        if not extra:
+            return text
+        extra_text = ("\n".join(extra) + "\n"
+                      if isinstance(extra, (list, tuple)) else str(extra))
+        return extra_text if text is None else text + extra_text
+
+    def on_stop(self, fn) -> "MonitorServer":
+        """Register a shutdown hook stop() runs exactly once."""
+        self._cleanups.append(fn)
+        return self
 
     def publish_progress(self, **fields):
         with self._lock:
@@ -254,8 +283,7 @@ class MonitorServer:
                     return
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
-                    with srv._lock:
-                        text = srv._metrics_text
+                    text = srv.metrics_text(include_extra=True)
                     if text is None:
                         self._send(503, "text/plain",
                                    b"no run record published yet\n")
@@ -321,6 +349,12 @@ class MonitorServer:
         return self
 
     def stop(self):
+        cleanups, self._cleanups = self._cleanups, []
+        for fn in cleanups:
+            try:
+                fn()
+            except Exception:
+                pass  # shutdown hooks must not block shutdown
         if self._hb_listener is not None:
             from tpusim.obs import heartbeat
 

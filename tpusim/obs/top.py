@@ -1,0 +1,203 @@
+"""`tpusim top URL` — the live fleet dashboard (ISSUE 20).
+
+One terminal pane stitching the coordinator's whole observable state:
+/healthz (role, epoch, readiness), /queue (depth, counters, per-kind
+latency), /workers (the fleet roster with measured profiles), /alerts
+(the SLO rule engine's firing set + recent transitions), and sparkline
+history pulled from /query — the single view the fleet never had.
+
+Stdlib only, plain redraw loop (ANSI home+clear each frame, no curses
+dependency): `watch`-style robustness over widget polish. --once
+renders a single frame with no escape codes — the scriptable form the
+slo smoke asserts against.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import List, Optional
+
+from tpusim.obs.series import sparkline
+
+POLL_TIMEOUT_S = 5.0
+
+
+def _get_json(base: str, path: str, query: Optional[dict] = None,
+              ok_codes=(200,)) -> Optional[dict]:
+    """GET base+path -> parsed JSON, or None when unreachable. /healthz
+    legitimately answers 503 (draining, degraded, page burn) with a
+    JSON body the dashboard still wants — `ok_codes` widens per call."""
+    url = base + path
+    if query:
+        pairs = []
+        for k, v in query.items():
+            for vv in (v if isinstance(v, list) else [v]):
+                pairs.append((k, vv))
+        url += "?" + urllib.parse.urlencode(pairs)
+    try:
+        with urllib.request.urlopen(url, timeout=POLL_TIMEOUT_S) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as err:
+        if err.code in ok_codes or err.code == 503:
+            try:
+                return json.loads(err.read().decode())
+            except (ValueError, OSError):
+                return None
+        return None
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def _spark_of(base: str, name: str, labels: dict, since: float,
+              width: int) -> str:
+    doc = _get_json(base, "/query", {
+        "name": name,
+        "label": [f"{k}={v}" for k, v in labels.items()],
+        "since": str(-abs(since)),
+    })
+    if not doc:
+        return ""
+    pts = [v for s in doc.get("series") or [] for _, v in s["points"]]
+    return sparkline(pts, width=width) if pts else ""
+
+
+def _fmt_s(v) -> str:
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return "?"
+    return f"{v * 1e3:.0f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def render(base: str, width: int = 0) -> str:
+    """One dashboard frame as plain text."""
+    if width <= 0:
+        width = max(shutil.get_terminal_size((100, 24)).columns, 60)
+    base = base.rstrip("/")
+    health = _get_json(base, "/healthz")
+    queue = _get_json(base, "/queue")
+    alerts = _get_json(base, "/alerts")
+
+    lines: List[str] = []
+    stamp = time.strftime("%H:%M:%S")
+    if health is None and queue is None:
+        lines.append(f"tpusim top — {base}  {stamp}")
+        lines.append("")
+        lines.append(f"  UNREACHABLE: no /healthz or /queue at {base}")
+        return "\n".join(lines) + "\n"
+
+    h = health or {}
+    head = [f"tpusim top — {base}"]
+    if h.get("role"):
+        head.append(f"role={h['role']} epoch={h.get('epoch', '?')}")
+    head.append(f"ok={h.get('ok', '?')}")
+    if h.get("alerts_page"):
+        head.append("PAGE:" + ",".join(h["alerts_page"]))
+    head.append(stamp)
+    lines.append("  ".join(head)[:width])
+    lines.append("-" * min(width, 100))
+
+    q = queue or {}
+    depth = int(q.get("depth", 0))
+    cap = max(int(q.get("capacity", 1) or 1), 1)
+    barw = 20
+    fill = min(int(round(barw * depth / cap)), barw)
+    lines.append(
+        f"queue  {depth}/{cap} [{'#' * fill}{'.' * (barw - fill)}]  "
+        f"submitted={q.get('submitted', 0)} done={q.get('done', 0)} "
+        f"failed={q.get('failed', 0)} steals={q.get('steals', 0)} "
+        f"dedup={q.get('dedup_hits', 0)}"[:width]
+    )
+    depth_spark = _spark_of(base, "tpusim_queue_depth", {}, 300,
+                            min(40, width - 20))
+    if depth_spark:
+        lines.append(f"  depth 5m  {depth_spark}")
+
+    latency = q.get("latency") or {}
+    if latency:
+        lines.append("latency (admission->result)")
+        for kind in sorted(latency):
+            row = latency[kind]
+            spark = _spark_of(
+                base, "tpusim_queue_latency_seconds",
+                {"kind": kind, "quantile": "0.99"}, 300,
+                min(30, width - 44),
+            )
+            lines.append(
+                f"  {kind:<6} p50={_fmt_s(row.get('p50_s')):<7} "
+                f"p99={_fmt_s(row.get('p99_s')):<7} "
+                f"n={row.get('count', 0):<5} {spark}"[:width]
+            )
+
+    workers = q.get("workers") or {}
+    if workers:
+        live = q.get("workers_live", 0)
+        lines.append(f"workers ({live} live / {len(workers)} known)")
+        lines.append(
+            f"  {'id':<14}{'live':<6}{'mode':<10}{'claims':>7}"
+            f"{'done':>6}{'fail':>6}{'leases':>7}{'ewma':>9}"
+        )
+        for wid in sorted(workers)[:12]:
+            row = workers[wid]
+            prof = row.get("profile") or {}
+            lines.append(
+                f"  {wid[:13]:<14}{str(bool(row.get('live'))):<6}"
+                f"{str(row.get('mode', ''))[:9]:<10}"
+                f"{row.get('claims', 0):>7}{row.get('jobs_done', 0):>6}"
+                f"{row.get('jobs_failed', 0):>6}"
+                f"{row.get('leases_held', 0):>7}"
+                f"{_fmt_s(prof.get('ewma_dispatch_s', 0)):>9}"[:width]
+            )
+
+    a = alerts or {}
+    firing = a.get("firing") or []
+    if firing:
+        lines.append(f"ALERTS ({len(firing)} firing)")
+        for f in firing:
+            lines.append(
+                f"  {f.get('severity', '?').upper():<7}"
+                f"{f.get('alert', '?'):<24} value={f.get('value')} "
+                f"metric={f.get('metric', '')}"[:width]
+            )
+    else:
+        lines.append("alerts: none firing")
+    trans = (a.get("transitions") or [])[-5:]
+    if trans:
+        lines.append("recent transitions")
+        for t in trans:
+            ts = time.strftime("%H:%M:%S", time.localtime(t.get("t", 0)))
+            lines.append(
+                f"  {ts} {t.get('state', '?'):<9}"
+                f"{t.get('alert', '?'):<24}"
+                f"({t.get('severity', '?')})"[:width]
+            )
+    return "\n".join(lines) + "\n"
+
+
+def run(url: str, interval: float = 2.0, once: bool = False,
+        width: int = 0, out=None) -> int:
+    """The redraw loop. --once prints a single frame (exit 2 when the
+    coordinator is unreachable — the smoke's assertion hook)."""
+    if out is None:
+        out = sys.stdout
+    if once:
+        frame = render(url, width=width)
+        out.write(frame)
+        out.flush()
+        return 2 if "UNREACHABLE" in frame else 0
+    try:
+        while True:
+            frame = render(url, width=width)
+            # home + clear-to-end: repaint without full-screen flash
+            out.write("\x1b[H\x1b[2J" + frame)
+            out.flush()
+            time.sleep(max(interval, 0.2))
+    except KeyboardInterrupt:
+        out.write("\n")
+        return 0

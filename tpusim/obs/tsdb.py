@@ -1,0 +1,571 @@
+"""In-process metrics history — the SLO plane's time axis (ISSUE 20).
+
+Every observability surface before this PR was instantaneous: `/metrics`
+is the newest scrape, `/queue` the current rings, the audit chain a list
+of discrete decisions. Nothing could answer "fork p99 has been degrading
+for ten minutes" without an external Prometheus. This module keeps that
+history in-process:
+
+  TSDB             fixed-interval sample store with downsampled
+                   retention tiers (default 1 s x 15 m -> 15 s x 4 h).
+                   Each tier holds per-series buckets of (sum, count);
+                   reads return the bucket mean, so a coarse tier is the
+                   honest average of the fine one, not a decimation.
+  ServiceCollector reads the coordinator's own in-process sources (the
+                   JobQueue stats/latency rings, the fleet registry's
+                   measured worker profiles, the supervisor breaker) and
+                   emits one sample batch per tick in the SAME metric
+                   vocabulary `/metrics` exposes — one set of names for
+                   scrapers, the tsdb, and the alert rules.
+  MetricsSampler   the daemon thread driving collect -> ingest ->
+                   alerts.evaluate once per interval, persisting the
+                   ring as a periodic SIGNED snapshot in the artifact
+                   dir. A standby coordinator starts the sampler PAUSED
+                   (sampling while not leading would interleave two
+                   writers); at promotion it adopts the leader's last
+                   snapshot and resumes, so `/query` history survives an
+                   epoch-fenced takeover instead of starting blind.
+  TsdbApp          the MonitorServer extension app serving
+                   GET /query?name=&label=&since=&step= (JSON series)
+                   and GET /alerts (the rule engine's view).
+
+The snapshot rides io.storage.write_signed_json — atomic tmp+rename, a
+digest-signed header — so a kill -9 mid-write leaves the previous
+snapshot intact and a torn/edited file is rejected at adopt time, never
+silently merged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.parse
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tpusim.io.storage import (
+    read_signed_json,
+    tsdb_snapshot_path,
+    write_signed_json,
+)
+
+SNAPSHOT_SCHEMA = "tpusim-tsdb-snapshot/1"
+
+# (step seconds, bucket capacity) fine -> coarse; every sample feeds
+# every tier, retention prunes each tier independently:
+#   1 s x 900  = 15 minutes at full resolution
+#   15 s x 960 = 4 hours downsampled
+DEFAULT_TIERS: Tuple[Tuple[float, int], ...] = ((1.0, 900), (15.0, 960))
+
+_JSON = "application/json"
+
+
+def _json_body(code: int, doc):
+    return code, _JSON, (json.dumps(doc, sort_keys=True) + "\n").encode()
+
+
+def _labels_key(labels) -> Tuple[Tuple[str, str], ...]:
+    """Canonical hashable form of a label set. Values pass through
+    verbatim — hostile worker names (quotes, backslashes, newlines) are
+    data here; only the Prometheus TEXT rendering needs escaping."""
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class TSDB:
+    """Thread-safe multi-tier sample store. Series are keyed by
+    (metric name, label set); each tier maps bucket index -> [sum,
+    count] so same-bucket samples merge into a mean instead of
+    overwriting each other."""
+
+    def __init__(self, tiers: Iterable[Tuple[float, int]] = DEFAULT_TIERS):
+        tiers = tuple((float(s), int(c)) for s, c in tiers)
+        if not tiers:
+            raise ValueError("tsdb needs at least one retention tier")
+        steps = [s for s, _ in tiers]
+        if steps != sorted(steps) or len(set(steps)) != len(steps):
+            raise ValueError(
+                f"tier steps must be strictly ascending, got {steps}"
+            )
+        if any(s <= 0 or c < 2 for s, c in tiers):
+            raise ValueError(f"bad tier shape {tiers}: want step > 0, "
+                             "capacity >= 2")
+        self.tiers = tiers
+        self._lock = threading.Lock()
+        # (name, labels_key) -> [tier dict: bucket -> [sum, count]]
+        self._series: Dict[Tuple[str, tuple], List[Dict[int, list]]] = {}
+        self.ingested = 0
+
+    # ---- write side ----
+
+    def ingest(self, samples, now: Optional[float] = None) -> int:
+        """Fold one batch of (name, labels|None, value) samples in at
+        time `now`. Returns the number accepted (non-finite values are
+        dropped — a NaN in the ring would poison every mean)."""
+        if now is None:
+            now = time.time()
+        n = 0
+        with self._lock:
+            for name, labels, value in samples:
+                try:
+                    v = float(value)
+                except (TypeError, ValueError):
+                    continue
+                if v != v or v in (float("inf"), float("-inf")):
+                    continue
+                key = (str(name), _labels_key(labels))
+                tiers = self._series.get(key)
+                if tiers is None:
+                    tiers = [{} for _ in self.tiers]
+                    self._series[key] = tiers
+                for (step, cap), buckets in zip(self.tiers, tiers):
+                    b = int(now / step)
+                    cell = buckets.get(b)
+                    if cell is None:
+                        buckets[b] = [v, 1]
+                        # prune: retention is bucket-count per tier
+                        floor = b - cap + 1
+                        if len(buckets) > cap:
+                            for old in [x for x in buckets if x < floor]:
+                                del buckets[old]
+                    else:
+                        cell[0] += v
+                        cell[1] += 1
+                n += 1
+            self.ingested += n
+        return n
+
+    # ---- read side ----
+
+    def _pick_tier(self, since: float, step: float, now: float) -> int:
+        """Finest tier that satisfies the requested step AND whose
+        retention window reaches back to `since`; the coarsest tier is
+        the fallback when nothing reaches that far."""
+        chosen = 0
+        for i, (tier_step, cap) in enumerate(self.tiers):
+            if step > 0 and tier_step < step:
+                continue
+            chosen = i
+            if since > 0 and since < now - tier_step * cap:
+                continue  # this tier can't reach back far enough
+            break
+        return chosen
+
+    def query(self, name: str = "", label=None, since: float = 0.0,
+              step: float = 0.0, now: Optional[float] = None) -> List[dict]:
+        """JSON-ready series list. `label` filters on a dict subset
+        (every given pair must match). `since` <= 0 means "that many
+        seconds ago"; absolute unix stamps pass through. Points are
+        [bucket start unix, mean] ascending."""
+        if now is None:
+            now = time.time()
+        if since < 0:
+            since = now + since  # relative-ago form
+        elif since == 0:
+            since = -1.0  # 0 -> everything (any positive stamp passes)
+        want = dict(label or {})
+        ti = self._pick_tier(since, step, now)
+        tier_step = self.tiers[ti][0]
+        out = []
+        with self._lock:
+            for (sname, lkey), tiers in sorted(self._series.items()):
+                if name and sname != name:
+                    continue
+                labels = dict(lkey)
+                if any(labels.get(k) != str(v) for k, v in want.items()):
+                    continue
+                pts = []
+                for b in sorted(tiers[ti]):
+                    t = b * tier_step
+                    if t < since or t > now:
+                        continue
+                    s, c = tiers[ti][b]
+                    pts.append([round(t, 3), s / c])
+                if pts:
+                    out.append({"name": sname, "labels": labels,
+                                "step_s": tier_step, "points": pts})
+        return out
+
+    def latest(self, name: str, label=None, within_s: float = 0.0,
+               now: Optional[float] = None) -> List[Tuple[dict, float, float]]:
+        """(labels, t, value) of each matching series' newest point —
+        the threshold rules' read. `within_s` > 0 drops stale series."""
+        if now is None:
+            now = time.time()
+        res = []
+        for s in self.query(name, label=label, since=0.0, step=0.0,
+                            now=now):
+            t, v = s["points"][-1]
+            if within_s > 0 and now - t > within_s:
+                continue
+            res.append((s["labels"], t, v))
+        return res
+
+    def names(self) -> List[dict]:
+        """The discovery document: every series name with its label
+        sets and fine-tier point counts."""
+        with self._lock:
+            rows: Dict[str, list] = {}
+            for (name, lkey), tiers in sorted(self._series.items()):
+                rows.setdefault(name, []).append(
+                    {"labels": dict(lkey), "points": len(tiers[0])}
+                )
+        return [{"name": n, "series": s} for n, s in sorted(rows.items())]
+
+    # ---- snapshot persistence (the takeover handoff) ----
+
+    def snapshot_doc(self, now: Optional[float] = None) -> dict:
+        if now is None:
+            now = time.time()
+        with self._lock:
+            series = []
+            for (name, lkey), tiers in sorted(self._series.items()):
+                series.append({
+                    "name": name,
+                    "labels": dict(lkey),
+                    "tiers": [
+                        [[b, cell[0], cell[1]]
+                         for b, cell in sorted(buckets.items())]
+                        for buckets in tiers
+                    ],
+                })
+        return {
+            "t": round(now, 3),
+            "tiers": [[s, c] for s, c in self.tiers],
+            "series": series,
+        }
+
+    def write_snapshot(self, artifact_dir: str,
+                       now: Optional[float] = None) -> str:
+        path = tsdb_snapshot_path(artifact_dir)
+        return write_signed_json(
+            path, {"schema": SNAPSHOT_SCHEMA}, self.snapshot_doc(now)
+        )
+
+    def adopt(self, artifact_dir_or_path: str) -> int:
+        """Merge a predecessor's snapshot into this ring: foreign
+        buckets fill gaps, LOCAL buckets win collisions (the adopter is
+        the live writer; the snapshot is history). Returns the number of
+        buckets adopted; a missing snapshot is 0, a torn/edited one
+        raises ValueError (read_signed_json's digest check) so a
+        takeover never splices corrupt history silently."""
+        path = (tsdb_snapshot_path(artifact_dir_or_path)
+                if os.path.isdir(artifact_dir_or_path)
+                else artifact_dir_or_path)
+        if not os.path.isfile(path):
+            return 0
+        _, doc = read_signed_json(path, SNAPSHOT_SCHEMA)
+        their_tiers = [tuple(t) for t in doc.get("tiers") or []]
+        # map their tier index -> ours by step value; mismatched layouts
+        # adopt only the tiers both sides share
+        index = {float(s): i for i, (s, _) in enumerate(self.tiers)}
+        n = 0
+        with self._lock:
+            for row in doc.get("series") or []:
+                key = (str(row.get("name", "")),
+                       _labels_key(row.get("labels") or {}))
+                tiers = self._series.get(key)
+                if tiers is None:
+                    tiers = [{} for _ in self.tiers]
+                    self._series[key] = tiers
+                for ti, cells in enumerate(row.get("tiers") or []):
+                    if ti >= len(their_tiers):
+                        break
+                    mine = index.get(float(their_tiers[ti][0]))
+                    if mine is None:
+                        continue
+                    buckets = tiers[mine]
+                    cap = self.tiers[mine][1]
+                    for b, s, c in cells:
+                        b = int(b)
+                        if b not in buckets:
+                            buckets[b] = [float(s), int(c)]
+                            n += 1
+                    if len(buckets) > cap:
+                        for old in sorted(buckets)[:-cap]:
+                            del buckets[old]
+        return n
+
+
+# ---------------------------------------------------------------------------
+# The coordinator's sample source
+# ---------------------------------------------------------------------------
+
+
+class ServiceCollector:
+    """One tick's samples off the live JobService: queue gauges,
+    counter-derived rates, per-kind latency percentiles, fleet worker
+    profiles, breaker state. Stateful: rates are deltas against the
+    previous tick's counters."""
+
+    # counters whose per-second rate the alert rules watch
+    RATE_COUNTERS = ("steals", "lease_expired", "done", "failed")
+
+    def __init__(self, service):
+        self.service = service
+        self._prev_t = 0.0
+        self._prev: Dict[str, float] = {}
+        self._lat_cursors: Dict[str, int] = {}
+
+    def __call__(self, now: Optional[float] = None):
+        if now is None:
+            now = time.time()
+        service = self.service
+        queue = service.queue
+        samples = []
+
+        stats = queue.stats()
+        depth = float(stats.get("depth", 0))
+        cap = float(stats.get("capacity", 1) or 1)
+        samples.append(("tpusim_queue_depth", None, depth))
+        samples.append(("tpusim_queue_capacity", None, cap))
+        samples.append(("tpusim_queue_saturation", None, depth / cap))
+        for fam, d in (stats.get("families") or {}).items():
+            samples.append(
+                ("tpusim_queue_family_depth", {"family": fam}, float(d))
+            )
+        for key in ("submitted", "done", "failed", "rejected",
+                    "dedup_hits", "quota_rejected", "steals",
+                    "lease_expired", "dup_completions", "starved_claims"):
+            samples.append(
+                (f"tpusim_queue_{key}_total", None,
+                 float(stats.get(key, 0)))
+            )
+
+        # counter rates: the burn-rate rules want "per second", not
+        # "since boot" — computed against the previous tick
+        dt = now - self._prev_t if self._prev_t else 0.0
+        cur = {k: float(stats.get(k, 0)) for k in self.RATE_COUNTERS}
+        if dt > 0:
+            for k in ("steals", "lease_expired"):
+                rate = max(cur[k] - self._prev.get(k, cur[k]), 0.0) / dt
+                samples.append((f"tpusim_queue_{k}_rate", None, rate))
+            dd = max(cur["done"] - self._prev.get("done", cur["done"]), 0.0)
+            df = max(cur["failed"] - self._prev.get("failed",
+                                                    cur["failed"]), 0.0)
+            total = dd + df
+            samples.append(
+                ("tpusim_queue_error_ratio", None,
+                 (df / total) if total else 0.0)
+            )
+        self._prev_t, self._prev = now, cur
+
+        # per-kind admission->result percentiles, same names as the
+        # /metrics summary rendering (emitters.latency_summary_lines)
+        for kind, row in (stats.get("latency") or {}).items():
+            kl = {"kind": kind}
+            samples.append(("tpusim_queue_latency_seconds",
+                            dict(kl, quantile="0.5"),
+                            float(row.get("p50_s", 0.0))))
+            samples.append(("tpusim_queue_latency_seconds",
+                            dict(kl, quantile="0.99"),
+                            float(row.get("p99_s", 0.0))))
+            samples.append(("tpusim_queue_latency_seconds_count", kl,
+                            float(row.get("count", 0))))
+            if "adjusted_p99_s" in row:
+                samples.append(("tpusim_queue_latency_adjusted_seconds",
+                                dict(kl, quantile="0.99"),
+                                float(row["adjusted_p99_s"])))
+
+        # per-COMPLETION worst latency since the last tick: the burn-
+        # rate SLI. A "p99 <= X" SLO is exactly a burn-rate rule with a
+        # 1% budget over these event samples — and unlike the ring p99
+        # gauge (which one slow job pins for 1024 completions), event
+        # samples age out of the burn windows, so alerts RESOLVE once
+        # the service is actually fast again
+        for kind, vals in queue.latency_samples_since(
+                self._lat_cursors).items():
+            samples.append(("tpusim_queue_latency_event_seconds",
+                            {"kind": kind}, max(vals)))
+
+        fleet = getattr(service, "fleet", None)
+        if fleet is not None:
+            reg = fleet.registry
+            now_u = time.time()
+            samples.append(("tpusim_fleet_workers_live", None,
+                            float(reg.live_count(now_u))))
+            sup = getattr(fleet, "supervisor", None)
+            if sup is not None:
+                br = sup.describe().get("breaker") or {}
+                samples.append(
+                    ("tpusim_fleet_breaker_open", None,
+                     1.0 if br.get("state") == "open" else 0.0)
+                )
+            for wid, row in reg.describe(queue).items():
+                wl = {"worker": wid}
+                prof = row.get("profile") or {}
+                samples.append(("tpusim_fleet_worker_ewma_dispatch_s", wl,
+                                float(prof.get("ewma_dispatch_s", 0.0))))
+                samples.append(("tpusim_fleet_worker_transfer_bps", wl,
+                                float(prof.get("transfer_bps", 0.0))))
+                samples.append(("tpusim_fleet_worker_compile_hit_rate",
+                                wl,
+                                float(prof.get("compile_hit_rate", 0.0))))
+                samples.append(("tpusim_fleet_worker_leases_held", wl,
+                                float(row.get("leases_held", 0))))
+        return samples
+
+
+# ---------------------------------------------------------------------------
+# Sampler thread
+# ---------------------------------------------------------------------------
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        v = float(raw)
+        return v if v > 0 else default
+    except ValueError:
+        return default
+
+
+class MetricsSampler:
+    """The clock of the SLO plane: one daemon thread ticking
+    collect -> ingest -> alerts.evaluate at a fixed interval, writing a
+    signed snapshot every `snapshot_every_s`. pause()/resume() gate the
+    whole loop — a standby holds the sampler paused until promotion
+    (only the leader may write history), and resume() after adopt()
+    splices new samples onto the inherited ring."""
+
+    def __init__(self, tsdb: TSDB, collect, alerts=None,
+                 artifact_dir: str = "", interval_s: float = 0.0,
+                 snapshot_every_s: float = 0.0, paused: bool = False):
+        self.tsdb = tsdb
+        self.collect = collect
+        self.alerts = alerts
+        self.artifact_dir = artifact_dir
+        self.interval_s = interval_s or _env_float("TPUSIM_TSDB_STEP_S",
+                                                   1.0)
+        self.snapshot_every_s = (
+            snapshot_every_s or _env_float("TPUSIM_TSDB_SNAPSHOT_S", 5.0)
+        )
+        self.ticks = 0
+        self.snapshot_errors = 0
+        self._last_snapshot = 0.0
+        self._stop = threading.Event()
+        self._active = threading.Event()
+        if not paused:
+            self._active.set()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def paused(self) -> bool:
+        return not self._active.is_set()
+
+    def start(self) -> "MetricsSampler":
+        self._thread = threading.Thread(
+            target=self._run, name="tpusim-sampler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def pause(self):
+        self._active.clear()
+
+    def resume(self):
+        self._active.set()
+
+    def stop(self):
+        self._stop.set()
+        self._active.set()  # unblock a paused loop so it can exit
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def tick(self, now: Optional[float] = None):
+        """One sampling step, callable directly from tests (the thread
+        loop is just this on a timer)."""
+        if now is None:
+            now = time.time()
+        self.tsdb.ingest(self.collect(now), now)
+        if self.alerts is not None:
+            self.alerts.evaluate(now)
+        self.ticks += 1
+        if (self.artifact_dir
+                and now - self._last_snapshot >= self.snapshot_every_s):
+            self._last_snapshot = now
+            try:
+                self.tsdb.write_snapshot(self.artifact_dir, now)
+            except OSError:
+                self.snapshot_errors += 1  # history is best-effort
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._active.wait()
+            if self._stop.is_set():
+                return
+            try:
+                self.tick()
+            except Exception:
+                # one bad tick (a racing shutdown, a half-built fleet)
+                # must not kill the history thread
+                pass
+            self._stop.wait(self.interval_s)
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: GET /query and GET /alerts
+# ---------------------------------------------------------------------------
+
+
+class TsdbApp:
+    """MonitorServer extension app for the SLO plane's read side."""
+
+    accepts_query = True
+
+    MAX_WINDOW_S = 4 * 3600.0
+
+    def __init__(self, tsdb: TSDB, alerts=None):
+        self.tsdb = tsdb
+        self.alerts = alerts
+
+    def handle(self, method: str, path: str, body: bytes, headers=None,
+               query: str = ""):
+        if method != "GET":
+            return None
+        if path == "/query":
+            return self._query(query)
+        if path == "/alerts":
+            if self.alerts is None:
+                return _json_body(200, {"rules": [], "firing": [],
+                                        "transitions": []})
+            return _json_body(200, self.alerts.describe())
+        return None
+
+    def _query(self, query: str):
+        q = urllib.parse.parse_qs(query or "")
+
+        def one(key, default=""):
+            vals = q.get(key) or [default]
+            return vals[0]
+
+        name = one("name")
+        if not name:
+            return _json_body(200, {"names": self.tsdb.names()})
+        label = {}
+        for pair in q.get("label") or []:
+            k, sep, v = pair.partition("=")
+            if not sep or not k:
+                return _json_body(
+                    400, {"error": f"label must be key=value, got "
+                          f"{pair!r}"}
+                )
+            label[k] = v
+        try:
+            since = float(one("since", "-900"))
+            step = float(one("step", "0"))
+        except ValueError:
+            return _json_body(
+                400, {"error": "since and step must be numbers"}
+            )
+        now = time.time()
+        if since <= 0:
+            since = max(since, -self.MAX_WINDOW_S)
+        series = self.tsdb.query(name, label=label, since=since,
+                                 step=step, now=now)
+        return _json_body(
+            200, {"now": round(now, 3), "series": series}
+        )

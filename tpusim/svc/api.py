@@ -85,6 +85,12 @@ class JobService:
         # a bare JobService in a unit test records nothing.
         self.spans = None  # obs.trace.SpanRecorder
         self.audit = None  # obs.audit.AuditLog
+        # the SLO plane (ISSUE 20), armed by start_job_server: metrics
+        # history ring, alert rule engine, and the sampler thread
+        # driving both. A bare JobService in a unit test has none.
+        self.tsdb = None  # obs.tsdb.TSDB
+        self.alerts = None  # obs.alerts.AlertEngine
+        self.sampler = None  # obs.tsdb.MetricsSampler
         # job digest -> trace id, fed by the submit header (or minted
         # here) and handed to workers at claim time so every process
         # tags its spans with the id minted at submit
@@ -95,6 +101,32 @@ class JobService:
 
     def trace_of(self, digest: str) -> str:
         return self.trace_ids.get(digest, "")
+
+    def adopt_history(self, out=None) -> int:
+        """Splice the predecessor's persisted tsdb snapshot into this
+        process's ring and start (or resume) sampling — the metrics
+        half of a takeover (ISSUE 20): a promoted standby serves
+        /query with the deposed leader's history behind its own new
+        samples instead of starting blind. Also the non-HA restart
+        path: a rebooted coordinator adopts its own last snapshot.
+        Returns the number of buckets adopted; a torn/edited snapshot
+        is refused loudly (and sampling still resumes — fresh history
+        beats no history)."""
+        if self.tsdb is None:
+            return 0
+        n = 0
+        try:
+            n = self.tsdb.adopt(self.artifact_dir)
+        except ValueError as err:
+            if out is not None:
+                print(f"[slo] refusing torn/edited tsdb snapshot: "
+                      f"{err}", file=out)
+        if self.sampler is not None:
+            self.sampler.resume()
+        if n and out is not None:
+            print(f"[slo] adopted {n} history bucket(s) from the "
+                  f"previous coordinator's snapshot", file=out)
+        return n
 
     def publish_job(self, job) -> None:
         """Push a job's lifecycle change into the monitor's per-job
@@ -326,19 +358,31 @@ class JobService:
             return vals[0]
 
         try:
-            n = min(max(int(one("n", "50")), 1), 500)
+            # `limit` is the cursor-pagination spelling (ISSUE 20);
+            # `n` stays as the original tail parameter — same clamp
+            n = min(max(int(one("limit", "") or one("n", "50")), 1), 500)
+            after = max(int(one("after", "0")), 0)
         except ValueError:
-            return _json_body(400, {"error": "n must be an integer"})
+            return _json_body(
+                400, {"error": "n, limit and after must be integers"}
+            )
         try:
             events = obs_audit.tail(
                 self.artifact_dir, n=n, kind=one("kind"),
-                job=one("job"), worker=one("worker"),
+                job=one("job"), worker=one("worker"), after=after,
             )
         except ValueError as err:
             return _json_body(
                 500, {"error": f"audit chain unreadable: {err}"}
             )
-        return _json_body(200, {"events": events, "n": len(events)})
+        # next_after: the cursor a delta poller passes back — the
+        # highest chain seq this response covers (records are
+        # seq-stamped by obs_audit.tail). No events -> echo the cursor.
+        next_after = max([r.get("seq", 0) for r in events] + [after])
+        return _json_body(
+            200, {"events": events, "n": len(events),
+                  "next_after": next_after}
+        )
 
     def _get_queue(self):
         """The aggregated /queue document (ISSUE 12): queue + quota
@@ -358,35 +402,86 @@ class JobService:
 
 
 def recover_pending_jobs(service: JobService, out=None) -> int:
-    """Restart recovery (ISSUE 10 satellite): requeue every persisted
-    job spec with no signed result — a service killed mid-batch answers
-    its stranded jobs after restart instead of leaving them `running`
-    forever. Returns the number requeued; malformed or no-longer-valid
-    specs (code drift changes the digest, a hosted trace vanished) are
-    skipped with a note, never fatal."""
-    n = 0
-    for digest, payload in svc_jobs.pending_job_specs(service.artifact_dir):
+    """Restart recovery (ISSUE 10 satellite; batched for the standby-
+    promotion path, ISSUE 20): requeue every persisted job spec with no
+    signed result — a service killed mid-batch answers its stranded
+    jobs after restart instead of leaving them `running` forever.
+
+    Two passes instead of the old one-submit_payload-per-spec loop: a
+    LOCK-FREE validation pass (preset expansion, fork resolution, spec
+    validation, digest recompute, result-cache probe — the expensive
+    re-verification), then ONE JobQueue.submit_many under one lock
+    acquisition, so a takeover with hundreds of queued jobs re-admits
+    in a single pass. Returns the number requeued; malformed or
+    no-longer-valid specs (code drift changes the digest, a hosted
+    trace vanished) are skipped with a note, never fatal; a full queue
+    stops the batch and leaves the rest for the clients' retries."""
+    pending = svc_jobs.pending_job_specs(service.artifact_dir)
+    if not pending:
+        return 0
+    t_admit = time.time()
+    prepared = []  # (persisted digest, recomputed digest, spec, payload, cached)
+    for digest, payload in pending:
         try:
-            service.submit_payload(payload)
-            if service.audit is not None:
-                service.audit.emit(
-                    "requeue", job=digest, reason="recovered-spec",
+            p = svc_jobs.expand_policy_preset(payload,
+                                              service.policy_presets)
+            if isinstance(p, dict) and p.get("fork"):
+                p = service._resolve_fork(p)
+            spec = svc_jobs.validate_job(p)
+            trace = service.traces.get(spec.trace)
+            if trace is None:
+                raise ValueError(
+                    f"unknown trace {spec.trace!r} (hosted: "
+                    f"{', '.join(sorted(service.traces)) or 'none'})"
                 )
-            n += 1
-        except QueueFull:
-            if out is not None:
-                print(
-                    f"[serve] recovery stopped at a full queue "
-                    f"({digest[:12]}… left for the client's retry)",
-                    file=out,
-                )
-            break
+            new_digest = svc_jobs.job_digest(spec, trace.digest)
+            cached = svc_jobs.find_result(service.artifact_dir,
+                                          new_digest)
+            prepared.append((digest, new_digest, spec, p, cached))
         except ValueError as err:
             if out is not None:
                 print(
                     f"[serve] skipping unrecoverable job "
                     f"{digest[:12]}…: {err}", file=out,
                 )
+    with service._submit_lock:
+        jobs, leftover = service.queue.submit_many(
+            [(spec, d, cached) for _, d, spec, _, cached in prepared]
+        )
+    t_done = time.time()
+    for job, (old_digest, new_digest, _, p, cached) in zip(jobs,
+                                                           prepared):
+        tid = obs_trace.new_trace_id()
+        service.trace_ids[new_digest] = tid
+        if cached is None and new_digest != old_digest:
+            # code drift moved the digest: persist under the NEW name
+            # so the next crash recovers the job the queue now runs
+            svc_jobs.write_job_spec(service.artifact_dir, new_digest, p)
+        if service.spans is not None:
+            service.spans.emit(
+                obs_trace.SPAN_ADMIT, t_admit, t_done,
+                job=new_digest, trace=tid,
+                cached=bool(cached is not None),
+            )
+        if service.monitor is not None:
+            service.monitor.publish_job_progress(
+                job.id, {"status": job.status, "phase": "recovered"}
+            )
+    while len(service.trace_ids) > service.MAX_TRACE_IDS:
+        service.trace_ids.pop(next(iter(service.trace_ids)))
+    n = len(jobs)
+    if n and service.audit is not None:
+        # one batch record, not n flocked appends: the takeover path
+        # must not serialize on the audit lock per queued job
+        service.audit.emit(
+            "requeue", n=n, reason="recovered-specs",
+            jobs=[d[:12] for _, d, _, _, _ in prepared[:16]],
+        )
+    if leftover and out is not None:
+        print(
+            f"[serve] recovery stopped at a full queue ({leftover} "
+            f"spec(s) left for the clients' retries)", file=out,
+        )
     if n and out is not None:
         print(f"[serve] requeued {n} interrupted job(s) from "
               f"{service.artifact_dir}", file=out)
@@ -400,7 +495,7 @@ def start_job_server(
     start_worker: bool = True, recover: bool = True, out=None,
     fleet: bool = False, lease_s: float = 0.0, family_quota: int = 0,
     policy_presets: Optional[dict] = None, token: str = "",
-    coord=None,
+    coord=None, slo_file: str = "", slo_rules=None,
 ) -> Tuple[object, JobService, Optional[Worker]]:
     """Wire the full service: MonitorServer (+ heartbeat-fed /progress)
     with the JobService app, a bounded JobQueue, and either the single
@@ -419,7 +514,11 @@ def start_job_server(
     every mutating endpoint (ISSUE 17); `coord` (a
     svc.coord.CoordinatorState, fleet mode only) arms HA — epoch-fenced
     mutations, standby 503s, and recovery deferred until this process
-    actually holds the leadership lease."""
+    actually holds the leadership lease. `slo_file` (or `slo_rules`, a
+    pre-validated list) arms the SLO plane (ISSUE 20): the tsdb
+    history ring + sampler thread, the alert rule engine, and the
+    /query + /alerts endpoints — a standby's sampler starts PAUSED and
+    resumes at promotion via service.adopt_history()."""
     from tpusim.obs.server import MonitorServer
 
     srv = MonitorServer(listen)
@@ -468,6 +567,38 @@ def start_job_server(
         srv.add_app(service.fleet)
         # fleet /healthz: 503 only when NO worker is live
         srv.health_hook = service.fleet.health
+
+    # the SLO plane (ISSUE 20): live per-kind latency summaries on
+    # /metrics, the tsdb history ring + sampler, the alert rule engine,
+    # and the /query + /alerts read surface. Always armed — history and
+    # alerting ARE the operational record, like the audit chain.
+    from tpusim.obs import alerts as obs_alerts
+    from tpusim.obs import tsdb as obs_tsdb
+    from tpusim.obs.emitters import latency_summary_lines
+
+    srv.metrics_extra_fn = (
+        lambda: latency_summary_lines(queue.latency_percentiles())
+    )
+    service.tsdb = obs_tsdb.TSDB()
+    rules = (slo_rules if slo_rules is not None
+             else obs_alerts.load_rules(slo_file))
+    service.alerts = obs_alerts.AlertEngine(
+        service.tsdb, rules, audit=service.audit
+    )
+    srv.add_app(obs_tsdb.TsdbApp(service.tsdb, service.alerts))
+    # page-severity burn flips /healthz readiness detail — composed
+    # over the fleet's worker-liveness hook, never replacing it
+    srv.health_hook = service.alerts.compose_health(srv.health_hook)
+    # a standby must not sample: only the leader writes history (and
+    # the snapshot file) — promotion adopts + resumes (adopt_history)
+    standby = coord is not None and coord.role != "leader"
+    service.sampler = obs_tsdb.MetricsSampler(
+        service.tsdb, obs_tsdb.ServiceCollector(service),
+        alerts=service.alerts, artifact_dir=artifact_dir,
+        paused=standby,
+    )
+    service.sampler.start()
+    srv.on_stop(service.sampler.stop)
     if recover and (coord is None or coord.role == "leader"):
         # before start(): recovered jobs must be queued before the first
         # client request can observe the service. A standby defers —
@@ -476,6 +607,10 @@ def start_job_server(
         recover_pending_jobs(service, out=out)
         if service.fleet is not None:
             service.fleet.adopt_leases(out=out)
+    if not standby:
+        # a booting leader adopts its own last snapshot: metrics
+        # history survives a graceful restart, not just a failover
+        service.adopt_history(out=out)
     srv.start()
     srv.attach_heartbeat()
     srv.publish_progress(phase="serving-jobs")

@@ -42,7 +42,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from tpusim.svc.jobs import JobSpec
 
@@ -234,6 +234,11 @@ class JobQueue:
         # adjusted p99 answers "what would the fleet do without deaths"
         self._latency_adj: Dict[str, List[float]] = {}
         self._latency_cap = 1024
+        # ever-increasing completion count per kind — the SLO plane's
+        # event cursor (ISSUE 20): latency_samples_since() slices the
+        # ring by completions-seen, so each alert-engine tick observes
+        # every completion exactly once instead of re-reading the ring
+        self._latency_total: Dict[str, int] = {}
 
     # ---- submission / lookup ----
 
@@ -246,38 +251,70 @@ class JobQueue:
         when a genuinely new job meets a full queue, QuotaFull when its
         FAMILY shard is at the per-family admission cap."""
         with self._cond:
-            existing = self._by_digest.get(digest)
-            if existing is not None and existing.status != "failed":
-                self.stats_counters["dedup_hits"] += 1
-                return existing
-            if cached_result is not None:
-                job = self._new_job(spec, digest)
-                job.status = "done"
-                job.cached = True
-                job.result = cached_result
-                job.finished_unix = time.time()
-                self.stats_counters["dedup_hits"] += 1
-                self.stats_counters["done"] += 1
-                return job
-            if len(self._queue) >= self.maxsize:
-                self.stats_counters["rejected"] += 1
-                raise QueueFull(len(self._queue), self.retry_after_s)
-            if self.family_quota > 0:
-                fam = spec.family_key()
-                depth = sum(
-                    1 for j in self._queue if j.spec.family_key() == fam
-                )
-                if depth >= self.family_quota:
-                    self.stats_counters["quota_rejected"] += 1
-                    raise QuotaFull(
-                        spec.family_label(), depth, self.family_quota,
-                        self.retry_after_s,
-                    )
-            job = self._new_job(spec, digest)
-            self._queue.append(job)
-            self.stats_counters["submitted"] += 1
+            job = self._submit_locked(spec, digest, cached_result)
             self._cond.notify_all()
             return job
+
+    def _submit_locked(self, spec: JobSpec, digest: str,
+                       cached_result: Optional[dict] = None) -> Job:
+        """submit()'s body under an ALREADY-HELD self._cond — the
+        single-lock core both submit and submit_many share (ISSUE 20).
+        Does not notify; callers do, once per lock hold."""
+        existing = self._by_digest.get(digest)
+        if existing is not None and existing.status != "failed":
+            self.stats_counters["dedup_hits"] += 1
+            return existing
+        if cached_result is not None:
+            job = self._new_job(spec, digest)
+            job.status = "done"
+            job.cached = True
+            job.result = cached_result
+            job.finished_unix = time.time()
+            self.stats_counters["dedup_hits"] += 1
+            self.stats_counters["done"] += 1
+            return job
+        if len(self._queue) >= self.maxsize:
+            self.stats_counters["rejected"] += 1
+            raise QueueFull(len(self._queue), self.retry_after_s)
+        if self.family_quota > 0:
+            fam = spec.family_key()
+            depth = sum(
+                1 for j in self._queue if j.spec.family_key() == fam
+            )
+            if depth >= self.family_quota:
+                self.stats_counters["quota_rejected"] += 1
+                raise QuotaFull(
+                    spec.family_label(), depth, self.family_quota,
+                    self.retry_after_s,
+                )
+        job = self._new_job(spec, digest)
+        self._queue.append(job)
+        self.stats_counters["submitted"] += 1
+        return job
+
+    def submit_many(self, items) -> Tuple[List[Job], int]:
+        """Batched admission (ISSUE 20, the standby-promotion path):
+        `items` is [(spec, digest, cached_result)], folded in under ONE
+        lock acquisition with ONE claimant wakeup — a takeover with
+        hundreds of queued specs re-admits in a single pass instead of
+        serially bouncing the queue lock per job. Returns (jobs,
+        leftover): one Job per accepted item in order; a full queue (or
+        an at-quota family) stops the batch, and `leftover` counts the
+        items never attempted — the same stop-at-backpressure contract
+        recovery's serial loop had."""
+        items = list(items)
+        jobs: List[Job] = []
+        with self._cond:
+            for spec, digest, cached in items:
+                try:
+                    jobs.append(
+                        self._submit_locked(spec, digest, cached)
+                    )
+                except QueueFull:
+                    break
+            if jobs:
+                self._cond.notify_all()
+        return jobs, len(items) - len(jobs)
 
     def _new_job(self, spec: JobSpec, digest: str) -> Job:
         self._seq += 1
@@ -608,6 +645,8 @@ class JobQueue:
             lat = job.finished_unix - job.submitted_unix
             samples = self._latency.setdefault(job.kind(), [])
             samples.append(lat)
+            kind = job.kind()
+            self._latency_total[kind] = self._latency_total.get(kind, 0) + 1
             if len(samples) > self._latency_cap:
                 del samples[: len(samples) - self._latency_cap]
             adj = self._latency_adj.setdefault(job.kind(), [])
@@ -643,6 +682,26 @@ class JobQueue:
             for j in self._queue:
                 label = j.spec.family_label()
                 out[label] = out.get(label, 0) + 1
+            return out
+
+    def latency_samples_since(self, cursors: Dict[str, int]
+                              ) -> Dict[str, List[float]]:
+        """Latency samples of completions PAST each kind's cursor,
+        advancing the cursors in place (ISSUE 20). The SLO sampler's
+        event feed: burn-rate math wants per-completion goodness, and
+        the cumulative ring p99 can't give it (one slow job pins the
+        p99 for the ring's whole lifetime). Completions that fell off
+        the bounded ring between polls are surfaced as what remains —
+        the cursor still advances past them, never double-counting."""
+        with self._cond:
+            out: Dict[str, List[float]] = {}
+            for kind, total in self._latency_total.items():
+                new = total - int(cursors.get(kind, 0))
+                if new <= 0:
+                    continue
+                samples = self._latency.get(kind) or []
+                out[kind] = list(samples[-min(new, len(samples)):])
+                cursors[kind] = total
             return out
 
     def latency_percentiles(self) -> Dict[str, dict]:

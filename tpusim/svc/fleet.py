@@ -895,8 +895,11 @@ class FleetService:
                 typed.add(name)
                 lines.append(f"# TYPE {name} gauge")
 
+        # include_extra: the live per-kind latency summaries (ISSUE 20)
+        # ride the merged scrape under the same names the tsdb samples
         monitor = getattr(self.service, "monitor", None)
-        base = monitor.metrics_text() if monitor is not None else ""
+        base = (monitor.metrics_text(include_extra=True)
+                if monitor is not None else "")
         if base:
             for ln in base.rstrip("\n").splitlines():
                 if ln.startswith("# TYPE "):
